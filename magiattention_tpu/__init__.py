@@ -1,0 +1,23 @@
+"""MagiAttention-TPU: a TPU-native distributed flex-attention framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capabilities of
+SandAI-org/MagiAttention (reference: /root/reference): context-parallel
+attention for ultra-long-context, heterogeneous-mask training.
+
+Layering (mirrors reference SURVEY.md layer map, re-designed TPU-first):
+
+- ``common/``   : range/mask data structures & enums (host-side planning types)
+- ``ops/``      : Pallas flex-flash-attention kernels + jnp fallbacks
+- ``meta/``     : host-side planning — dispatch/overlap/dist-attn solvers
+- ``comm/``     : group_cast/group_reduce collectives over jax.lax + shard_map
+- ``parallel/`` : distributed attention runtime (the hot path)
+- ``api/``      : user-facing key-cached interface
+- ``models/``   : flagship model families built on the framework
+- ``testing/``  : reference oracles + precision harness
+"""
+
+__version__ = "0.1.0"
+
+from . import common  # noqa: F401
+
+__all__ = ["common", "__version__"]

@@ -1,0 +1,41 @@
+"""Common host-side data structures: ranges, enums, dense-mask semantics."""
+
+from .enum import (
+    AttnKernelBackend,
+    AttnMaskType,
+    AttnOverlapMode,
+    AttnPrecision,
+    AttnRole,
+    AttnType,
+    DispatchAlgType,
+    DynamicAttnAlgType,
+    GroupReduceOp,
+    OverlapAlgType,
+)
+from .mask import make_attn_mask_from_ranges, slice_area, slice_mask, total_area
+from .range import AttnRange, NaiveRange, RangeError
+from .ranges import AttnRanges, NaiveRanges, check_valid_cu_seqlens, is_valid_cu_seqlens
+
+__all__ = [
+    "AttnKernelBackend",
+    "AttnMaskType",
+    "AttnOverlapMode",
+    "AttnPrecision",
+    "AttnRange",
+    "AttnRanges",
+    "AttnRole",
+    "AttnType",
+    "DispatchAlgType",
+    "DynamicAttnAlgType",
+    "GroupReduceOp",
+    "NaiveRange",
+    "NaiveRanges",
+    "OverlapAlgType",
+    "RangeError",
+    "check_valid_cu_seqlens",
+    "is_valid_cu_seqlens",
+    "make_attn_mask_from_ranges",
+    "slice_area",
+    "slice_mask",
+    "total_area",
+]

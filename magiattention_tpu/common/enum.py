@@ -1,0 +1,136 @@
+"""Enums shared across the framework.
+
+Behavioral parity with reference ``magi_attention/common/enum.py`` (int codes
+for mask types are part of the kernel ABI: 0=FULL, 1=CAUSAL, 2=INVCAUSAL,
+3=BICAUSAL — chosen so that bit0 = "causal lower bound", bit1 = "inv-causal
+upper bound", which the Pallas kernel exploits directly).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Literal, TypeAlias
+
+GroupReduceOp: TypeAlias = Literal["sum", "avg", "lse"]
+
+
+class AttnType(enum.Enum):
+    """Type of attention calculation."""
+
+    SELF_ATTN = "self_attn"
+    CROSS_ATTN = "cross_attn"
+
+
+class AttnRole(enum.Enum):
+    """Tensor role in attention."""
+
+    QUERY = "query"
+    KEY = "key"
+    VALUE = "value"
+
+
+class AttnMaskType(enum.IntEnum):
+    """Unit mask types applied per (q_range, k_range) attention slice.
+
+    The int values are a stable ABI shared with the Pallas kernels:
+    bit 0 set -> causal constraint (bottom-right aligned lower triangle),
+    bit 1 set -> inv-causal constraint (top-left aligned upper triangle).
+
+    Semantics (see reference flex_flash_attn.py:1247-1341):
+      FULL      : every q in q_range attends every k in k_range.
+      CAUSAL    : bottom-right aligned — allow iff (k - k_end) <= (q - q_end),
+                  i.e. the *last* q row sees the whole k_range.
+      INVCAUSAL : top-left aligned — allow iff (k - k_start) >= (q - q_start),
+                  i.e. the *first* q row sees the whole k_range.
+      BICAUSAL  : intersection of CAUSAL and INVCAUSAL.
+    """
+
+    FULL = 0
+    CAUSAL = 1
+    INVCAUSAL = 2
+    BICAUSAL = 3
+
+    @classmethod
+    def from_int_type(cls, int_type: int) -> "AttnMaskType":
+        return cls(int_type)
+
+    def to_int_type(self) -> int:
+        return int(self.value)
+
+    @property
+    def is_causal_bound(self) -> bool:
+        return bool(self.value & 1)
+
+    @property
+    def is_inv_causal_bound(self) -> bool:
+        return bool(self.value & 2)
+
+
+class AttnOverlapMode(enum.Enum):
+    """Multi-stage-overlap scheduling mode."""
+
+    STATIC = "static"
+    DYNAMIC = "dynamic"
+
+
+class DispatchAlgType(enum.Enum):
+    """Load-balance bin-packing algorithms for the dispatch solver."""
+
+    LOWER_BOUND = "lower_bound"
+    DYNAMIC_PROGRAMMING = "dynamic_programming"
+    BINARY_SEARCH = "binary_search"
+    MIN_HEAP = "min_heap"
+    BACKTRACK_PRUNING = "backtrack_pruning"
+    TOPP_HEAP = "topp_heap"
+    RANDOM_SELECT = "random_select"
+    SEQUENTIAL_SELECT = "sequential_select"
+    BATCH_TOPP_HEAP = "batch_topp_heap"
+    SORTED_SEQUENTIAL_SELECT = "sorted_sequential_select"
+
+
+class OverlapAlgType(enum.Enum):
+    """Multi-stage overlap partitioning algorithms."""
+
+    UNIFORM = "uniform"
+    GREEDY = "greedy"
+
+
+class DynamicAttnAlgType(enum.Enum):
+    """Dynamic (qo-comm) attention partitioning algorithms."""
+
+    BINARY_GREEDY_PARALLEL = "binary_greedy_parallel"
+    BINARY_GREEDY = "binary_greedy"
+    FAST_SIMPLEX_NETWORK_FLOW = "fast_simplex_network_flow"
+    SIMPLEX_NETWORK_FLOW = "simplex_network_flow"
+    GREEDY_RANDOM_GRID = "greedy_random_grid"
+    NON_COMMUNICATION_QO = "non_communication_qo"
+
+
+class AttnKernelBackend(enum.Enum):
+    """Which attention kernel executes the per-stage AttnArgs.
+
+    PALLAS : the TPU Pallas flex-flash-attention kernel (production path).
+    JNP    : pure-jnp dense reference (any platform; testing/precision).
+    JNP_ONLINE : block-wise online-softmax jnp variant (lower memory).
+    """
+
+    PALLAS = "pallas"
+    JNP = "jnp"
+    JNP_ONLINE = "jnp_online"
+
+
+class AttnPrecision(enum.Enum):
+    """Compute precision for the attention kernels."""
+
+    BF16 = "bf16"
+    FP32 = "fp32"
+    FP64 = "fp64"
+
+    def to_jnp_dtype(self):
+        import jax.numpy as jnp
+
+        return {
+            AttnPrecision.BF16: jnp.bfloat16,
+            AttnPrecision.FP32: jnp.float32,
+            AttnPrecision.FP64: jnp.float64,
+        }[self]

@@ -1,0 +1,118 @@
+"""Dense attention-mask materialization from (q_range, k_range, mask_type) slices.
+
+The dense [total_q, total_k] boolean mask is the ground-truth semantics of the
+whole framework (reference: magi_attention/common/mask.py and the mask-type
+doc at functional/flex_flash_attn.py:1247-1341). Used by the jnp oracle, the
+sanity checkers, and the area accounting — never on the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from .enum import AttnMaskType
+from .ranges import AttnRanges
+
+
+def slice_mask(
+    q_start: int,
+    q_end: int,
+    k_start: int,
+    k_end: int,
+    mask_type: AttnMaskType | int,
+    total_q: int,
+    total_k: int,
+) -> np.ndarray:
+    """Dense bool mask [total_q, total_k] contributed by one attention slice.
+
+    CAUSAL is bottom-right aligned: allow iff (k - k_end) <= (q - q_end).
+    INVCAUSAL is top-left aligned: allow iff (k - k_start) >= (q - q_start).
+    BICAUSAL is their intersection; FULL is the whole rectangle.
+    """
+    mt = AttnMaskType(int(mask_type))
+    q = np.arange(total_q)[:, None]
+    k = np.arange(total_k)[None, :]
+    m = (q >= q_start) & (q < q_end) & (k >= k_start) & (k < k_end)
+    if mt.is_causal_bound:
+        m &= (k - k_end) <= (q - q_end)
+    if mt.is_inv_causal_bound:
+        m &= (k - k_start) >= (q - q_start)
+    return m
+
+
+def slice_area(
+    q_start: int, q_end: int, k_start: int, k_end: int, mask_type: AttnMaskType | int
+) -> int:
+    """Exact number of unmasked (q, k) pairs in one slice — the FLOPs proxy.
+
+    Closed forms per mask type (reference _make_dispatch_meta.py:541-619
+    trapezoid/parallelogram/rectangle formulas, re-derived):
+
+    - FULL: sq * sk.
+    - CAUSAL (bottom-right): row q (relative, 0-based) attends
+      ``clamp(sk - sq + q + 1, 0, sk)`` keys — a trapezoid/triangle.
+    - INVCAUSAL (top-left): row q attends ``clamp(sk - q, 0, sk)`` keys.
+    - BICAUSAL: row q attends ``clamp(min(sk-sq+q+1, sk) - max(q, 0), 0, .)``
+      intersection band.
+    """
+    sq = q_end - q_start
+    sk = k_end - k_start
+    if sq <= 0 or sk <= 0:
+        return 0
+    mt = AttnMaskType(int(mask_type))
+    if mt == AttnMaskType.FULL:
+        return sq * sk
+
+    def _tri_sum(lo: int, hi: int) -> int:
+        # sum of integers lo..hi inclusive (0 if hi < lo)
+        if hi < lo:
+            return 0
+        return (hi + lo) * (hi - lo + 1) // 2
+
+    if mt == AttnMaskType.CAUSAL:
+        # per-row key count c(q) = clamp(sk - sq + q + 1, 0, sk), q in [0, sq)
+        if sk >= sq:
+            return _tri_sum(sk - sq + 1, sk)  # trapezoid
+        return _tri_sum(1, sk)  # triangle; rows [0, sq - sk) are fully masked
+    if mt == AttnMaskType.INVCAUSAL:
+        # per-row key count c(q) = clamp(sk - q, 0, sk)
+        n_pos = min(sq, sk)
+        return _tri_sum(sk - n_pos + 1, sk)
+    # BICAUSAL: row band [q, sk - sq + q] in relative coords → constant width
+    width = sk - sq + 1
+    return sq * width if width > 0 else 0
+
+
+def make_attn_mask_from_ranges(
+    q_ranges: AttnRanges | Sequence[Sequence[int]],
+    k_ranges: AttnRanges | Sequence[Sequence[int]],
+    attn_type_map: Sequence[AttnMaskType | int],
+    total_q: int,
+    total_k: int,
+) -> np.ndarray:
+    """Union of all slice masks — the dense ground-truth mask [total_q, total_k]."""
+    q_list = (
+        q_ranges.to_naive_ranges() if isinstance(q_ranges, AttnRanges) else q_ranges
+    )
+    k_list = (
+        k_ranges.to_naive_ranges() if isinstance(k_ranges, AttnRanges) else k_ranges
+    )
+    assert len(q_list) == len(k_list) == len(attn_type_map)
+    mask = np.zeros((total_q, total_k), dtype=bool)
+    for (qs, qe), (ks, ke), mt in zip(q_list, k_list, attn_type_map):
+        mask |= slice_mask(qs, qe, ks, ke, mt, total_q, total_k)
+    return mask
+
+
+def total_area(
+    q_ranges: AttnRanges,
+    k_ranges: AttnRanges,
+    attn_type_map: Sequence[AttnMaskType | int],
+) -> int:
+    """Sum of per-slice areas (assumes slices do not double-count pairs)."""
+    return sum(
+        slice_area(q.start, q.end, k.start, k.end, mt)
+        for q, k, mt in zip(q_ranges, k_ranges, attn_type_map)
+    )

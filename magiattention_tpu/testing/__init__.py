@@ -1,0 +1,20 @@
+"""Testing library: jnp oracles and precision assertions."""
+
+from .precision import (
+    MISMATCH_THRES_RATIO,
+    assert_close,
+    assert_close_to_ref,
+    calc_inf_norm,
+    calc_rel_err,
+)
+from .ref_attn import ref_attn, ref_attn_from_ranges
+
+__all__ = [
+    "MISMATCH_THRES_RATIO",
+    "assert_close",
+    "assert_close_to_ref",
+    "calc_inf_norm",
+    "calc_rel_err",
+    "ref_attn",
+    "ref_attn_from_ranges",
+]

@@ -1,0 +1,83 @@
+"""Precision harness: relative-to-reference tolerance checks.
+
+Mirrors the reference's testing philosophy (testing/precision.py:92): a
+low-precision kernel is "close enough" when its error vs a high-precision
+oracle is within a ratio of the error that a *low-precision reference*
+implementation makes vs the same oracle — plus small norm checks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Default mismatch-ratio threshold: our kernel may make up to 2x the error of
+# the low-precision reference implementation before we call it a failure.
+MISMATCH_THRES_RATIO = 2.0
+EPS = 1e-8
+
+
+def calc_inf_norm(x, ref) -> float:
+    """Infinity norm of (x - ref), computed in fp64."""
+    xa = np.asarray(jax.device_get(x), dtype=np.float64)
+    ra = np.asarray(jax.device_get(ref), dtype=np.float64)
+    return float(np.max(np.abs(xa - ra))) if xa.size else 0.0
+
+
+def calc_rel_err(x, ref) -> float:
+    """Relative L2 error of x vs ref in fp64."""
+    xa = np.asarray(jax.device_get(x), dtype=np.float64).ravel()
+    ra = np.asarray(jax.device_get(ref), dtype=np.float64).ravel()
+    denom = np.linalg.norm(ra) + EPS
+    return float(np.linalg.norm(xa - ra) / denom)
+
+
+def assert_close(
+    actual,
+    expected,
+    *,
+    atol: float | None = None,
+    rtol: float | None = None,
+    msg: str = "",
+) -> None:
+    """Plain elementwise closeness with per-dtype defaults."""
+    a = np.asarray(jax.device_get(actual), dtype=np.float64)
+    e = np.asarray(jax.device_get(expected), dtype=np.float64)
+    dtype = jnp.asarray(actual).dtype
+    if atol is None:
+        atol = {jnp.bfloat16.dtype: 2e-2, jnp.float32.dtype: 1e-5}.get(dtype, 1e-8)
+    if rtol is None:
+        rtol = {jnp.bfloat16.dtype: 2e-2, jnp.float32.dtype: 1e-5}.get(dtype, 1e-7)
+    np.testing.assert_allclose(a, e, atol=atol, rtol=rtol, err_msg=msg)
+
+
+def assert_close_to_ref(
+    actual,
+    ref_lp,
+    ref_hp,
+    *,
+    mismatch_thres_ratio: float = MISMATCH_THRES_RATIO,
+    norm_atol: float = 1e-2,
+    msg: str = "",
+) -> None:
+    """Relative-to-reference check.
+
+    Args:
+        actual: output of the implementation under test (low precision ok).
+        ref_lp: reference implementation run at the *same* precision.
+        ref_hp: reference implementation run at high precision (the oracle).
+    """
+    err_actual = calc_rel_err(actual, ref_hp)
+    err_ref = calc_rel_err(ref_lp, ref_hp)
+    thres = max(err_ref * mismatch_thres_ratio, EPS * 10)
+    assert err_actual <= thres or err_actual <= 1e-6, (
+        f"{msg}: rel err {err_actual:.3e} exceeds {mismatch_thres_ratio}x "
+        f"reference err {err_ref:.3e}"
+    )
+    inf_norm = calc_inf_norm(actual, ref_hp)
+    ref_inf_norm = calc_inf_norm(ref_lp, ref_hp)
+    assert inf_norm <= max(ref_inf_norm * mismatch_thres_ratio, norm_atol), (
+        f"{msg}: inf-norm {inf_norm:.3e} exceeds "
+        f"{mismatch_thres_ratio}x reference inf-norm {ref_inf_norm:.3e}"
+    )

@@ -1,0 +1,91 @@
+"""Pure-jnp reference attention over (q_range, k_range, mask_type) slices.
+
+The ground-truth oracle for every kernel / distributed test (role of
+reference ``magi_attention/testing/ref_attn.py``): dense-mask attention with
+GQA, softcap, attention sink, LSE and max-logits outputs, in fp32 or fp64.
+Runs on any backend (CPU in tests). Differentiable — used to check backward
+passes via jax.grad.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.mask import make_attn_mask_from_ranges
+
+NEG_INF = float("-inf")
+
+
+def ref_attn(
+    q: jax.Array,  # [tq, hq, d]
+    k: jax.Array,  # [tk, hk, d]
+    v: jax.Array,  # [tk, hk, d]
+    mask: np.ndarray | jax.Array,  # [tq, tk] bool
+    *,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    sink: jax.Array | None = None,  # [hq] per-head sink logit
+    compute_dtype=jnp.float32,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Dense-mask attention. Returns (out [tq,hq,d], lse [tq,hq], max_logits [hq]).
+
+    - lse is the natural-log softmax denominator per (q, head), including the
+      sink term when ``sink`` is given; fully-masked rows give lse=-inf (or
+      lse=sink with a sink) and out=0.
+    - max_logits is the per-head max of masked scaled (and softcapped) logits.
+    """
+    tq, hq, d = q.shape
+    tk, hk, _ = k.shape
+    assert hq % hk == 0, f"GQA requires hq % hk == 0, got {hq=} {hk=}"
+    group = hq // hk
+
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+
+    qf = q.astype(compute_dtype)
+    kf = jnp.repeat(k.astype(compute_dtype), group, axis=1)  # [tk, hq, d]
+    vf = jnp.repeat(v.astype(compute_dtype), group, axis=1)
+
+    # scores [hq, tq, tk]
+    s = jnp.einsum("qhd,khd->hqk", qf, kf) * scale
+    if softcap > 0.0:
+        s = softcap * jnp.tanh(s / softcap)
+
+    mask_arr = jnp.asarray(np.asarray(mask), dtype=bool)  # [tq, tk]
+    s = jnp.where(mask_arr[None, :, :], s, NEG_INF)
+
+    max_logits = jnp.max(s, axis=(1, 2))  # [hq]
+
+    m = jnp.max(s, axis=-1)  # [hq, tq] rowwise max (may be -inf)
+    if sink is not None:
+        m = jnp.maximum(m, sink.astype(compute_dtype)[:, None])
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])  # masked entries: exp(-inf)=0
+    l = jnp.sum(p, axis=-1)  # [hq, tq]
+    if sink is not None:
+        l = l + jnp.exp(sink.astype(compute_dtype)[:, None] - m_safe)
+    lse = jnp.where(l > 0, m_safe + jnp.log(jnp.maximum(l, 1e-300)), NEG_INF)
+
+    denom = jnp.where(l > 0, l, 1.0)
+    o = jnp.einsum("hqk,khd->qhd", p / denom[..., None], vf)  # [tq, hq, d]
+    return o, jnp.transpose(lse, (1, 0)), max_logits  # lse → [tq, hq]
+
+
+def ref_attn_from_ranges(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_ranges,
+    k_ranges,
+    attn_type_map: Sequence[int],
+    **kwargs,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """ref_attn with the mask materialized from attention slices."""
+    mask = make_attn_mask_from_ranges(
+        q_ranges, k_ranges, attn_type_map, q.shape[0], k.shape[0]
+    )
+    return ref_attn(q, k, v, mask, **kwargs)

@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Distributed behavior is tested by simulating N devices on host CPU
+(xla_force_host_platform_device_count), matching how the reference simulates
+multi-rank with spawned local processes (testing/dist_common.py). Must run
+before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,85 @@
+"""Mask materialization + area formulas vs brute force."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.common import (
+    AttnMaskType,
+    AttnRanges,
+    make_attn_mask_from_ranges,
+    slice_area,
+    slice_mask,
+)
+
+MASK_TYPES = [
+    AttnMaskType.FULL,
+    AttnMaskType.CAUSAL,
+    AttnMaskType.INVCAUSAL,
+    AttnMaskType.BICAUSAL,
+]
+
+
+def test_mask_type_int_abi():
+    assert AttnMaskType.FULL.to_int_type() == 0
+    assert AttnMaskType.CAUSAL.to_int_type() == 1
+    assert AttnMaskType.INVCAUSAL.to_int_type() == 2
+    assert AttnMaskType.BICAUSAL.to_int_type() == 3
+    assert AttnMaskType.from_int_type(3) is AttnMaskType.BICAUSAL
+    assert AttnMaskType.BICAUSAL.is_causal_bound
+    assert AttnMaskType.BICAUSAL.is_inv_causal_bound
+    assert not AttnMaskType.FULL.is_causal_bound
+
+
+def test_causal_semantics_docstring_examples():
+    # reference flex_flash_attn.py docstring examples, sq=5 sk=2
+    m = slice_mask(0, 5, 0, 2, AttnMaskType.CAUSAL, 5, 2)
+    expected = np.array(
+        [[0, 0], [0, 0], [0, 0], [1, 0], [1, 1]], dtype=bool
+    )
+    np.testing.assert_array_equal(m, expected)
+    # sq=2 sk=5
+    m = slice_mask(0, 2, 0, 5, AttnMaskType.CAUSAL, 2, 5)
+    expected = np.array([[1, 1, 1, 1, 0], [1, 1, 1, 1, 1]], dtype=bool)
+    np.testing.assert_array_equal(m, expected)
+
+
+def test_invcausal_semantics_docstring_examples():
+    m = slice_mask(0, 5, 0, 2, AttnMaskType.INVCAUSAL, 5, 2)
+    expected = np.array(
+        [[1, 1], [0, 1], [0, 0], [0, 0], [0, 0]], dtype=bool
+    )
+    np.testing.assert_array_equal(m, expected)
+    m = slice_mask(0, 2, 0, 5, AttnMaskType.INVCAUSAL, 2, 5)
+    expected = np.array([[1, 1, 1, 1, 1], [0, 1, 1, 1, 1]], dtype=bool)
+    np.testing.assert_array_equal(m, expected)
+
+
+def test_bicausal_semantics_docstring_examples():
+    m = slice_mask(0, 5, 0, 2, AttnMaskType.BICAUSAL, 5, 2)
+    assert not m.any()
+    m = slice_mask(0, 2, 0, 5, AttnMaskType.BICAUSAL, 2, 5)
+    expected = np.array([[1, 1, 1, 1, 0], [0, 1, 1, 1, 1]], dtype=bool)
+    np.testing.assert_array_equal(m, expected)
+    m = slice_mask(0, 5, 0, 5, AttnMaskType.BICAUSAL, 5, 5)
+    np.testing.assert_array_equal(m, np.eye(5, dtype=bool))
+
+
+@pytest.mark.parametrize("mt", MASK_TYPES)
+@pytest.mark.parametrize("sq,sk", [(1, 1), (3, 7), (7, 3), (5, 5), (8, 1), (1, 8)])
+def test_area_matches_mask_popcount(mt, sq, sk):
+    qs, ks = 2, 3  # offsets should not matter
+    m = slice_mask(qs, qs + sq, ks, ks + sk, mt, qs + sq + 1, ks + sk + 2)
+    assert slice_area(qs, qs + sq, ks, ks + sk, mt) == int(m.sum())
+
+
+def test_make_attn_mask_union():
+    q_ranges = AttnRanges.from_ranges([(0, 4), (4, 8)])
+    k_ranges = AttnRanges.from_ranges([(0, 4), (0, 8)])
+    mask = make_attn_mask_from_ranges(
+        q_ranges, k_ranges, [AttnMaskType.FULL, AttnMaskType.CAUSAL], 8, 8
+    )
+    # rows 0-3 attend keys 0-3 fully
+    assert mask[:4, :4].all() and not mask[:4, 4:].any()
+    # rows 4-7: causal bottom-right over k [0,8)
+    for i, row in enumerate(mask[4:]):
+        assert row.sum() == 5 + i

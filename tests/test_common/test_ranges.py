@@ -1,0 +1,159 @@
+"""Tests for AttnRange / AttnRanges (model: reference tests/test_common)."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.common import (
+    AttnRange,
+    AttnRanges,
+    RangeError,
+)
+
+
+class TestAttnRange:
+    def test_basic(self):
+        r = AttnRange(2, 10)
+        assert r.start == 2 and r.end == 10 and r.seqlen == 8 and len(r) == 8
+        assert r.to_naive_range() == (2, 10)
+        assert AttnRange.from_range((2, 10)) == r
+        assert r.clone() == r and r.clone() is not r
+
+    def test_invalid(self):
+        with pytest.raises(RangeError):
+            AttnRange(5, 3)
+        with pytest.raises(RangeError):
+            AttnRange(-1, 3)
+        r = AttnRange(2, 10)
+        with pytest.raises(RangeError):
+            r.start = 11
+        with pytest.raises(RangeError):
+            r.end = 1
+
+    def test_offset_truncate(self):
+        r = AttnRange(2, 10)
+        assert r.offset(5) == AttnRange(7, 15)
+        assert r.truncate(4, 8) == AttnRange(4, 8)
+        assert r.truncate(0, 100) == r
+        assert r.truncate(20, 30).is_empty()
+
+    def test_set_ops(self):
+        a, b = AttnRange(2, 10), AttnRange(5, 15)
+        assert a.intersect(b) == AttnRange(5, 10)
+        assert a.intersect_size(b) == 5
+        assert a.union(b) == [AttnRange(2, 15)]
+        assert a.union_size(b) == 13
+        c = AttnRange(20, 25)
+        assert a.intersect(c).is_empty()
+        assert a.union(c) == [a, c]
+        assert a.diff_by(b) == [AttnRange(2, 5)]
+        assert b.diff_by(a) == [AttnRange(10, 15)]
+        assert a.diff_by(AttnRange(4, 6)) == [AttnRange(2, 4), AttnRange(6, 10)]
+        assert a.diff_by(AttnRange(0, 100)) == []
+
+    def test_predicates(self):
+        a = AttnRange(2, 10)
+        assert AttnRange(3, 5).is_subrange_of(a)
+        assert not AttnRange(3, 11).is_subrange_of(a)
+        assert a.is_overlap_with(AttnRange(9, 12))
+        assert not a.is_overlap_with(AttnRange(10, 12))
+        assert AttnRange(4, 4).is_empty()
+        assert a.is_valid_close(0, 10)
+        assert not a.is_valid_close(3, 10)
+
+
+class TestAttnRanges:
+    def test_construction(self):
+        rs = AttnRanges.from_ranges([(0, 5), (10, 20)])
+        assert len(rs) == 2 and rs.total_seqlen == 15
+        assert rs.to_naive_ranges() == [(0, 5), (10, 20)]
+        t = rs.to_tensor()
+        assert t.shape == (2, 2) and t.dtype == np.int32
+
+    def test_cu_seqlens_roundtrip(self):
+        cu = [0, 4, 4, 10, 16]
+        rs = AttnRanges.from_cu_seqlens(cu, 16)
+        assert rs.to_cu_seqlens(16) == cu
+        assert rs.is_cu_seqlens(16)
+        assert not AttnRanges.from_ranges([(0, 4), (5, 10)]).is_cu_seqlens(10)
+
+    def test_sort_merge(self):
+        rs = AttnRanges.from_ranges([(10, 20), (0, 5), (4, 12), (30, 31)])
+        assert not rs.is_sorted()
+        assert rs.sort().is_sorted()
+        merged = rs.merge()
+        assert merged.to_naive_ranges() == [(0, 20), (30, 31)]
+        assert merged.is_merged()
+        # adjacent ranges coalesce
+        assert AttnRanges.from_ranges([(0, 5), (5, 9)]).merge().to_naive_ranges() == [
+            (0, 9)
+        ]
+
+    def test_merge_with_split_alignment(self):
+        rs = AttnRanges.from_ranges([(3, 10), (21, 30)])
+        m = rs.merge_with_split_alignment(8)
+        # aligned outward rounding: [3,10) → [0,16); [21,30) → [16,32); they touch
+        assert m.to_naive_ranges() == [(0, 32)]
+
+    def test_chunk(self):
+        rs = AttnRanges.from_ranges([(0, 10), (20, 27)])
+        chunks = rs.chunk(6)
+        # 17 tokens → chunks of 6, 6, 5
+        sizes = [c.total_seqlen for c in chunks]
+        assert sizes == [6, 6, 5]
+        assert chunks[0].to_naive_ranges() == [(0, 6)]
+        assert chunks[1].to_naive_ranges() == [(6, 10), (20, 22)]
+        assert chunks[2].to_naive_ranges() == [(22, 27)]
+        with pytest.raises(ValueError):
+            AttnRanges.from_ranges([(0, 5), (3, 8)]).chunk(4)
+
+    def test_find_hole_ranges(self):
+        # example from the reference docstring
+        a = AttnRanges.from_ranges([(0, 10), (15, 20), (20, 30)])
+        b = AttnRanges.from_ranges([(5, 10), (25, 30)])
+        assert a.find_hole_ranges(b).to_naive_ranges() == [(0, 5), (15, 25)]
+        # no overlap → a (merged) unchanged
+        c = AttnRanges.from_ranges([(100, 110)])
+        assert a.find_hole_ranges(c).to_naive_ranges() == [(0, 10), (15, 30)]
+        # full cover → empty
+        d = AttnRanges.from_ranges([(0, 30)])
+        assert a.find_hole_ranges(d).is_empty()
+
+    def test_find_overlap_ranges(self):
+        a = AttnRanges.from_ranges([(0, 10), (15, 20), (25, 30)])
+        b = AttnRanges.from_ranges([(5, 10), (18, 30)])
+        assert a.find_overlap_ranges(b).to_naive_ranges() == [
+            (5, 10),
+            (18, 20),
+            (25, 30),
+        ]
+
+    def test_make_ranges_local(self):
+        host = AttnRanges.from_ranges([(0, 4), (10, 14), (20, 28)])
+        # global [11,13) lives at local 4 + 1 = 5
+        local = host.make_ranges_local(AttnRanges.from_ranges([(11, 13), (20, 24)]))
+        assert local.to_naive_ranges() == [(5, 7), (8, 12)]
+        lr, target = host.make_range_local(AttnRange(2, 4))
+        assert lr == AttnRange(2, 4) and target == AttnRange(0, 4)
+        with pytest.raises(ValueError):
+            host.make_range_local(AttnRange(3, 11))
+
+    def test_size_metrics(self):
+        a = AttnRanges.from_ranges([(0, 10), (5, 15)])
+        assert a.total_seqlen == 20
+        assert a.union_size() == 15
+        assert a.intersect_size() == 5
+        b = AttnRanges.from_ranges([(8, 20)])
+        assert a.intersect_size_with(b) == 7
+        assert a.union_size_with(b) == 20
+        assert a.max_seqlen == 10
+        assert a.start == 0 and a.end == 15
+        assert a.points == [0, 5, 10, 15]
+
+    def test_non_overlap(self):
+        assert AttnRanges.from_ranges([(0, 5), (5, 10)]).is_non_overlap()
+        assert not AttnRanges.from_ranges([(0, 6), (5, 10)]).is_non_overlap()
+        assert AttnRanges().is_non_overlap()
+
+    def test_truncate(self):
+        rs = AttnRanges.from_ranges([(0, 10), (20, 30)])
+        assert rs.truncate(5, 25).to_naive_ranges() == [(5, 10), (20, 25)]
