@@ -1,0 +1,182 @@
+"""DispatchMeta + the global-bucket slicer + meta builder.
+
+Role of reference ``meta/_make_dispatch_meta.py`` + ``collection/
+dispatch_meta.py``: cut the global mask into per-chunk AttnSlices with exact
+areas, solve the chunk->rank assignment, and record the resulting sequence
+permutation (position ids / perm indices) that dispatch/undispatch apply.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+from ..common.enum import AttnMaskType, DispatchAlgType
+from ..common.range import AttnRange
+from ..common.ranges import AttnRanges
+from .containers import AttnBucket, AttnChunk, truncate_slice_q
+from .solver.dispatch_solver import (
+    DispatchConfig,
+    DispatchData,
+    DispatchJob,
+    DispatchSolver,
+    IOUAffinity,
+)
+
+
+@dataclass(frozen=True, eq=False)
+class DispatchMeta:
+    """Sharding result for one tensor role (query or key).
+
+    ``partitions[rank]`` lists the chunk ids owned by that rank (ascending).
+    Tokens of a rank are the concatenation of its chunks' rows in chunk order;
+    ``position_ids(rank)`` maps local slot -> global position.
+    """
+
+    total_seqlen: int
+    chunk_size: int
+    num_chunks: int
+    cp_size: int
+    partitions: tuple[tuple[int, ...], ...]
+
+    @property
+    def shard_seqlen(self) -> int:
+        assert self.num_chunks % self.cp_size == 0
+        return (self.num_chunks // self.cp_size) * self.chunk_size
+
+    def position_ids(self, rank: int) -> np.ndarray:
+        """Global positions of rank's local tokens, int32 [shard_seqlen]."""
+        cs = self.chunk_size
+        out = np.empty(len(self.partitions[rank]) * cs, dtype=np.int32)
+        for i, c in enumerate(self.partitions[rank]):
+            out[i * cs : (i + 1) * cs] = np.arange(c * cs, (c + 1) * cs)
+        return out
+
+    def host_ranges_per_rank(self) -> list[AttnRanges]:
+        """Per-rank owned global q ranges (merged)."""
+        out = []
+        for rank in range(self.cp_size):
+            rs = AttnRanges()
+            cs = self.chunk_size
+            for c in self.partitions[rank]:
+                rs.append(AttnRange(c * cs, (c + 1) * cs))
+            out.append(rs.merge())
+        return out
+
+    @property
+    def perm_idx(self) -> np.ndarray:
+        """Global gather indices: dispatched[i] = x[perm_idx[i]], int32 [total]."""
+        return np.concatenate(
+            [self.position_ids(r) for r in range(self.cp_size)]
+        )
+
+    @property
+    def unperm_idx(self) -> np.ndarray:
+        """Inverse permutation: x[i] = dispatched[unperm_idx[i]]."""
+        perm = self.perm_idx
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(perm.shape[0], dtype=np.int32)
+        return inv
+
+
+def make_global_bucket_from_qk_ranges(
+    q_ranges: AttnRanges,
+    k_ranges: AttnRanges,
+    attn_mask_type: Sequence[AttnMaskType],
+    total_seqlen_q: int,
+    chunk_size: int,
+) -> AttnBucket:
+    """Slice the global mask into per-chunk AttnSlices with exact areas.
+
+    (reference _make_dispatch_meta.py:450 make_global_bucket_from_qk_ranges)
+    """
+    assert total_seqlen_q % chunk_size == 0, (
+        f"total_seqlen_q {total_seqlen_q} must be a chunk_size {chunk_size} "
+        "multiple (apply padding first)"
+    )
+    num_chunks = total_seqlen_q // chunk_size
+    # sort slices by q start for deterministic per-chunk ordering
+    order = sorted(
+        range(len(attn_mask_type)),
+        key=lambda i: (q_ranges[i].start, q_ranges[i].end, k_ranges[i].start),
+    )
+    bucket = AttnBucket()
+    for c in range(num_chunks):
+        chunk_range = AttnRange(c * chunk_size, (c + 1) * chunk_size)
+        chunk = AttnChunk(chunk_id=c, q_range=chunk_range)
+        for i in order:
+            qi = q_ranges[i].intersect(chunk_range)
+            if qi.is_empty():
+                continue
+            s = truncate_slice_q(
+                q_ranges[i], k_ranges[i], AttnMaskType(attn_mask_type[i]), qi
+            )
+            if s is not None:
+                s.slice_id = i
+                chunk.attn_slices.append(s)
+                chunk.sample_ids.append(i)
+        bucket.q_chunks.append(chunk)
+    return bucket
+
+
+def make_dispatch_meta_from_qk_ranges(
+    q_ranges: AttnRanges,
+    k_ranges: AttnRanges,
+    attn_mask_type: Sequence[AttnMaskType],
+    total_seqlen_q: int,
+    total_seqlen_k: int,
+    chunk_size: int,
+    cp_size: int,
+    dispatch_config: DispatchConfig | None = None,
+) -> tuple[DispatchMeta, DispatchMeta, AttnBucket]:
+    """Build (query meta, key meta, global bucket) for a self-attention mask.
+
+    (reference _make_dispatch_meta.py:56). Self-attention: queries and keys
+    share the permutation so K/V shards line up with Q shards.
+    """
+    assert total_seqlen_q == total_seqlen_k, (
+        "self-attention dispatch requires equal q/k seqlens "
+        "(cross-attention dispatches roles separately)"
+    )
+    if dispatch_config is None:
+        dispatch_config = DispatchConfig()
+    num_chunks = total_seqlen_q // chunk_size
+    assert num_chunks % cp_size == 0, (
+        f"num_chunks {num_chunks} must be divisible by cp_size {cp_size} "
+        "(apply padding first)"
+    )
+
+    bucket = make_global_bucket_from_qk_ranges(
+        q_ranges, k_ranges, attn_mask_type, total_seqlen_q, chunk_size
+    )
+
+    if cp_size == 1:  # shortcut (reference :408-447)
+        partitions: list[list[int]] = [list(range(num_chunks))]
+    else:
+        workloads = [float(c.area) for c in bucket.q_chunks]
+        affinities = None
+        if dispatch_config.alg.is_affinity_considered:
+            affinities = [
+                IOUAffinity.from_ranges(c.k_ranges.merge()) for c in bucket.q_chunks
+            ]
+        jobs = DispatchJob.from_job_list(workloads, affinities)
+        solver = DispatchSolver(dispatch_config.alg)
+        solution = solver.solve(DispatchData(jobs=jobs, num_buckets=cp_size))
+        assert solution.bucket_partitions, (
+            f"{dispatch_config.alg.type} does not return partitions; "
+            "choose a partition-returning algorithm for dispatch"
+        )
+        partitions = [sorted(p) for p in solution.bucket_partitions]
+        assert sorted(x for p in partitions for x in p) == list(range(num_chunks))
+
+    meta = DispatchMeta(
+        total_seqlen=total_seqlen_q,
+        chunk_size=chunk_size,
+        num_chunks=num_chunks,
+        cp_size=cp_size,
+        partitions=tuple(tuple(p) for p in partitions),
+    )
+    # self-attn: K/V follow the same partition
+    return meta, meta, bucket

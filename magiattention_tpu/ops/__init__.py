@@ -1,0 +1,11 @@
+"""Pallas TPU kernels + host-side kernel planning."""
+
+from .block_meta import FlexAttnBlockMeta, build_block_meta
+from .flex_attn import flex_attn_with_meta, flex_flash_attn_func
+
+__all__ = [
+    "FlexAttnBlockMeta",
+    "build_block_meta",
+    "flex_attn_with_meta",
+    "flex_flash_attn_func",
+]

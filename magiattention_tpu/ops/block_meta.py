@@ -1,0 +1,216 @@
+"""Host-side block metadata for the Pallas flex-flash-attention kernels.
+
+Role of the reference's ``csrc/flexible_flash_attention/block_meta.h`` +
+tile scheduler (fwd_tile_scheduler.hpp), re-designed TPU-first: instead of a
+persistent CUDA kernel walking (range, m-block) tiles with atomics, we
+precompute — per unique mask, on host, in numpy — a flattened *entry table*:
+one entry per (q-block, slice, k-block) tile that intersects the mask. The
+Pallas kernel walks entries on a sequential grid with scalar-prefetched
+block indices (splash-attention style), so no atomics are ever needed:
+entries of the same q-block are consecutive and accumulate in VMEM scratch.
+
+Tables are built in both orientations:
+- q-major (sorted by q-block): forward + dq backward kernels,
+- k-major (sorted by k-block): dkv backward kernel.
+
+Every q-block (resp. k-block) is guaranteed at least one entry — a dummy
+all-masked entry referencing the sentinel slice — so output tiles are always
+written (out=0 / lse=-inf for uncovered rows, dk=dv=0 for uncovered keys).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+# Fields per slice in the flattened bounds table.
+SLICE_FIELDS = 5  # qs, qe, ks, ke, mask_type
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _round_up(a: int, b: int) -> int:
+    return _cdiv(a, b) * b
+
+
+@dataclasses.dataclass(frozen=True)
+class FlexAttnBlockMeta:
+    """Immutable host-side kernel plan for one (mask, shape, blocking) combo.
+
+    All arrays are numpy int32; they become scalar-prefetch operands of the
+    Pallas kernels. ``slice_bounds`` is flattened [num_slices+1, SLICE_FIELDS]
+    -> 1-D; the last slice is the all-zero sentinel used by dummy entries.
+    """
+
+    total_q: int
+    total_k: int
+    block_q: int
+    block_k: int
+    num_q_blocks: int
+    num_k_blocks: int
+    num_slices: int  # real slices (sentinel excluded)
+    total_area: int  # exact unmasked (q, k) pair count — FLOPs proxy
+
+    # q-major table (forward / dq): entries sorted by q-block.
+    fwd_q_block: np.ndarray  # [E] q-block index per entry
+    fwd_k_block: np.ndarray  # [E] k-block index per entry
+    fwd_slice_id: np.ndarray  # [E] slice id per entry (sentinel = num_slices)
+
+    # k-major table (dkv): entries sorted by k-block.
+    bwd_k_block: np.ndarray  # [E2]
+    bwd_q_block: np.ndarray  # [E2]
+    bwd_slice_id: np.ndarray  # [E2]
+
+    slice_bounds: np.ndarray  # [(num_slices+1) * SLICE_FIELDS]
+
+    @property
+    def num_fwd_entries(self) -> int:
+        return int(self.fwd_q_block.shape[0])
+
+    @property
+    def num_bwd_entries(self) -> int:
+        return int(self.bwd_k_block.shape[0])
+
+
+def _slice_tiles(
+    qs: int, qe: int, ks: int, ke: int, mask_type: int, bq: int, bk: int
+) -> list[tuple[int, int]]:
+    """All (q_block, k_block) tiles intersecting one slice's unmasked region."""
+    tiles: list[tuple[int, int]] = []
+    causal = bool(mask_type & 1)
+    inv = bool(mask_type & 2)
+    for i in range(qs // bq, _cdiv(qe, bq)):
+        rq_lo = max(qs, i * bq)
+        rq_hi = min(qe, (i + 1) * bq)  # exclusive
+        # tightest k span needed by rows [rq_lo, rq_hi) of this slice:
+        k_lo, k_hi = ks, ke
+        if causal:
+            # allow iff (k - ke) <= (q - qe); max q row rq_hi-1 → k < ke - qe + rq_hi
+            k_hi = min(k_hi, ke - qe + rq_hi)
+        if inv:
+            # allow iff (k - ks) >= (q - qs); min q row rq_lo → k >= ks + rq_lo - qs
+            k_lo = max(k_lo, ks + (rq_lo - qs))
+        if k_hi <= k_lo:
+            continue
+        for j in range(k_lo // bk, _cdiv(k_hi, bk)):
+            tiles.append((i, j))
+    return tiles
+
+
+def _build_table(
+    entries: np.ndarray,  # [E, 3] = (major_block, minor_block, slice_id)
+    num_major_blocks: int,
+    sentinel_slice: int,
+    pad_to: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Sort by major block, insert dummies for uncovered major blocks, pad."""
+    covered = np.zeros(num_major_blocks, dtype=bool)
+    if entries.size:
+        covered[entries[:, 0]] = True
+    dummies = [
+        (i, 0, sentinel_slice) for i in range(num_major_blocks) if not covered[i]
+    ]
+    if dummies:
+        entries = (
+            np.concatenate([entries, np.asarray(dummies, dtype=np.int64)], axis=0)
+            if entries.size
+            else np.asarray(dummies, dtype=np.int64)
+        )
+    order = np.lexsort((entries[:, 1], entries[:, 2], entries[:, 0]))
+    entries = entries[order]
+    e = entries.shape[0]
+    target = max(_round_up(e, max(pad_to, 1)), 1)
+    if target > e:
+        # pad entries replicate the last major block with the sentinel slice
+        # (all-masked, contribute nothing, keep output index monotone)
+        last_major = entries[-1, 0]
+        pad = np.tile(
+            np.asarray([[last_major, 0, sentinel_slice]], dtype=np.int64),
+            (target - e, 1),
+        )
+        entries = np.concatenate([entries, pad], axis=0)
+    return (
+        entries[:, 0].astype(np.int32),
+        entries[:, 1].astype(np.int32),
+        entries[:, 2].astype(np.int32),
+    )
+
+
+def build_block_meta(
+    q_ranges: np.ndarray | Sequence[Sequence[int]],  # [S, 2]
+    k_ranges: np.ndarray | Sequence[Sequence[int]],  # [S, 2]
+    attn_type_map: np.ndarray | Sequence[int],  # [S]
+    total_q: int,
+    total_k: int,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    entry_pad: int = 8,
+) -> FlexAttnBlockMeta:
+    """Build the entry tables for one mask. Pure host-side numpy.
+
+    ``entry_pad`` rounds table lengths up so that nearby masks share compiled
+    kernel shapes (bounding pjit/pallas recompiles, the role of the
+    reference's JIT kernel cache).
+    """
+    q_arr = np.asarray(q_ranges, dtype=np.int64).reshape(-1, 2)
+    k_arr = np.asarray(k_ranges, dtype=np.int64).reshape(-1, 2)
+    t_arr = np.asarray(attn_type_map, dtype=np.int64).reshape(-1)
+    assert q_arr.shape[0] == k_arr.shape[0] == t_arr.shape[0]
+    num_slices = q_arr.shape[0]
+    nq = max(_cdiv(total_q, block_q), 1)
+    nk = max(_cdiv(total_k, block_k), 1)
+
+    from ..common.mask import slice_area
+
+    area = 0
+    ent: list[tuple[int, int, int]] = []
+    for s in range(num_slices):
+        qs, qe = int(q_arr[s, 0]), int(q_arr[s, 1])
+        ks, ke = int(k_arr[s, 0]), int(k_arr[s, 1])
+        mt = int(t_arr[s])
+        assert 0 <= qs <= qe <= total_q, f"slice {s}: bad q_range [{qs},{qe})"
+        assert 0 <= ks <= ke <= total_k, f"slice {s}: bad k_range [{ks},{ke})"
+        assert 0 <= mt <= 3, f"slice {s}: bad mask type {mt}"
+        area += slice_area(qs, qe, ks, ke, mt)
+        for (i, j) in _slice_tiles(qs, qe, ks, ke, mt, block_q, block_k):
+            ent.append((i, j, s))
+
+    entries = (
+        np.asarray(ent, dtype=np.int64) if ent else np.empty((0, 3), dtype=np.int64)
+    )
+    fwd_q, fwd_k, fwd_s = _build_table(entries.copy(), nq, num_slices, entry_pad)
+    # k-major: swap major/minor columns
+    kmaj = entries[:, [1, 0, 2]] if entries.size else entries
+    bwd_k, bwd_q, bwd_s = _build_table(kmaj, nk, num_slices, entry_pad)
+
+    bounds = np.zeros((num_slices + 1, SLICE_FIELDS), dtype=np.int32)
+    if num_slices:
+        bounds[:num_slices, 0] = q_arr[:, 0]
+        bounds[:num_slices, 1] = q_arr[:, 1]
+        bounds[:num_slices, 2] = k_arr[:, 0]
+        bounds[:num_slices, 3] = k_arr[:, 1]
+        bounds[:num_slices, 4] = t_arr
+    # sentinel row stays all-zero: empty q/k range → all-masked tile
+
+    return FlexAttnBlockMeta(
+        total_q=total_q,
+        total_k=total_k,
+        block_q=block_q,
+        block_k=block_k,
+        num_q_blocks=nq,
+        num_k_blocks=nk,
+        num_slices=num_slices,
+        total_area=int(area),
+        fwd_q_block=fwd_q,
+        fwd_k_block=fwd_k,
+        fwd_slice_id=fwd_s,
+        bwd_k_block=bwd_k,
+        bwd_q_block=bwd_q,
+        bwd_slice_id=bwd_s,
+        slice_bounds=bounds.reshape(-1),
+    )

@@ -1,0 +1,682 @@
+"""Pallas TPU flex-flash-attention: fwd + bwd kernels over attention slices.
+
+TPU-native equivalent of the reference FFA CUDA kernel
+(csrc/flexible_flash_attention/, see SURVEY.md §2.7 module A): computes
+attention over an arbitrary list of (q_range, k_range, mask_type) slices
+with online softmax, GQA, softcap, attention sink, LSE + per-row max-logit
+outputs, and a two-kernel backward (dq q-major / dkv k-major) that needs no
+atomics: the sequential TPU grid walks a host-precomputed entry table
+(ops/block_meta.py) so tiles of the same output block are consecutive and
+accumulate in VMEM scratch.
+
+Layout convention inside kernels: head-major [num_heads, tokens, head_dim]
+(contiguous per-head 2-D tiles for the MXU). Public wrappers accept the
+reference layout [tokens, heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .block_meta import SLICE_FIELDS, FlexAttnBlockMeta, build_block_meta
+
+NEG_INF = float("-inf")
+LANES = 128
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class FlexAttnParams:
+    """Static (hashable-by-identity) parameters closed over by the kernels."""
+
+    meta: FlexAttnBlockMeta
+    scale: float
+    softcap: float
+    has_sink: bool
+    out_dtype: jnp.dtype
+    interpret: bool
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _entry_mask(bounds_ref, sid, row0, col0, bq, bk):
+    """Boolean [bq, bk] mask for one entry from its slice bounds (SMEM)."""
+    base = sid * SLICE_FIELDS
+    q0 = bounds_ref[base + 0]
+    q1 = bounds_ref[base + 1]
+    k0 = bounds_ref[base + 2]
+    k1 = bounds_ref[base + 3]
+    typ = bounds_ref[base + 4]
+    row = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col = col0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = (row >= q0) & (row < q1) & (col >= k0) & (col < k1)
+    is_causal = (typ & 1) == 1
+    is_inv = (typ & 2) == 2
+    # CAUSAL (bottom-right aligned): allow iff (col - k1) <= (row - q1)
+    mask &= jnp.logical_or(~is_causal, (col - k1) <= (row - q1))
+    # INVCAUSAL (top-left aligned): allow iff (col - k0) >= (row - q0)
+    mask &= jnp.logical_or(~is_inv, (col - k0) >= (row - q0))
+    return mask
+
+
+def _scores(q, k, scale, softcap):
+    """Scaled (and optionally softcapped) logits z -> s, both f32 [bq, bk]."""
+    z = jax.lax.dot_general(
+        q,
+        k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    z = z * scale
+    if softcap > 0.0:
+        s = softcap * jnp.tanh(z / softcap)
+    else:
+        s = z
+    return s
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    # scalar prefetch
+    qblk,
+    kblk,
+    sid,
+    bounds,
+    # inputs
+    q_ref,
+    k_ref,
+    v_ref,
+    sink_ref,
+    # outputs
+    out_ref,
+    lse_ref,
+    rowmax_ref,
+    # scratch
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    params: FlexAttnParams,
+):
+    meta = params.meta
+    bq, bk = meta.block_q, meta.block_k
+    h = pl.program_id(0)
+    e = pl.program_id(1)
+    num_e = pl.num_programs(1)
+
+    cur_q = qblk[e]
+    prev_q = jnp.where(e == 0, -1, qblk[jnp.maximum(e - 1, 0)])
+    next_q = jnp.where(e == num_e - 1, -1, qblk[jnp.minimum(e + 1, num_e - 1)])
+    is_first = prev_q != cur_q
+    is_last = next_q != cur_q
+
+    @pl.when(is_first)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    s = _scores(q_ref[0], k_ref[0], params.scale, params.softcap)
+    mask = _entry_mask(bounds, sid[e], cur_q * bq, kblk[e] * bk, bq, bk)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_scr[...]  # [bq, LANES], value broadcast along lanes
+    m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
+    m_new = jnp.maximum(m_prev, m_cur)  # [bq, LANES]
+    m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+    alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
+    p = jnp.exp(s - m_safe[:, :1])  # masked: exp(-inf)=0
+    l_new = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+        p.astype(v_ref.dtype),
+        v_ref[0],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[...] = m_new
+    l_scr[...] = l_new
+    acc_scr[...] = acc
+
+    @pl.when(is_last)
+    def _finalize():
+        m = m_scr[:, :1]  # [bq, 1]
+        l = l_scr[:, :1]
+        m_fin_safe = jnp.where(m == NEG_INF, 0.0, m)
+        if params.has_sink:
+            sink = sink_ref[h, 0]
+            m_tot = jnp.maximum(m, sink)
+            m_tot_safe = jnp.where(m_tot == NEG_INF, 0.0, m_tot)
+            resc = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - m_tot_safe))
+            l_tot = l * resc + jnp.exp(sink - m_tot_safe)
+            acc_fin = acc_scr[...] * resc
+        else:
+            m_tot = m
+            m_tot_safe = m_fin_safe
+            l_tot = l
+            acc_fin = acc_scr[...]
+        covered = l_tot > 0.0
+        inv = jnp.where(covered, 1.0 / jnp.where(covered, l_tot, 1.0), 0.0)
+        out_ref[0] = (acc_fin * inv).astype(out_ref.dtype)
+        lse = jnp.where(
+            covered, m_tot_safe + jnp.log(jnp.where(covered, l_tot, 1.0)), NEG_INF
+        )
+        # lse/rowmax live in a lane-broadcast [.., bq, LANES] layout (Mosaic
+        # requires the last two block dims tiled (8, 128); same convention as
+        # jax's own TPU flash-attention l/m outputs)
+        lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], LANES))
+        rowmax_ref[0] = jnp.broadcast_to(m, (m.shape[0], LANES))
+
+
+def _fwd_pallas(q, k, v, sink2d, params: FlexAttnParams):
+    """q/k/v head-major padded: q [hq, tqp, d], k/v [hk, tkp, d]."""
+    meta = params.meta
+    hq, tqp, d = q.shape
+    hk = k.shape[0]
+    group = hq // hk
+    bq, bk = meta.block_q, meta.block_k
+    E = meta.num_fwd_entries
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(hq, E),
+        in_specs=[
+            pl.BlockSpec(
+                (1, bq, d), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
+            ),
+            pl.BlockSpec(
+                (1, bk, d), lambda h, e, qb, kb, si, bo: (h // group, kb[e], 0)
+            ),
+            pl.BlockSpec(
+                (1, bk, d), lambda h, e, qb, kb, si, bo: (h // group, kb[e], 0)
+            ),
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # sink: whole [hq, 1] array
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, bq, d), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
+            ),
+            pl.BlockSpec(
+                (1, bq, LANES), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
+            ),
+            pl.BlockSpec(
+                (1, bq, LANES), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
+            ),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+    )
+    flops_fwd = 4 * meta.total_area * hq * d
+    out, lse, rowmax = pl.pallas_call(
+        functools.partial(_fwd_kernel, params=params),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hq, tqp, d), params.out_dtype),
+            jax.ShapeDtypeStruct((hq, tqp, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((hq, tqp, LANES), jnp.float32),
+        ],
+        interpret=params.interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=flops_fwd,
+            bytes_accessed=q.size * q.dtype.itemsize
+            + k.size * k.dtype.itemsize * 2,
+            transcendentals=meta.total_area * hq,
+        ),
+    )(
+        jnp.asarray(meta.fwd_q_block),
+        jnp.asarray(meta.fwd_k_block),
+        jnp.asarray(meta.fwd_slice_id),
+        jnp.asarray(meta.slice_bounds),
+        q,
+        k,
+        v,
+        sink2d,
+    )
+    return out, lse, rowmax
+
+
+# ---------------------------------------------------------------------------
+# backward: dq (q-major walk)
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    qblk,
+    kblk,
+    sid,
+    bounds,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,
+    dq_scr,
+    *,
+    params: FlexAttnParams,
+):
+    meta = params.meta
+    bq, bk = meta.block_q, meta.block_k
+    e = pl.program_id(1)
+    num_e = pl.num_programs(1)
+    cur_q = qblk[e]
+    prev_q = jnp.where(e == 0, -1, qblk[jnp.maximum(e - 1, 0)])
+    next_q = jnp.where(e == num_e - 1, -1, qblk[jnp.minimum(e + 1, num_e - 1)])
+
+    @pl.when(prev_q != cur_q)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    s = _scores(q_ref[0], k_ref[0], params.scale, params.softcap)
+    mask = _entry_mask(bounds, sid[e], cur_q * bq, kblk[e] * bk, bq, bk)
+    s = jnp.where(mask, s, NEG_INF)
+    lse = lse_ref[0][:, :1]  # [bq, 1] f32 (lane-broadcast layout)
+    lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
+    p = jnp.exp(s - lse_safe)  # masked rows: exp(-inf - 0) = 0
+    dp = jax.lax.dot_general(
+        do_ref[0],
+        v_ref[0],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    delta = delta_ref[0][:, :1]
+    ds = p * (dp - delta)
+    if params.softcap > 0.0:
+        ds = ds * (1.0 - (s / params.softcap) ** 2)
+        ds = jnp.where(mask, ds, 0.0)  # s=-inf outside mask → nan guard
+    dq_scr[...] += params.scale * jax.lax.dot_general(
+        ds.astype(k_ref.dtype),
+        k_ref[0],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(next_q != cur_q)
+    def _write():
+        dq_ref[0] = dq_scr[...]
+
+
+def _dq_pallas(q, k, v, do, lse, delta, params: FlexAttnParams):
+    meta = params.meta
+    hq, tqp, d = q.shape
+    hk = k.shape[0]
+    group = hq // hk
+    bq, bk = meta.block_q, meta.block_k
+    E = meta.num_fwd_entries
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(hq, E),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)),
+            pl.BlockSpec(
+                (1, bk, d), lambda h, e, qb, kb, si, bo: (h // group, kb[e], 0)
+            ),
+            pl.BlockSpec(
+                (1, bk, d), lambda h, e, qb, kb, si, bo: (h // group, kb[e], 0)
+            ),
+            pl.BlockSpec((1, bq, d), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)),
+            pl.BlockSpec(
+                (1, bq, LANES), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
+            ),
+            pl.BlockSpec(
+                (1, bq, LANES), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, bq, d), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
+        ),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_dq_kernel, params=params),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((hq, tqp, d), jnp.float32),
+        interpret=params.interpret,
+    )(
+        jnp.asarray(meta.fwd_q_block),
+        jnp.asarray(meta.fwd_k_block),
+        jnp.asarray(meta.fwd_slice_id),
+        jnp.asarray(meta.slice_bounds),
+        q,
+        k,
+        v,
+        do,
+        lse,
+        delta,
+    )
+
+
+# ---------------------------------------------------------------------------
+# backward: dk/dv (k-major walk, GQA group loop as innermost grid dim)
+# ---------------------------------------------------------------------------
+
+
+def _dkv_kernel(
+    kblk,
+    qblk,
+    sid,
+    bounds,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    dk_scr,
+    dv_scr,
+    *,
+    params: FlexAttnParams,
+    group: int,
+):
+    meta = params.meta
+    bq, bk = meta.block_q, meta.block_k
+    e = pl.program_id(1)
+    g = pl.program_id(2)
+    num_e = pl.num_programs(1)
+    cur_k = kblk[e]
+    prev_k = jnp.where(e == 0, -1, kblk[jnp.maximum(e - 1, 0)])
+    next_k = jnp.where(e == num_e - 1, -1, kblk[jnp.minimum(e + 1, num_e - 1)])
+
+    @pl.when((prev_k != cur_k) & (g == 0))
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    s = _scores(q_ref[0], k_ref[0], params.scale, params.softcap)
+    mask = _entry_mask(bounds, sid[e], qblk[e] * bq, cur_k * bk, bq, bk)
+    s = jnp.where(mask, s, NEG_INF)
+    lse = lse_ref[0][:, :1]  # [bq, 1] (lane-broadcast layout)
+    lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
+    p = jnp.exp(s - lse_safe)  # [bq, bk]
+    # dv += p^T @ do
+    dv_scr[...] += jax.lax.dot_general(
+        p.astype(do_ref.dtype),
+        do_ref[0],
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do_ref[0],
+        v_ref[0],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    delta = delta_ref[0][:, :1]
+    ds = p * (dp - delta)
+    if params.softcap > 0.0:
+        ds = ds * (1.0 - (s / params.softcap) ** 2)
+        ds = jnp.where(mask, ds, 0.0)
+    # dk += ds^T @ q * scale
+    dk_scr[...] += params.scale * jax.lax.dot_general(
+        ds.astype(q_ref.dtype),
+        q_ref[0],
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when((next_k != cur_k) & (g == group - 1))
+    def _write():
+        dk_ref[0] = dk_scr[...]
+        dv_ref[0] = dv_scr[...]
+
+
+def _dkv_pallas(q, k, v, do, lse, delta, params: FlexAttnParams):
+    meta = params.meta
+    hq, tqp, d = q.shape
+    hk, tkp, _ = k.shape
+    group = hq // hk
+    bq, bk = meta.block_q, meta.block_k
+    E = meta.num_bwd_entries
+
+    def qmap(h, e, g, kb, qb, si, bo):
+        return (h * group + g, qb[e], 0)
+
+    def kmap(h, e, g, kb, qb, si, bo):
+        return (h, kb[e], 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(hk, E, group),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bq, LANES), lambda h, e, g, kb, qb, si, bo: (h * group + g, qb[e], 0)),
+            pl.BlockSpec((1, bq, LANES), lambda h, e, g, kb, qb, si, bo: (h * group + g, qb[e], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bk, d), kmap),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_dkv_kernel, params=params, group=group),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hk, tkp, d), jnp.float32),
+            jax.ShapeDtypeStruct((hk, tkp, d), jnp.float32),
+        ],
+        interpret=params.interpret,
+    )(
+        jnp.asarray(meta.bwd_k_block),
+        jnp.asarray(meta.bwd_q_block),
+        jnp.asarray(meta.bwd_slice_id),
+        jnp.asarray(meta.slice_bounds),
+        q,
+        k,
+        v,
+        do,
+        lse,
+        delta,
+    )
+
+
+# ---------------------------------------------------------------------------
+# differentiable core (head-major, padded)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flex_attn_core(q, k, v, sink2d, params: FlexAttnParams):
+    return _fwd_pallas(q, k, v, sink2d, params)
+
+
+def _flex_attn_core_fwd(q, k, v, sink2d, params: FlexAttnParams):
+    out, lse_lanes, rowmax_lanes = _fwd_pallas(q, k, v, sink2d, params)
+    return (out, lse_lanes, rowmax_lanes), (q, k, v, sink2d, out, lse_lanes)
+
+
+def _flex_attn_core_bwd(params: FlexAttnParams, residuals, grads):
+    q, k, v, sink2d, out, lse_lanes = residuals
+    # lse / rowmax are auxiliary outputs: their cotangents are not supported
+    # (matches the reference, which treats lse/max_logits as non-diff)
+    dout, _dlse, _dmax = grads
+    do = dout.astype(q.dtype)
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta_lanes = jnp.broadcast_to(delta[:, :, None], lse_lanes.shape)
+    dq = _dq_pallas(q, k, v, do, lse_lanes, delta_lanes, params)
+    dk, dv = _dkv_pallas(q, k, v, do, lse_lanes, delta_lanes, params)
+    if params.has_sink:
+        # dL/dsink_h = -sum_q exp(sink_h - lse_hq) * delta_hq  (covered rows)
+        lse = lse_lanes[:, :, 0]
+        sink = sink2d[:, :1]  # [hq, 1]
+        w = jnp.where(lse == NEG_INF, 0.0, jnp.exp(sink - lse))
+        dsink = -(w * delta).sum(axis=1, keepdims=True)  # [hq, 1]
+        dsink2d = jnp.broadcast_to(dsink, sink2d.shape).astype(sink2d.dtype)
+    else:
+        dsink2d = jnp.zeros_like(sink2d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dsink2d
+
+
+_flex_attn_core.defvjp(_flex_attn_core_fwd, _flex_attn_core_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def _pad_tokens(x, target, axis):
+    pad = target - x.shape[axis]
+    if pad <= 0:
+        return x
+    cfg = [(0, 0)] * x.ndim
+    cfg[axis] = (0, pad)
+    return jnp.pad(x, cfg)
+
+
+def flex_attn_with_meta(
+    q: jax.Array,  # [tq, hq, d]
+    k: jax.Array,  # [tk, hk, d]
+    v: jax.Array,  # [tk, hk, d]
+    meta: FlexAttnBlockMeta,
+    *,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    sink: jax.Array | None = None,  # [hq]
+    out_dtype=None,
+    return_max_logits: bool = False,
+    interpret: bool | None = None,
+):
+    """Flex attention with a prebuilt block plan. Differentiable in q/k/v/sink.
+
+    Returns (out [tq, hq, d], lse [tq, hq]) and additionally max_logits [hq]
+    when ``return_max_logits`` (max_logits path is non-differentiable).
+    """
+    tq, hq, d = q.shape
+    tk, hk, _ = k.shape
+    assert meta.total_q == tq and meta.total_k == tk, (
+        f"meta built for ({meta.total_q},{meta.total_k}), got ({tq},{tk})"
+    )
+    assert hq % hk == 0
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _default_interpret()
+    out_dtype = jnp.dtype(out_dtype) if out_dtype is not None else q.dtype
+
+    tqp = meta.num_q_blocks * meta.block_q
+    tkp = meta.num_k_blocks * meta.block_k
+    qh = _pad_tokens(jnp.transpose(q, (1, 0, 2)), tqp, 1)
+    kh = _pad_tokens(jnp.transpose(k, (1, 0, 2)), tkp, 1)
+    vh = _pad_tokens(jnp.transpose(v, (1, 0, 2)), tkp, 1)
+
+    has_sink = sink is not None
+    if has_sink:
+        sink2d = jnp.broadcast_to(
+            sink.astype(jnp.float32).reshape(hq, 1), (hq, 1)
+        )
+    else:
+        sink2d = jnp.zeros((hq, 1), jnp.float32)
+
+    params = FlexAttnParams(
+        meta=meta,
+        scale=float(scale),
+        softcap=float(softcap),
+        has_sink=has_sink,
+        out_dtype=out_dtype,
+        interpret=bool(interpret),
+    )
+    out_h, lse_lanes, rowmax_lanes = _flex_attn_core(qh, kh, vh, sink2d, params)
+    out = jnp.transpose(out_h, (1, 0, 2))[:tq]
+    lse = jnp.transpose(lse_lanes[:, :, 0], (1, 0))[:tq]
+    if return_max_logits:
+        max_logits = jnp.max(rowmax_lanes[:, :, 0], axis=1)
+        return out, lse, max_logits
+    return out, lse
+
+
+@functools.lru_cache(maxsize=256)
+def _cached_meta(
+    q_ranges_b: bytes,
+    k_ranges_b: bytes,
+    types_b: bytes,
+    n_slices: int,
+    total_q: int,
+    total_k: int,
+    block_q: int,
+    block_k: int,
+) -> FlexAttnBlockMeta:
+    return build_block_meta(
+        np.frombuffer(q_ranges_b, dtype=np.int64).reshape(n_slices, 2),
+        np.frombuffer(k_ranges_b, dtype=np.int64).reshape(n_slices, 2),
+        np.frombuffer(types_b, dtype=np.int64),
+        total_q,
+        total_k,
+        block_q=block_q,
+        block_k=block_k,
+    )
+
+
+def flex_flash_attn_func(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_ranges,  # [S, 2] host values (numpy / lists) — static per mask
+    k_ranges,
+    attn_type_map,
+    *,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    sink: jax.Array | None = None,
+    out_dtype=None,
+    block_q: int = 128,
+    block_k: int = 128,
+    return_max_logits: bool = False,
+    interpret: bool | None = None,
+):
+    """Single-device flex-flash-attention (reference flex_flash_attn.py:1066).
+
+    The ranges are host-side values: the kernel plan is built once per unique
+    (mask, shape, blocking) and cached, the TPU-idiomatic replacement for the
+    reference's runtime q_ranges device tensors + persistent-kernel scheduler.
+    """
+    q_arr = np.ascontiguousarray(np.asarray(q_ranges, dtype=np.int64).reshape(-1, 2))
+    k_arr = np.ascontiguousarray(np.asarray(k_ranges, dtype=np.int64).reshape(-1, 2))
+    t_arr = np.ascontiguousarray(np.asarray(attn_type_map, dtype=np.int64).reshape(-1))
+    meta = _cached_meta(
+        q_arr.tobytes(),
+        k_arr.tobytes(),
+        t_arr.tobytes(),
+        int(t_arr.shape[0]),
+        int(q.shape[0]),
+        int(k.shape[0]),
+        int(block_q),
+        int(block_k),
+    )
+    return flex_attn_with_meta(
+        q,
+        k,
+        v,
+        meta,
+        scale=scale,
+        softcap=softcap,
+        sink=sink,
+        out_dtype=out_dtype,
+        return_max_logits=return_max_logits,
+        interpret=interpret,
+    )

@@ -2,16 +2,21 @@
 
 Distributed behavior is tested by simulating N devices on host CPU
 (xla_force_host_platform_device_count), matching how the reference simulates
-multi-rank with spawned local processes (testing/dist_common.py). Must run
-before jax initializes.
+multi-rank with spawned local processes (testing/dist_common.py).
+
+Note: the axon TPU plugin in this image ignores the JAX_PLATFORMS env var, so
+we must force the platform through jax.config before any backend init.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

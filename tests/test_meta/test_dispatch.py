@@ -1,0 +1,210 @@
+"""Dispatch solver + meta builder tests (model: reference tests/test_dispatch)."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.common import AttnMaskType, AttnRange, AttnRanges
+from magiattention_tpu.common.mask import make_attn_mask_from_ranges
+from magiattention_tpu.meta import (
+    BSDispatchAlg,
+    BTPDispatchAlg,
+    DispatchConfig,
+    DispatchData,
+    DispatchJob,
+    DispatchSolver,
+    DPDispatchAlg,
+    IOUAffinity,
+    LBDispatchAlg,
+    MinHeapDispatchAlg,
+    RandomSelectDispatchAlg,
+    SequentialDispatchAlg,
+    SortedSequentialSelectAlg,
+    ToppHeapDispatchAlg,
+    make_dispatch_meta_from_qk_ranges,
+    make_global_bucket_from_qk_ranges,
+)
+
+C = AttnMaskType.CAUSAL
+F = AttnMaskType.FULL
+
+
+def _check_partition(parts, n, k):
+    flat = sorted(x for p in parts for x in p)
+    assert flat == list(range(n)), f"not a partition: {parts}"
+    assert len(parts) == k
+
+
+class TestDispatchSolver:
+    W = [8.0, 7.0, 6.0, 5.0, 4.0, 2.0, 2.0, 2.0]
+
+    def test_lower_bound(self):
+        sol = DispatchSolver(LBDispatchAlg()).solve(
+            DispatchData(DispatchJob.from_job_list(self.W), 2)
+        )
+        assert sol.minimax_workload == sum(self.W) / 2
+
+    def test_dp_optimal(self):
+        sol = DispatchSolver(DPDispatchAlg()).solve(
+            DispatchData(DispatchJob.from_job_list(self.W), 2)
+        )
+        assert sol.minimax_workload == 18.0  # known optimum
+
+    def test_bs_optimal_with_partitions(self):
+        sol = DispatchSolver(BSDispatchAlg()).solve(
+            DispatchData(DispatchJob.from_job_list(self.W), 2)
+        )
+        assert sol.minimax_workload == 18.0
+        _check_partition(sol.bucket_partitions, 8, 2)
+        loads = [sum(self.W[i] for i in p) for p in sol.bucket_partitions]
+        assert max(loads) == 18.0
+
+    def test_btp_optimal_equal_count(self):
+        sol = DispatchSolver(BTPDispatchAlg()).solve(
+            DispatchData(DispatchJob.from_job_list(self.W), 2)
+        )
+        _check_partition(sol.bucket_partitions, 8, 2)
+        assert all(len(p) == 4 for p in sol.bucket_partitions)
+        assert sol.minimax_workload == 18.0
+
+    def test_minheap_greedy(self):
+        sol = DispatchSolver(MinHeapDispatchAlg()).solve(
+            DispatchData(DispatchJob.from_job_list(self.W), 2)
+        )
+        _check_partition(sol.bucket_partitions, 8, 2)
+        # known greedy result from the reference docstring: 19 vs 17
+        assert sol.minimax_workload == 19.0
+
+    def test_minheap_count_cap(self):
+        # 6 jobs, 3 buckets → each bucket gets exactly 2
+        w = [10.0, 1.0, 1.0, 1.0, 1.0, 1.0]
+        sol = DispatchSolver(MinHeapDispatchAlg()).solve(
+            DispatchData(DispatchJob.from_job_list(w), 3)
+        )
+        assert all(len(p) == 2 for p in sol.bucket_partitions)
+
+    def test_sequential(self):
+        sol = DispatchSolver(SequentialDispatchAlg()).solve(
+            DispatchData(DispatchJob.from_job_list(self.W), 2)
+        )
+        assert sol.bucket_partitions == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+    def test_random_select(self):
+        sol = DispatchSolver(RandomSelectDispatchAlg()).solve(
+            DispatchData(DispatchJob.from_job_list(self.W), 2)
+        )
+        _check_partition(sol.bucket_partitions, 8, 2)
+        assert all(len(p) == 4 for p in sol.bucket_partitions)
+
+    def test_sorted_sequential(self):
+        sol = DispatchSolver(SortedSequentialSelectAlg()).solve(
+            DispatchData(DispatchJob.from_job_list(self.W), 2)
+        )
+        _check_partition(sol.bucket_partitions, 8, 2)
+        assert all(len(p) == 4 for p in sol.bucket_partitions)
+
+    def test_topp_heap_affinity(self):
+        # two "samples": jobs 0-3 attend k [0,100); jobs 4-7 attend [100,200)
+        w = [10.0, 10.0, 10.0, 10.0, 10.0, 10.0, 10.0, 10.0]
+        affs = [
+            IOUAffinity.from_ranges(
+                AttnRanges.from_ranges([(0, 100) if i < 4 else (100, 200)])
+            )
+            for i in range(8)
+        ]
+        sol = DispatchSolver(ToppHeapDispatchAlg(top_p=1.0)).solve(
+            DispatchData(DispatchJob.from_job_list(w, affs), 2)
+        )
+        _check_partition(sol.bucket_partitions, 8, 2)
+        # affinity should group same-sample jobs together
+        for p in sol.bucket_partitions:
+            groups = {0 if i < 4 else 1 for i in p}
+            assert len(groups) == 1, f"affinity not respected: {sol.bucket_partitions}"
+
+
+class TestGlobalBucket:
+    def test_causal_chunk_slicing_areas(self):
+        # one causal doc over 8 tokens, chunk 2 → 4 chunks
+        q = AttnRanges.from_ranges([(0, 8)])
+        k = AttnRanges.from_ranges([(0, 8)])
+        bucket = make_global_bucket_from_qk_ranges(q, k, [C], 8, 2)
+        assert len(bucket.q_chunks) == 4
+        # chunk c rows attend causally: per-chunk area = popcount of mask rows
+        mask = make_attn_mask_from_ranges(q, k, [C], 8, 8)
+        for c, chunk in enumerate(bucket.q_chunks):
+            assert chunk.area == int(mask[c * 2 : (c + 1) * 2].sum())
+        assert bucket.area == int(mask.sum())
+
+    def test_varlen_mixed_slicing(self):
+        q = AttnRanges.from_ranges([(0, 6), (6, 16)])
+        k = AttnRanges.from_ranges([(0, 6), (6, 16)])
+        types = [C, F]
+        bucket = make_global_bucket_from_qk_ranges(q, k, types, 16, 4)
+        mask = make_attn_mask_from_ranges(q, k, types, 16, 16)
+        for c, chunk in enumerate(bucket.q_chunks):
+            assert chunk.area == int(mask[c * 4 : (c + 1) * 4].sum()), f"chunk {c}"
+
+    def test_inv_and_bicausal_slicing(self):
+        types = [AttnMaskType.INVCAUSAL, AttnMaskType.BICAUSAL]
+        q = AttnRanges.from_ranges([(0, 8), (8, 16)])
+        k = AttnRanges.from_ranges([(0, 12), (4, 16)])
+        bucket = make_global_bucket_from_qk_ranges(q, k, types, 16, 4)
+        mask = make_attn_mask_from_ranges(q, k, types, 16, 16)
+        for c, chunk in enumerate(bucket.q_chunks):
+            assert chunk.area == int(mask[c * 4 : (c + 1) * 4].sum()), f"chunk {c}"
+            # reconstruct the chunk's rows from its slices and compare exactly
+            sub = np.zeros_like(mask)
+            for s in chunk.attn_slices:
+                sub |= make_attn_mask_from_ranges(
+                    AttnRanges.from_ranges([s.q_range.to_naive_range()]),
+                    AttnRanges.from_ranges([s.k_range.to_naive_range()]),
+                    [s.mask_type],
+                    16,
+                    16,
+                )
+            np.testing.assert_array_equal(
+                sub[c * 4 : (c + 1) * 4], mask[c * 4 : (c + 1) * 4]
+            )
+
+
+class TestDispatchMeta:
+    def test_meta_roundtrip(self):
+        q = AttnRanges.from_ranges([(0, 64)])
+        k = AttnRanges.from_ranges([(0, 64)])
+        mq, mk, bucket = make_dispatch_meta_from_qk_ranges(
+            q, k, [C], 64, 64, chunk_size=8, cp_size=4
+        )
+        assert mq is mk
+        assert mq.shard_seqlen == 16
+        _check_partition([list(p) for p in mq.partitions], 8, 4)
+        perm = mq.perm_idx
+        unperm = mq.unperm_idx
+        x = np.arange(64)
+        np.testing.assert_array_equal(x[perm][unperm], x)
+        # position ids per rank = that rank's slice of perm
+        for r in range(4):
+            np.testing.assert_array_equal(
+                mq.position_ids(r), perm[r * 16 : (r + 1) * 16]
+            )
+
+    def test_load_balance_causal(self):
+        # causal mask: minheap should spread early+late chunks; the max rank
+        # area must beat the naive contiguous split
+        q = AttnRanges.from_ranges([(0, 128)])
+        k = AttnRanges.from_ranges([(0, 128)])
+        mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+            q, k, [C], 128, 128, chunk_size=16, cp_size=4,
+            dispatch_config=DispatchConfig(alg=MinHeapDispatchAlg()),
+        )
+        areas = [c.area for c in bucket.q_chunks]
+        rank_areas = [
+            sum(areas[c] for c in part) for part in mq.partitions
+        ]
+        naive = [sum(areas[i] for i in range(r * 2, r * 2 + 2)) for r in range(4)]
+        assert max(rank_areas) < max(naive)
+
+    def test_cp1_shortcut(self):
+        q = AttnRanges.from_ranges([(0, 32)])
+        mq, _, _ = make_dispatch_meta_from_qk_ranges(
+            q, q, [C], 32, 32, chunk_size=8, cp_size=1
+        )
+        assert mq.partitions == ((0, 1, 2, 3),)

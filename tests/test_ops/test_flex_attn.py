@@ -1,0 +1,212 @@
+"""Pallas flex-flash-attention vs jnp oracle (fwd + bwd), CPU interpret mode.
+
+Model: reference tests/test_attn/test_flex_flash_attn.py — kernel vs oracle
+over a grid of mask scenarios × head configs × features.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.common import AttnMaskType
+from magiattention_tpu.ops import build_block_meta, flex_flash_attn_func
+from magiattention_tpu.ops.block_meta import SLICE_FIELDS
+from magiattention_tpu.testing import assert_close, ref_attn_from_ranges
+
+F = AttnMaskType.FULL
+C = AttnMaskType.CAUSAL
+I = AttnMaskType.INVCAUSAL
+B = AttnMaskType.BICAUSAL
+
+# mask scenarios: (name, tq, tk, q_ranges, k_ranges, types)
+SCENARIOS = [
+    ("dense_full_256", 256, 256, [(0, 256)], [(0, 256)], [F]),
+    ("dense_causal_256", 256, 256, [(0, 256)], [(0, 256)], [C]),
+    ("unaligned_causal", 200, 200, [(0, 200)], [(0, 200)], [C]),
+    (
+        "varlen_causal",
+        320,
+        320,
+        [(0, 100), (100, 256), (256, 320)],
+        [(0, 100), (100, 256), (256, 320)],
+        [C, C, C],
+    ),
+    (
+        "varlen_full",
+        256,
+        256,
+        [(0, 96), (96, 256)],
+        [(0, 96), (96, 256)],
+        [F, F],
+    ),
+    (
+        "mixed_types",
+        256,
+        256,
+        [(0, 64), (64, 128), (128, 192), (192, 256)],
+        [(0, 128), (0, 64), (64, 200), (100, 256)],
+        [C, F, I, B],
+    ),
+    (
+        "q_overlap",  # two slices share q rows (multi-k attention)
+        128,
+        256,
+        [(0, 128), (32, 96)],
+        [(0, 128), (128, 256)],
+        [C, F],
+    ),
+    ("uncovered_rows", 256, 256, [(0, 100)], [(0, 100)], [C]),
+    ("cross_attn_rect", 128, 384, [(0, 128)], [(0, 384)], [C]),
+    (
+        "sliding_window_ish",
+        256,
+        256,
+        [(0, 64), (64, 128), (128, 192), (192, 256)],
+        [(0, 64), (32, 128), (96, 192), (160, 256)],
+        [C, C, C, C],
+    ),
+]
+
+
+def _rand_qkv(tq, tk, hq, hk, d, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((tq, hq, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((tk, hk, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((tk, hk, d)), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("name,tq,tk,qr,kr,ts", SCENARIOS, ids=[s[0] for s in SCENARIOS])
+@pytest.mark.parametrize("hq,hk", [(2, 2), (4, 2)])
+def test_fwd_matches_oracle(name, tq, tk, qr, kr, ts, hq, hk):
+    d = 128
+    q, k, v = _rand_qkv(tq, tk, hq, hk, d)
+    out, lse = flex_flash_attn_func(q, k, v, qr, kr, ts, block_q=64, block_k=64)
+    ref_out, ref_lse, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out, ref_out, atol=2e-5, rtol=2e-5, msg=f"{name} out")
+    # lse: compare only finite entries; -inf rows must agree exactly
+    np.testing.assert_array_equal(
+        np.isneginf(np.asarray(lse)), np.isneginf(np.asarray(ref_lse))
+    )
+    finite = ~np.isneginf(np.asarray(ref_lse))
+    assert_close(
+        np.asarray(lse)[finite], np.asarray(ref_lse)[finite], atol=2e-5, rtol=2e-5,
+        msg=f"{name} lse",
+    )
+
+
+@pytest.mark.parametrize(
+    "name,tq,tk,qr,kr,ts",
+    [s for s in SCENARIOS if s[0] in (
+        "dense_causal_256", "varlen_causal", "mixed_types", "q_overlap",
+        "uncovered_rows", "unaligned_causal",
+    )],
+    ids=lambda s: s if isinstance(s, str) else "",
+)
+def test_bwd_matches_oracle(name, tq, tk, qr, kr, ts):
+    hq, hk, d = 4, 2, 64
+    q, k, v = _rand_qkv(tq, tk, hq, hk, d, seed=1)
+    do = jnp.asarray(
+        np.random.default_rng(2).standard_normal((tq, hq, d)), jnp.float32
+    )
+
+    def f(q, k, v):
+        out, _ = flex_flash_attn_func(q, k, v, qr, kr, ts, block_q=64, block_k=64)
+        return (out * do).sum()
+
+    def f_ref(q, k, v):
+        out, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+        return (out * do).sum()
+
+    dq, dk, dv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    assert_close(dq, rq, atol=5e-5, rtol=5e-5, msg=f"{name} dq")
+    assert_close(dk, rk, atol=5e-5, rtol=5e-5, msg=f"{name} dk")
+    assert_close(dv, rv, atol=5e-5, rtol=5e-5, msg=f"{name} dv")
+
+
+def test_softcap_fwd_bwd():
+    qr, kr, ts = [(0, 128)], [(0, 128)], [C]
+    q, k, v = _rand_qkv(128, 128, 2, 2, 64, seed=3)
+    do = jnp.asarray(np.random.default_rng(4).standard_normal((128, 2, 64)), jnp.float32)
+    out, lse = flex_flash_attn_func(q, k, v, qr, kr, ts, softcap=30.0, block_q=64, block_k=64)
+    ref_out, ref_lse, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts, softcap=30.0)
+    assert_close(out, ref_out, atol=2e-5, rtol=2e-5)
+
+    g = jax.grad(
+        lambda q, k, v: (
+            flex_flash_attn_func(q, k, v, qr, kr, ts, softcap=30.0, block_q=64, block_k=64)[0] * do
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: (
+            ref_attn_from_ranges(q, k, v, qr, kr, ts, softcap=30.0)[0] * do
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, n in zip(g, gr, "qkv"):
+        assert_close(a, b, atol=5e-5, rtol=5e-5, msg=f"softcap d{n}")
+
+
+def test_sink_fwd_bwd():
+    qr, kr, ts = [(0, 128)], [(0, 128)], [C]
+    hq = 4
+    q, k, v = _rand_qkv(128, 128, hq, 2, 64, seed=5)
+    sink = jnp.asarray([0.5, -0.3, 1.2, 0.0], jnp.float32)
+    out, lse = flex_flash_attn_func(q, k, v, qr, kr, ts, sink=sink, block_q=64, block_k=64)
+    ref_out, ref_lse, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts, sink=sink)
+    assert_close(out, ref_out, atol=2e-5, rtol=2e-5)
+    assert_close(lse, ref_lse, atol=2e-5, rtol=2e-5)
+
+    do = jnp.asarray(np.random.default_rng(6).standard_normal((128, hq, 64)), jnp.float32)
+    g = jax.grad(
+        lambda q, k, v, s: (
+            flex_flash_attn_func(q, k, v, qr, kr, ts, sink=s, block_q=64, block_k=64)[0] * do
+        ).sum(),
+        argnums=(0, 1, 2, 3),
+    )(q, k, v, sink)
+    gr = jax.grad(
+        lambda q, k, v, s: (
+            ref_attn_from_ranges(q, k, v, qr, kr, ts, sink=s)[0] * do
+        ).sum(),
+        argnums=(0, 1, 2, 3),
+    )(q, k, v, sink)
+    for a, b, n in zip(g, gr, ["dq", "dk", "dv", "dsink"]):
+        assert_close(a, b, atol=5e-5, rtol=5e-5, msg=f"sink {n}")
+
+
+def test_max_logits():
+    qr, kr, ts = [(0, 128)], [(0, 128)], [C]
+    q, k, v = _rand_qkv(128, 128, 2, 2, 64, seed=7)
+    out, lse, ml = flex_flash_attn_func(
+        q, k, v, qr, kr, ts, return_max_logits=True, block_q=64, block_k=64
+    )
+    _, _, ref_ml = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(ml, ref_ml, atol=2e-5, rtol=2e-5)
+
+
+def test_block_meta_tables():
+    meta = build_block_meta([(0, 256)], [(0, 256)], [C.value], 256, 256, block_q=64, block_k=64)
+    # causal 4x4 blocks → lower-triangular block pattern: 4+3+2+1 = 10 entries
+    assert meta.num_fwd_entries >= 10
+    real = meta.fwd_slice_id < meta.num_slices
+    assert int(real.sum()) == 10
+    # every q block covered, monotone q-major order
+    assert set(meta.fwd_q_block.tolist()) == {0, 1, 2, 3}
+    assert (np.diff(meta.fwd_q_block) >= 0).all()
+    assert (np.diff(meta.bwd_k_block) >= 0).all()
+    assert meta.total_area == 256 * 257 // 2
+    assert meta.slice_bounds.shape[0] == 2 * SLICE_FIELDS
+
+
+def test_bf16_reasonable():
+    qr, kr, ts = [(0, 256)], [(0, 256)], [C]
+    q, k, v = _rand_qkv(256, 256, 2, 2, 64, seed=8)
+    out16, _ = flex_flash_attn_func(
+        q.astype(jnp.bfloat16), k.astype(jnp.bfloat16), v.astype(jnp.bfloat16),
+        qr, kr, ts, block_q=64, block_k=64,
+    )
+    ref_out, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out16.astype(jnp.float32), ref_out, atol=3e-2, rtol=3e-2)
