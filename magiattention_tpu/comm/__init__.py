@@ -1,0 +1,15 @@
+"""Communication layer: group collectives over jax.lax on mesh axes."""
+
+from .group_collective import (
+    GroupCollectiveMeta,
+    group_cast,
+    group_reduce_lse,
+    group_reduce_sum,
+)
+
+__all__ = [
+    "GroupCollectiveMeta",
+    "group_cast",
+    "group_reduce_lse",
+    "group_reduce_sum",
+]

@@ -1,0 +1,258 @@
+"""GroupCast / GroupReduce: zero-redundancy group collectives on a mesh axis.
+
+TPU-native re-design of the reference's two custom collectives
+(comm/primitive/grpcoll/_group_collective.py:81,255 and the NVSHMEM kernels
+of csrc/comm/grpcoll): identical *semantics* — each input split multicast to
+a set of destination ranks (cast), partials reduced back to owner ranks with
+sum/avg/lse (reduce) — but realized as one static `lax.all_to_all` per call
+inside `shard_map`, with all routing captured host-side in padded numpy index
+arrays (per unique mask, cached with the runtime key):
+
+- send routing  : gather rows into a [cp, S] send buffer (S = max rows any
+  rank sends one peer; SPMD requires a uniform shape, the moral equivalent of
+  the reference's ``split_alignment`` bucketing),
+- all_to_all    : rides ICI; XLA overlaps it with compute where possible,
+- recv layout   : receivers select valid rows in (src_rank, send_pos) order,
+- reduce        : scatter back through the transposed routing + segment
+  reductions (sum / avg / LSE-weighted out+lse merge).
+
+No WorkWithPostProcessFn-style handle is needed: XLA's async scheduling
+replaces the reference's stream/event plumbing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = float("-inf")
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class GroupCollectiveMeta:
+    """Host-side routing plan for one group_cast (and its reverse reduce).
+
+    Built from ``send_map[src][dst] = local row indices`` (numpy) via
+    :meth:`build`. The stacked arrays have a leading cp axis so that, placed
+    in device memory sharded on the cp mesh axis, each rank reads exactly its
+    own routing row inside shard_map.
+    """
+
+    cp_size: int
+    max_send: int  # S: rows any rank sends to any one peer (padded)
+    max_recv: int  # R: output rows any rank receives (padded)
+    send_total: tuple[int, ...]  # valid send rows per rank (diagnostics)
+    recv_total: tuple[int, ...]  # valid recv rows per rank
+
+    send_idx: np.ndarray  # [cp, cp, S] int32: [src, dst, pos] -> src-local row
+    recv_sel: np.ndarray  # [cp, R] int32: [dst, out_pos] -> flat (src*S+pos)
+    recv_valid: np.ndarray  # [cp, R] bool: out_pos < recv_total[dst]
+    seg_ids: np.ndarray  # [cp, cp, S] int32: [owner, src, pos] -> owner row
+    # (pad positions -> num_segments sentinel, dropped by the reduce)
+
+    @staticmethod
+    def build(
+        send_map: Sequence[Sequence[np.ndarray]],
+        num_local_rows: Sequence[int],
+        pad_to: int = 8,
+    ) -> "GroupCollectiveMeta":
+        """``send_map[src][dst]``: int array of src-local rows sent src->dst.
+
+        ``num_local_rows[rank]``: rank's local row count (segment count for
+        the reverse reduce). Output layout at each dst: concatenation over
+        src ranks (rank order) of received rows (send order) — the a2av
+        convention the solver's CommMeta is built around.
+        """
+        cp = len(send_map)
+        sizes = np.zeros((cp, cp), dtype=np.int64)
+        for s in range(cp):
+            assert len(send_map[s]) == cp
+            for d in range(cp):
+                sizes[s, d] = len(send_map[s][d])
+        S = max(int(sizes.max()), 1)
+        S = -(-S // pad_to) * pad_to
+        recv_tot = sizes.sum(axis=0)  # rows arriving at each dst
+        R = max(int(recv_tot.max()), 1)
+        R = -(-R // pad_to) * pad_to
+
+        send_idx = np.zeros((cp, cp, S), dtype=np.int32)
+        # pad positions point at the trash slot cp*S (one past the real flat
+        # recv buffer) so reverse scatters cannot clobber real rows
+        recv_sel = np.full((cp, R), cp * S, dtype=np.int32)
+        recv_valid = np.zeros((cp, R), dtype=bool)
+        seg_ids = np.full((cp, cp, S), 0, dtype=np.int32)
+        for s in range(cp):
+            for d in range(cp):
+                idx = np.asarray(send_map[s][d], dtype=np.int32).reshape(-1)
+                assert (idx < num_local_rows[s]).all() if idx.size else True
+                send_idx[s, d, : idx.size] = idx
+                # reverse direction: rows owner s sent to d come back from d;
+                # at owner s, recv row (d, pos) reduces into local row idx[pos]
+                seg_ids[s, d, : idx.size] = idx
+                seg_ids[s, d, idx.size :] = num_local_rows[s]  # drop sentinel
+        for d in range(cp):
+            pos = 0
+            for s in range(cp):
+                n = int(sizes[s, d])
+                recv_sel[d, pos : pos + n] = s * S + np.arange(n)
+                recv_valid[d, pos : pos + n] = True
+                pos += n
+        return GroupCollectiveMeta(
+            cp_size=cp,
+            max_send=S,
+            max_recv=R,
+            send_total=tuple(int(x) for x in sizes.sum(axis=1)),
+            recv_total=tuple(int(x) for x in recv_tot),
+            send_idx=send_idx,
+            recv_sel=recv_sel,
+            recv_valid=recv_valid,
+            seg_ids=seg_ids,
+        )
+
+    # device-array views (leading cp axis -> shard over the cp mesh axis)
+    def device_args(self):
+        return (
+            jnp.asarray(self.send_idx),
+            jnp.asarray(self.recv_sel),
+            jnp.asarray(self.recv_valid),
+            jnp.asarray(self.seg_ids),
+        )
+
+    @property
+    def comm_bytes_per_rank(self) -> int:
+        """Padded all-to-all payload rows (volume accounting, per element)."""
+        return self.cp_size * self.max_send
+
+
+def group_cast(
+    x: jax.Array,  # [T_local, ...] rank-local rows (inside shard_map)
+    send_idx: jax.Array,  # [1, cp, S] this rank's routing row
+    recv_sel: jax.Array,  # [1, R]
+    recv_valid: jax.Array,  # [1, R]
+    *,
+    axis_name: str,
+):
+    """Multicast local rows to their destination set; returns [R, ...] rows
+    in (src_rank, send_pos) order (padded rows zeroed)."""
+    si = send_idx[0]  # [cp, S]
+    send_buf = jnp.take(x, si.reshape(-1), axis=0).reshape(
+        si.shape + x.shape[1:]
+    )  # [cp, S, ...]
+    recv = jax.lax.all_to_all(
+        send_buf, axis_name, split_axis=0, concat_axis=0, tiled=False
+    )  # [cp, S, ...]
+    flat = recv.reshape((-1,) + x.shape[1:])
+    # pad entries of recv_sel point one past the end; clip + mask them out
+    out = jnp.take(flat, jnp.minimum(recv_sel[0], flat.shape[0] - 1), axis=0)
+    mask_shape = (out.shape[0],) + (1,) * (out.ndim - 1)
+    return jnp.where(recv_valid[0].reshape(mask_shape), out, 0)
+
+
+def _reverse_a2a(y, recv_sel, recv_valid, cp, S, axis_name):
+    """Scatter partial rows back through the transposed cast routing.
+
+    Returns [cp, S, ...]: rows that each peer sent back to me, in my original
+    send order (= my cast send_idx positions).
+    """
+    flat = jnp.zeros((cp * S + 1,) + y.shape[1:], dtype=y.dtype)
+    mask_shape = (y.shape[0],) + (1,) * (y.ndim - 1)
+    y_masked = jnp.where(recv_valid[0].reshape(mask_shape), y, 0)
+    flat = flat.at[recv_sel[0]].set(y_masked)  # pads land in the trash slot
+    send_back = flat[:-1].reshape((cp, S) + y.shape[1:])
+    return jax.lax.all_to_all(
+        send_back, axis_name, split_axis=0, concat_axis=0, tiled=False
+    )
+
+
+def group_reduce_sum(
+    y: jax.Array,  # [R, ...] partial rows (layout of group_cast output)
+    acc: jax.Array,  # [T_local, ...] buffer to accumulate into
+    send_idx_unused,  # kept for signature symmetry
+    recv_sel: jax.Array,
+    recv_valid: jax.Array,
+    seg_ids: jax.Array,  # [1, cp, S]
+    *,
+    axis_name: str,
+    average: bool = False,
+    counts: jax.Array | None = None,  # [T_local] contributions per row (avg)
+):
+    """Reduce partials back onto owner rows: acc += segment_sum(partials)."""
+    cp, S = seg_ids.shape[1], seg_ids.shape[2]
+    recv = _reverse_a2a(y, recv_sel, recv_valid, cp, S, axis_name)
+    flat = recv.reshape((cp * S,) + y.shape[1:])
+    T = acc.shape[0]
+    seg = seg_ids[0].reshape(-1)
+    contrib = jax.ops.segment_sum(flat, seg, num_segments=T + 1)[:T]
+    if average:
+        assert counts is not None
+        denom = jnp.maximum(counts, 1).reshape((T,) + (1,) * (acc.ndim - 1))
+        return acc + contrib.astype(acc.dtype) / denom.astype(acc.dtype)
+    return acc + contrib.astype(acc.dtype)
+
+
+def group_reduce_lse(
+    out_partial: jax.Array,  # [R, h, d] partial attention outputs
+    lse_partial: jax.Array,  # [R, h] partial lse (NEG_INF where invalid)
+    out_acc: jax.Array,  # [T, h, d] local partial out
+    lse_acc: jax.Array,  # [T, h] local partial lse
+    recv_sel: jax.Array,
+    recv_valid: jax.Array,
+    seg_ids: jax.Array,
+    *,
+    axis_name: str,
+):
+    """LSE-weighted merge of remote partial (out, lse) onto owner rows.
+
+    The distributed-attention correction (reference functional/utils.py
+    correct_attn_out/lse + range_reduce lse op): for contributions i with
+    (out_i, lse_i):  lse = log Σ exp(lse_i),  out = Σ exp(lse_i - lse) out_i.
+    Rows nobody contributed to keep (out_acc, lse_acc).
+    """
+    cp, S = seg_ids.shape[1], seg_ids.shape[2]
+    # mark invalid rows with -inf lse so they vanish from the merge
+    lse_masked = jnp.where(recv_valid[0], lse_partial.T, NEG_INF).T  # [R, h]
+    recv_out = _reverse_a2a(out_partial, recv_sel, recv_valid, cp, S, axis_name)
+    # lse travels alongside; -inf encodes "no contribution"
+    flat_lse = jnp.full(
+        (cp * S + 1,) + lse_partial.shape[1:], NEG_INF, lse_partial.dtype
+    )
+    flat_lse = flat_lse.at[recv_sel[0]].set(lse_masked)
+    recv_lse = jax.lax.all_to_all(
+        flat_lse[:-1].reshape((cp, S) + lse_partial.shape[1:]),
+        axis_name,
+        split_axis=0,
+        concat_axis=0,
+        tiled=False,
+    )
+    T = out_acc.shape[0]
+    seg = seg_ids[0].reshape(-1)
+    flat_out = recv_out.reshape((cp * S,) + out_partial.shape[1:])
+    flat_lse = recv_lse.reshape((cp * S,) + lse_partial.shape[1:])
+
+    # segment-logsumexp including the local accumulator as one contribution
+    m_remote = jax.ops.segment_max(flat_lse, seg, num_segments=T + 1)[:T]
+    m = jnp.maximum(m_remote, lse_acc)  # [T, h]
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    w_remote = jnp.exp(flat_lse - m_safe[seg.clip(0, T - 1)])
+    # zero out sentinel rows (seg == T) explicitly
+    w_remote = jnp.where((seg < T)[:, None], w_remote, 0.0)
+    w_remote = jnp.where(jnp.isneginf(flat_lse), 0.0, w_remote)
+    l_remote = jax.ops.segment_sum(w_remote, seg, num_segments=T + 1)[:T]
+    l_local = jnp.where(
+        jnp.isneginf(lse_acc), 0.0, jnp.exp(lse_acc - m_safe)
+    )
+    l_tot = l_remote + l_local  # [T, h]
+    lse_new = jnp.where(l_tot > 0, m_safe + jnp.log(jnp.maximum(l_tot, 1e-38)), NEG_INF)
+
+    out_remote = jax.ops.segment_sum(
+        w_remote[..., None] * flat_out.astype(jnp.float32),
+        seg,
+        num_segments=T + 1,
+    )[:T]
+    out_new = out_remote + l_local[..., None] * out_acc.astype(jnp.float32)
+    denom = jnp.where(l_tot > 0, l_tot, 1.0)[..., None]
+    return (out_new / denom).astype(out_acc.dtype), lse_new

@@ -1,21 +1,30 @@
 """Host-side block metadata for the Pallas flex-flash-attention kernels.
 
 Role of the reference's ``csrc/flexible_flash_attention/block_meta.h`` +
-tile scheduler (fwd_tile_scheduler.hpp), re-designed TPU-first: instead of a
-persistent CUDA kernel walking (range, m-block) tiles with atomics, we
-precompute — per unique mask, on host, in numpy — a flattened *entry table*:
-one entry per (q-block, slice, k-block) tile that intersects the mask. The
-Pallas kernel walks entries on a sequential grid with scalar-prefetched
-block indices (splash-attention style), so no atomics are ever needed:
-entries of the same q-block are consecutive and accumulate in VMEM scratch.
+tile schedulers *and* of its ``meta/solver/slice_maker.py``: instead of a
+persistent CUDA kernel walking (range, m-block) tiles with atomics — and
+instead of host-side splitting of k-ranges into local sub-slices with
+adjusted mask types — we precompute, per unique mask, a flattened *entry
+table*: one entry per (q-block, k-block, slice, run-pair) tile that
+intersects the mask. The Pallas kernel walks entries on a sequential grid
+with scalar-prefetched indices (splash-attention style); entries of the same
+q-block are consecutive so accumulation happens in VMEM scratch, no atomics.
+
+The *run* generalization is what makes the distributed path trivial: a rank's
+local Q/K buffers are permuted concatenations of global-coordinate segments
+("runs": local_start -> global_start, length). Each entry carries its runs'
+local windows + global offsets, and the kernel evaluates the ORIGINAL
+global-coordinate mask semantics on (local + offset) indices. Arbitrary
+sequence shards and remote-KV buffer layouts then need no mask rewriting at
+all — the moral replacement for slice_maker.py's trapezoid case analysis.
 
 Tables are built in both orientations:
 - q-major (sorted by q-block): forward + dq backward kernels,
 - k-major (sorted by k-block): dkv backward kernel.
 
-Every q-block (resp. k-block) is guaranteed at least one entry — a dummy
-all-masked entry referencing the sentinel slice — so output tiles are always
-written (out=0 / lse=-inf for uncovered rows, dk=dv=0 for uncovered keys).
+Every q-block (resp. k-block) has at least one entry — a dummy all-masked
+entry pointing at the sentinel slice — so output tiles are always written
+(out=0 / lse=-inf for uncovered rows, dk=dv=0 for uncovered keys).
 """
 
 from __future__ import annotations
@@ -25,8 +34,10 @@ from typing import Sequence
 
 import numpy as np
 
-# Fields per slice in the flattened bounds table.
+# Fields per slice in the flattened bounds table (global coords).
 SLICE_FIELDS = 5  # qs, qe, ks, ke, mask_type
+# Fields per entry in the flattened runs table (local windows + offsets).
+RUN_FIELDS = 6  # ql0, ql1, kl0, kl1, qoff, koff
 
 
 def _cdiv(a: int, b: int) -> int:
@@ -38,32 +49,76 @@ def _round_up(a: int, b: int) -> int:
 
 
 @dataclasses.dataclass(frozen=True)
-class FlexAttnBlockMeta:
-    """Immutable host-side kernel plan for one (mask, shape, blocking) combo.
+class Run:
+    """A contiguous segment: local rows [local_start, local_start+length)
+    hold global positions [global_start, global_start+length)."""
 
-    All arrays are numpy int32; they become scalar-prefetch operands of the
-    Pallas kernels. ``slice_bounds`` is flattened [num_slices+1, SLICE_FIELDS]
-    -> 1-D; the last slice is the all-zero sentinel used by dummy entries.
+    local_start: int
+    global_start: int
+    length: int
+
+    @property
+    def local_end(self) -> int:
+        return self.local_start + self.length
+
+    @property
+    def global_end(self) -> int:
+        return self.global_start + self.length
+
+    @property
+    def offset(self) -> int:
+        return self.global_start - self.local_start
+
+
+def runs_from_position_ids(position_ids: np.ndarray) -> list[Run]:
+    """Compress a local->global id map into maximal contiguous runs."""
+    pos = np.asarray(position_ids, dtype=np.int64).reshape(-1)
+    runs: list[Run] = []
+    i = 0
+    n = pos.shape[0]
+    while i < n:
+        j = i + 1
+        while j < n and pos[j] == pos[j - 1] + 1:
+            j += 1
+        runs.append(Run(local_start=i, global_start=int(pos[i]), length=j - i))
+        i = j
+    return runs
+
+
+def identity_runs(total: int) -> list[Run]:
+    return [Run(0, 0, total)] if total > 0 else []
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class FlexAttnBlockMeta:
+    """Immutable host-side kernel plan for one (mask, layout, blocking) combo.
+
+    All arrays are numpy int32, becoming scalar-prefetch operands of the
+    Pallas kernels (or, in the distributed runtime, stacked per-rank and fed
+    as sharded device arrays). ``slice_bounds`` is [num_slices+1, SLICE_FIELDS]
+    flattened; the last row is the all-zero sentinel used by dummy entries.
     """
 
-    total_q: int
+    total_q: int  # local q rows (padded to block_q multiple by the wrapper)
     total_k: int
     block_q: int
     block_k: int
     num_q_blocks: int
     num_k_blocks: int
-    num_slices: int  # real slices (sentinel excluded)
-    total_area: int  # exact unmasked (q, k) pair count — FLOPs proxy
+    num_slices: int
+    total_area: int  # exact unmasked pair count within this rank's plan
 
-    # q-major table (forward / dq): entries sorted by q-block.
-    fwd_q_block: np.ndarray  # [E] q-block index per entry
-    fwd_k_block: np.ndarray  # [E] k-block index per entry
-    fwd_slice_id: np.ndarray  # [E] slice id per entry (sentinel = num_slices)
+    # q-major table (forward / dq)
+    fwd_q_block: np.ndarray  # [E]
+    fwd_k_block: np.ndarray  # [E]
+    fwd_slice_id: np.ndarray  # [E]
+    fwd_runs: np.ndarray  # [E * RUN_FIELDS]
 
-    # k-major table (dkv): entries sorted by k-block.
+    # k-major table (dkv)
     bwd_k_block: np.ndarray  # [E2]
     bwd_q_block: np.ndarray  # [E2]
     bwd_slice_id: np.ndarray  # [E2]
+    bwd_runs: np.ndarray  # [E2 * RUN_FIELDS]
 
     slice_bounds: np.ndarray  # [(num_slices+1) * SLICE_FIELDS]
 
@@ -76,126 +131,192 @@ class FlexAttnBlockMeta:
         return int(self.bwd_k_block.shape[0])
 
 
-def _slice_tiles(
-    qs: int, qe: int, ks: int, ke: int, mask_type: int, bq: int, bk: int
-) -> list[tuple[int, int]]:
-    """All (q_block, k_block) tiles intersecting one slice's unmasked region."""
-    tiles: list[tuple[int, int]] = []
-    causal = bool(mask_type & 1)
-    inv = bool(mask_type & 2)
-    for i in range(qs // bq, _cdiv(qe, bq)):
-        rq_lo = max(qs, i * bq)
-        rq_hi = min(qe, (i + 1) * bq)  # exclusive
-        # tightest k span needed by rows [rq_lo, rq_hi) of this slice:
-        k_lo, k_hi = ks, ke
-        if causal:
-            # allow iff (k - ke) <= (q - qe); max q row rq_hi-1 → k < ke - qe + rq_hi
-            k_hi = min(k_hi, ke - qe + rq_hi)
-        if inv:
-            # allow iff (k - ks) >= (q - qs); min q row rq_lo → k >= ks + rq_lo - qs
-            k_lo = max(k_lo, ks + (rq_lo - qs))
-        if k_hi <= k_lo:
+def _slice_k_span(
+    gq_lo: int, gq_hi: int, ks: int, ke: int, qs: int, qe: int, mask_type: int
+) -> tuple[int, int]:
+    """Global k interval attended by global q rows [gq_lo, gq_hi) of a slice."""
+    k_lo, k_hi = ks, ke
+    if mask_type & 1:  # causal: k - ke <= q - qe; max row gq_hi-1
+        k_hi = min(k_hi, ke - qe + gq_hi)
+    if mask_type & 2:  # inv-causal: k - ks >= q - qs; min row gq_lo
+        k_lo = max(k_lo, ks + (gq_lo - qs))
+    return k_lo, k_hi
+
+
+def _emit_entries(
+    slices: np.ndarray,  # [S, 5] (qs, qe, ks, ke, type) global coords
+    q_runs: Sequence[Run],
+    k_runs: Sequence[Run],
+    block_q: int,
+    block_k: int,
+) -> list[tuple]:
+    """All (q_block, k_block, slice, runfields...) tiles intersecting the mask.
+
+    Entry tuple: (qblk, kblk, sid, ql0, ql1, kl0, kl1, qoff, koff).
+    """
+    out: list[tuple] = []
+    for sid in range(slices.shape[0]):
+        qs, qe, ks, ke, mt = (int(x) for x in slices[sid])
+        if qs >= qe or ks >= ke:
             continue
-        for j in range(k_lo // bk, _cdiv(k_hi, bk)):
-            tiles.append((i, j))
-    return tiles
+        for qr in q_runs:
+            # global q rows of this run covered by the slice
+            gq_lo = max(qs, qr.global_start)
+            gq_hi = min(qe, qr.global_end)
+            if gq_lo >= gq_hi:
+                continue
+            ql_lo = gq_lo - qr.offset  # local rows
+            ql_hi = gq_hi - qr.offset
+            for i in range(ql_lo // block_q, _cdiv(ql_hi, block_q)):
+                bq_lo = max(ql_lo, i * block_q)
+                bq_hi = min(ql_hi, (i + 1) * block_q)
+                # k span needed by these global rows
+                k_lo, k_hi = _slice_k_span(
+                    bq_lo + qr.offset, bq_hi + qr.offset, ks, ke, qs, qe, mt
+                )
+                if k_hi <= k_lo:
+                    continue
+                for kr in k_runs:
+                    gk_lo = max(k_lo, kr.global_start)
+                    gk_hi = min(k_hi, kr.global_end)
+                    if gk_lo >= gk_hi:
+                        continue
+                    kl_lo = gk_lo - kr.offset
+                    kl_hi = gk_hi - kr.offset
+                    for j in range(kl_lo // block_k, _cdiv(kl_hi, block_k)):
+                        out.append(
+                            (
+                                i,
+                                j,
+                                sid,
+                                bq_lo,
+                                bq_hi,
+                                max(kl_lo, j * block_k),
+                                min(kl_hi, (j + 1) * block_k),
+                                qr.offset,
+                                kr.offset,
+                            )
+                        )
+    return out
 
 
 def _build_table(
-    entries: np.ndarray,  # [E, 3] = (major_block, minor_block, slice_id)
+    entries: np.ndarray,  # [E, 9] entry tuples (major-first ordering applied)
     num_major_blocks: int,
     sentinel_slice: int,
     pad_to: int,
-) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Sort by major block, insert dummies for uncovered major blocks, pad."""
+    major_col: int = 0,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Sort by major block, add dummies for uncovered majors, pad length."""
+    dummy = [0] * 9
+    dummy[2] = sentinel_slice
     covered = np.zeros(num_major_blocks, dtype=bool)
     if entries.size:
-        covered[entries[:, 0]] = True
-    dummies = [
-        (i, 0, sentinel_slice) for i in range(num_major_blocks) if not covered[i]
-    ]
+        covered[entries[:, major_col]] = True
+    dummies = []
+    for i in range(num_major_blocks):
+        if not covered[i]:
+            row = list(dummy)
+            row[major_col] = i
+            dummies.append(row)
     if dummies:
-        entries = (
-            np.concatenate([entries, np.asarray(dummies, dtype=np.int64)], axis=0)
-            if entries.size
-            else np.asarray(dummies, dtype=np.int64)
-        )
-    order = np.lexsort((entries[:, 1], entries[:, 2], entries[:, 0]))
+        d = np.asarray(dummies, dtype=np.int64)
+        entries = np.concatenate([entries, d], axis=0) if entries.size else d
+    minor_col = 1 - major_col
+    order = np.lexsort(
+        (entries[:, 2], entries[:, minor_col], entries[:, major_col])
+    )
     entries = entries[order]
     e = entries.shape[0]
     target = max(_round_up(e, max(pad_to, 1)), 1)
     if target > e:
-        # pad entries replicate the last major block with the sentinel slice
-        # (all-masked, contribute nothing, keep output index monotone)
-        last_major = entries[-1, 0]
-        pad = np.tile(
-            np.asarray([[last_major, 0, sentinel_slice]], dtype=np.int64),
-            (target - e, 1),
-        )
+        row = list(dummy)
+        row[major_col] = int(entries[-1, major_col])
+        pad = np.tile(np.asarray([row], dtype=np.int64), (target - e, 1))
         entries = np.concatenate([entries, pad], axis=0)
-    return (
-        entries[:, 0].astype(np.int32),
-        entries[:, 1].astype(np.int32),
-        entries[:, 2].astype(np.int32),
-    )
+    major = entries[:, major_col].astype(np.int32)
+    minor = entries[:, minor_col].astype(np.int32)
+    sid = entries[:, 2].astype(np.int32)
+    runs = entries[:, 3:9].astype(np.int32).reshape(-1)
+    return major, minor, sid, runs
 
 
-def build_block_meta(
-    q_ranges: np.ndarray | Sequence[Sequence[int]],  # [S, 2]
-    k_ranges: np.ndarray | Sequence[Sequence[int]],  # [S, 2]
-    attn_type_map: np.ndarray | Sequence[int],  # [S]
-    total_q: int,
-    total_k: int,
+def build_block_meta_general(
+    slices: np.ndarray,  # [S, 5] global (qs, qe, ks, ke, type)
+    q_runs: Sequence[Run],
+    k_runs: Sequence[Run],
+    total_q: int,  # local q rows
+    total_k: int,  # local k rows
     *,
     block_q: int = 128,
     block_k: int = 128,
     entry_pad: int = 8,
+    pad_entries_to: int | None = None,  # uniform E across ranks (SPMD)
+    pad_bwd_entries_to: int | None = None,
+    num_slices_padded: int | None = None,
 ) -> FlexAttnBlockMeta:
-    """Build the entry tables for one mask. Pure host-side numpy.
+    """Build entry tables for one rank's local attention problem.
 
-    ``entry_pad`` rounds table lengths up so that nearby masks share compiled
-    kernel shapes (bounding pjit/pallas recompiles, the role of the
-    reference's JIT kernel cache).
+    Local buffers are described by runs (local<->global segment map); the
+    mask slices stay in global coordinates.
     """
-    q_arr = np.asarray(q_ranges, dtype=np.int64).reshape(-1, 2)
-    k_arr = np.asarray(k_ranges, dtype=np.int64).reshape(-1, 2)
-    t_arr = np.asarray(attn_type_map, dtype=np.int64).reshape(-1)
-    assert q_arr.shape[0] == k_arr.shape[0] == t_arr.shape[0]
-    num_slices = q_arr.shape[0]
+    from ..common.mask import slice_area
+
+    slices = np.asarray(slices, dtype=np.int64).reshape(-1, SLICE_FIELDS)
+    S = slices.shape[0]
     nq = max(_cdiv(total_q, block_q), 1)
     nk = max(_cdiv(total_k, block_k), 1)
 
-    from ..common.mask import slice_area
-
-    area = 0
-    ent: list[tuple[int, int, int]] = []
-    for s in range(num_slices):
-        qs, qe = int(q_arr[s, 0]), int(q_arr[s, 1])
-        ks, ke = int(k_arr[s, 0]), int(k_arr[s, 1])
-        mt = int(t_arr[s])
-        assert 0 <= qs <= qe <= total_q, f"slice {s}: bad q_range [{qs},{qe})"
-        assert 0 <= ks <= ke <= total_k, f"slice {s}: bad k_range [{ks},{ke})"
-        assert 0 <= mt <= 3, f"slice {s}: bad mask type {mt}"
-        area += slice_area(qs, qe, ks, ke, mt)
-        for (i, j) in _slice_tiles(qs, qe, ks, ke, mt, block_q, block_k):
-            ent.append((i, j, s))
-
+    ent = _emit_entries(slices, list(q_runs), list(k_runs), block_q, block_k)
     entries = (
-        np.asarray(ent, dtype=np.int64) if ent else np.empty((0, 3), dtype=np.int64)
+        np.asarray(ent, dtype=np.int64) if ent else np.empty((0, 9), dtype=np.int64)
     )
-    fwd_q, fwd_k, fwd_s = _build_table(entries.copy(), nq, num_slices, entry_pad)
-    # k-major: swap major/minor columns
-    kmaj = entries[:, [1, 0, 2]] if entries.size else entries
-    bwd_k, bwd_q, bwd_s = _build_table(kmaj, nk, num_slices, entry_pad)
 
-    bounds = np.zeros((num_slices + 1, SLICE_FIELDS), dtype=np.int32)
-    if num_slices:
-        bounds[:num_slices, 0] = q_arr[:, 0]
-        bounds[:num_slices, 1] = q_arr[:, 1]
-        bounds[:num_slices, 2] = k_arr[:, 0]
-        bounds[:num_slices, 3] = k_arr[:, 1]
-        bounds[:num_slices, 4] = t_arr
-    # sentinel row stays all-zero: empty q/k range → all-masked tile
+    fwd = _build_table(entries.copy(), nq, S, entry_pad, major_col=0)
+    bwd = _build_table(entries.copy(), nk, S, entry_pad, major_col=1)
+
+    def _pad_table(table, target):
+        major, minor, sid, runs = table
+        e = major.shape[0]
+        if target is None or target <= e:
+            assert target is None or target == e, (
+                f"table length {e} exceeds requested pad {target}"
+            )
+            return table
+        extra = target - e
+        major = np.concatenate([major, np.full(extra, major[-1], np.int32)])
+        minor = np.concatenate([minor, np.zeros(extra, np.int32)])
+        pad_sid = np.full(extra, S, np.int32)
+        sid = np.concatenate([sid, pad_sid])
+        runs = np.concatenate([runs, np.zeros(extra * RUN_FIELDS, np.int32)])
+        return major, minor, sid, runs
+
+    fwd = _pad_table(fwd, pad_entries_to)
+    bwd = _pad_table(bwd, pad_bwd_entries_to)
+
+    n_slices_store = S if num_slices_padded is None else num_slices_padded
+    assert n_slices_store >= S
+    bounds = np.zeros((n_slices_store + 1, SLICE_FIELDS), dtype=np.int32)
+    bounds[:S] = slices
+    # rows S..n_slices_store stay all-zero (sentinels: empty range = all-masked)
+
+    # exact area: intersect each slice with the runs (a slice may reference
+    # global rows/cols this rank does not hold)
+    area = 0
+    for sid in range(S):
+        qs, qe, ks, ke, mt = (int(x) for x in slices[sid])
+        for qr in q_runs:
+            a, b = max(qs, qr.global_start), min(qe, qr.global_end)
+            if a >= b:
+                continue
+            k_lo, k_hi = _slice_k_span(a, b, ks, ke, qs, qe, mt)
+            for kr in k_runs:
+                c, d = max(k_lo, kr.global_start), min(k_hi, kr.global_end)
+                if c >= d:
+                    continue
+                # area of the sub-rectangle (a,b)x(c,d) under the slice mask:
+                # count pairs satisfying the type constraints
+                area += _sub_area(a, b, c, d, qs, qe, ks, ke, mt)
 
     return FlexAttnBlockMeta(
         total_q=total_q,
@@ -204,13 +325,128 @@ def build_block_meta(
         block_k=block_k,
         num_q_blocks=nq,
         num_k_blocks=nk,
-        num_slices=num_slices,
+        num_slices=n_slices_store,
         total_area=int(area),
-        fwd_q_block=fwd_q,
-        fwd_k_block=fwd_k,
-        fwd_slice_id=fwd_s,
-        bwd_k_block=bwd_k,
-        bwd_q_block=bwd_q,
-        bwd_slice_id=bwd_s,
+        fwd_q_block=fwd[0],
+        fwd_k_block=fwd[1],
+        fwd_slice_id=fwd[2],
+        fwd_runs=fwd[3],
+        bwd_k_block=bwd[0],
+        bwd_q_block=bwd[1],
+        bwd_slice_id=bwd[2],
+        bwd_runs=bwd[3],
         slice_bounds=bounds.reshape(-1),
+    )
+
+
+def _sub_area(a, b, c, d, qs, qe, ks, ke, mt) -> int:
+    """Unmasked pairs in global sub-rectangle rows [a,b) x cols [c,d).
+
+    Row q attends cols [lo(q), hi(q)) with lo = ks + (q - qs) under an
+    inv-causal bound (else ks) and hi = ke - qe + q + 1 under a causal bound
+    (else ke); vectorized over rows (host-side planning only).
+    """
+    q = np.arange(a, b, dtype=np.int64)
+    lo = (ks + (q - qs)) if (mt & 2) else np.full_like(q, ks)
+    hi = (ke - qe + q + 1) if (mt & 1) else np.full_like(q, ke)
+    cnt = np.minimum(hi, d) - np.maximum(lo, c)
+    return int(np.maximum(cnt, 0).sum())
+
+
+def pad_block_meta(
+    meta: FlexAttnBlockMeta,
+    pad_entries_to: int,
+    pad_bwd_entries_to: int,
+    num_slices_padded: int,
+) -> FlexAttnBlockMeta:
+    """Pad a built meta's tables to uniform lengths (SPMD across ranks).
+
+    Pad entries replicate the last major block with the sentinel slice
+    (all-masked, inert); extra bounds rows are zeros (further sentinels).
+    """
+    S = meta.num_slices
+    assert num_slices_padded >= S
+
+    def pad_tab(major, minor, sid, runs, target, sentinel):
+        e = major.shape[0]
+        assert target >= e, f"table length {e} exceeds pad target {target}"
+        if target == e:
+            return major, minor, sid, runs
+        extra = target - e
+        return (
+            np.concatenate([major, np.full(extra, major[-1], np.int32)]),
+            np.concatenate([minor, np.zeros(extra, np.int32)]),
+            np.concatenate([sid, np.full(extra, sentinel, np.int32)]),
+            np.concatenate([runs, np.zeros(extra * RUN_FIELDS, np.int32)]),
+        )
+
+    fq, fk, fs, fr = pad_tab(
+        meta.fwd_q_block,
+        meta.fwd_k_block,
+        meta.fwd_slice_id,
+        meta.fwd_runs,
+        pad_entries_to,
+        S,
+    )
+    bk, bq, bs, br = pad_tab(
+        meta.bwd_k_block,
+        meta.bwd_q_block,
+        meta.bwd_slice_id,
+        meta.bwd_runs,
+        pad_bwd_entries_to,
+        S,
+    )
+    bounds = np.zeros(((num_slices_padded + 1) * SLICE_FIELDS,), np.int32)
+    bounds[: meta.slice_bounds.shape[0]] = meta.slice_bounds
+    return dataclasses.replace(
+        meta,
+        num_slices=num_slices_padded,
+        fwd_q_block=fq,
+        fwd_k_block=fk,
+        fwd_slice_id=fs,
+        fwd_runs=fr,
+        bwd_k_block=bk,
+        bwd_q_block=bq,
+        bwd_slice_id=bs,
+        bwd_runs=br,
+        slice_bounds=bounds,
+    )
+
+
+def build_block_meta(
+    q_ranges: np.ndarray | Sequence[Sequence[int]],
+    k_ranges: np.ndarray | Sequence[Sequence[int]],
+    attn_type_map: np.ndarray | Sequence[int],
+    total_q: int,
+    total_k: int,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    entry_pad: int = 8,
+) -> FlexAttnBlockMeta:
+    """Single-device plan: identity runs, slices given as range lists."""
+    q_arr = np.asarray(q_ranges, dtype=np.int64).reshape(-1, 2)
+    k_arr = np.asarray(k_ranges, dtype=np.int64).reshape(-1, 2)
+    t_arr = np.asarray(attn_type_map, dtype=np.int64).reshape(-1)
+    assert q_arr.shape[0] == k_arr.shape[0] == t_arr.shape[0]
+    for s in range(t_arr.shape[0]):
+        assert 0 <= q_arr[s, 0] <= q_arr[s, 1] <= total_q, (
+            f"slice {s}: bad q_range [{q_arr[s,0]},{q_arr[s,1]})"
+        )
+        assert 0 <= k_arr[s, 0] <= k_arr[s, 1] <= total_k, (
+            f"slice {s}: bad k_range [{k_arr[s,0]},{k_arr[s,1]})"
+        )
+        assert 0 <= t_arr[s] <= 3, f"slice {s}: bad mask type {t_arr[s]}"
+    slices = np.concatenate(
+        [q_arr, k_arr, t_arr[:, None]], axis=1
+    )  # [S, 5]
+    return build_block_meta_general(
+        slices,
+        identity_runs(total_q),
+        identity_runs(total_k),
+        total_q,
+        total_k,
+        block_q=block_q,
+        block_k=block_k,
+        entry_pad=entry_pad,
     )

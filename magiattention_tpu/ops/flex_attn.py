@@ -1,17 +1,21 @@
 """Pallas TPU flex-flash-attention: fwd + bwd kernels over attention slices.
 
 TPU-native equivalent of the reference FFA CUDA kernel
-(csrc/flexible_flash_attention/, see SURVEY.md §2.7 module A): computes
-attention over an arbitrary list of (q_range, k_range, mask_type) slices
-with online softmax, GQA, softcap, attention sink, LSE + per-row max-logit
-outputs, and a two-kernel backward (dq q-major / dkv k-major) that needs no
-atomics: the sequential TPU grid walks a host-precomputed entry table
-(ops/block_meta.py) so tiles of the same output block are consecutive and
-accumulate in VMEM scratch.
+(csrc/flexible_flash_attention/, SURVEY.md §2.7 module A): attention over an
+arbitrary list of (q_range, k_range, mask_type) slices with online softmax,
+GQA, softcap, attention sink, LSE + per-row max-logit outputs, and a
+two-kernel backward (dq q-major / dkv k-major) needing no atomics: the
+sequential TPU grid walks a host-precomputed entry table (ops/block_meta.py)
+so tiles of the same output block are consecutive and accumulate in VMEM
+scratch.
 
-Layout convention inside kernels: head-major [num_heads, tokens, head_dim]
-(contiguous per-head 2-D tiles for the MXU). Public wrappers accept the
-reference layout [tokens, heads, head_dim].
+Entries carry run fields (local window + local->global offset), so the same
+kernels serve the distributed runtime where each rank's Q/KV buffers are
+permuted concatenations of global segments: table arrays may be traced jax
+arrays (stacked per-rank, sharded on the cp mesh axis), not just constants.
+
+Layout inside kernels: head-major [num_heads, tokens, head_dim]. Public
+wrappers accept the reference layout [tokens, heads, head_dim].
 """
 
 from __future__ import annotations
@@ -26,50 +30,96 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .block_meta import SLICE_FIELDS, FlexAttnBlockMeta, build_block_meta
+from .block_meta import (
+    RUN_FIELDS,
+    SLICE_FIELDS,
+    FlexAttnBlockMeta,
+    build_block_meta,
+)
 
 NEG_INF = float("-inf")
 LANES = 128
 
 
-@dataclasses.dataclass(frozen=True, eq=False)
+@dataclasses.dataclass(frozen=True)
 class FlexAttnParams:
-    """Static (hashable-by-identity) parameters closed over by the kernels."""
+    """Static parameters closed over by the kernels (hashable)."""
 
-    meta: FlexAttnBlockMeta
+    block_q: int
+    block_k: int
     scale: float
     softcap: float
     has_sink: bool
-    out_dtype: jnp.dtype
+    out_dtype: str
     interpret: bool
+
+    @property
+    def out_jnp_dtype(self):
+        return jnp.dtype(self.out_dtype)
 
 
 def _default_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _entry_mask(bounds_ref, sid, row0, col0, bq, bk):
-    """Boolean [bq, bk] mask for one entry from its slice bounds (SMEM)."""
-    base = sid * SLICE_FIELDS
-    q0 = bounds_ref[base + 0]
-    q1 = bounds_ref[base + 1]
-    k0 = bounds_ref[base + 2]
-    k1 = bounds_ref[base + 3]
-    typ = bounds_ref[base + 4]
-    row = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    col = col0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    mask = (row >= q0) & (row < q1) & (col >= k0) & (col < k1)
+def fwd_tables(meta: FlexAttnBlockMeta):
+    return (
+        jnp.asarray(meta.fwd_q_block),
+        jnp.asarray(meta.fwd_k_block),
+        jnp.asarray(meta.fwd_slice_id),
+        jnp.asarray(meta.fwd_runs),
+        jnp.asarray(meta.slice_bounds),
+    )
+
+
+def bwd_tables(meta: FlexAttnBlockMeta):
+    return (
+        jnp.asarray(meta.bwd_k_block),
+        jnp.asarray(meta.bwd_q_block),
+        jnp.asarray(meta.bwd_slice_id),
+        jnp.asarray(meta.bwd_runs),
+        jnp.asarray(meta.slice_bounds),
+    )
+
+
+def _entry_mask(bounds, runs, sid_e, e, row0, col0, bq, bk):
+    """Boolean [bq, bk] mask for one entry.
+
+    Local coordinates come from the grid (row0/col0 block origins + iota);
+    run fields translate them to global coordinates where the slice's
+    original mask semantics (bit0 causal / bit1 inv-causal) are evaluated.
+    """
+    rbase = e * RUN_FIELDS
+    ql0 = runs[rbase + 0]
+    ql1 = runs[rbase + 1]
+    kl0 = runs[rbase + 2]
+    kl1 = runs[rbase + 3]
+    qoff = runs[rbase + 4]
+    koff = runs[rbase + 5]
+    sbase = sid_e * SLICE_FIELDS
+    q0 = bounds[sbase + 0]
+    q1 = bounds[sbase + 1]
+    k0 = bounds[sbase + 2]
+    k1 = bounds[sbase + 3]
+    typ = bounds[sbase + 4]
+
+    rl = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)  # local rows
+    cl = col0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)  # local cols
+    mask = (rl >= ql0) & (rl < ql1) & (cl >= kl0) & (cl < kl1)
+    gq = rl + qoff
+    gk = cl + koff
+    mask &= (gq >= q0) & (gq < q1) & (gk >= k0) & (gk < k1)
     is_causal = (typ & 1) == 1
     is_inv = (typ & 2) == 2
-    # CAUSAL (bottom-right aligned): allow iff (col - k1) <= (row - q1)
-    mask &= jnp.logical_or(~is_causal, (col - k1) <= (row - q1))
-    # INVCAUSAL (top-left aligned): allow iff (col - k0) >= (row - q0)
-    mask &= jnp.logical_or(~is_inv, (col - k0) >= (row - q0))
+    # CAUSAL (bottom-right aligned): allow iff (gk - k1) <= (gq - q1)
+    mask &= jnp.logical_or(~is_causal, (gk - k1) <= (gq - q1))
+    # INVCAUSAL (top-left aligned): allow iff (gk - k0) >= (gq - q0)
+    mask &= jnp.logical_or(~is_inv, (gk - k0) >= (gq - q0))
     return mask
 
 
 def _scores(q, k, scale, softcap):
-    """Scaled (and optionally softcapped) logits z -> s, both f32 [bq, bk]."""
+    """Scaled (and optionally softcapped) logits, f32 [bq, bk]."""
     z = jax.lax.dot_general(
         q,
         k,
@@ -78,10 +128,8 @@ def _scores(q, k, scale, softcap):
     )
     z = z * scale
     if softcap > 0.0:
-        s = softcap * jnp.tanh(z / softcap)
-    else:
-        s = z
-    return s
+        return softcap * jnp.tanh(z / softcap)
+    return z
 
 
 # ---------------------------------------------------------------------------
@@ -90,29 +138,25 @@ def _scores(q, k, scale, softcap):
 
 
 def _fwd_kernel(
-    # scalar prefetch
     qblk,
     kblk,
     sid,
+    runs,
     bounds,
-    # inputs
     q_ref,
     k_ref,
     v_ref,
     sink_ref,
-    # outputs
     out_ref,
     lse_ref,
     rowmax_ref,
-    # scratch
     m_scr,
     l_scr,
     acc_scr,
     *,
     params: FlexAttnParams,
 ):
-    meta = params.meta
-    bq, bk = meta.block_q, meta.block_k
+    bq, bk = params.block_q, params.block_k
     h = pl.program_id(0)
     e = pl.program_id(1)
     num_e = pl.num_programs(1)
@@ -130,15 +174,15 @@ def _fwd_kernel(
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     s = _scores(q_ref[0], k_ref[0], params.scale, params.softcap)
-    mask = _entry_mask(bounds, sid[e], cur_q * bq, kblk[e] * bk, bq, bk)
+    mask = _entry_mask(bounds, runs, sid[e], e, cur_q * bq, kblk[e] * bk, bq, bk)
     s = jnp.where(mask, s, NEG_INF)
 
-    m_prev = m_scr[...]  # [bq, LANES], value broadcast along lanes
-    m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
-    m_new = jnp.maximum(m_prev, m_cur)  # [bq, LANES]
+    m_prev = m_scr[...]  # [bq, LANES] lane-broadcast
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
     m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
     alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
-    p = jnp.exp(s - m_safe[:, :1])  # masked: exp(-inf)=0
+    p = jnp.exp(s - m_safe[:, :1])
     l_new = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
     acc = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
         p.astype(v_ref.dtype),
@@ -152,9 +196,8 @@ def _fwd_kernel(
 
     @pl.when(is_last)
     def _finalize():
-        m = m_scr[:, :1]  # [bq, 1]
+        m = m_scr[:, :1]
         l = l_scr[:, :1]
-        m_fin_safe = jnp.where(m == NEG_INF, 0.0, m)
         if params.has_sink:
             sink = sink_ref[h, 0]
             m_tot = jnp.maximum(m, sink)
@@ -163,8 +206,7 @@ def _fwd_kernel(
             l_tot = l * resc + jnp.exp(sink - m_tot_safe)
             acc_fin = acc_scr[...] * resc
         else:
-            m_tot = m
-            m_tot_safe = m_fin_safe
+            m_tot_safe = jnp.where(m == NEG_INF, 0.0, m)
             l_tot = l
             acc_fin = acc_scr[...]
         covered = l_tot > 0.0
@@ -173,46 +215,41 @@ def _fwd_kernel(
         lse = jnp.where(
             covered, m_tot_safe + jnp.log(jnp.where(covered, l_tot, 1.0)), NEG_INF
         )
-        # lse/rowmax live in a lane-broadcast [.., bq, LANES] layout (Mosaic
-        # requires the last two block dims tiled (8, 128); same convention as
-        # jax's own TPU flash-attention l/m outputs)
+        # lane-broadcast [bq, LANES] layout (Mosaic (8,128)-tiling legal; the
+        # same convention as jax's own TPU flash-attention l/m outputs)
         lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], LANES))
         rowmax_ref[0] = jnp.broadcast_to(m, (m.shape[0], LANES))
 
 
-def _fwd_pallas(q, k, v, sink2d, params: FlexAttnParams):
-    """q/k/v head-major padded: q [hq, tqp, d], k/v [hk, tkp, d]."""
-    meta = params.meta
+def _fwd_pallas(q, k, v, sink2d, tables, params: FlexAttnParams):
+    """q [hq, tqp, d]; k/v [hk, tkp, d]; tables from fwd_tables()."""
+    qblk, kblk, sid, runs, bounds = tables
     hq, tqp, d = q.shape
     hk = k.shape[0]
     group = hq // hk
-    bq, bk = meta.block_q, meta.block_k
-    E = meta.num_fwd_entries
+    bq, bk = params.block_q, params.block_k
+    E = qblk.shape[0]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=5,
         grid=(hq, E),
         in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, e, qb, kb, si, ru, bo: (h, qb[e], 0)),
             pl.BlockSpec(
-                (1, bq, d), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
+                (1, bk, d), lambda h, e, qb, kb, si, ru, bo: (h // group, kb[e], 0)
             ),
             pl.BlockSpec(
-                (1, bk, d), lambda h, e, qb, kb, si, bo: (h // group, kb[e], 0)
+                (1, bk, d), lambda h, e, qb, kb, si, ru, bo: (h // group, kb[e], 0)
             ),
-            pl.BlockSpec(
-                (1, bk, d), lambda h, e, qb, kb, si, bo: (h // group, kb[e], 0)
-            ),
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # sink: whole [hq, 1] array
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # sink [hq, 1]
         ],
         out_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, e, qb, kb, si, ru, bo: (h, qb[e], 0)),
             pl.BlockSpec(
-                (1, bq, d), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
+                (1, bq, LANES), lambda h, e, qb, kb, si, ru, bo: (h, qb[e], 0)
             ),
             pl.BlockSpec(
-                (1, bq, LANES), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
-            ),
-            pl.BlockSpec(
-                (1, bq, LANES), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
+                (1, bq, LANES), lambda h, e, qb, kb, si, ru, bo: (h, qb[e], 0)
             ),
         ],
         scratch_shapes=[
@@ -221,33 +258,21 @@ def _fwd_pallas(q, k, v, sink2d, params: FlexAttnParams):
             pltpu.VMEM((bq, d), jnp.float32),
         ],
     )
-    flops_fwd = 4 * meta.total_area * hq * d
-    out, lse, rowmax = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_fwd_kernel, params=params),
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((hq, tqp, d), params.out_dtype),
+            jax.ShapeDtypeStruct((hq, tqp, d), params.out_jnp_dtype),
             jax.ShapeDtypeStruct((hq, tqp, LANES), jnp.float32),
             jax.ShapeDtypeStruct((hq, tqp, LANES), jnp.float32),
         ],
         interpret=params.interpret,
         cost_estimate=pl.CostEstimate(
-            flops=flops_fwd,
-            bytes_accessed=q.size * q.dtype.itemsize
-            + k.size * k.dtype.itemsize * 2,
-            transcendentals=meta.total_area * hq,
+            flops=4 * int(E) * bq * bk * d * hq,
+            bytes_accessed=q.size * q.dtype.itemsize + 2 * k.size * k.dtype.itemsize,
+            transcendentals=int(E) * bq * bk * hq,
         ),
-    )(
-        jnp.asarray(meta.fwd_q_block),
-        jnp.asarray(meta.fwd_k_block),
-        jnp.asarray(meta.fwd_slice_id),
-        jnp.asarray(meta.slice_bounds),
-        q,
-        k,
-        v,
-        sink2d,
-    )
-    return out, lse, rowmax
+    )(qblk, kblk, sid, runs, bounds, q, k, v, sink2d)
 
 
 # ---------------------------------------------------------------------------
@@ -259,6 +284,7 @@ def _dq_kernel(
     qblk,
     kblk,
     sid,
+    runs,
     bounds,
     q_ref,
     k_ref,
@@ -271,8 +297,7 @@ def _dq_kernel(
     *,
     params: FlexAttnParams,
 ):
-    meta = params.meta
-    bq, bk = meta.block_q, meta.block_k
+    bq, bk = params.block_q, params.block_k
     e = pl.program_id(1)
     num_e = pl.num_programs(1)
     cur_q = qblk[e]
@@ -284,11 +309,11 @@ def _dq_kernel(
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     s = _scores(q_ref[0], k_ref[0], params.scale, params.softcap)
-    mask = _entry_mask(bounds, sid[e], cur_q * bq, kblk[e] * bk, bq, bk)
+    mask = _entry_mask(bounds, runs, sid[e], e, cur_q * bq, kblk[e] * bk, bq, bk)
     s = jnp.where(mask, s, NEG_INF)
-    lse = lse_ref[0][:, :1]  # [bq, 1] f32 (lane-broadcast layout)
+    lse = lse_ref[0][:, :1]
     lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
-    p = jnp.exp(s - lse_safe)  # masked rows: exp(-inf - 0) = 0
+    p = jnp.exp(s - lse_safe)
     dp = jax.lax.dot_general(
         do_ref[0],
         v_ref[0],
@@ -299,7 +324,7 @@ def _dq_kernel(
     ds = p * (dp - delta)
     if params.softcap > 0.0:
         ds = ds * (1.0 - (s / params.softcap) ** 2)
-        ds = jnp.where(mask, ds, 0.0)  # s=-inf outside mask → nan guard
+        ds = jnp.where(mask, ds, 0.0)
     dq_scr[...] += params.scale * jax.lax.dot_general(
         ds.astype(k_ref.dtype),
         k_ref[0],
@@ -312,36 +337,33 @@ def _dq_kernel(
         dq_ref[0] = dq_scr[...]
 
 
-def _dq_pallas(q, k, v, do, lse, delta, params: FlexAttnParams):
-    meta = params.meta
+def _dq_pallas(q, k, v, do, lse, delta, tables, params: FlexAttnParams):
+    qblk, kblk, sid, runs, bounds = tables
     hq, tqp, d = q.shape
     hk = k.shape[0]
     group = hq // hk
-    bq, bk = meta.block_q, meta.block_k
-    E = meta.num_fwd_entries
+    bq, bk = params.block_q, params.block_k
+    E = qblk.shape[0]
+
+    def qmap(h, e, qb, kb, si, ru, bo):
+        return (h, qb[e], 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=5,
         grid=(hq, E),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)),
+            pl.BlockSpec((1, bq, d), qmap),
             pl.BlockSpec(
-                (1, bk, d), lambda h, e, qb, kb, si, bo: (h // group, kb[e], 0)
+                (1, bk, d), lambda h, e, qb, kb, si, ru, bo: (h // group, kb[e], 0)
             ),
             pl.BlockSpec(
-                (1, bk, d), lambda h, e, qb, kb, si, bo: (h // group, kb[e], 0)
+                (1, bk, d), lambda h, e, qb, kb, si, ru, bo: (h // group, kb[e], 0)
             ),
-            pl.BlockSpec((1, bq, d), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)),
-            pl.BlockSpec(
-                (1, bq, LANES), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
-            ),
-            pl.BlockSpec(
-                (1, bq, LANES), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
-            ),
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bq, LANES), qmap),
+            pl.BlockSpec((1, bq, LANES), qmap),
         ],
-        out_specs=pl.BlockSpec(
-            (1, bq, d), lambda h, e, qb, kb, si, bo: (h, qb[e], 0)
-        ),
+        out_specs=pl.BlockSpec((1, bq, d), qmap),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
     )
     return pl.pallas_call(
@@ -349,22 +371,11 @@ def _dq_pallas(q, k, v, do, lse, delta, params: FlexAttnParams):
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((hq, tqp, d), jnp.float32),
         interpret=params.interpret,
-    )(
-        jnp.asarray(meta.fwd_q_block),
-        jnp.asarray(meta.fwd_k_block),
-        jnp.asarray(meta.fwd_slice_id),
-        jnp.asarray(meta.slice_bounds),
-        q,
-        k,
-        v,
-        do,
-        lse,
-        delta,
-    )
+    )(qblk, kblk, sid, runs, bounds, q, k, v, do, lse, delta)
 
 
 # ---------------------------------------------------------------------------
-# backward: dk/dv (k-major walk, GQA group loop as innermost grid dim)
+# backward: dk/dv (k-major walk; GQA group = innermost grid dim)
 # ---------------------------------------------------------------------------
 
 
@@ -372,6 +383,7 @@ def _dkv_kernel(
     kblk,
     qblk,
     sid,
+    runs,
     bounds,
     q_ref,
     k_ref,
@@ -387,8 +399,7 @@ def _dkv_kernel(
     params: FlexAttnParams,
     group: int,
 ):
-    meta = params.meta
-    bq, bk = meta.block_q, meta.block_k
+    bq, bk = params.block_q, params.block_k
     e = pl.program_id(1)
     g = pl.program_id(2)
     num_e = pl.num_programs(1)
@@ -402,12 +413,11 @@ def _dkv_kernel(
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     s = _scores(q_ref[0], k_ref[0], params.scale, params.softcap)
-    mask = _entry_mask(bounds, sid[e], qblk[e] * bq, cur_k * bk, bq, bk)
+    mask = _entry_mask(bounds, runs, sid[e], e, qblk[e] * bq, cur_k * bk, bq, bk)
     s = jnp.where(mask, s, NEG_INF)
-    lse = lse_ref[0][:, :1]  # [bq, 1] (lane-broadcast layout)
+    lse = lse_ref[0][:, :1]
     lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
-    p = jnp.exp(s - lse_safe)  # [bq, bk]
-    # dv += p^T @ do
+    p = jnp.exp(s - lse_safe)
     dv_scr[...] += jax.lax.dot_general(
         p.astype(do_ref.dtype),
         do_ref[0],
@@ -425,7 +435,6 @@ def _dkv_kernel(
     if params.softcap > 0.0:
         ds = ds * (1.0 - (s / params.softcap) ** 2)
         ds = jnp.where(mask, ds, 0.0)
-    # dk += ds^T @ q * scale
     dk_scr[...] += params.scale * jax.lax.dot_general(
         ds.astype(q_ref.dtype),
         q_ref[0],
@@ -439,30 +448,33 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[...]
 
 
-def _dkv_pallas(q, k, v, do, lse, delta, params: FlexAttnParams):
-    meta = params.meta
+def _dkv_pallas(q, k, v, do, lse, delta, tables, params: FlexAttnParams):
+    kblk, qblk, sid, runs, bounds = tables
     hq, tqp, d = q.shape
     hk, tkp, _ = k.shape
     group = hq // hk
-    bq, bk = meta.block_q, meta.block_k
-    E = meta.num_bwd_entries
+    bq, bk = params.block_q, params.block_k
+    E = kblk.shape[0]
 
-    def qmap(h, e, g, kb, qb, si, bo):
+    def qmap(h, e, g, kb, qb, si, ru, bo):
         return (h * group + g, qb[e], 0)
 
-    def kmap(h, e, g, kb, qb, si, bo):
+    def kmap(h, e, g, kb, qb, si, ru, bo):
         return (h, kb[e], 0)
 
+    def lmap(h, e, g, kb, qb, si, ru, bo):
+        return (h * group + g, qb[e], 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=5,
         grid=(hk, E, group),
         in_specs=[
             pl.BlockSpec((1, bq, d), qmap),
             pl.BlockSpec((1, bk, d), kmap),
             pl.BlockSpec((1, bk, d), kmap),
             pl.BlockSpec((1, bq, d), qmap),
-            pl.BlockSpec((1, bq, LANES), lambda h, e, g, kb, qb, si, bo: (h * group + g, qb[e], 0)),
-            pl.BlockSpec((1, bq, LANES), lambda h, e, g, kb, qb, si, bo: (h * group + g, qb[e], 0)),
+            pl.BlockSpec((1, bq, LANES), lmap),
+            pl.BlockSpec((1, bq, LANES), lmap),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), kmap),
@@ -481,18 +493,7 @@ def _dkv_pallas(q, k, v, do, lse, delta, params: FlexAttnParams):
             jax.ShapeDtypeStruct((hk, tkp, d), jnp.float32),
         ],
         interpret=params.interpret,
-    )(
-        jnp.asarray(meta.bwd_k_block),
-        jnp.asarray(meta.bwd_q_block),
-        jnp.asarray(meta.bwd_slice_id),
-        jnp.asarray(meta.slice_bounds),
-        q,
-        k,
-        v,
-        do,
-        lse,
-        delta,
-    )
+    )(kblk, qblk, sid, runs, bounds, q, k, v, do, lse, delta)
 
 
 # ---------------------------------------------------------------------------
@@ -500,36 +501,58 @@ def _dkv_pallas(q, k, v, do, lse, delta, params: FlexAttnParams):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _flex_attn_core(q, k, v, sink2d, params: FlexAttnParams):
-    return _fwd_pallas(q, k, v, sink2d, params)
+def _zero_tangents(tables):
+    return tuple(
+        np.zeros(t.shape, dtype=jax.dtypes.float0) for t in tables
+    )
 
 
-def _flex_attn_core_fwd(q, k, v, sink2d, params: FlexAttnParams):
-    out, lse_lanes, rowmax_lanes = _fwd_pallas(q, k, v, sink2d, params)
-    return (out, lse_lanes, rowmax_lanes), (q, k, v, sink2d, out, lse_lanes)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _flex_attn_core(q, k, v, sink2d, ftab, btab, params: FlexAttnParams):
+    return _fwd_pallas(q, k, v, sink2d, ftab, params)
+
+
+def _flex_attn_core_fwd(q, k, v, sink2d, ftab, btab, params: FlexAttnParams):
+    out, lse_lanes, rowmax_lanes = _fwd_pallas(q, k, v, sink2d, ftab, params)
+    return (out, lse_lanes, rowmax_lanes), (
+        q,
+        k,
+        v,
+        sink2d,
+        out,
+        lse_lanes,
+        ftab,
+        btab,
+    )
 
 
 def _flex_attn_core_bwd(params: FlexAttnParams, residuals, grads):
-    q, k, v, sink2d, out, lse_lanes = residuals
-    # lse / rowmax are auxiliary outputs: their cotangents are not supported
-    # (matches the reference, which treats lse/max_logits as non-diff)
+    q, k, v, sink2d, out, lse_lanes, ftab, btab = residuals
+    # lse / rowmax are auxiliary outputs; their cotangents are unsupported
+    # (matches the reference treating lse/max_logits as non-differentiable)
     dout, _dlse, _dmax = grads
     do = dout.astype(q.dtype)
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta_lanes = jnp.broadcast_to(delta[:, :, None], lse_lanes.shape)
-    dq = _dq_pallas(q, k, v, do, lse_lanes, delta_lanes, params)
-    dk, dv = _dkv_pallas(q, k, v, do, lse_lanes, delta_lanes, params)
+    dq = _dq_pallas(q, k, v, do, lse_lanes, delta_lanes, ftab, params)
+    dk, dv = _dkv_pallas(q, k, v, do, lse_lanes, delta_lanes, btab, params)
     if params.has_sink:
-        # dL/dsink_h = -sum_q exp(sink_h - lse_hq) * delta_hq  (covered rows)
+        # dL/dsink_h = -sum_q exp(sink_h - lse_hq) * delta_hq
         lse = lse_lanes[:, :, 0]
-        sink = sink2d[:, :1]  # [hq, 1]
+        sink = sink2d[:, :1]
         w = jnp.where(lse == NEG_INF, 0.0, jnp.exp(sink - lse))
-        dsink = -(w * delta).sum(axis=1, keepdims=True)  # [hq, 1]
+        dsink = -(w * delta).sum(axis=1, keepdims=True)
         dsink2d = jnp.broadcast_to(dsink, sink2d.shape).astype(sink2d.dtype)
     else:
         dsink2d = jnp.zeros_like(sink2d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dsink2d
+    return (
+        dq.astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+        dsink2d,
+        _zero_tangents(ftab),
+        _zero_tangents(btab),
+    )
 
 
 _flex_attn_core.defvjp(_flex_attn_core_fwd, _flex_attn_core_bwd)
@@ -549,23 +572,45 @@ def _pad_tokens(x, target, axis):
     return jnp.pad(x, cfg)
 
 
+def flex_attn_headmajor(
+    q: jax.Array,  # [hq, tq_pad, d] (block-multiple padded)
+    k: jax.Array,  # [hk, tk_pad, d]
+    v: jax.Array,
+    ftab,
+    btab,
+    params: FlexAttnParams,
+    sink: jax.Array | None = None,  # [hq]
+):
+    """Head-major differentiable core for the distributed runtime.
+
+    Returns (out [hq, tqp, d], lse_lanes [hq, tqp, LANES], rowmax_lanes).
+    Table arrays may be traced (per-rank, sharded) values.
+    """
+    hq = q.shape[0]
+    if sink is not None:
+        sink2d = sink.astype(jnp.float32).reshape(hq, 1)
+    else:
+        sink2d = jnp.zeros((hq, 1), jnp.float32)
+    return _flex_attn_core(q, k, v, sink2d, tuple(ftab), tuple(btab), params)
+
+
 def flex_attn_with_meta(
     q: jax.Array,  # [tq, hq, d]
     k: jax.Array,  # [tk, hk, d]
-    v: jax.Array,  # [tk, hk, d]
+    v: jax.Array,
     meta: FlexAttnBlockMeta,
     *,
     scale: float | None = None,
     softcap: float = 0.0,
-    sink: jax.Array | None = None,  # [hq]
+    sink: jax.Array | None = None,
     out_dtype=None,
     return_max_logits: bool = False,
     interpret: bool | None = None,
 ):
     """Flex attention with a prebuilt block plan. Differentiable in q/k/v/sink.
 
-    Returns (out [tq, hq, d], lse [tq, hq]) and additionally max_logits [hq]
-    when ``return_max_logits`` (max_logits path is non-differentiable).
+    Returns (out [tq, hq, d], lse [tq, hq]) plus max_logits [hq] when
+    ``return_max_logits`` (max_logits is non-differentiable).
     """
     tq, hq, d = q.shape
     tk, hk, _ = k.shape
@@ -585,23 +630,18 @@ def flex_attn_with_meta(
     kh = _pad_tokens(jnp.transpose(k, (1, 0, 2)), tkp, 1)
     vh = _pad_tokens(jnp.transpose(v, (1, 0, 2)), tkp, 1)
 
-    has_sink = sink is not None
-    if has_sink:
-        sink2d = jnp.broadcast_to(
-            sink.astype(jnp.float32).reshape(hq, 1), (hq, 1)
-        )
-    else:
-        sink2d = jnp.zeros((hq, 1), jnp.float32)
-
     params = FlexAttnParams(
-        meta=meta,
+        block_q=meta.block_q,
+        block_k=meta.block_k,
         scale=float(scale),
         softcap=float(softcap),
-        has_sink=has_sink,
-        out_dtype=out_dtype,
+        has_sink=sink is not None,
+        out_dtype=str(out_dtype),
         interpret=bool(interpret),
     )
-    out_h, lse_lanes, rowmax_lanes = _flex_attn_core(qh, kh, vh, sink2d, params)
+    out_h, lse_lanes, rowmax_lanes = flex_attn_headmajor(
+        qh, kh, vh, fwd_tables(meta), bwd_tables(meta), params, sink=sink
+    )
     out = jnp.transpose(out_h, (1, 0, 2))[:tq]
     lse = jnp.transpose(lse_lanes[:, :, 0], (1, 0))[:tq]
     if return_max_logits:
@@ -652,7 +692,7 @@ def flex_flash_attn_func(
     """Single-device flex-flash-attention (reference flex_flash_attn.py:1066).
 
     The ranges are host-side values: the kernel plan is built once per unique
-    (mask, shape, blocking) and cached, the TPU-idiomatic replacement for the
+    (mask, shape, blocking) and cached — the TPU-idiomatic replacement for the
     reference's runtime q_ranges device tensors + persistent-kernel scheduler.
     """
     q_arr = np.ascontiguousarray(np.asarray(q_ranges, dtype=np.int64).reshape(-1, 2))

@@ -1,0 +1,21 @@
+"""Distributed execution runtime: CP attention plan + hot path + dispatch."""
+
+from .dispatch import dispatch, position_ids, undispatch
+from .dist_attn import (
+    DistAttnPlan,
+    build_dist_attn_plan,
+    dist_attn_local,
+    make_attn_params,
+    make_dist_attn_fn,
+)
+
+__all__ = [
+    "DistAttnPlan",
+    "build_dist_attn_plan",
+    "dispatch",
+    "dist_attn_local",
+    "make_attn_params",
+    "make_dist_attn_fn",
+    "position_ids",
+    "undispatch",
+]

@@ -1,0 +1,40 @@
+"""dispatch / undispatch: move tensors between global and CP-sharded layouts.
+
+Role of reference ``functional/dispatch.py``: the forward dispatch selects
+each rank's chunks (a pure permutation — communication-free given the
+replicated input convention), undispatch is the inverse permutation (an
+all-gather in SPMD). We express both as global gathers under jit and let
+GSPMD insert the collectives — the XLA-idiomatic form of the reference's
+autograd Function pair (dispatch bwd = all-gather-v, undispatch bwd =
+reduce-scatter fall out of gather transposition automatically).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..meta.dispatch_meta import DispatchMeta
+
+
+def dispatch(x: jax.Array, meta: DispatchMeta, axis: int = 0) -> jax.Array:
+    """Permute the global tensor into dispatch order (rank-major chunks).
+
+    Shard the result on the cp mesh axis along ``axis`` to realize the
+    rank-local layout; position ids follow meta.position_ids(rank).
+    """
+    perm = jnp.asarray(meta.perm_idx)
+    return jnp.take(x, perm, axis=axis)
+
+
+def undispatch(y: jax.Array, meta: DispatchMeta, axis: int = 0) -> jax.Array:
+    """Inverse of :func:`dispatch` (back to natural global order)."""
+    unperm = jnp.asarray(meta.unperm_idx)
+    return jnp.take(y, unperm, axis=axis)
+
+
+def position_ids(meta: DispatchMeta) -> jax.Array:
+    """Global position of every dispatched slot, [total] int32 (sharded the
+    same way as dispatched activations; used for RoPE etc.)."""
+    return jnp.asarray(meta.perm_idx)

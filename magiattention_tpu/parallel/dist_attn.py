@@ -1,0 +1,377 @@
+"""Distributed context-parallel flex attention: plan builder + runtime.
+
+Role of the reference's ``meta/solver/dist_attn_solver.py`` +
+``functional/dist_attn.py`` (DistAttnRuntime/DistAttnFunc), re-designed
+TPU-first. Per rank, on host (once per unique mask, cached under the runtime
+key):
+
+1. host q/k ranges from the dispatch partition (chunked permutable shard),
+2. ``remote_k = needed_k \\ host_k`` (zero-redundancy exact remote set,
+   the reference's find_hole_ranges step),
+3. a GroupCollectiveMeta routing K/V rows owner->consumer (the reference's
+   TransferTable -> GroupCastArg pipeline),
+4. a per-rank Pallas entry table over the rank-local [own | received] KV
+   buffer, built directly in global mask coordinates via run translation
+   (ops/block_meta.py) — this replaces slice_maker's host/remote sub-mask
+   case analysis entirely.
+
+The hot path is ONE jittable SPMD function per plan: group_cast KV (a padded
+all_to_all over the cp axis) -> local flex-flash-attention kernel. Because
+group_cast is built from differentiable gather/scatter ops, autodiff of the
+whole function yields exactly the reference's backward comm pattern —
+group_reduce(sum) of dKV partials to owners — with no hand-written
+collective transpose. Overlap scheduling is delegated to XLA's async
+collectives (replacing sm_margin / KernelBarrier stream plumbing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.range import AttnRange
+from ..common.ranges import AttnRanges
+from ..comm.group_collective import GroupCollectiveMeta, group_cast
+from ..meta.containers import AttnBucket
+from ..meta.dispatch_meta import DispatchMeta
+from ..ops.block_meta import (
+    Run,
+    build_block_meta_general,
+    pad_block_meta,
+    runs_from_position_ids,
+)
+from ..ops.flex_attn import FlexAttnParams, flex_attn_headmajor
+
+
+def _round_up(a: int, b: int) -> int:
+    return -(-a // b) * b
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class DistAttnPlan:
+    """Host-side plan for one (mask, dispatch, blocking) combination.
+
+    All stacked arrays have leading cp axis; placed sharded on the cp mesh
+    axis, each rank reads its own row inside shard_map.
+    """
+
+    cp_size: int
+    shard_q_len: int  # rank-local q rows (uniform)
+    shard_q_pad: int  # padded to block_q multiple
+    kv_buf_len: int  # own shard + padded remote rows
+    kv_buf_pad: int  # padded to block_k multiple
+    block_q: int
+    block_k: int
+    comm: GroupCollectiveMeta  # K/V row routing
+    total_area: int  # global mask area (FLOPs accounting)
+    max_rank_area: int  # load-balance diagnostic
+
+    # stacked per-rank kernel tables (numpy int32)
+    fwd_qblk: np.ndarray  # [cp, E]
+    fwd_kblk: np.ndarray
+    fwd_sid: np.ndarray
+    fwd_runs: np.ndarray  # [cp, E*RUN_FIELDS]
+    bwd_kblk: np.ndarray  # [cp, E2]
+    bwd_qblk: np.ndarray
+    bwd_sid: np.ndarray
+    bwd_runs: np.ndarray
+    bounds: np.ndarray  # [cp, (S_max+1)*SLICE_FIELDS]
+
+    def device_tables(self):
+        """All sharded operands for the SPMD runtime fn, leading cp axis."""
+        return tuple(
+            jnp.asarray(a)
+            for a in (
+                self.fwd_qblk,
+                self.fwd_kblk,
+                self.fwd_sid,
+                self.fwd_runs,
+                self.bwd_kblk,
+                self.bwd_qblk,
+                self.bwd_sid,
+                self.bwd_runs,
+                self.bounds,
+                self.comm.send_idx,
+                self.comm.recv_sel,
+                self.comm.recv_valid,
+            )
+        )
+
+
+def build_dist_attn_plan(
+    dispatch_meta: DispatchMeta,
+    bucket: AttnBucket,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> DistAttnPlan:
+    """Plan the distributed attention for one dispatched mask (self-attn)."""
+    cp = dispatch_meta.cp_size
+    shard_len = dispatch_meta.shard_seqlen
+    chunk_size = dispatch_meta.chunk_size
+
+    # per-rank host geometry
+    pos_ids = [dispatch_meta.position_ids(r) for r in range(cp)]
+    host_ranges = dispatch_meta.host_ranges_per_rank()
+
+    # per-rank slices (global coords) from the rank's chunks
+    slices_per_rank: list[np.ndarray] = []
+    needed_k: list[AttnRanges] = []
+    for r in range(cp):
+        rows = []
+        ks = AttnRanges()
+        for c in dispatch_meta.partitions[r]:
+            for s in bucket.q_chunks[c].attn_slices:
+                rows.append(
+                    (
+                        s.q_range.start,
+                        s.q_range.end,
+                        s.k_range.start,
+                        s.k_range.end,
+                        int(s.mask_type),
+                    )
+                )
+                ks.append(s.k_range.clone())
+        slices_per_rank.append(
+            np.asarray(rows, dtype=np.int64).reshape(-1, 5)
+        )
+        needed_k.append(ks.merge())
+
+    # zero-redundancy remote sets + send routing (owner s -> consumer d)
+    remote_k = [
+        needed_k[r].find_hole_ranges(host_ranges[r]) for r in range(cp)
+    ]
+    send_map: list[list[np.ndarray]] = [
+        [np.empty(0, np.int64) for _ in range(cp)] for _ in range(cp)
+    ]
+    recv_runs_per_rank: list[list[tuple[int, list[Run]]]] = [
+        [] for _ in range(cp)
+    ]
+    for d in range(cp):
+        for s in range(cp):
+            if s == d:
+                continue
+            inter = remote_k[d].find_overlap_ranges(host_ranges[s])
+            if inter.is_empty():
+                continue
+            # owner-local rows, in ascending owner-local order
+            local = host_ranges[s].make_ranges_local(inter, is_self_merged=True)
+            order = sorted(range(len(local)), key=lambda i: local[i].start)
+            idx_parts = [
+                np.arange(local[i].start, local[i].end, dtype=np.int64)
+                for i in order
+            ]
+            send_map[s][d] = (
+                np.concatenate(idx_parts) if idx_parts else np.empty(0, np.int64)
+            )
+            # global ids of those rows, same order, for the dst's run layout
+            recv_runs_per_rank[d].append((s, pos_ids[s][send_map[s][d]]))
+
+    comm = GroupCollectiveMeta.build(send_map, [shard_len] * cp)
+
+    # rank-local KV buffer layout: [own shard | received rows (padded)]
+    kv_buf_len = shard_len + comm.max_recv
+    shard_q_pad = _round_up(shard_len, block_q)
+    kv_buf_pad = _round_up(kv_buf_len, block_k)
+
+    rank_metas = [
+        build_block_meta_general(
+            slices_per_rank[r],
+            runs_from_position_ids(pos_ids[r]),
+            _rank_k_runs(r, pos_ids, shard_len, send_map, recv_runs_per_rank),
+            shard_q_pad,
+            kv_buf_pad,
+            block_q=block_q,
+            block_k=block_k,
+        )
+        for r in range(cp)
+    ]
+    # uniform table shapes across ranks (SPMD)
+    e_max = max(m.num_fwd_entries for m in rank_metas)
+    e2_max = max(m.num_bwd_entries for m in rank_metas)
+    s_max = max(m.num_slices for m in rank_metas)
+    rank_metas = [
+        pad_block_meta(m, e_max, e2_max, s_max) for m in rank_metas
+    ]
+
+    return DistAttnPlan(
+        cp_size=cp,
+        shard_q_len=shard_len,
+        shard_q_pad=shard_q_pad,
+        kv_buf_len=kv_buf_len,
+        kv_buf_pad=kv_buf_pad,
+        block_q=block_q,
+        block_k=block_k,
+        comm=comm,
+        total_area=bucket.area,
+        max_rank_area=max(m.total_area for m in rank_metas),
+        fwd_qblk=np.stack([m.fwd_q_block for m in rank_metas]),
+        fwd_kblk=np.stack([m.fwd_k_block for m in rank_metas]),
+        fwd_sid=np.stack([m.fwd_slice_id for m in rank_metas]),
+        fwd_runs=np.stack([m.fwd_runs for m in rank_metas]),
+        bwd_kblk=np.stack([m.bwd_k_block for m in rank_metas]),
+        bwd_qblk=np.stack([m.bwd_q_block for m in rank_metas]),
+        bwd_sid=np.stack([m.bwd_slice_id for m in rank_metas]),
+        bwd_runs=np.stack([m.bwd_runs for m in rank_metas]),
+        bounds=np.stack([m.slice_bounds for m in rank_metas]),
+    )
+
+
+def _rank_k_runs(r, pos_ids, shard_len, send_map, recv_runs_per_rank):
+    q_runs = runs_from_position_ids(pos_ids[r])
+    k_runs = list(q_runs)
+    for s, gids in recv_runs_per_rank[r]:
+        off = 0
+        for s2 in range(s):
+            off += len(send_map[s2][r])
+        for run in runs_from_position_ids(gids):
+            k_runs.append(
+                Run(
+                    local_start=shard_len + off + run.local_start,
+                    global_start=run.global_start,
+                    length=run.length,
+                )
+            )
+    return k_runs
+
+
+def make_attn_params(
+    plan: DistAttnPlan,
+    head_dim: int,
+    *,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    has_sink: bool = False,
+    out_dtype="bfloat16",
+    interpret: bool | None = None,
+) -> FlexAttnParams:
+    if scale is None:
+        scale = 1.0 / math.sqrt(head_dim)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return FlexAttnParams(
+        block_q=plan.block_q,
+        block_k=plan.block_k,
+        scale=float(scale),
+        softcap=float(softcap),
+        has_sink=has_sink,
+        out_dtype=str(jnp.dtype(out_dtype)),
+        interpret=bool(interpret),
+    )
+
+
+def dist_attn_local(
+    q: jax.Array,  # [shard_q_len, hq, d] rank-local dispatched q
+    k: jax.Array,  # [shard_q_len, hk, d]
+    v: jax.Array,
+    tables,  # the 12 per-rank table slices (leading dim 1) from device_tables
+    plan: DistAttnPlan,
+    params: FlexAttnParams,
+    *,
+    axis_name: str = "cp",
+    sink: jax.Array | None = None,
+):
+    """The SPMD hot path — call inside shard_map over the cp axis.
+
+    group_cast remote KV -> concat local buffer -> Pallas flex kernel.
+    Fully differentiable (autodiff produces the dKV group_reduce).
+    Returns (out [shard_q_len, hq, d], lse [shard_q_len, hq]).
+    """
+    (
+        fq,
+        fk,
+        fs,
+        fr,
+        bk_,
+        bq_,
+        bs_,
+        br_,
+        bo,
+        send_idx,
+        recv_sel,
+        recv_valid,
+    ) = tables
+    # one all_to_all for both K and V: rows [t, 2, hk, d]
+    kv = jnp.stack([k, v], axis=1)
+    recv = group_cast(kv, send_idx, recv_sel, recv_valid, axis_name=axis_name)
+    k_full = jnp.concatenate([k, recv[:, 0]], axis=0)  # [kv_buf_len, hk, d]
+    v_full = jnp.concatenate([v, recv[:, 1]], axis=0)
+
+    # head-major + block padding
+    def hm(x, target):
+        x = jnp.transpose(x, (1, 0, 2))
+        pad = target - x.shape[1]
+        if pad > 0:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        return x
+
+    qh = hm(q, plan.shard_q_pad)
+    kh = hm(k_full, plan.kv_buf_pad)
+    vh = hm(v_full, plan.kv_buf_pad)
+
+    ftab = (fq[0], fk[0], fs[0], fr[0], bo[0])
+    btab = (bk_[0], bq_[0], bs_[0], br_[0], bo[0])
+    out_h, lse_lanes, _ = flex_attn_headmajor(
+        qh, kh, vh, ftab, btab, params, sink=sink
+    )
+    out = jnp.transpose(out_h, (1, 0, 2))[: plan.shard_q_len]
+    lse = jnp.transpose(lse_lanes[:, :, 0], (1, 0))[: plan.shard_q_len]
+    return out, lse
+
+
+def make_dist_attn_fn(
+    plan: DistAttnPlan,
+    mesh: jax.sharding.Mesh,
+    params: FlexAttnParams,
+    *,
+    axis_name: str = "cp",
+    sink: jax.Array | None = None,  # [hq] learned sink logits (replicated)
+):
+    """Convenience: a jittable fn over *dispatched global* arrays.
+
+    Inputs/outputs are [total_tokens, heads, d] arrays sharded P(axis_name)
+    along tokens (the dispatch layout). Suitable for direct use or as a
+    building block inside a larger pjit'd train step.
+    """
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert params.has_sink == (sink is not None), (
+        "params.has_sink must match whether a sink array is provided"
+    )
+    tables = plan.device_tables()
+    tables = tuple(
+        jax.device_put(t, NamedSharding(mesh, P(axis_name)))
+        for t in tables
+    )
+    n_tab = len(tables)
+    sink_specs = (P(),) if sink is not None else ()
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name))
+        + (P(axis_name),) * n_tab
+        + sink_specs,
+        out_specs=(P(axis_name), P(axis_name)),
+        # pallas_call out_shapes carry no vma info; skip the static check
+        check_vma=False,
+    )
+    def _local(q, k, v, *rest):
+        tabs = rest[:n_tab]
+        s = rest[n_tab] if len(rest) > n_tab else None
+        return dist_attn_local(
+            q, k, v, tabs, plan, params, axis_name=axis_name, sink=s
+        )
+
+    def fn(q, k, v):
+        extra = (sink,) if sink is not None else ()
+        return _local(q, k, v, *tables, *extra)
+
+    return fn
