@@ -1,0 +1,101 @@
+"""Round benchmark: flex-flash-attention on the real TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: forward TFLOPs/s of the Pallas flex-flash-attention kernel on the
+BASELINE config-1 shape (4k dense causal, head_dim 128, bf16, GQA 8 heads).
+vs_baseline: ratio against jax's own official TPU flash-attention kernel
+(jax.experimental.pallas.ops.tpu.flash_attention) on the SAME chip and
+shape — the TPU analogue of the reference's "FFA is comparable to FA3"
+headline (cp_benchmark.md:78-86).
+
+Timing note: through the axon tunnel, block_until_ready does not fully
+synchronize; a scalar host readback does, so every timed region ends with
+one.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _timeit(fn, *args, n=20):
+    import jax.numpy as jnp
+
+    r = fn(*args)
+    _ = float(jnp.sum(r))  # sync
+    t0 = time.time()
+    for _i in range(n):
+        r = fn(*args)
+    _ = float(jnp.sum(r))  # sync
+    return (time.time() - t0) / n
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from magiattention_tpu.ops import flex_flash_attn_func
+
+    tq = 4096
+    hq = hk = 8
+    d = 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((tq, hq, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((tq, hk, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((tq, hk, d)), jnp.bfloat16)
+    qr, kr, ts = [(0, tq)], [(0, tq)], [1]  # dense causal
+
+    area = tq * (tq + 1) // 2
+    flops = 4 * area * hq * d
+
+    fwd = jax.jit(
+        lambda q, k, v: flex_flash_attn_func(
+            q, k, v, qr, kr, ts, block_q=256, block_k=512
+        )[0]
+    )
+    dt = _timeit(fwd, q, k, v)
+    tflops = flops / dt / 1e12
+    print(f"flex fwd: {dt*1e3:.2f} ms  {tflops:.2f} TFLOPs/s", file=sys.stderr)
+
+    # baseline: jax official TPU flash attention, causal, same shape
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention,
+        )
+
+        qb = q.transpose(1, 0, 2)[None]  # [1, h, t, d]
+        kb = k.transpose(1, 0, 2)[None]
+        vb = v.transpose(1, 0, 2)[None]
+        ref = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=True)
+        )
+        dt_ref = _timeit(ref, qb, kb, vb)
+        ref_tflops = flops / dt_ref / 1e12
+        print(
+            f"jax flash: {dt_ref*1e3:.2f} ms  {ref_tflops:.2f} TFLOPs/s",
+            file=sys.stderr,
+        )
+        vs = tflops / ref_tflops
+    except Exception as e:  # pragma: no cover
+        print(f"baseline kernel failed: {e}", file=sys.stderr)
+        vs = 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "flex_attn_fwd_tflops_4k_causal_bf16",
+                "value": round(tflops, 3),
+                "unit": "TFLOPs/s",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

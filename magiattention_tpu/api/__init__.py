@@ -1,0 +1,49 @@
+"""Public API: key-cached distributed flex attention."""
+
+from .functools import (
+    apply_padding,
+    compute_pad_size,
+    full_attention_mask,
+    infer_attn_mask_from_cu_seqlens,
+    infer_attn_mask_from_sliding_window,
+    infer_varlen_mask_from_batch,
+    pad_at_dim,
+    squash_batch_dim,
+    unpad_at_dim,
+)
+from .interface import (
+    DistAttnRuntimeDict,
+    DistAttnRuntimeKey,
+    DistAttnRuntimeMgr,
+    calc_attn,
+    dispatch,
+    get_most_recent_key,
+    get_position_ids,
+    get_runtime_mgr,
+    magi_attn_flex_key,
+    magi_attn_varlen_key,
+    undispatch,
+)
+
+__all__ = [
+    "DistAttnRuntimeDict",
+    "DistAttnRuntimeKey",
+    "DistAttnRuntimeMgr",
+    "apply_padding",
+    "calc_attn",
+    "compute_pad_size",
+    "dispatch",
+    "full_attention_mask",
+    "get_most_recent_key",
+    "get_position_ids",
+    "get_runtime_mgr",
+    "infer_attn_mask_from_cu_seqlens",
+    "infer_attn_mask_from_sliding_window",
+    "infer_varlen_mask_from_batch",
+    "magi_attn_flex_key",
+    "magi_attn_varlen_key",
+    "pad_at_dim",
+    "squash_batch_dim",
+    "undispatch",
+    "unpad_at_dim",
+]

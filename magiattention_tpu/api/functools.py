@@ -1,0 +1,140 @@
+"""Mask-construction helpers (reference ``magi_attention/api/functools.py``).
+
+Pure host-side utilities that turn common training-data descriptions
+(batches, cu_seqlens, sliding windows) into (q_ranges, k_ranges, mask types)
+plus padding helpers for the chunked dispatch layout.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.enum import AttnMaskType
+from ..common.ranges import AttnRanges
+
+
+def compute_pad_size(
+    total_seqlen_q: int, cp_size: int, chunk_size: int
+) -> int:
+    """Tokens to append so the sequence splits into whole chunks per rank
+    (reference api/functools.py compute_pad_size)."""
+    block = cp_size * chunk_size
+    return (-total_seqlen_q) % block
+
+
+def pad_at_dim(
+    x: jax.Array, dim: int, pad_size: int, value: float = 0.0
+) -> jax.Array:
+    if pad_size <= 0:
+        return x
+    cfg = [(0, 0)] * x.ndim
+    cfg[dim] = (0, pad_size)
+    return jnp.pad(x, cfg, constant_values=value)
+
+
+def unpad_at_dim(x: jax.Array, dim: int, orig_size: int) -> jax.Array:
+    return jax.lax.slice_in_dim(x, 0, orig_size, axis=dim)
+
+
+def apply_padding(
+    q_ranges: AttnRanges,
+    k_ranges: AttnRanges,
+    attn_mask_type: Sequence[AttnMaskType],
+    total_seqlen: int,
+    pad_size: int,
+):
+    """Extend the mask description over padded tokens: pad rows attend
+    nothing (no new slices; the kernel yields out=0 / lse=-inf there)."""
+    return (
+        q_ranges,
+        k_ranges,
+        list(attn_mask_type),
+        total_seqlen + pad_size,
+    )
+
+
+def squash_batch_dim(x: jax.Array) -> jax.Array:
+    """[b, s, ...] -> [b*s, ...] token-major packing (reference squash)."""
+    return x.reshape((-1,) + x.shape[2:])
+
+
+def full_attention_mask(total_seqlen: int):
+    q = AttnRanges.from_ranges([(0, total_seqlen)])
+    return q, q.clone(), [AttnMaskType.FULL]
+
+
+def infer_varlen_mask_from_batch(
+    batch_seqlens: Sequence[int], causal: bool = True
+):
+    """Per-sample (self-)attention ranges from a list of sample lengths."""
+    cu = np.concatenate([[0], np.cumsum(np.asarray(batch_seqlens))])
+    return infer_attn_mask_from_cu_seqlens(cu.tolist(), causal=causal)
+
+
+def infer_attn_mask_from_cu_seqlens(
+    cu_seqlens: Sequence[int], causal: bool = True
+):
+    """(q_ranges, k_ranges, types) for a packed varlen batch."""
+    total = int(cu_seqlens[-1])
+    q = AttnRanges.from_cu_seqlens(list(cu_seqlens), total)
+    mt = AttnMaskType.CAUSAL if causal else AttnMaskType.FULL
+    return q, q.clone(), [mt] * len(q)
+
+
+def infer_attn_mask_from_sliding_window(
+    total_seqlen: int,
+    window_size: int,
+    causal: bool = True,
+    global_tokens: int = 0,
+):
+    """Exact causal sliding-window attention as slices: row q attends keys
+    [q - window_size + 1, q] (+ optional ``global_tokens`` prefix).
+
+    Decomposition (the same bi-causal trick as the reference's
+    infer_attn_mask_from_sliding_window, api/functools.py:180, expressed per
+    band): with band width w = window_size,
+    - band 0 rows [0, w): one CAUSAL slice over k [0, band_end) —
+      bottom-right alignment gives exactly k <= q;
+    - band i >= 1 rows [iw, e): one BICAUSAL slice over k [iw - (w-1), e):
+      its inv-causal bound gives k >= q - (w-1), its causal bound k <= q —
+      the exact window, with physical bounds (no clamping needed).
+    """
+    assert causal, "bidirectional SWA not yet supported"
+    from ..common.range import AttnRange
+
+    w = window_size
+    gt = global_tokens
+    q_ranges = AttnRanges()
+    k_ranges = AttnRanges()
+    types: list[AttnMaskType] = []
+    n_bands = -(-total_seqlen // w)
+    for i in range(n_bands):
+        qs, qe = i * w, min((i + 1) * w, total_seqlen)
+        if i == 0:
+            q_ranges.append(AttnRange(qs, qe))
+            k_ranges.append(AttnRange(0, qe))
+            types.append(AttnMaskType.CAUSAL)
+            continue
+        q_ranges.append(AttnRange(qs, qe))
+        k_ranges.append(AttnRange(qs - (w - 1), qe))
+        types.append(AttnMaskType.BICAUSAL)
+        if gt <= 0:
+            continue
+        # global prefix = [0, gt) minus the row's own window [q-w+1, q]:
+        # rows with q - w + 1 <= gt (q < q*) attend [0, q - w + 1) — a
+        # CAUSAL slice aligned so k <= q - w; rows q >= q* attend [0, gt)
+        q_star = min(max(gt + w - 1, qs), qe)
+        if q_star > qs and q_star - w > 0:
+            # bottom-right align (q1=q_star, k1=q_star-w) gives k <= q - w
+            q_ranges.append(AttnRange(qs, q_star))
+            k_ranges.append(AttnRange(0, q_star - w))
+            types.append(AttnMaskType.CAUSAL)
+        if q_star < qe:
+            q_ranges.append(AttnRange(q_star, qe))
+            k_ranges.append(AttnRange(0, gt))
+            types.append(AttnMaskType.FULL)
+    return q_ranges, k_ranges, types

@@ -1,0 +1,327 @@
+"""User-facing key-cached interface.
+
+Role of reference ``magi_attention/api/magi_attn_interface.py`` +
+``dist_attn_runtime_mgr.py``: all expensive planning (dispatch solve, hole
+ranges, comm routing, kernel entry tables, pjit tracing) happens once per
+unique (mask, shapes, mesh, flags) under a frozen hashable
+:class:`DistAttnRuntimeKey`; the hot path is dictionary lookups + jitted
+calls.
+
+Typical flow::
+
+    key = magi_attn_varlen_key(cu_seqlens, total, mesh, num_heads=(hq, hk),
+                               head_dim=d)
+    xq = dispatch(x, key)                       # global -> cp-sharded layout
+    out = calc_attn(q, k, v, key)[0]            # distributed flex attention
+    y = undispatch(out, key)                    # back to natural order
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import env
+from ..common.enum import AttnMaskType
+from ..common.ranges import AttnRanges
+from ..meta.dispatch_meta import DispatchMeta, make_dispatch_meta_from_qk_ranges
+from ..meta.solver.dispatch_solver import DispatchConfig
+from ..parallel.dist_attn import (
+    DistAttnPlan,
+    build_dist_attn_plan,
+    make_attn_params,
+    make_dist_attn_fn,
+)
+from ..parallel.dispatch import dispatch as _dispatch_op
+from ..parallel.dispatch import undispatch as _undispatch_op
+from .functools import compute_pad_size, pad_at_dim
+
+
+@dataclasses.dataclass(frozen=True)
+class DistAttnRuntimeKey:
+    """Frozen hash key for one planned runtime
+    (reference dist_attn_runtime_mgr.py:61-119; env flags folded in)."""
+
+    q_ranges: tuple[tuple[int, int], ...]
+    k_ranges: tuple[tuple[int, int], ...]
+    attn_type_map: tuple[int, ...]
+    total_seqlen_q: int
+    total_seqlen_k: int
+    pad_size: int
+    chunk_size: int
+    cp_size: int
+    cp_axis: str
+    num_heads_q: int
+    num_heads_kv: int
+    head_dim: int
+    softcap: float
+    has_sink: bool
+    sink_fingerprint: int  # hash of the sink values (0 when no sink)
+    out_dtype: str
+    dispatch_config_repr: str  # planning algorithm choice
+    interpret: Optional[bool]
+    mesh_id: int  # id() of the mesh (meshes aren't hashable by value)
+    flags: tuple
+
+
+class DistAttnRuntimeMgr:
+    """Holds everything planned for one key: dispatch meta, plan, jitted fns
+    (reference DistAttnRuntimeMgr, :122-407)."""
+
+    def __init__(
+        self,
+        key: DistAttnRuntimeKey,
+        mesh: jax.sharding.Mesh,
+        dispatch_meta: DispatchMeta,
+        plan: DistAttnPlan,
+        attn_fn,
+    ):
+        self.key = key
+        self.mesh = mesh
+        self.dispatch_meta = dispatch_meta
+        self.plan = plan
+        self._attn_fn = attn_fn
+
+    # -- data movement -----------------------------------------------------
+
+    def dispatch(self, x: jax.Array, pad_value: float = 0.0) -> jax.Array:
+        """Global natural-order [total, ...] -> dispatched order (pad+permute).
+
+        Shard the result P(cp_axis) along tokens for the rank-local layout.
+        """
+        if self.key.pad_size:
+            x = pad_at_dim(x, 0, self.key.pad_size, pad_value)
+        return _dispatch_op(x, self.dispatch_meta)
+
+    def undispatch(self, y: jax.Array) -> jax.Array:
+        """Dispatched order -> global natural order (pad rows dropped)."""
+        out = _undispatch_op(y, self.dispatch_meta)
+        if self.key.pad_size:
+            out = out[: self.key.total_seqlen_q - self.key.pad_size]
+        return out
+
+    def get_position_ids(self) -> jax.Array:
+        """Global position of each dispatched slot [total_padded] int32."""
+        return jnp.asarray(self.dispatch_meta.perm_idx)
+
+    # -- attention ---------------------------------------------------------
+
+    def calc_attn(self, q, k, v):
+        """Distributed flex attention on dispatched tensors.
+
+        q [total_padded, hq, d], k/v [total_padded, hk, d] in dispatch order
+        (sharded P(cp_axis) or to-be-sharded). Returns (out, lse) in the same
+        layout. A sink, if any, was baked in at key-creation time (its values
+        are part of the cache key; pass updated sinks by re-keying).
+        """
+        return self._attn_fn(q, k, v)
+
+
+class DistAttnRuntimeDict:
+    """LRU key -> mgr cache (reference DistAttnRuntimeDict :410-449)."""
+
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._d: OrderedDict[DistAttnRuntimeKey, DistAttnRuntimeMgr] = (
+            OrderedDict()
+        )
+
+    def get(self, key: DistAttnRuntimeKey) -> Optional[DistAttnRuntimeMgr]:
+        mgr = self._d.get(key)
+        if mgr is not None:
+            self._d.move_to_end(key)
+        return mgr
+
+    def put(self, key: DistAttnRuntimeKey, mgr: DistAttnRuntimeMgr) -> None:
+        self._d[key] = mgr
+        self._d.move_to_end(key)
+        while len(self._d) > self.maxsize:
+            self._d.popitem(last=False)
+
+    def __contains__(self, key) -> bool:
+        return key in self._d
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+_runtime_dict = DistAttnRuntimeDict(maxsize=env.runtime_dict_size())
+_most_recent_key: Optional[DistAttnRuntimeKey] = None
+
+
+def get_runtime_mgr(key: DistAttnRuntimeKey) -> DistAttnRuntimeMgr:
+    mgr = _runtime_dict.get(key)
+    if mgr is None:
+        raise KeyError(
+            f"no runtime planned for this key (cache evicted?): {key}"
+        )
+    return mgr
+
+
+def get_most_recent_key() -> DistAttnRuntimeKey:
+    """The key most recently created (reference get_most_recent_key — the
+    HF-integration hook where the attention module can't thread the key)."""
+    assert _most_recent_key is not None, "no key has been created yet"
+    return _most_recent_key
+
+
+def magi_attn_flex_key(
+    q_ranges: AttnRanges | Sequence[Sequence[int]],
+    k_ranges: AttnRanges | Sequence[Sequence[int]],
+    attn_type_map: Sequence[AttnMaskType | int],
+    total_seqlen_q: int,
+    total_seqlen_k: int,
+    mesh: jax.sharding.Mesh,
+    *,
+    num_heads: tuple[int, int],  # (hq, hkv)
+    head_dim: int,
+    cp_axis: str = "cp",
+    chunk_size: int | None = None,
+    softcap: float = 0.0,
+    has_sink: bool = False,
+    sink: jax.Array | None = None,
+    out_dtype="bfloat16",
+    dispatch_config: DispatchConfig | None = None,
+    interpret: bool | None = None,
+) -> DistAttnRuntimeKey:
+    """Plan (or fetch from cache) a distributed flex-attention runtime
+    (reference magi_attn_flex_key, api/magi_attn_interface.py:440).
+
+    The mask may have any (q_range, k_range, mask_type) slice list with
+    disjoint (q, k) coverage. The sequence is padded so chunks divide evenly
+    (reference compute_pad_size/apply_padding, :663-676).
+    """
+    assert total_seqlen_q == total_seqlen_k, (
+        "self-attention interface requires equal q/k seqlens"
+    )
+    global _most_recent_key
+    if not isinstance(q_ranges, AttnRanges):
+        q_ranges = AttnRanges.from_ranges(q_ranges)
+    if not isinstance(k_ranges, AttnRanges):
+        k_ranges = AttnRanges.from_ranges(k_ranges)
+    types = tuple(int(t) for t in attn_type_map)
+    cp_size = mesh.shape[cp_axis]
+
+    if chunk_size is None:
+        # auto: total / (min_chunks_per_rank * cp), floored to a sane block
+        chunk_size = max(
+            total_seqlen_q // (env.min_chunks_per_rank() * cp_size), 128
+        )
+    pad = compute_pad_size(total_seqlen_q, cp_size, chunk_size)
+    hq, hkv = num_heads
+    has_sink = has_sink or sink is not None
+    assert not (has_sink and sink is None), (
+        "has_sink=True requires the sink array at key-creation time"
+    )
+    sink_fp = (
+        hash(np.asarray(jax.device_get(sink), np.float32).tobytes())
+        if sink is not None
+        else 0
+    )
+
+    key = DistAttnRuntimeKey(
+        q_ranges=tuple(q_ranges.to_naive_ranges()),
+        k_ranges=tuple(k_ranges.to_naive_ranges()),
+        attn_type_map=types,
+        total_seqlen_q=total_seqlen_q + pad,
+        total_seqlen_k=total_seqlen_k + pad,
+        pad_size=pad,
+        chunk_size=chunk_size,
+        cp_size=cp_size,
+        cp_axis=cp_axis,
+        num_heads_q=hq,
+        num_heads_kv=hkv,
+        head_dim=head_dim,
+        softcap=float(softcap),
+        has_sink=has_sink,
+        sink_fingerprint=sink_fp,
+        out_dtype=str(jnp.dtype(out_dtype)),
+        dispatch_config_repr=repr(dispatch_config),
+        interpret=interpret,
+        mesh_id=id(mesh),
+        flags=env.flags_fingerprint(),
+    )
+    if key in _runtime_dict:
+        _most_recent_key = key
+        return key
+
+    # cold path: full planning
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        q_ranges,
+        k_ranges,
+        [AttnMaskType(t) for t in types],
+        total_seqlen_q + pad,
+        total_seqlen_k + pad,
+        chunk_size=chunk_size,
+        cp_size=cp_size,
+        dispatch_config=dispatch_config,
+    )
+    plan = build_dist_attn_plan(
+        mq, bucket, block_q=env.block_q(), block_k=env.block_k()
+    )
+    params = make_attn_params(
+        plan,
+        head_dim,
+        softcap=softcap,
+        has_sink=has_sink,
+        out_dtype=out_dtype,
+        interpret=interpret,
+    )
+    attn_fn = make_dist_attn_fn(
+        plan, mesh, params, axis_name=cp_axis, sink=sink
+    )
+    mgr = DistAttnRuntimeMgr(key, mesh, mq, plan, attn_fn)
+    _runtime_dict.put(key, mgr)
+    _most_recent_key = key
+    return key
+
+
+def magi_attn_varlen_key(
+    cu_seqlens: Sequence[int],
+    total_seqlen: int,
+    mesh: jax.sharding.Mesh,
+    *,
+    causal: bool = True,
+    **kwargs,
+) -> DistAttnRuntimeKey:
+    """Varlen (packed-batch) convenience key
+    (reference magi_attn_varlen_key :160)."""
+    from .functools import infer_attn_mask_from_cu_seqlens
+
+    q_ranges, k_ranges, types = infer_attn_mask_from_cu_seqlens(
+        list(cu_seqlens), causal=causal
+    )
+    return magi_attn_flex_key(
+        q_ranges,
+        k_ranges,
+        types,
+        total_seqlen,
+        total_seqlen,
+        mesh,
+        **kwargs,
+    )
+
+
+def dispatch(x: jax.Array, key: DistAttnRuntimeKey, pad_value: float = 0.0):
+    """Reference api.dispatch :887."""
+    return get_runtime_mgr(key).dispatch(x, pad_value)
+
+
+def undispatch(y: jax.Array, key: DistAttnRuntimeKey):
+    """Reference api.undispatch :924."""
+    return get_runtime_mgr(key).undispatch(y)
+
+
+def calc_attn(q, k, v, key: DistAttnRuntimeKey):
+    """Reference api.calc_attn :1041 — returns (out, lse)."""
+    return get_runtime_mgr(key).calc_attn(q, k, v)
+
+
+def get_position_ids(key: DistAttnRuntimeKey):
+    """Reference api.get_position_ids :1112."""
+    return get_runtime_mgr(key).get_position_ids()

@@ -1,0 +1,77 @@
+"""Environment-variable flags (reference ``magi_attention/env/``).
+
+Same MAGI_ATTENTION_* names where the concept survives on TPU; CUDA-specific
+flags (sm margins, NVSHMEM buffers, JIT build dirs) are intentionally absent
+— XLA's async scheduler and AOT compilation replace them. Flags that
+influence planning are folded into DistAttnRuntimeKey hashing (reference
+dist_attn_runtime_mgr.py:61-119) via :func:`flags_fingerprint`.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v is not None else default
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def log_level() -> str:
+    return _env_str("MAGI_ATTENTION_LOG_LEVEL", "WARNING")
+
+
+def is_sanity_check_enabled() -> bool:
+    """Deep invariant checks in the planners (reference env/general.py:75)."""
+    return _env_bool("MAGI_ATTENTION_SANITY_CHECK")
+
+
+def is_deterministic_mode_enabled() -> bool:
+    """Informational on TPU: the entry-table kernels are deterministic by
+    construction (sequential grid, no atomics) — the property the reference
+    needs range-locks/conflict-ordering to achieve (env/general.py:181)."""
+    return _env_bool("MAGI_ATTENTION_DETERMINISTIC_MODE")
+
+
+def min_chunks_per_rank() -> int:
+    """Auto chunk-size resolution divisor (reference env/general.py, =8)."""
+    return _env_int("MAGI_ATTENTION_MIN_CHUNKS_PER_RANK", 8)
+
+
+def runtime_dict_size() -> int:
+    """LRU capacity of the runtime-key cache (reference env/general.py)."""
+    return _env_int("MAGI_ATTENTION_RUNTIME_DICT_SIZE", 100)
+
+
+def kernel_backend() -> str:
+    """'pallas' (TPU production) or 'jnp' (any-platform reference path)."""
+    return _env_str("MAGI_ATTENTION_KERNEL_BACKEND", "pallas").lower()
+
+
+def block_q() -> int:
+    return _env_int("MAGI_ATTENTION_BLOCK_Q", 128)
+
+
+def block_k() -> int:
+    return _env_int("MAGI_ATTENTION_BLOCK_K", 128)
+
+
+def flags_fingerprint() -> tuple:
+    """The behavior-influencing flags, folded into runtime-key hashing."""
+    return (
+        is_deterministic_mode_enabled(),
+        kernel_backend(),
+        block_q(),
+        block_k(),
+    )

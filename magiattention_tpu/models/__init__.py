@@ -1,0 +1,5 @@
+"""Model families built on the framework."""
+
+from .llama import LlamaConfig, MagiLlama, build_magi_llama, init_params
+
+__all__ = ["LlamaConfig", "MagiLlama", "build_magi_llama", "init_params"]

@@ -1,0 +1,334 @@
+"""Llama-style decoder trained with context-parallel flex attention.
+
+Role of reference ``examples/torch_native/main.py`` (Llama-3 1B FSDP+CP
+trainer), re-designed TPU-first: the whole transformer runs inside one
+``shard_map`` over a (dp, cp) mesh — parameters replicated, tokens sharded on
+cp, batch on dp — with the attention layers calling the framework's
+``dist_attn_local`` hot path. RoPE uses the dispatch position ids, so the
+chunk-permuted token layout is transparent to the model.
+
+Pure-jax (params = pytree), so the train step is a single jit: autodiff
+through shard_map inserts the parameter-gradient psums and the dKV
+group-reduce automatically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.dist_attn import (
+    DistAttnPlan,
+    dist_attn_local,
+    make_attn_params,
+)
+from ..ops.flex_attn import FlexAttnParams
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 2048
+    n_layers: int = 16
+    n_heads: int = 16
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    ffn_hidden: int = 5632
+    rope_theta: float = 500000.0
+    dtype: str = "bfloat16"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> dict:
+    """Parameter pytree (fp32 master weights)."""
+    keys = jax.random.split(rng, cfg.n_layers + 2)
+
+    def dense(key, shape, scale=None):
+        scale = scale or (1.0 / np.sqrt(shape[0]))
+        return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[i], 7)
+        layers.append(
+            {
+                "wq": dense(k[0], (cfg.dim, cfg.n_heads * cfg.head_dim)),
+                "wk": dense(k[1], (cfg.dim, cfg.n_kv_heads * cfg.head_dim)),
+                "wv": dense(k[2], (cfg.dim, cfg.n_kv_heads * cfg.head_dim)),
+                "wo": dense(k[3], (cfg.n_heads * cfg.head_dim, cfg.dim)),
+                "w_gate": dense(k[4], (cfg.dim, cfg.ffn_hidden)),
+                "w_up": dense(k[5], (cfg.dim, cfg.ffn_hidden)),
+                "w_down": dense(k[6], (cfg.ffn_hidden, cfg.dim)),
+                "attn_norm": jnp.ones((cfg.dim,), jnp.float32),
+                "mlp_norm": jnp.ones((cfg.dim,), jnp.float32),
+            }
+        )
+    return {
+        "embed": dense(keys[-2], (cfg.vocab_size, cfg.dim), scale=0.02),
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.dim,), jnp.float32),
+        "lm_head": dense(keys[-1], (cfg.dim, cfg.vocab_size)),
+    }
+
+
+def _rms_norm(x, w, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _rope(x, pos_ids, theta, head_dim):
+    """x [t, h, hd]; pos_ids [t] global positions (dispatch-aware)."""
+    half = head_dim // 2
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    angles = pos_ids.astype(jnp.float32)[:, None] * freqs[None, :]  # [t, half]
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rot = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return rot.astype(x.dtype)
+
+
+def _layer_local(
+    x,  # [t_loc, dim]
+    pos,  # [t_loc] global position ids
+    layer: dict,
+    cfg: LlamaConfig,
+    tables,
+    plan: DistAttnPlan,
+    attn_params: FlexAttnParams,
+    axis_name: str,
+):
+    dt = cfg.jnp_dtype
+    h = _rms_norm(x, layer["attn_norm"])
+    t = h.shape[0]
+    q = (h @ layer["wq"].astype(dt)).reshape(t, cfg.n_heads, cfg.head_dim)
+    k = (h @ layer["wk"].astype(dt)).reshape(t, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ layer["wv"].astype(dt)).reshape(t, cfg.n_kv_heads, cfg.head_dim)
+    q = _rope(q, pos, cfg.rope_theta, cfg.head_dim)
+    k = _rope(k, pos, cfg.rope_theta, cfg.head_dim)
+    out, _ = dist_attn_local(
+        q, k, v, tables, plan, attn_params, axis_name=axis_name
+    )
+    x = x + out.reshape(t, -1) @ layer["wo"].astype(dt)
+
+    h = _rms_norm(x, layer["mlp_norm"])
+    gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
+    up = h @ layer["w_up"].astype(dt)
+    x = x + (gate * up) @ layer["w_down"].astype(dt)
+    return x
+
+
+def forward_local(
+    params: dict,
+    tokens,  # [t_loc] int32 dispatched tokens
+    pos,  # [t_loc] global position ids
+    cfg: LlamaConfig,
+    tables,
+    plan: DistAttnPlan,
+    attn_params: FlexAttnParams,
+    axis_name: str = "cp",
+):
+    """Per-cp-rank forward over dispatched tokens -> logits [t_loc, vocab]."""
+    dt = cfg.jnp_dtype
+    x = params["embed"].astype(dt)[tokens]
+    for layer in params["layers"]:
+        x = _layer_local(
+            x, pos, layer, cfg, tables, plan, attn_params, axis_name
+        )
+    x = _rms_norm(x, params["final_norm"])
+    return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class MagiLlama:
+    """The flagship model bundle: config + plan + mesh + jitted step makers.
+
+    ``tokens`` / ``labels`` / ``pos`` are in DISPATCH order, shaped
+    [batch, total_padded] with batch sharded on 'dp' and tokens on 'cp'.
+    """
+
+    cfg: LlamaConfig
+    mesh: Mesh
+    plan: DistAttnPlan
+    attn_params: FlexAttnParams
+    cp_axis: str = "cp"
+    dp_axis: str = "dp"
+
+    def loss_fn(self, params, tokens, labels, pos, tables):
+        """Mean next-token CE over valid (label >= 0) positions."""
+        cfg = self.cfg
+        tables = tuple(tables)
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(
+                P(),  # params replicated
+                P(self.dp_axis, self.cp_axis),
+                P(self.dp_axis, self.cp_axis),
+                P(self.dp_axis, self.cp_axis),
+            )
+            + (P(self.cp_axis),) * len(tables),
+            out_specs=P(),
+            check_vma=False,
+        )
+        def _local(params, tok, lab, pos, *tabs):
+            def one(tok1, lab1, pos1):
+                logits = forward_local(
+                    params,
+                    tok1,
+                    pos1,
+                    cfg,
+                    tabs,
+                    self.plan,
+                    self.attn_params,
+                    self.cp_axis,
+                )
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                valid = lab1 >= 0
+                safe_lab = jnp.where(valid, lab1, 0)
+                tok_loss = -jnp.take_along_axis(
+                    logp, safe_lab[:, None], axis=1
+                )[:, 0]
+                return (
+                    jnp.where(valid, tok_loss, 0.0).sum(),
+                    valid.sum().astype(jnp.float32),
+                )
+
+            loss_sum, count = jax.vmap(one)(tok, lab, pos)
+            loss_sum = jax.lax.psum(
+                jax.lax.psum(loss_sum.sum(), self.cp_axis), self.dp_axis
+            )
+            count = jax.lax.psum(
+                jax.lax.psum(count.sum(), self.cp_axis), self.dp_axis
+            )
+            return loss_sum / jnp.maximum(count, 1.0)
+
+        return _local(params, tokens, labels, pos, *tables)
+
+    def sharded_tables(self):
+        spec = NamedSharding(self.mesh, P(self.cp_axis))
+        return tuple(
+            jax.device_put(t, spec) for t in self.plan.device_tables()
+        )
+
+    def make_train_step(self, optimizer):
+        """optax-style optimizer -> jitted (params, opt_state, batch) step."""
+        tables = self.sharded_tables()
+
+        def step(params, opt_state, tokens, labels, pos):
+            loss, grads = jax.value_and_grad(self.loss_fn)(
+                params, tokens, labels, pos, tables
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = jax.tree.map(
+                lambda p, u: p + u, params, updates
+            )
+            return params, opt_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def make_forward(self):
+        tables = self.sharded_tables()
+        cfg = self.cfg
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(
+                P(),
+                P(self.dp_axis, self.cp_axis),
+                P(self.dp_axis, self.cp_axis),
+            )
+            + (P(self.cp_axis),) * len(tables),
+            out_specs=P(self.dp_axis, self.cp_axis),
+            check_vma=False,
+        )
+        def _fwd(params, tok, pos, *tabs):
+            return jax.vmap(
+                lambda t1, p1: forward_local(
+                    params,
+                    t1,
+                    p1,
+                    cfg,
+                    tabs,
+                    self.plan,
+                    self.attn_params,
+                    self.cp_axis,
+                )
+            )(tok, pos)
+
+        def fwd(params, tokens, pos):
+            return _fwd(params, tokens, pos, *tables)
+
+        return fwd
+
+
+def build_magi_llama(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    total_seqlen: int,
+    q_ranges,
+    k_ranges,
+    attn_type_map,
+    *,
+    chunk_size: int,
+    cp_axis: str = "cp",
+    dp_axis: str = "dp",
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[MagiLlama, Any]:
+    """Plan the CP attention for one mask and bundle the model.
+
+    Returns (model, dispatch_meta) — dispatch tokens/labels with
+    parallel.dispatch using the meta before feeding the step.
+    """
+    from .. import env
+    from ..common.enum import AttnMaskType
+    from ..meta.dispatch_meta import make_dispatch_meta_from_qk_ranges
+    from ..parallel.dist_attn import build_dist_attn_plan
+
+    cp_size = mesh.shape[cp_axis]
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        q_ranges,
+        k_ranges,
+        [AttnMaskType(int(t)) for t in attn_type_map],
+        total_seqlen,
+        total_seqlen,
+        chunk_size=chunk_size,
+        cp_size=cp_size,
+    )
+    plan = build_dist_attn_plan(
+        mq,
+        bucket,
+        block_q=block_q or env.block_q(),
+        block_k=block_k or env.block_k(),
+    )
+    attn_params = make_attn_params(
+        plan, cfg.head_dim, out_dtype=cfg.dtype, interpret=interpret
+    )
+    model = MagiLlama(
+        cfg=cfg,
+        mesh=mesh,
+        plan=plan,
+        attn_params=attn_params,
+        cp_axis=cp_axis,
+        dp_axis=dp_axis,
+    )
+    return model, mq
