@@ -187,6 +187,7 @@ def magi_attn_flex_key(
     sink: jax.Array | None = None,
     out_dtype="bfloat16",
     dispatch_config: DispatchConfig | None = None,
+    dist_attn_config: "DistAttnConfig | None" = None,
     interpret: bool | None = None,
 ) -> DistAttnRuntimeKey:
     """Plan (or fetch from cache) a distributed flex-attention runtime
@@ -200,6 +201,12 @@ def magi_attn_flex_key(
         "self-attention interface requires equal q/k seqlens"
     )
     global _most_recent_key
+    from ..config import DistAttnConfig
+
+    if dist_attn_config is None:
+        dist_attn_config = DistAttnConfig()
+    if dispatch_config is None:
+        dispatch_config = dist_attn_config.dispatch_config
     if not isinstance(q_ranges, AttnRanges):
         q_ranges = AttnRanges.from_ranges(q_ranges)
     if not isinstance(k_ranges, AttnRanges):
@@ -241,7 +248,7 @@ def magi_attn_flex_key(
         has_sink=has_sink,
         sink_fingerprint=sink_fp,
         out_dtype=str(jnp.dtype(out_dtype)),
-        dispatch_config_repr=repr(dispatch_config),
+        dispatch_config_repr=repr((dispatch_config, dist_attn_config.overlap_config)),
         interpret=interpret,
         mesh_id=id(mesh),
         flags=env.flags_fingerprint(),
@@ -262,7 +269,11 @@ def magi_attn_flex_key(
         dispatch_config=dispatch_config,
     )
     plan = build_dist_attn_plan(
-        mq, bucket, block_q=env.block_q(), block_k=env.block_k()
+        mq,
+        bucket,
+        block_q=env.block_q(),
+        block_k=env.block_k(),
+        overlap_config=dist_attn_config.overlap_config,
     )
     params = make_attn_params(
         plan,

@@ -1,0 +1,17 @@
+"""Structured configs (reference ``magi_attention/config.py``):
+DistAttnConfig = DispatchConfig + OverlapConfig, hashable, part of the
+runtime cache key."""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import field
+
+from .meta.solver.dispatch_solver import DispatchConfig
+from .meta.solver.overlap_solver import OverlapConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class DistAttnConfig:
+    dispatch_config: DispatchConfig = field(default_factory=DispatchConfig)
+    overlap_config: OverlapConfig = field(default_factory=OverlapConfig)

@@ -1,0 +1,50 @@
+"""Out/LSE correction math: merging partial attention results.
+
+Role of reference ``functional/utils.py`` (correct_attn_lse :286,
+correct_attn_out :322, fused Triton correct_out_lse_kernel :371, safe_lse
+:38-106): numerically-safe log-sum-exp merging of partial attention outputs
+computed over disjoint KV subsets. On TPU these are plain jnp elementwise
+ops — XLA fuses them; no custom kernel needed.
+
+Convention: a partial result is (out, lse) where out rows with no coverage
+are 0 and their lse is -inf; merging is associative and commutative.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float("-inf")
+
+
+def safe_lse_merge(lse1: jax.Array, lse2: jax.Array) -> jax.Array:
+    """logaddexp with -inf-safe gradients (reference safe_lse)."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    s = jnp.where(jnp.isneginf(lse1), 0.0, jnp.exp(lse1 - m_safe)) + jnp.where(
+        jnp.isneginf(lse2), 0.0, jnp.exp(lse2 - m_safe)
+    )
+    return jnp.where(s > 0, m_safe + jnp.log(jnp.maximum(s, 1e-38)), NEG_INF)
+
+
+def correct_attn_out_lse(
+    out1: jax.Array,  # [t, h, d]
+    lse1: jax.Array,  # [t, h]
+    out2: jax.Array,
+    lse2: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Merge two partial (out, lse) pairs over disjoint KV sets.
+
+    out = exp(lse1 - lse) * out1 + exp(lse2 - lse) * out2;
+    rows covered by neither stay (0, -inf). fp32 internally.
+    """
+    lse = safe_lse_merge(lse1, lse2)
+    lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
+    w1 = jnp.where(jnp.isneginf(lse1), 0.0, jnp.exp(lse1 - lse_safe))
+    w2 = jnp.where(jnp.isneginf(lse2), 0.0, jnp.exp(lse2 - lse_safe))
+    out = (
+        w1[..., None] * out1.astype(jnp.float32)
+        + w2[..., None] * out2.astype(jnp.float32)
+    )
+    return out.astype(out1.dtype), lse

@@ -528,20 +528,26 @@ def _flex_attn_core_fwd(q, k, v, sink2d, ftab, btab, params: FlexAttnParams):
 
 def _flex_attn_core_bwd(params: FlexAttnParams, residuals, grads):
     q, k, v, sink2d, out, lse_lanes, ftab, btab = residuals
-    # lse / rowmax are auxiliary outputs; their cotangents are unsupported
-    # (matches the reference treating lse/max_logits as non-differentiable)
-    dout, _dlse, _dmax = grads
+    # The lse cotangent is first-class: with out = softmax(s) @ v and
+    # lse = logsumexp(s), dL/ds = p * (dp - (delta - dlse)) — so dlse folds
+    # into the delta term. This is what makes multi-stage LSE-merging
+    # differentiable with stage-local lse (the per-stage vjp then equals the
+    # reference's global-lse backward exactly). rowmax stays non-diff.
+    dout, dlse_lanes, _dmax = grads
     do = dout.astype(q.dtype)
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    delta_lanes = jnp.broadcast_to(delta[:, :, None], lse_lanes.shape)
+    # lse consumers read lane 0; sum lanes to collect the full cotangent
+    dlse = dlse_lanes.astype(jnp.float32).sum(axis=-1)
+    delta_eff = delta - dlse
+    delta_lanes = jnp.broadcast_to(delta_eff[:, :, None], lse_lanes.shape)
     dq = _dq_pallas(q, k, v, do, lse_lanes, delta_lanes, ftab, params)
     dk, dv = _dkv_pallas(q, k, v, do, lse_lanes, delta_lanes, btab, params)
     if params.has_sink:
-        # dL/dsink_h = -sum_q exp(sink_h - lse_hq) * delta_hq
+        # dL/dsink_h = -sum_q exp(sink_h - lse_hq) * delta_eff_hq
         lse = lse_lanes[:, :, 0]
         sink = sink2d[:, :1]
         w = jnp.where(lse == NEG_INF, 0.0, jnp.exp(sink - lse))
-        dsink = -(w * delta).sum(axis=1, keepdims=True)
+        dsink = -(w * delta_eff).sum(axis=1, keepdims=True)
         dsink2d = jnp.broadcast_to(dsink, sink2d.shape).astype(sink2d.dtype)
     else:
         dsink2d = jnp.zeros_like(sink2d)

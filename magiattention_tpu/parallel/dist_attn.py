@@ -8,20 +8,24 @@ key):
 1. host q/k ranges from the dispatch partition (chunked permutable shard),
 2. ``remote_k = needed_k \\ host_k`` (zero-redundancy exact remote set,
    the reference's find_hole_ranges step),
-3. a GroupCollectiveMeta routing K/V rows owner->consumer (the reference's
-   TransferTable -> GroupCastArg pipeline),
-4. a per-rank Pallas entry table over the rank-local [own | received] KV
-   buffer, built directly in global mask coordinates via run translation
-   (ops/block_meta.py) — this replaces slice_maker's host/remote sub-mask
-   case analysis entirely.
+3. GroupCollectiveMeta(s) routing K/V rows owner->consumer (the reference's
+   TransferTable -> GroupCastArg pipeline), one per overlap stage,
+4. per-rank Pallas entry tables over the rank-local KV buffers, built
+   directly in global mask coordinates via run translation
+   (ops/block_meta.py) — replacing slice_maker's sub-mask case analysis.
 
-The hot path is ONE jittable SPMD function per plan: group_cast KV (a padded
-all_to_all over the cp axis) -> local flex-flash-attention kernel. Because
-group_cast is built from differentiable gather/scatter ops, autodiff of the
-whole function yields exactly the reference's backward comm pattern —
-group_reduce(sum) of dKV partials to owners — with no hand-written
-collective transpose. Overlap scheduling is delegated to XLA's async
-collectives (replacing sm_margin / KernelBarrier stream plumbing).
+Execution modes (reference OverlapConfig semantics, overlap_solver.py:71):
+- degree 0 (no-overlap): ONE group_cast of all remote KV, concat with the
+  own shard, ONE kernel call over the merged buffer — no LSE-merge
+  precision loss (reference _no_overlap_forward, dist_attn.py:3197).
+- degree D >= 1 (multi-stage overlap): the host stage attends the own
+  shard while D group_casts are in flight; each remote stage's partial
+  (out, lse) is LSE-merged in. XLA's latency-hiding scheduler overlaps the
+  casts with the Pallas kernels — the role of the reference's sm_margin /
+  KernelBarrier stream machinery.
+
+Everything is differentiable: autodiff transposes the casts into the dKV
+group-reduces of the reference backward automatically.
 """
 
 from __future__ import annotations
@@ -29,23 +33,28 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
-from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..common.range import AttnRange
 from ..common.ranges import AttnRanges
 from ..comm.group_collective import GroupCollectiveMeta, group_cast
 from ..meta.containers import AttnBucket
 from ..meta.dispatch_meta import DispatchMeta
+from ..meta.solver.overlap_solver import (
+    OverlapConfig,
+    OverlapSolver,
+    OverlapStageCost,
+)
 from ..ops.block_meta import (
+    FlexAttnBlockMeta,
     Run,
     build_block_meta_general,
     pad_block_meta,
     runs_from_position_ids,
 )
+from ..ops.correction import correct_attn_out_lse
 from ..ops.flex_attn import FlexAttnParams, flex_attn_headmajor
 
 
@@ -54,54 +63,156 @@ def _round_up(a: int, b: int) -> int:
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
-class DistAttnPlan:
-    """Host-side plan for one (mask, dispatch, blocking) combination.
+class StageTables:
+    """Stacked per-rank kernel tables for one attention call (numpy int32,
+    leading cp axis; sharded on the cp mesh axis at runtime)."""
 
-    All stacked arrays have leading cp axis; placed sharded on the cp mesh
-    axis, each rank reads its own row inside shard_map.
-    """
-
-    cp_size: int
-    shard_q_len: int  # rank-local q rows (uniform)
-    shard_q_pad: int  # padded to block_q multiple
-    kv_buf_len: int  # own shard + padded remote rows
-    kv_buf_pad: int  # padded to block_k multiple
-    block_q: int
-    block_k: int
-    comm: GroupCollectiveMeta  # K/V row routing
-    total_area: int  # global mask area (FLOPs accounting)
-    max_rank_area: int  # load-balance diagnostic
-
-    # stacked per-rank kernel tables (numpy int32)
-    fwd_qblk: np.ndarray  # [cp, E]
+    kv_pad: int  # padded local KV length this stage's kernel sees
+    fwd_qblk: np.ndarray
     fwd_kblk: np.ndarray
     fwd_sid: np.ndarray
-    fwd_runs: np.ndarray  # [cp, E*RUN_FIELDS]
-    bwd_kblk: np.ndarray  # [cp, E2]
+    fwd_runs: np.ndarray
+    bwd_kblk: np.ndarray
     bwd_qblk: np.ndarray
     bwd_sid: np.ndarray
     bwd_runs: np.ndarray
-    bounds: np.ndarray  # [cp, (S_max+1)*SLICE_FIELDS]
+    bounds: np.ndarray
+
+    def arrays(self):
+        return (
+            self.fwd_qblk,
+            self.fwd_kblk,
+            self.fwd_sid,
+            self.fwd_runs,
+            self.bwd_kblk,
+            self.bwd_qblk,
+            self.bwd_sid,
+            self.bwd_runs,
+            self.bounds,
+        )
+
+    @staticmethod
+    def from_rank_metas(metas: list[FlexAttnBlockMeta], kv_pad: int):
+        e = max(m.num_fwd_entries for m in metas)
+        e2 = max(m.num_bwd_entries for m in metas)
+        s = max(m.num_slices for m in metas)
+        metas = [pad_block_meta(m, e, e2, s) for m in metas]
+        return StageTables(
+            kv_pad=kv_pad,
+            fwd_qblk=np.stack([m.fwd_q_block for m in metas]),
+            fwd_kblk=np.stack([m.fwd_k_block for m in metas]),
+            fwd_sid=np.stack([m.fwd_slice_id for m in metas]),
+            fwd_runs=np.stack([m.fwd_runs for m in metas]),
+            bwd_kblk=np.stack([m.bwd_k_block for m in metas]),
+            bwd_qblk=np.stack([m.bwd_q_block for m in metas]),
+            bwd_sid=np.stack([m.bwd_slice_id for m in metas]),
+            bwd_runs=np.stack([m.bwd_runs for m in metas]),
+            bounds=np.stack([m.slice_bounds for m in metas]),
+        )
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class StagePlan:
+    comm: GroupCollectiveMeta
+    tables: StageTables
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class DistAttnPlan:
+    """Host-side plan for one (mask, dispatch, blocking, overlap) combo."""
+
+    cp_size: int
+    shard_q_len: int
+    shard_q_pad: int
+    block_q: int
+    block_k: int
+    overlap_degree: int  # 0 = merged no-overlap path
+    total_area: int
+    max_rank_area: int
+
+    # degree-0 (merged) path
+    merged_comm: GroupCollectiveMeta | None
+    merged_tables: StageTables | None
+
+    # staged path (degree >= 1)
+    host_tables: StageTables | None
+    stages: tuple[StagePlan, ...]
+
+    @property
+    def comm(self) -> GroupCollectiveMeta:
+        """Primary comm meta (diagnostics; degree-0 path or stage union)."""
+        if self.merged_comm is not None:
+            return self.merged_comm
+        # staged: synthesize recv totals for diagnostics
+        return self._union_comm()
+
+    def _union_comm(self):
+        rt = [0] * self.cp_size
+        st = [0] * self.cp_size
+        for sp in self.stages:
+            for r in range(self.cp_size):
+                rt[r] += sp.comm.recv_total[r]
+                st[r] += sp.comm.send_total[r]
+        c0 = self.stages[0].comm if self.stages else None
+        return dataclasses.replace(
+            c0,
+            recv_total=tuple(rt),
+            send_total=tuple(st),
+        )
 
     def device_tables(self):
-        """All sharded operands for the SPMD runtime fn, leading cp axis."""
-        return tuple(
-            jnp.asarray(a)
-            for a in (
-                self.fwd_qblk,
-                self.fwd_kblk,
-                self.fwd_sid,
-                self.fwd_runs,
-                self.bwd_kblk,
-                self.bwd_qblk,
-                self.bwd_sid,
-                self.bwd_runs,
-                self.bounds,
-                self.comm.send_idx,
-                self.comm.recv_sel,
-                self.comm.recv_valid,
+        """Flattened sharded operands, deterministic order (see
+        ``dist_attn_local`` for the consuming cursor)."""
+        arrs: list[np.ndarray] = []
+        if self.overlap_degree == 0:
+            assert self.merged_tables is not None and self.merged_comm
+            arrs.extend(self.merged_tables.arrays())
+            arrs.extend(
+                (
+                    self.merged_comm.send_idx,
+                    self.merged_comm.recv_sel,
+                    self.merged_comm.recv_valid,
+                )
             )
-        )
+        else:
+            assert self.host_tables is not None
+            arrs.extend(self.host_tables.arrays())
+            for sp in self.stages:
+                arrs.extend(sp.tables.arrays())
+                arrs.extend(
+                    (sp.comm.send_idx, sp.comm.recv_sel, sp.comm.recv_valid)
+                )
+        return tuple(jnp.asarray(a) for a in arrs)
+
+
+# ---------------------------------------------------------------------------
+# plan building
+# ---------------------------------------------------------------------------
+
+
+def _split_send_map_by_stage(
+    send_map: list[list[np.ndarray]],
+    stage_row_of: list[np.ndarray],  # per dst rank: stage id of each recv row
+    num_stages: int,
+    cp: int,
+) -> list[list[list[np.ndarray]]]:
+    """stage -> owner -> dst -> owner-local rows (subset of send_map)."""
+    out = [
+        [[np.empty(0, np.int64) for _ in range(cp)] for _ in range(cp)]
+        for _ in range(num_stages)
+    ]
+    for d in range(cp):
+        pos = 0
+        for s in range(cp):
+            rows = send_map[s][d]
+            n = len(rows)
+            if n:
+                stages = stage_row_of[d][pos : pos + n]
+                for st in range(num_stages):
+                    sel = rows[stages == st]
+                    out[st][s][d] = sel
+            pos += n
+    return out
 
 
 def build_dist_attn_plan(
@@ -110,17 +221,18 @@ def build_dist_attn_plan(
     *,
     block_q: int = 128,
     block_k: int = 128,
+    overlap_config: OverlapConfig | None = None,
 ) -> DistAttnPlan:
     """Plan the distributed attention for one dispatched mask (self-attn)."""
     cp = dispatch_meta.cp_size
     shard_len = dispatch_meta.shard_seqlen
-    chunk_size = dispatch_meta.chunk_size
+    overlap_config = overlap_config or OverlapConfig()
+    degree = overlap_config.degree
 
-    # per-rank host geometry
     pos_ids = [dispatch_meta.position_ids(r) for r in range(cp)]
     host_ranges = dispatch_meta.host_ranges_per_rank()
 
-    # per-rank slices (global coords) from the rank's chunks
+    # per-rank slices (global coords) + needed K sets
     slices_per_rank: list[np.ndarray] = []
     needed_k: list[AttnRanges] = []
     for r in range(cp):
@@ -138,21 +250,14 @@ def build_dist_attn_plan(
                     )
                 )
                 ks.append(s.k_range.clone())
-        slices_per_rank.append(
-            np.asarray(rows, dtype=np.int64).reshape(-1, 5)
-        )
+        slices_per_rank.append(np.asarray(rows, dtype=np.int64).reshape(-1, 5))
         needed_k.append(ks.merge())
 
-    # zero-redundancy remote sets + send routing (owner s -> consumer d)
-    remote_k = [
-        needed_k[r].find_hole_ranges(host_ranges[r]) for r in range(cp)
-    ]
+    remote_k = [needed_k[r].find_hole_ranges(host_ranges[r]) for r in range(cp)]
     send_map: list[list[np.ndarray]] = [
         [np.empty(0, np.int64) for _ in range(cp)] for _ in range(cp)
     ]
-    recv_runs_per_rank: list[list[tuple[int, list[Run]]]] = [
-        [] for _ in range(cp)
-    ]
+    recv_segments: list[list[tuple[int, np.ndarray]]] = [[] for _ in range(cp)]
     for d in range(cp):
         for s in range(cp):
             if s == d:
@@ -160,7 +265,6 @@ def build_dist_attn_plan(
             inter = remote_k[d].find_overlap_ranges(host_ranges[s])
             if inter.is_empty():
                 continue
-            # owner-local rows, in ascending owner-local order
             local = host_ranges[s].make_ranges_local(inter, is_self_merged=True)
             order = sorted(range(len(local)), key=lambda i: local[i].start)
             idx_parts = [
@@ -170,75 +274,167 @@ def build_dist_attn_plan(
             send_map[s][d] = (
                 np.concatenate(idx_parts) if idx_parts else np.empty(0, np.int64)
             )
-            # global ids of those rows, same order, for the dst's run layout
-            recv_runs_per_rank[d].append((s, pos_ids[s][send_map[s][d]]))
+            recv_segments[d].append((s, pos_ids[s][send_map[s][d]]))
 
-    comm = GroupCollectiveMeta.build(send_map, [shard_len] * cp)
-
-    # rank-local KV buffer layout: [own shard | received rows (padded)]
-    kv_buf_len = shard_len + comm.max_recv
     shard_q_pad = _round_up(shard_len, block_q)
-    kv_buf_pad = _round_up(kv_buf_len, block_k)
+    q_runs_per_rank = [runs_from_position_ids(pos_ids[r]) for r in range(cp)]
+    total_area = bucket.area
 
-    rank_metas = [
+    def _recv_global_ids(r) -> np.ndarray:
+        parts = [g for _, g in recv_segments[r]]
+        return (
+            np.concatenate(parts) if parts else np.empty(0, np.int64)
+        )
+
+    def _runs_from_recv_rows(global_ids: np.ndarray, base: int) -> list[Run]:
+        runs = []
+        for run in runs_from_position_ids(global_ids):
+            runs.append(
+                Run(
+                    local_start=base + run.local_start,
+                    global_start=run.global_start,
+                    length=run.length,
+                )
+            )
+        return runs
+
+    if degree == 0:
+        comm = GroupCollectiveMeta.build(send_map, [shard_len] * cp)
+        kv_buf_pad = _round_up(shard_len + comm.max_recv, block_k)
+        metas = []
+        for r in range(cp):
+            k_runs = list(q_runs_per_rank[r])
+            gids = _recv_global_ids(r)
+            # received rows sit right after the own shard, in recv order
+            k_runs += _runs_from_recv_rows(gids, shard_len)
+            metas.append(
+                build_block_meta_general(
+                    slices_per_rank[r],
+                    q_runs_per_rank[r],
+                    k_runs,
+                    shard_q_pad,
+                    kv_buf_pad,
+                    block_q=block_q,
+                    block_k=block_k,
+                )
+            )
+        tables = StageTables.from_rank_metas(metas, kv_buf_pad)
+        return DistAttnPlan(
+            cp_size=cp,
+            shard_q_len=shard_len,
+            shard_q_pad=shard_q_pad,
+            block_q=block_q,
+            block_k=block_k,
+            overlap_degree=0,
+            total_area=total_area,
+            max_rank_area=max(m.total_area for m in metas),
+            merged_comm=comm,
+            merged_tables=tables,
+            host_tables=None,
+            stages=(),
+        )
+
+    # ---- staged path -----------------------------------------------------
+    # host stage: own shard only
+    host_kv_pad = _round_up(shard_len, block_k)
+    host_metas = [
         build_block_meta_general(
             slices_per_rank[r],
-            runs_from_position_ids(pos_ids[r]),
-            _rank_k_runs(r, pos_ids, shard_len, send_map, recv_runs_per_rank),
+            q_runs_per_rank[r],
+            q_runs_per_rank[r],  # own rows double as K rows (self-attn)
             shard_q_pad,
-            kv_buf_pad,
+            host_kv_pad,
             block_q=block_q,
             block_k=block_k,
         )
         for r in range(cp)
     ]
-    # uniform table shapes across ranks (SPMD)
-    e_max = max(m.num_fwd_entries for m in rank_metas)
-    e2_max = max(m.num_bwd_entries for m in rank_metas)
-    s_max = max(m.num_slices for m in rank_metas)
-    rank_metas = [
-        pad_block_meta(m, e_max, e2_max, s_max) for m in rank_metas
-    ]
+    host_tables = StageTables.from_rank_metas(host_metas, host_kv_pad)
+
+    # assign each rank's remote recv rows to stages via the overlap solver,
+    # at row-block granularity in recv order
+    gran = max(overlap_config.min_stage_rows, block_k)
+    stage_row_of: list[np.ndarray] = []
+    solver = OverlapSolver(overlap_config)
+    for r in range(cp):
+        n_rows = sum(len(g) for _, g in recv_segments[r])
+        n_blocks = -(-n_rows // gran) if n_rows else 0
+        costs = [
+            OverlapStageCost(comm_cost=float(min(gran, n_rows - b * gran)), calc_cost=1.0)
+            for b in range(n_blocks)
+        ]
+        sol = solver.solve(costs)
+        row_stage = np.zeros(n_rows, dtype=np.int64)
+        for b in range(n_blocks):
+            row_stage[b * gran : (b + 1) * gran] = (
+                sol.stage_of[b] if b < len(sol.stage_of) else 0
+            )
+        stage_row_of.append(row_stage)
+
+    num_stages = degree
+    staged_maps = _split_send_map_by_stage(
+        send_map, stage_row_of, num_stages, cp
+    )
+    rank_area = [host_metas[r].total_area for r in range(cp)]
+    stages: list[StagePlan] = []
+    for st in range(num_stages):
+        st_comm = GroupCollectiveMeta.build(staged_maps[st], [shard_len] * cp)
+        st_kv_pad = _round_up(max(st_comm.max_recv, block_k), block_k)
+        st_metas = []
+        for r in range(cp):
+            # global ids of this rank's stage-st recv rows, in recv order
+            gids_parts = []
+            for s, gids in recv_segments[r]:
+                rows = staged_maps[st][s][r]
+                if len(rows):
+                    gids_parts.append(pos_ids[s][rows])
+            gids = (
+                np.concatenate(gids_parts)
+                if gids_parts
+                else np.empty(0, np.int64)
+            )
+            k_runs = _runs_from_recv_rows(gids, 0)
+            st_metas.append(
+                build_block_meta_general(
+                    slices_per_rank[r],
+                    q_runs_per_rank[r],
+                    k_runs,
+                    shard_q_pad,
+                    st_kv_pad,
+                    block_q=block_q,
+                    block_k=block_k,
+                )
+            )
+        if all(t == 0 for t in st_comm.recv_total):
+            continue  # globally empty stage: no collective, no kernel call
+        for r in range(cp):
+            rank_area[r] += st_metas[r].total_area
+        stages.append(
+            StagePlan(
+                comm=st_comm,
+                tables=StageTables.from_rank_metas(st_metas, st_kv_pad),
+            )
+        )
 
     return DistAttnPlan(
         cp_size=cp,
         shard_q_len=shard_len,
         shard_q_pad=shard_q_pad,
-        kv_buf_len=kv_buf_len,
-        kv_buf_pad=kv_buf_pad,
         block_q=block_q,
         block_k=block_k,
-        comm=comm,
-        total_area=bucket.area,
-        max_rank_area=max(m.total_area for m in rank_metas),
-        fwd_qblk=np.stack([m.fwd_q_block for m in rank_metas]),
-        fwd_kblk=np.stack([m.fwd_k_block for m in rank_metas]),
-        fwd_sid=np.stack([m.fwd_slice_id for m in rank_metas]),
-        fwd_runs=np.stack([m.fwd_runs for m in rank_metas]),
-        bwd_kblk=np.stack([m.bwd_k_block for m in rank_metas]),
-        bwd_qblk=np.stack([m.bwd_q_block for m in rank_metas]),
-        bwd_sid=np.stack([m.bwd_slice_id for m in rank_metas]),
-        bwd_runs=np.stack([m.bwd_runs for m in rank_metas]),
-        bounds=np.stack([m.slice_bounds for m in rank_metas]),
+        overlap_degree=num_stages,
+        total_area=total_area,
+        max_rank_area=max(rank_area),
+        merged_comm=None,
+        merged_tables=None,
+        host_tables=host_tables,
+        stages=tuple(stages),
     )
 
 
-def _rank_k_runs(r, pos_ids, shard_len, send_map, recv_runs_per_rank):
-    q_runs = runs_from_position_ids(pos_ids[r])
-    k_runs = list(q_runs)
-    for s, gids in recv_runs_per_rank[r]:
-        off = 0
-        for s2 in range(s):
-            off += len(send_map[s2][r])
-        for run in runs_from_position_ids(gids):
-            k_runs.append(
-                Run(
-                    local_start=shard_len + off + run.local_start,
-                    global_start=run.global_start,
-                    length=run.length,
-                )
-            )
-    return k_runs
+# ---------------------------------------------------------------------------
+# runtime
+# ---------------------------------------------------------------------------
 
 
 def make_attn_params(
@@ -266,11 +462,28 @@ def make_attn_params(
     )
 
 
+def _hm(x, target):
+    """[t, h, d] -> head-major [h, t_pad, d]."""
+    x = jnp.transpose(x, (1, 0, 2))
+    pad = target - x.shape[1]
+    if pad > 0:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _call_kernel(qh, k_buf, v_buf, tab_arrays, kv_pad, params, sink):
+    kh = _hm(k_buf, kv_pad)
+    vh = _hm(v_buf, kv_pad)
+    ftab = tuple(a[0] for a in tab_arrays[:4]) + (tab_arrays[8][0],)
+    btab = tuple(a[0] for a in tab_arrays[4:8]) + (tab_arrays[8][0],)
+    return flex_attn_headmajor(qh, kh, vh, ftab, btab, params, sink=sink)
+
+
 def dist_attn_local(
     q: jax.Array,  # [shard_q_len, hq, d] rank-local dispatched q
     k: jax.Array,  # [shard_q_len, hk, d]
     v: jax.Array,
-    tables,  # the 12 per-rank table slices (leading dim 1) from device_tables
+    tables,  # flattened per-rank table slices from plan.device_tables()
     plan: DistAttnPlan,
     params: FlexAttnParams,
     *,
@@ -279,50 +492,60 @@ def dist_attn_local(
 ):
     """The SPMD hot path — call inside shard_map over the cp axis.
 
-    group_cast remote KV -> concat local buffer -> Pallas flex kernel.
-    Fully differentiable (autodiff produces the dKV group_reduce).
     Returns (out [shard_q_len, hq, d], lse [shard_q_len, hq]).
     """
-    (
-        fq,
-        fk,
-        fs,
-        fr,
-        bk_,
-        bq_,
-        bs_,
-        br_,
-        bo,
-        send_idx,
-        recv_sel,
-        recv_valid,
-    ) = tables
-    # one all_to_all for both K and V: rows [t, 2, hk, d]
-    kv = jnp.stack([k, v], axis=1)
-    recv = group_cast(kv, send_idx, recv_sel, recv_valid, axis_name=axis_name)
-    k_full = jnp.concatenate([k, recv[:, 0]], axis=0)  # [kv_buf_len, hk, d]
-    v_full = jnp.concatenate([v, recv[:, 1]], axis=0)
+    qh = _hm(q, plan.shard_q_pad)
+    kv = jnp.stack([k, v], axis=1)  # one all_to_all payload for K and V
+    cur = 0
 
-    # head-major + block padding
-    def hm(x, target):
-        x = jnp.transpose(x, (1, 0, 2))
-        pad = target - x.shape[1]
-        if pad > 0:
-            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
-        return x
+    def take(n):
+        nonlocal cur
+        out = tables[cur : cur + n]
+        cur += n
+        return out
 
-    qh = hm(q, plan.shard_q_pad)
-    kh = hm(k_full, plan.kv_buf_pad)
-    vh = hm(v_full, plan.kv_buf_pad)
+    if plan.overlap_degree == 0:
+        tab = take(9)
+        send_idx, recv_sel, recv_valid = take(3)
+        recv = group_cast(kv, send_idx, recv_sel, recv_valid, axis_name=axis_name)
+        k_full = jnp.concatenate([k, recv[:, 0]], axis=0)
+        v_full = jnp.concatenate([v, recv[:, 1]], axis=0)
+        out_h, lse_lanes, _ = _call_kernel(
+            qh, k_full, v_full, tab, plan.merged_tables.kv_pad, params, sink
+        )
+        out = jnp.transpose(out_h, (1, 0, 2))[: plan.shard_q_len]
+        lse = jnp.transpose(lse_lanes[:, :, 0], (1, 0))[: plan.shard_q_len]
+        return out, lse
 
-    ftab = (fq[0], fk[0], fs[0], fr[0], bo[0])
-    btab = (bk_[0], bq_[0], bs_[0], br_[0], bo[0])
-    out_h, lse_lanes, _ = flex_attn_headmajor(
-        qh, kh, vh, ftab, btab, params, sink=sink
+    # staged path: host stage + D lse-merged remote stages.
+    # The sink joins the softmax denominator exactly once — in the host
+    # stage; remote partials are sink-free. The running accumulator stays
+    # fp32 across merges (reference fwd_out_lse_use_acc semantics); a single
+    # downcast happens at the end.
+    host_params = dataclasses.replace(params, out_dtype="float32")
+    host_tab = take(9)
+    out_h, lse_lanes, _ = _call_kernel(
+        qh, k, v, host_tab, plan.host_tables.kv_pad, host_params, sink
     )
     out = jnp.transpose(out_h, (1, 0, 2))[: plan.shard_q_len]
     lse = jnp.transpose(lse_lanes[:, :, 0], (1, 0))[: plan.shard_q_len]
-    return out, lse
+
+    stage_params = dataclasses.replace(
+        params, has_sink=False, out_dtype="float32"
+    )
+    for sp in plan.stages:
+        tab = take(9)
+        send_idx, recv_sel, recv_valid = take(3)
+        recv = group_cast(
+            kv, send_idx, recv_sel, recv_valid, axis_name=axis_name
+        )
+        out_i_h, lse_i_lanes, _ = _call_kernel(
+            qh, recv[:, 0], recv[:, 1], tab, sp.tables.kv_pad, stage_params, None
+        )
+        out_i = jnp.transpose(out_i_h, (1, 0, 2))[: plan.shard_q_len]
+        lse_i = jnp.transpose(lse_i_lanes[:, :, 0], (1, 0))[: plan.shard_q_len]
+        out, lse = correct_attn_out_lse(out, lse, out_i, lse_i)
+    return out.astype(params.out_jnp_dtype), lse
 
 
 def make_dist_attn_fn(
@@ -333,12 +556,8 @@ def make_dist_attn_fn(
     axis_name: str = "cp",
     sink: jax.Array | None = None,  # [hq] learned sink logits (replicated)
 ):
-    """Convenience: a jittable fn over *dispatched global* arrays.
-
-    Inputs/outputs are [total_tokens, heads, d] arrays sharded P(axis_name)
-    along tokens (the dispatch layout). Suitable for direct use or as a
-    building block inside a larger pjit'd train step.
-    """
+    """Convenience: a jittable fn over *dispatched global* arrays sharded
+    P(axis_name) along tokens."""
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -347,8 +566,7 @@ def make_dist_attn_fn(
     )
     tables = plan.device_tables()
     tables = tuple(
-        jax.device_put(t, NamedSharding(mesh, P(axis_name)))
-        for t in tables
+        jax.device_put(t, NamedSharding(mesh, P(axis_name))) for t in tables
     )
     n_tab = len(tables)
     sink_specs = (P(),) if sink is not None else ()
