@@ -336,3 +336,11 @@ def calc_attn(q, k, v, key: DistAttnRuntimeKey):
 def get_position_ids(key: DistAttnRuntimeKey):
     """Reference api.get_position_ids :1112."""
     return get_runtime_mgr(key).get_position_ids()
+
+
+def roll(x: jax.Array, key: DistAttnRuntimeKey, shift: int, axis: int = 0):
+    """Distributed roll along the global sequence of a dispatched tensor
+    (reference api.roll :960 — MTP label shifting)."""
+    from ..parallel.dispatch import roll as _roll
+
+    return _roll(x, get_runtime_mgr(key).dispatch_meta, shift, axis=axis)

@@ -126,9 +126,9 @@ def _scores(q, k, scale, softcap):
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    z = z * scale
+    z = z * jnp.float32(scale)
     if softcap > 0.0:
-        return softcap * jnp.tanh(z / softcap)
+        return jnp.float32(softcap) * jnp.tanh(z / jnp.float32(softcap))
     return z
 
 
@@ -323,9 +323,9 @@ def _dq_kernel(
     delta = delta_ref[0][:, :1]
     ds = p * (dp - delta)
     if params.softcap > 0.0:
-        ds = ds * (1.0 - (s / params.softcap) ** 2)
+        ds = ds * (1.0 - (s / jnp.float32(params.softcap)) ** 2)
         ds = jnp.where(mask, ds, 0.0)
-    dq_scr[...] += params.scale * jax.lax.dot_general(
+    dq_scr[...] += jnp.float32(params.scale) * jax.lax.dot_general(
         ds.astype(k_ref.dtype),
         k_ref[0],
         dimension_numbers=(((1,), (0,)), ((), ())),
@@ -433,9 +433,9 @@ def _dkv_kernel(
     delta = delta_ref[0][:, :1]
     ds = p * (dp - delta)
     if params.softcap > 0.0:
-        ds = ds * (1.0 - (s / params.softcap) ** 2)
+        ds = ds * (1.0 - (s / jnp.float32(params.softcap)) ** 2)
         ds = jnp.where(mask, ds, 0.0)
-    dk_scr[...] += params.scale * jax.lax.dot_general(
+    dk_scr[...] += jnp.float32(params.scale) * jax.lax.dot_general(
         ds.astype(q_ref.dtype),
         q_ref[0],
         dimension_numbers=(((0,), (0,)), ((), ())),

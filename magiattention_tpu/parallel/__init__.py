@@ -1,6 +1,6 @@
 """Distributed execution runtime: CP attention plan + hot path + dispatch."""
 
-from .dispatch import dispatch, position_ids, undispatch
+from .dispatch import dispatch, position_ids, roll, undispatch
 from .dist_attn import (
     DistAttnPlan,
     build_dist_attn_plan,
@@ -17,5 +17,6 @@ __all__ = [
     "make_attn_params",
     "make_dist_attn_fn",
     "position_ids",
+    "roll",
     "undispatch",
 ]

@@ -1,0 +1,22 @@
+"""CP baseline implementations (reference exps/dist_attn/baselines/):
+ring attention and Ulysses — the comparison points for the benchmark-parity
+story. USP (Ulysses x ring over a 2-D mesh) composes the two."""
+
+from .ring import RingAttnPlan, build_ring_attn_plan, make_ring_attn_fn, ring_attn_local
+from .ulysses import (
+    UlyssesPlan,
+    build_ulysses_plan,
+    make_ulysses_attn_fn,
+    ulysses_attn_local,
+)
+
+__all__ = [
+    "RingAttnPlan",
+    "UlyssesPlan",
+    "build_ring_attn_plan",
+    "build_ulysses_plan",
+    "make_ring_attn_fn",
+    "make_ulysses_attn_fn",
+    "ring_attn_local",
+    "ulysses_attn_local",
+]

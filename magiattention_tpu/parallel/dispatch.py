@@ -38,3 +38,16 @@ def position_ids(meta: DispatchMeta) -> jax.Array:
     """Global position of every dispatched slot, [total] int32 (sharded the
     same way as dispatched activations; used for RoPE etc.)."""
     return jnp.asarray(meta.perm_idx)
+
+
+def roll(x: jax.Array, meta: DispatchMeta, shift: int, axis: int = 0) -> jax.Array:
+    """Distributed roll along the *global* sequence of a dispatched tensor
+    (reference functional/roll.py roll_p2p — MTP label shifting): in global
+    order, y[i] = x[(i - shift) mod total], computed directly in dispatch
+    space as one static gather (GSPMD inserts the point-to-point comm)."""
+    perm = meta.perm_idx.astype(np.int64)
+    unperm = meta.unperm_idx.astype(np.int64)
+    total = perm.shape[0]
+    src_global = (perm - shift) % total
+    gather = unperm[src_global].astype(np.int32)
+    return jnp.take(x, jnp.asarray(gather), axis=axis)

@@ -151,3 +151,20 @@ def test_swa_end_to_end():
     # rows before its start — nowhere near all-KV (total - shard = 768)
     plan = get_runtime_mgr(key).plan
     assert max(plan.comm.recv_total) <= w
+
+
+def test_roll_matches_global_roll():
+    """roll in dispatch space == undispatch -> np.roll -> dispatch."""
+    from magiattention_tpu.api import roll
+
+    mesh = _mesh(4)
+    total = 512
+    key = magi_attn_varlen_key(
+        [0, total], total, mesh, num_heads=(2, 2), head_dim=32,
+        chunk_size=32, out_dtype="float32",
+    )
+    x = jnp.arange(total, dtype=jnp.int32)
+    xd = dispatch(x, key)
+    for shift in [1, -1, 7]:
+        got = np.asarray(undispatch(roll(xd, key, shift), key))
+        np.testing.assert_array_equal(got, np.roll(np.arange(total), shift))
