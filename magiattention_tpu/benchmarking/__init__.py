@@ -1,0 +1,5 @@
+"""Benchmark harness (reference ``magi_attention/benchmarking/``)."""
+
+from .bench import BenchResult, do_bench, perf_report
+
+__all__ = ["BenchResult", "do_bench", "perf_report"]

@@ -1,0 +1,102 @@
+"""do_bench / perf_report: timing harness for TPU.
+
+Role of reference ``benchmarking/bench.py`` (CUDA-event do_bench + NVML
+memory recorder + Mark/perf_report): wall-clock timing with a forced
+device->host scalar readback per measured region (through remote TPU
+tunnels, ``block_until_ready`` alone does not fully synchronize — measured
+in this repo's round-1 bring-up), plus jax device memory stats.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _sync(result) -> None:
+    leaves = jax.tree.leaves(result)
+    if leaves:
+        _ = float(jnp.sum(leaves[0].ravel()[0]))
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchResult:
+    mean_ms: float
+    median_ms: float
+    min_ms: float
+    max_ms: float
+    reps: int
+    peak_bytes: int | None = None
+
+    def tflops(self, flops: float) -> float:
+        return flops / (self.median_ms * 1e-3) / 1e12
+
+
+def do_bench(
+    fn: Callable,
+    *args,
+    warmup: int = 3,
+    rep: int = 10,
+    inner: int = 5,
+    record_memory: bool = False,
+    **kwargs,
+) -> BenchResult:
+    """Time fn(*args) with warmup; each rep runs ``inner`` calls between
+    syncs so fixed sync latency amortizes (reference do_bench :79)."""
+    r = fn(*args, **kwargs)  # at least one call before timing (compile)
+    for _ in range(max(warmup - 1, 0)):
+        r = fn(*args, **kwargs)
+    _sync(r)
+    times = []
+    for _ in range(rep):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            r = fn(*args, **kwargs)
+        _sync(r)
+        times.append((time.perf_counter() - t0) / inner * 1e3)
+    peak = None
+    if record_memory:
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            peak = int(stats.get("peak_bytes_in_use", 0)) if stats else None
+        except Exception:
+            peak = None
+    return BenchResult(
+        mean_ms=statistics.fmean(times),
+        median_ms=statistics.median(times),
+        min_ms=min(times),
+        max_ms=max(times),
+        reps=rep,
+        peak_bytes=peak,
+    )
+
+
+def perf_report(
+    rows: Sequence[dict[str, Any]],
+    *,
+    sort_key: str | None = None,
+) -> str:
+    """Plain-text table of benchmark rows (reference Mark/perf_report)."""
+    if not rows:
+        return "(no results)"
+    cols = list(rows[0].keys())
+    if sort_key:
+        rows = sorted(rows, key=lambda r: r[sort_key])
+    widths = {
+        c: max(len(str(c)), *(len(f"{r.get(c, '')}") for r in rows))
+        for c in cols
+    }
+    lines = [
+        "  ".join(str(c).ljust(widths[c]) for c in cols),
+        "  ".join("-" * widths[c] for c in cols),
+    ]
+    for r in rows:
+        lines.append(
+            "  ".join(f"{r.get(c, '')}".ljust(widths[c]) for c in cols)
+        )
+    return "\n".join(lines)

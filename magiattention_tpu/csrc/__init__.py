@@ -1,0 +1,139 @@
+"""Native (C++) planning accelerators, loaded via ctypes.
+
+Role of reference ``magi_attn_ext`` (CMake C++ extension accelerating
+solver hot loops, csrc/extensions/): here a single shared library built
+from entry_table.cpp with g++ at first use (no pybind11 in this image —
+plain C ABI + ctypes). Controlled by MAGI_ATTENTION_CPP_BACKEND (default
+on when a toolchain is available); the Python implementations remain the
+fallback and the parity oracle (tests/test_ops/test_cpp_ext.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+_SRC = os.path.join(os.path.dirname(__file__), "entry_table.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "libmagi_ext.so")
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded native library, building it on first use; None if
+    disabled or unbuildable."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("MAGI_ATTENTION_CPP_BACKEND", "1").strip().lower() in (
+            "0",
+            "false",
+            "off",
+        ):
+            return None
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
+            _SRC
+        ):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.magi_emit_entries.restype = ctypes.c_int64
+        lib.magi_emit_entries.argtypes = [i64p, ctypes.c_int64] * 3 + [
+            ctypes.c_int64,
+            ctypes.c_int64,
+            i64p,
+            ctypes.c_int64,
+        ]
+        lib.magi_slice_area_runs.restype = ctypes.c_int64
+        lib.magi_slice_area_runs.argtypes = [i64p, ctypes.c_int64] * 3
+        _LIB = lib
+        return _LIB
+
+
+def _as_i64(arr: np.ndarray):
+    a = np.ascontiguousarray(arr, dtype=np.int64)
+    return a, a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def emit_entries_native(
+    slices: np.ndarray,  # [S, 5]
+    q_runs: np.ndarray,  # [Nq, 3]
+    k_runs: np.ndarray,  # [Nk, 3]
+    block_q: int,
+    block_k: int,
+) -> np.ndarray | None:
+    """[E, 9] entry array, or None when the native backend is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    s, sp = _as_i64(slices.reshape(-1, 5))
+    q, qp = _as_i64(q_runs.reshape(-1, 3))
+    k, kp = _as_i64(k_runs.reshape(-1, 3))
+    # capacity from the block grid: per slice at most every (q-block, k-block)
+    # pair it touches, bounded by the grid each run contributes
+    nq_blocks = sum(
+        int(-(-(r[0] + r[2]) // block_q) - r[0] // block_q) for r in q
+    )
+    nk_blocks = sum(
+        int(-(-(r[0] + r[2]) // block_k) - r[0] // block_k) for r in k
+    )
+    cap = max(64, s.shape[0] * max(nq_blocks, 1) * max(nk_blocks, 1))
+    cap = min(cap, 1 << 24)  # keep the first allocation bounded (128MB rows)
+    while True:
+        out = np.empty((cap, 9), dtype=np.int64)
+        n = lib.magi_emit_entries(
+            sp,
+            s.shape[0],
+            qp,
+            q.shape[0],
+            kp,
+            k.shape[0],
+            block_q,
+            block_k,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            cap,
+        )
+        if n <= cap:
+            return out[:n]
+        cap = int(n)
+
+
+def slice_area_runs_native(
+    slices: np.ndarray, q_runs: np.ndarray, k_runs: np.ndarray
+) -> int | None:
+    lib = get_lib()
+    if lib is None:
+        return None
+    s, sp = _as_i64(slices.reshape(-1, 5))
+    q, qp = _as_i64(q_runs.reshape(-1, 3))
+    k, kp = _as_i64(k_runs.reshape(-1, 3))
+    return int(
+        lib.magi_slice_area_runs(sp, s.shape[0], qp, q.shape[0], kp, k.shape[0])
+    )

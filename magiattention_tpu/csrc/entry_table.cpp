@@ -1,0 +1,140 @@
+// Native entry-table emission for the Pallas flex-attention planner.
+//
+// Role of the reference's magi_attn_ext C++ module (csrc/extensions/
+// attn_ranges.hpp + dyn_solver_alg.cpp): accelerate the host-side planning
+// hot loops. Here the hot loop is ops/block_meta._emit_entries — for every
+// (slice, q_run, q_block, k_run, k_block) intersection emit one kernel
+// entry. Exposed via a plain C ABI consumed through ctypes (no pybind11 in
+// this image); the Python implementation remains as fallback and parity
+// oracle.
+//
+// Entry layout (9 int64s, matching the Python tuple):
+//   (q_block, k_block, slice_id, ql0, ql1, kl0, kl1, qoff, koff)
+
+#include <cstdint>
+
+extern "C" {
+
+// slices: [n_slices, 5] = (qs, qe, ks, ke, mask_type)
+// q_runs / k_runs: [n, 3] = (local_start, global_start, length)
+// out: [capacity, 9]; returns number of entries (may exceed capacity, in
+// which case only the first `capacity` were written — caller re-allocs).
+int64_t magi_emit_entries(
+    const int64_t* slices, int64_t n_slices,
+    const int64_t* q_runs, int64_t n_q_runs,
+    const int64_t* k_runs, int64_t n_k_runs,
+    int64_t block_q, int64_t block_k,
+    int64_t* out, int64_t capacity) {
+  int64_t count = 0;
+  for (int64_t sid = 0; sid < n_slices; ++sid) {
+    const int64_t qs = slices[sid * 5 + 0];
+    const int64_t qe = slices[sid * 5 + 1];
+    const int64_t ks = slices[sid * 5 + 2];
+    const int64_t ke = slices[sid * 5 + 3];
+    const int64_t mt = slices[sid * 5 + 4];
+    if (qs >= qe || ks >= ke) continue;
+    const bool causal = (mt & 1) != 0;
+    const bool inv = (mt & 2) != 0;
+    for (int64_t qi = 0; qi < n_q_runs; ++qi) {
+      const int64_t q_ls = q_runs[qi * 3 + 0];
+      const int64_t q_gs = q_runs[qi * 3 + 1];
+      const int64_t q_len = q_runs[qi * 3 + 2];
+      const int64_t q_off = q_gs - q_ls;
+      const int64_t gq_lo = qs > q_gs ? qs : q_gs;
+      const int64_t gq_hi = qe < q_gs + q_len ? qe : q_gs + q_len;
+      if (gq_lo >= gq_hi) continue;
+      const int64_t ql_lo = gq_lo - q_off;
+      const int64_t ql_hi = gq_hi - q_off;
+      for (int64_t i = ql_lo / block_q; i * block_q < ql_hi; ++i) {
+        const int64_t bq_lo = ql_lo > i * block_q ? ql_lo : i * block_q;
+        int64_t bq_hi = (i + 1) * block_q;
+        if (ql_hi < bq_hi) bq_hi = ql_hi;
+        // k span needed by global rows [bq_lo+q_off, bq_hi+q_off)
+        int64_t k_lo = ks, k_hi = ke;
+        if (causal) {
+          const int64_t h = ke - qe + (bq_hi + q_off);
+          if (h < k_hi) k_hi = h;
+        }
+        if (inv) {
+          const int64_t l = ks + ((bq_lo + q_off) - qs);
+          if (l > k_lo) k_lo = l;
+        }
+        if (k_hi <= k_lo) continue;
+        for (int64_t ki = 0; ki < n_k_runs; ++ki) {
+          const int64_t k_ls = k_runs[ki * 3 + 0];
+          const int64_t k_gs = k_runs[ki * 3 + 1];
+          const int64_t k_len = k_runs[ki * 3 + 2];
+          const int64_t k_off = k_gs - k_ls;
+          const int64_t gk_lo = k_lo > k_gs ? k_lo : k_gs;
+          const int64_t gk_hi = k_hi < k_gs + k_len ? k_hi : k_gs + k_len;
+          if (gk_lo >= gk_hi) continue;
+          const int64_t kl_lo = gk_lo - k_off;
+          const int64_t kl_hi = gk_hi - k_off;
+          for (int64_t j = kl_lo / block_k; j * block_k < kl_hi; ++j) {
+            if (count < capacity) {
+              int64_t* row = out + count * 9;
+              row[0] = i;
+              row[1] = j;
+              row[2] = sid;
+              row[3] = bq_lo;
+              row[4] = bq_hi;
+              row[5] = kl_lo > j * block_k ? kl_lo : j * block_k;
+              row[6] = kl_hi < (j + 1) * block_k ? kl_hi : (j + 1) * block_k;
+              row[7] = q_off;
+              row[8] = k_off;
+            }
+            ++count;
+          }
+        }
+      }
+    }
+  }
+  return count;
+}
+
+// Exact unmasked-pair count of one slice restricted to (q_runs x k_runs):
+// the area accounting loop of build_block_meta_general.
+int64_t magi_slice_area_runs(
+    const int64_t* slices, int64_t n_slices,
+    const int64_t* q_runs, int64_t n_q_runs,
+    const int64_t* k_runs, int64_t n_k_runs) {
+  int64_t area = 0;
+  for (int64_t sid = 0; sid < n_slices; ++sid) {
+    const int64_t qs = slices[sid * 5 + 0];
+    const int64_t qe = slices[sid * 5 + 1];
+    const int64_t ks = slices[sid * 5 + 2];
+    const int64_t ke = slices[sid * 5 + 3];
+    const int64_t mt = slices[sid * 5 + 4];
+    if (qs >= qe || ks >= ke) continue;
+    const bool causal = (mt & 1) != 0;
+    const bool inv = (mt & 2) != 0;
+    for (int64_t qi = 0; qi < n_q_runs; ++qi) {
+      const int64_t q_gs = q_runs[qi * 3 + 1];
+      const int64_t q_len = q_runs[qi * 3 + 2];
+      const int64_t a = qs > q_gs ? qs : q_gs;
+      const int64_t b = qe < q_gs + q_len ? qe : q_gs + q_len;
+      if (a >= b) continue;
+      for (int64_t ki = 0; ki < n_k_runs; ++ki) {
+        const int64_t k_gs = k_runs[ki * 3 + 1];
+        const int64_t k_len = k_runs[ki * 3 + 2];
+        const int64_t c = ks > k_gs ? ks : k_gs;
+        const int64_t d = (ke < k_gs + k_len ? ke : k_gs + k_len);
+        if (c >= d) continue;
+        // rows q in [a, b): cols [max(lo(q), c), min(hi(q), d)) with
+        // lo(q) = inv ? ks + q - qs : ks, hi(q) = causal ? ke - qe + q + 1 : ke.
+        // A plain per-row loop is plenty fast in native code and immune to
+        // the clip-breakpoint case analysis a closed form would need.
+        for (int64_t q = a; q < b; ++q) {
+          const int64_t lo_q = inv ? ks + (q - qs) : ks;
+          const int64_t hi_q = causal ? ke - qe + q + 1 : ke;
+          const int64_t lo = lo_q > c ? lo_q : c;
+          const int64_t hi = hi_q < d ? hi_q : d;
+          if (hi > lo) area += hi - lo;
+        }
+      }
+    }
+  }
+  return area;
+}
+
+}  // extern "C"

@@ -260,17 +260,34 @@ def build_block_meta_general(
     Local buffers are described by runs (local<->global segment map); the
     mask slices stay in global coordinates.
     """
-    from ..common.mask import slice_area
-
     slices = np.asarray(slices, dtype=np.int64).reshape(-1, SLICE_FIELDS)
     S = slices.shape[0]
     nq = max(_cdiv(total_q, block_q), 1)
     nk = max(_cdiv(total_k, block_k), 1)
 
-    ent = _emit_entries(slices, list(q_runs), list(k_runs), block_q, block_k)
-    entries = (
-        np.asarray(ent, dtype=np.int64) if ent else np.empty((0, 9), dtype=np.int64)
+    q_runs_arr = np.asarray(
+        [(r.local_start, r.global_start, r.length) for r in q_runs],
+        dtype=np.int64,
+    ).reshape(-1, 3)
+    k_runs_arr = np.asarray(
+        [(r.local_start, r.global_start, r.length) for r in k_runs],
+        dtype=np.int64,
+    ).reshape(-1, 3)
+
+    from ..csrc import emit_entries_native
+
+    entries = emit_entries_native(
+        slices, q_runs_arr, k_runs_arr, block_q, block_k
     )
+    if entries is None:  # python fallback (also the parity oracle)
+        ent = _emit_entries(
+            slices, list(q_runs), list(k_runs), block_q, block_k
+        )
+        entries = (
+            np.asarray(ent, dtype=np.int64)
+            if ent
+            else np.empty((0, 9), dtype=np.int64)
+        )
 
     fwd = _build_table(entries.copy(), nq, S, entry_pad, major_col=0)
     bwd = _build_table(entries.copy(), nk, S, entry_pad, major_col=1)
@@ -302,21 +319,25 @@ def build_block_meta_general(
 
     # exact area: intersect each slice with the runs (a slice may reference
     # global rows/cols this rank does not hold)
-    area = 0
-    for sid in range(S):
-        qs, qe, ks, ke, mt = (int(x) for x in slices[sid])
-        for qr in q_runs:
-            a, b = max(qs, qr.global_start), min(qe, qr.global_end)
-            if a >= b:
-                continue
-            k_lo, k_hi = _slice_k_span(a, b, ks, ke, qs, qe, mt)
-            for kr in k_runs:
-                c, d = max(k_lo, kr.global_start), min(k_hi, kr.global_end)
-                if c >= d:
+    from ..csrc import slice_area_runs_native
+
+    area_native = slice_area_runs_native(slices, q_runs_arr, k_runs_arr)
+    if area_native is not None:
+        area = area_native
+    else:
+        area = 0
+        for sid in range(S):
+            qs, qe, ks, ke, mt = (int(x) for x in slices[sid])
+            for qr in q_runs:
+                a, b = max(qs, qr.global_start), min(qe, qr.global_end)
+                if a >= b:
                     continue
-                # area of the sub-rectangle (a,b)x(c,d) under the slice mask:
-                # count pairs satisfying the type constraints
-                area += _sub_area(a, b, c, d, qs, qe, ks, ke, mt)
+                k_lo, k_hi = _slice_k_span(a, b, ks, ke, qs, qe, mt)
+                for kr in k_runs:
+                    c, d = max(k_lo, kr.global_start), min(k_hi, kr.global_end)
+                    if c >= d:
+                        continue
+                    area += _sub_area(a, b, c, d, qs, qe, ks, ke, mt)
 
     return FlexAttnBlockMeta(
         total_q=total_q,

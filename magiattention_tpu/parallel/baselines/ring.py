@@ -25,7 +25,7 @@ import numpy as np
 from ...ops.block_meta import build_block_meta_general, Run
 from ...ops.correction import correct_attn_out_lse
 from ...ops.flex_attn import FlexAttnParams
-from ..dist_attn import StageTables, _call_kernel, _hm, _round_up
+from ..dist_attn import StageTables, _call_kernel, _headmajor_to_seq, _hm, _round_up
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -115,8 +115,7 @@ def ring_attn_local(
         out_h, lse_lanes, _ = _call_kernel(
             qh, kv[0], kv[1], tab, plan.shard_k_pad, fp32_params, None
         )
-        out_i = jnp.transpose(out_h, (1, 0, 2))[: plan.shard_len]
-        lse_i = jnp.transpose(lse_lanes[:, :, 0], (1, 0))[: plan.shard_len]
+        out_i, lse_i = _headmajor_to_seq(out_h, lse_lanes, plan.shard_len)
         if out is None:
             out, lse = out_i, lse_i
         else:

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 from ...ops.block_meta import FlexAttnBlockMeta, build_block_meta
 from ...ops.flex_attn import FlexAttnParams, flex_attn_headmajor, fwd_tables, bwd_tables
-from ..dist_attn import _hm
+from ..dist_attn import _headmajor_to_seq, _hm
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -97,8 +97,7 @@ def ulysses_attn_local(
     out_h, lse_lanes, _ = flex_attn_headmajor(
         qh, kh, vh, fwd_tables(meta), bwd_tables(meta), fp32_params
     )
-    out_g = jnp.transpose(out_h, (1, 0, 2))[: plan.total_seqlen]
-    lse_g = jnp.transpose(lse_lanes[:, :, 0], (1, 0))[: plan.total_seqlen]
+    out_g, lse_g = _headmajor_to_seq(out_h, lse_lanes, plan.total_seqlen)
     out = heads_to_seq(out_g).astype(params.out_jnp_dtype)
     # lse [total, hq/cp] -> [t_loc, hq]
     lse = heads_to_seq(lse_g[..., None])[..., 0]

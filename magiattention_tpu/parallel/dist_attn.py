@@ -471,6 +471,13 @@ def _hm(x, target):
     return x
 
 
+def _headmajor_to_seq(out_h, lse_lanes, n):
+    """Kernel head-major outputs -> ([n, h, d] out, [n, h] lse)."""
+    out = jnp.transpose(out_h, (1, 0, 2))[:n]
+    lse = jnp.transpose(lse_lanes[:, :, 0], (1, 0))[:n]
+    return out, lse
+
+
 def _call_kernel(qh, k_buf, v_buf, tab_arrays, kv_pad, params, sink):
     kh = _hm(k_buf, kv_pad)
     vh = _hm(v_buf, kv_pad)
@@ -513,9 +520,7 @@ def dist_attn_local(
         out_h, lse_lanes, _ = _call_kernel(
             qh, k_full, v_full, tab, plan.merged_tables.kv_pad, params, sink
         )
-        out = jnp.transpose(out_h, (1, 0, 2))[: plan.shard_q_len]
-        lse = jnp.transpose(lse_lanes[:, :, 0], (1, 0))[: plan.shard_q_len]
-        return out, lse
+        return _headmajor_to_seq(out_h, lse_lanes, plan.shard_q_len)
 
     # staged path: host stage + D lse-merged remote stages.
     # The sink joins the softmax denominator exactly once — in the host
@@ -527,8 +532,7 @@ def dist_attn_local(
     out_h, lse_lanes, _ = _call_kernel(
         qh, k, v, host_tab, plan.host_tables.kv_pad, host_params, sink
     )
-    out = jnp.transpose(out_h, (1, 0, 2))[: plan.shard_q_len]
-    lse = jnp.transpose(lse_lanes[:, :, 0], (1, 0))[: plan.shard_q_len]
+    out, lse = _headmajor_to_seq(out_h, lse_lanes, plan.shard_q_len)
 
     stage_params = dataclasses.replace(
         params, has_sink=False, out_dtype="float32"
@@ -542,8 +546,7 @@ def dist_attn_local(
         out_i_h, lse_i_lanes, _ = _call_kernel(
             qh, recv[:, 0], recv[:, 1], tab, sp.tables.kv_pad, stage_params, None
         )
-        out_i = jnp.transpose(out_i_h, (1, 0, 2))[: plan.shard_q_len]
-        lse_i = jnp.transpose(lse_i_lanes[:, :, 0], (1, 0))[: plan.shard_q_len]
+        out_i, lse_i = _headmajor_to_seq(out_i_h, lse_i_lanes, plan.shard_q_len)
         out, lse = correct_attn_out_lse(out, lse, out_i, lse_i)
     return out.astype(params.out_jnp_dtype), lse
 

@@ -1,0 +1,17 @@
+"""Utility subpackage: instrumentation, cost factors, misc helpers."""
+
+from .instrument import add_trace_event, instrument_trace, switch_profile
+from .cost import (
+    TPU_PEAK_SPECS,
+    get_calc_cost_factor,
+    get_comm_cost_factor,
+)
+
+__all__ = [
+    "TPU_PEAK_SPECS",
+    "add_trace_event",
+    "get_calc_cost_factor",
+    "get_comm_cost_factor",
+    "instrument_trace",
+    "switch_profile",
+]

@@ -1,0 +1,53 @@
+"""Tracing / profiling instrumentation.
+
+Role of reference ``utils/nvtx.py`` (instrument_nvtx decorator,
+add_nvtx_event, switch_profile): on TPU the equivalents are
+``jax.named_scope`` (annotates traced computations so they show up in the
+XLA profiler timeline) plus ``jax.profiler`` trace sessions.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Callable, Optional
+
+import jax
+
+
+def instrument_trace(fn: Optional[Callable] = None, *, name: str | None = None):
+    """Decorator: wrap a function in a named scope for profiler timelines
+    (reference @nvtx.instrument_nvtx)."""
+
+    def deco(f):
+        scope = name or f.__qualname__
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            with jax.named_scope(scope):
+                return f(*args, **kwargs)
+
+        return wrapper
+
+    return deco(fn) if fn is not None else deco
+
+
+@contextlib.contextmanager
+def add_trace_event(name: str):
+    """Context manager named-scope (reference add_nvtx_event)."""
+    with jax.named_scope(name):
+        yield
+
+
+@contextlib.contextmanager
+def switch_profile(trace_dir: str | None = None):
+    """Profiler session (reference switch_profile / cudaProfilerStart-Stop):
+    writes an XLA trace viewable in TensorBoard / xprof."""
+    if trace_dir is None:
+        yield
+        return
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,91 @@
+"""Native (C++) planner vs Python fallback parity.
+
+Model: reference tests/test_common/test_protocol_conformance.py — the C++
+backend must produce identical planning results to the Python oracle.
+"""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.csrc import (
+    emit_entries_native,
+    get_lib,
+    slice_area_runs_native,
+)
+from magiattention_tpu.ops.block_meta import (
+    Run,
+    _emit_entries,
+    _slice_k_span,
+    _sub_area,
+)
+
+pytestmark = pytest.mark.skipif(
+    get_lib() is None, reason="native backend unavailable (no g++?)"
+)
+
+
+def _random_case(rng, n_slices=6, n_q_runs=3, n_k_runs=4, span=512):
+    slices = []
+    for _ in range(n_slices):
+        qs = int(rng.integers(0, span - 1))
+        qe = int(rng.integers(qs + 1, span + 1))
+        ks = int(rng.integers(0, span - 1))
+        ke = int(rng.integers(ks + 1, span + 1))
+        slices.append((qs, qe, ks, ke, int(rng.integers(0, 4))))
+    slices = np.asarray(slices, dtype=np.int64)
+
+    def runs(n):
+        out, local = [], 0
+        for _ in range(n):
+            length = int(rng.integers(16, 128))
+            gstart = int(rng.integers(0, span))
+            out.append(Run(local, gstart, length))
+            local += length
+        return out
+
+    return slices, runs(n_q_runs), runs(n_k_runs)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_emit_entries_parity(seed):
+    rng = np.random.default_rng(seed)
+    slices, q_runs, k_runs = _random_case(rng)
+    bq = bk = int(rng.choice([32, 64, 128]))
+    py = _emit_entries(slices, q_runs, k_runs, bq, bk)
+    py_arr = (
+        np.asarray(py, dtype=np.int64) if py else np.empty((0, 9), np.int64)
+    )
+    q_arr = np.asarray(
+        [(r.local_start, r.global_start, r.length) for r in q_runs], np.int64
+    )
+    k_arr = np.asarray(
+        [(r.local_start, r.global_start, r.length) for r in k_runs], np.int64
+    )
+    cpp = emit_entries_native(slices, q_arr, k_arr, bq, bk)
+    np.testing.assert_array_equal(cpp, py_arr)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_area_parity(seed):
+    rng = np.random.default_rng(100 + seed)
+    slices, q_runs, k_runs = _random_case(rng)
+    py_area = 0
+    for sid in range(slices.shape[0]):
+        qs, qe, ks, ke, mt = (int(x) for x in slices[sid])
+        for qr in q_runs:
+            a, b = max(qs, qr.global_start), min(qe, qr.global_end)
+            if a >= b:
+                continue
+            k_lo, k_hi = _slice_k_span(a, b, ks, ke, qs, qe, mt)
+            for kr in k_runs:
+                c, d = max(k_lo, kr.global_start), min(k_hi, kr.global_end)
+                if c >= d:
+                    continue
+                py_area += _sub_area(a, b, c, d, qs, qe, ks, ke, mt)
+    q_arr = np.asarray(
+        [(r.local_start, r.global_start, r.length) for r in q_runs], np.int64
+    )
+    k_arr = np.asarray(
+        [(r.local_start, r.global_start, r.length) for r in k_runs], np.int64
+    )
+    assert slice_area_runs_native(slices, q_arr, k_arr) == py_area
