@@ -37,7 +37,7 @@ import numpy as np
 # Fields per slice in the flattened bounds table (global coords).
 SLICE_FIELDS = 5  # qs, qe, ks, ke, mask_type
 # Fields per entry in the flattened runs table (local windows + offsets).
-RUN_FIELDS = 6  # ql0, ql1, kl0, kl1, qoff, koff
+RUN_FIELDS = 7  # ql0, ql1, kl0, kl1, qoff, koff, needs_mask
 
 
 def _cdiv(a: int, b: int) -> int:
@@ -200,12 +200,57 @@ def _emit_entries(
     return out
 
 
+def _needs_mask_flags(
+    entries: np.ndarray,  # [E, 9] sorted entries
+    slices: np.ndarray | None,  # [S, 5]
+    block_q: int,
+    block_k: int,
+) -> np.ndarray:
+    """1 where the tile needs in-kernel masking, 0 where it is provably
+    fully unmasked (window covers the whole tile AND the slice constraints
+    hold at the worst corners) — lets the kernel skip all VPU mask work on
+    interior tiles via lax.cond."""
+    e = entries.shape[0]
+    if e == 0 or slices is None:
+        return np.ones((e,), dtype=np.int64)
+    qb = entries[:, 0]
+    kb = entries[:, 1]
+    sid = np.minimum(entries[:, 2], slices.shape[0] - 1)
+    dummy = entries[:, 2] >= slices.shape[0]
+    ql0, ql1 = entries[:, 3], entries[:, 4]
+    kl0, kl1 = entries[:, 5], entries[:, 6]
+    qoff, koff = entries[:, 7], entries[:, 8]
+    r0 = qb * block_q
+    c0 = kb * block_k
+    # window covers the whole tile
+    full = (ql0 <= r0) & (ql1 >= r0 + block_q) & (kl0 <= c0) & (
+        kl1 >= c0 + block_k
+    )
+    qs, qe = slices[sid, 0], slices[sid, 1]
+    ks, ke = slices[sid, 2], slices[sid, 3]
+    mt = slices[sid, 4]
+    gq_lo, gq_hi = r0 + qoff, r0 + block_q - 1 + qoff
+    gk_lo, gk_hi = c0 + koff, c0 + block_k - 1 + koff
+    full &= (gq_lo >= qs) & (gq_hi < qe) & (gk_lo >= ks) & (gk_hi < ke)
+    causal = (mt & 1) != 0
+    inv = (mt & 2) != 0
+    # causal worst corner: top row, rightmost col
+    full &= ~causal | ((gk_hi - ke) <= (gq_lo - qe))
+    # inv-causal worst corner: bottom row, leftmost col
+    full &= ~inv | ((gk_lo - ks) >= (gq_hi - qs))
+    full &= ~dummy
+    return (~full).astype(np.int64)
+
+
 def _build_table(
     entries: np.ndarray,  # [E, 9] entry tuples (major-first ordering applied)
     num_major_blocks: int,
     sentinel_slice: int,
     pad_to: int,
     major_col: int = 0,
+    slices_for_flags: np.ndarray | None = None,
+    block_q_f: int = 0,
+    block_k_f: int = 0,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Sort by major block, add dummies for uncovered majors, pad length."""
     dummy = [0] * 9
@@ -234,10 +279,13 @@ def _build_table(
         row[major_col] = int(entries[-1, major_col])
         pad = np.tile(np.asarray([row], dtype=np.int64), (target - e, 1))
         entries = np.concatenate([entries, pad], axis=0)
+    flags = _needs_mask_flags(entries, slices_for_flags, block_q_f, block_k_f)
     major = entries[:, major_col].astype(np.int32)
     minor = entries[:, minor_col].astype(np.int32)
     sid = entries[:, 2].astype(np.int32)
-    runs = entries[:, 3:9].astype(np.int32).reshape(-1)
+    runs = np.concatenate(
+        [entries[:, 3:9], flags[:, None]], axis=1
+    ).astype(np.int32).reshape(-1)
     return major, minor, sid, runs
 
 
@@ -289,8 +337,14 @@ def build_block_meta_general(
             else np.empty((0, 9), dtype=np.int64)
         )
 
-    fwd = _build_table(entries.copy(), nq, S, entry_pad, major_col=0)
-    bwd = _build_table(entries.copy(), nk, S, entry_pad, major_col=1)
+    fwd = _build_table(
+        entries.copy(), nq, S, entry_pad, major_col=0,
+        slices_for_flags=slices, block_q_f=block_q, block_k_f=block_k,
+    )
+    bwd = _build_table(
+        entries.copy(), nk, S, entry_pad, major_col=1,
+        slices_for_flags=slices, block_q_f=block_q, block_k_f=block_k,
+    )
 
     def _pad_table(table, target):
         major, minor, sid, runs = table

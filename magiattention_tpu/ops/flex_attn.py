@@ -174,24 +174,35 @@ def _fwd_kernel(
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     s = _scores(q_ref[0], k_ref[0], params.scale, params.softcap)
-    mask = _entry_mask(bounds, runs, sid[e], e, cur_q * bq, kblk[e] * bk, bq, bk)
-    s = jnp.where(mask, s, NEG_INF)
+    # interior tiles (needs_mask=0, host-precomputed) skip all mask VPU work
+    s = jax.lax.cond(
+        runs[e * RUN_FIELDS + 6] == 1,
+        lambda s: jnp.where(
+            _entry_mask(bounds, runs, sid[e], e, cur_q * bq, kblk[e] * bk, bq, bk),
+            s,
+            NEG_INF,
+        ),
+        lambda s: s,
+        s,
+    )
 
-    m_prev = m_scr[...]  # [bq, LANES] lane-broadcast
+    # softmax state updates on a single lane column (the scratch keeps the
+    # [bq, LANES] layout for tiling legality; only column 0 is meaningful)
+    m_prev = m_scr[:, :1]  # [bq, 1]
     m_cur = jnp.max(s, axis=1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
     m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
     alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
-    p = jnp.exp(s - m_safe[:, :1])
-    l_new = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+    p = jnp.exp(s - m_safe)
+    l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc = acc_scr[...] * alpha + jax.lax.dot_general(
         p.astype(v_ref.dtype),
         v_ref[0],
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    m_scr[...] = m_new
-    l_scr[...] = l_new
+    m_scr[:, :1] = m_new
+    l_scr[:, :1] = l_new
     acc_scr[...] = acc
 
     @pl.when(is_last)
@@ -309,8 +320,16 @@ def _dq_kernel(
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     s = _scores(q_ref[0], k_ref[0], params.scale, params.softcap)
-    mask = _entry_mask(bounds, runs, sid[e], e, cur_q * bq, kblk[e] * bk, bq, bk)
-    s = jnp.where(mask, s, NEG_INF)
+    s = jax.lax.cond(
+        runs[e * RUN_FIELDS + 6] == 1,
+        lambda s: jnp.where(
+            _entry_mask(bounds, runs, sid[e], e, cur_q * bq, kblk[e] * bk, bq, bk),
+            s,
+            NEG_INF,
+        ),
+        lambda s: s,
+        s,
+    )
     lse = lse_ref[0][:, :1]
     lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
     p = jnp.exp(s - lse_safe)
@@ -324,7 +343,7 @@ def _dq_kernel(
     ds = p * (dp - delta)
     if params.softcap > 0.0:
         ds = ds * (1.0 - (s / jnp.float32(params.softcap)) ** 2)
-        ds = jnp.where(mask, ds, 0.0)
+        ds = jnp.where(jnp.isneginf(s), 0.0, ds)  # nan guard off-mask
     dq_scr[...] += jnp.float32(params.scale) * jax.lax.dot_general(
         ds.astype(k_ref.dtype),
         k_ref[0],
@@ -413,8 +432,16 @@ def _dkv_kernel(
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     s = _scores(q_ref[0], k_ref[0], params.scale, params.softcap)
-    mask = _entry_mask(bounds, runs, sid[e], e, qblk[e] * bq, cur_k * bk, bq, bk)
-    s = jnp.where(mask, s, NEG_INF)
+    s = jax.lax.cond(
+        runs[e * RUN_FIELDS + 6] == 1,
+        lambda s: jnp.where(
+            _entry_mask(bounds, runs, sid[e], e, qblk[e] * bq, cur_k * bk, bq, bk),
+            s,
+            NEG_INF,
+        ),
+        lambda s: s,
+        s,
+    )
     lse = lse_ref[0][:, :1]
     lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
     p = jnp.exp(s - lse_safe)
@@ -434,7 +461,7 @@ def _dkv_kernel(
     ds = p * (dp - delta)
     if params.softcap > 0.0:
         ds = ds * (1.0 - (s / jnp.float32(params.softcap)) ** 2)
-        ds = jnp.where(mask, ds, 0.0)
+        ds = jnp.where(jnp.isneginf(s), 0.0, ds)  # nan guard off-mask
     dk_scr[...] += jnp.float32(params.scale) * jax.lax.dot_general(
         ds.astype(q_ref.dtype),
         q_ref[0],
