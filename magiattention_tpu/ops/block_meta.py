@@ -211,7 +211,8 @@ def _needs_mask_flags(
     hold at the worst corners) — lets the kernel skip all VPU mask work on
     interior tiles via lax.cond."""
     e = entries.shape[0]
-    if e == 0 or slices is None:
+    import os
+    if e == 0 or slices is None or os.environ.get("MAGI_DISABLE_MASK_SKIP"):
         return np.ones((e,), dtype=np.int64)
     qb = entries[:, 0]
     kb = entries[:, 1]
@@ -359,7 +360,9 @@ def build_block_meta_general(
         minor = np.concatenate([minor, np.zeros(extra, np.int32)])
         pad_sid = np.full(extra, S, np.int32)
         sid = np.concatenate([sid, pad_sid])
-        runs = np.concatenate([runs, np.zeros(extra * RUN_FIELDS, np.int32)])
+        pad_runs = np.zeros((extra, RUN_FIELDS), np.int32)
+        pad_runs[:, 6] = 1  # pads must mask: sentinel slice = all-masked
+        runs = np.concatenate([runs, pad_runs.reshape(-1)])
         return major, minor, sid, runs
 
     fwd = _pad_table(fwd, pad_entries_to)
@@ -448,11 +451,13 @@ def pad_block_meta(
         if target == e:
             return major, minor, sid, runs
         extra = target - e
+        pad_runs = np.zeros((extra, RUN_FIELDS), np.int32)
+        pad_runs[:, 6] = 1  # pads must mask: sentinel slice = all-masked
         return (
             np.concatenate([major, np.full(extra, major[-1], np.int32)]),
             np.concatenate([minor, np.zeros(extra, np.int32)]),
             np.concatenate([sid, np.full(extra, sentinel, np.int32)]),
-            np.concatenate([runs, np.zeros(extra * RUN_FIELDS, np.int32)]),
+            np.concatenate([runs, pad_runs.reshape(-1)]),
         )
 
     fq, fk, fs, fr = pad_tab(
