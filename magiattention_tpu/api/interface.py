@@ -115,11 +115,16 @@ class DistAttnRuntimeMgr:
         """Distributed flex attention on dispatched tensors.
 
         q [total_padded, hq, d], k/v [total_padded, hk, d] in dispatch order
-        (sharded P(cp_axis) or to-be-sharded). Returns (out, lse) in the same
-        layout. A sink, if any, was baked in at key-creation time (its values
-        are part of the cache key; pass updated sinks by re-keying).
+        (sharded P(cp_axis) or to-be-sharded). Returns
+        ``(out, AttnForwardMeta(lse=...))`` in the same layout (reference
+        calc_attn returns the forward meta alongside out). A sink, if any,
+        was baked in at key-creation time (its values are part of the cache
+        key; pass updated sinks by re-keying).
         """
-        return self._attn_fn(q, k, v)
+        from ..common.forward_meta import AttnForwardMeta
+
+        out, lse = self._attn_fn(q, k, v)
+        return out, AttnForwardMeta(lse=lse)
 
 
 class DistAttnRuntimeDict:
@@ -329,7 +334,7 @@ def undispatch(y: jax.Array, key: DistAttnRuntimeKey):
 
 
 def calc_attn(q, k, v, key: DistAttnRuntimeKey):
-    """Reference api.calc_attn :1041 — returns (out, lse)."""
+    """Reference api.calc_attn :1041 — returns (out, AttnForwardMeta)."""
     return get_runtime_mgr(key).calc_attn(q, k, v)
 
 

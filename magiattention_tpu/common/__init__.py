@@ -12,12 +12,17 @@ from .enum import (
     GroupReduceOp,
     OverlapAlgType,
 )
+from .forward_meta import AttnForwardMeta
 from .mask import make_attn_mask_from_ranges, slice_area, slice_mask, total_area
+from .rectangle import AttnRectangle, AttnRectangles
 from .range import AttnRange, NaiveRange, RangeError
 from .ranges import AttnRanges, NaiveRanges, check_valid_cu_seqlens, is_valid_cu_seqlens
 
 __all__ = [
+    "AttnForwardMeta",
     "AttnKernelBackend",
+    "AttnRectangle",
+    "AttnRectangles",
     "AttnMaskType",
     "AttnOverlapMode",
     "AttnPrecision",

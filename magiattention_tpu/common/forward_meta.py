@@ -1,0 +1,17 @@
+"""AttnForwardMeta: auxiliary forward outputs (reference common/forward_meta.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+
+
+@dataclasses.dataclass
+class AttnForwardMeta:
+    """Auxiliary outputs of every forward path: the log-sum-exp per (token,
+    head) and optionally the per-head max logit (Muon QK-Clip)."""
+
+    lse: Optional[jax.Array] = None  # [tokens, heads_q] f32
+    max_logits: Optional[jax.Array] = None  # [heads_q] f32

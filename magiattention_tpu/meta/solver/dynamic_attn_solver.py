@@ -1,0 +1,101 @@
+"""DynamicAttnSolver: partition the attention plane itself across ranks.
+
+Role of reference ``meta/solver/dynamic_attn_solver.py`` + the
+``meta/algorithms`` family (BinaryGreedyParallel default, _make_attn_meta.py
+:81): instead of assigning whole q-chunks (the static solver), model the
+workload as AttnRectangles in the (q, k) plane and cut it into cp
+equal-area regions — the planning core of qo-comm mode, where both Q/O and
+KV can move. The default algorithm here is the binary-greedy KD split:
+recursively halve the rank set, alternating q-line and k-line cuts placed
+by binary search so area divides proportionally.
+
+This module provides the geometric solver + balance accounting; wiring its
+output into a qo-comm execution runtime (group-casting Q and group-reducing
+O with the lse op) is the planned extension of parallel/dist_attn.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ...common.rectangle import AttnRectangles
+
+
+@dataclasses.dataclass(frozen=True)
+class DynamicAttnSolution:
+    """Per-rank workload regions; areas sum exactly to the input area."""
+
+    rank_rects: tuple[AttnRectangles, ...]
+
+    @property
+    def areas(self) -> tuple[int, ...]:
+        return tuple(r.area for r in self.rank_rects)
+
+    @property
+    def balance_ratio(self) -> float:
+        areas = self.areas
+        total = sum(areas)
+        if total == 0:
+            return 1.0
+        return max(areas) / (total / len(areas))
+
+
+class DynamicAttnSolver:
+    """Binary-greedy KD partition (reference BinaryGreedyParallel default)."""
+
+    def __init__(self, alternate: bool = True):
+        self.alternate = alternate
+
+    def solve(
+        self, rects: AttnRectangles, cp_size: int
+    ) -> DynamicAttnSolution:
+        parts = self._split(rects, cp_size, axis_q=True)
+        assert len(parts) == cp_size
+        return DynamicAttnSolution(rank_rects=tuple(parts))
+
+    def _split(
+        self, rects: AttnRectangles, n: int, axis_q: bool
+    ) -> list[AttnRectangles]:
+        if n == 1:
+            return [rects]
+        n_left = n // 2
+        frac = n_left / n
+        left, right = self._cut_for_fraction(rects, frac, axis_q)
+        next_axis = (not axis_q) if self.alternate else axis_q
+        return self._split(left, n_left, next_axis) + self._split(
+            right, n - n_left, next_axis
+        )
+
+    def _cut_for_fraction(
+        self, rects: AttnRectangles, frac: float, axis_q: bool
+    ) -> tuple[AttnRectangles, AttnRectangles]:
+        """Binary-search the cut line so the first side holds ~frac of area."""
+        total = rects.area
+        if total == 0 or len(rects) == 0:
+            return rects, AttnRectangles()
+        if axis_q:
+            lo = min(r.q_range.start for r in rects)
+            hi = max(r.q_range.end for r in rects)
+            area_left = rects.area_left_of_q
+            cut = rects.cut_q
+        else:
+            lo = min(r.k_range.start for r in rects)
+            hi = max(r.k_range.end for r in rects)
+            area_left = rects.area_left_of_k
+            cut = rects.cut_k
+        target = frac * total
+        # probe with closed-form areas only; build pieces once at the end
+        best_pos, best_err = lo, abs(area_left(lo) - target)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            a = area_left(mid)
+            err = abs(a - target)
+            if err < best_err:
+                best_pos, best_err = mid, err
+            if a < target:
+                lo = mid + 1
+            else:
+                hi = mid
+        if abs(area_left(lo) - target) < best_err:
+            best_pos = lo
+        return cut(best_pos)

@@ -7,7 +7,9 @@ from .precision import (
     calc_inf_norm,
     calc_rel_err,
 )
-from .ref_attn import ref_attn, ref_attn_from_ranges
+from .flag_generator import FlagCombGenerator
+from .gt_dispatcher import GroundTruthDispatcher
+from .ref_attn import ref_attn, ref_attn_from_ranges, ref_attn_online
 
 __all__ = [
     "MISMATCH_THRES_RATIO",
@@ -15,6 +17,9 @@ __all__ = [
     "assert_close_to_ref",
     "calc_inf_norm",
     "calc_rel_err",
+    "FlagCombGenerator",
+    "GroundTruthDispatcher",
     "ref_attn",
     "ref_attn_from_ranges",
+    "ref_attn_online",
 ]

@@ -1,0 +1,72 @@
+"""FlagCombGenerator: iterate valid configuration combinations in tests.
+
+Role of reference ``testing/flag_generator.py`` (env-flag matrix coverage
+with cross-rank sync): enumerate combinations of behavior-influencing
+options, filtering illegal pairs. On TPU there is no cross-rank sync needed
+(tests are single-process SPMD), so this is a plain constrained-product
+iterator with deterministic/random/sequential modes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Iterator, Mapping, Sequence
+
+
+class FlagCombGenerator:
+    """Iterate dicts over a cartesian flag space, skipping illegal combos.
+
+    Args:
+        space: mapping flag name -> candidate values.
+        is_legal: optional predicate rejecting combinations.
+        mode: 'sequential' (full product), 'random' (sampled), or
+            'heuristic' (one-hot around the first/default combination —
+            covers every value of every flag once, linear in space size).
+    """
+
+    def __init__(
+        self,
+        space: Mapping[str, Sequence[Any]],
+        is_legal: Callable[[dict], bool] | None = None,
+        mode: str = "heuristic",
+        num_samples: int = 16,
+        seed: int = 0,
+    ):
+        self.space = dict(space)
+        self.is_legal = is_legal or (lambda c: True)
+        self.mode = mode
+        self.num_samples = num_samples
+        self.seed = seed
+
+    def __iter__(self) -> Iterator[dict]:
+        keys = list(self.space)
+        if self.mode == "sequential":
+            for vals in itertools.product(*(self.space[k] for k in keys)):
+                combo = dict(zip(keys, vals))
+                if self.is_legal(combo):
+                    yield combo
+        elif self.mode == "random":
+            rng = random.Random(self.seed)
+            seen = set()
+            trials = 0
+            while len(seen) < self.num_samples and trials < 100 * self.num_samples:
+                trials += 1
+                combo = {k: rng.choice(list(self.space[k])) for k in keys}
+                key = tuple(combo[k] for k in keys)
+                if key in seen or not self.is_legal(combo):
+                    continue
+                seen.add(key)
+                yield combo
+        elif self.mode == "heuristic":
+            base = {k: self.space[k][0] for k in keys}
+            if self.is_legal(base):
+                yield dict(base)
+            for k in keys:
+                for v in self.space[k][1:]:
+                    combo = dict(base)
+                    combo[k] = v
+                    if self.is_legal(combo):
+                        yield combo
+        else:  # pragma: no cover
+            raise ValueError(f"unknown mode {self.mode}")

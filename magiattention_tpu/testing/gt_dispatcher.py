@@ -1,0 +1,43 @@
+"""GroundTruthDispatcher: naive dispatch oracle (reference testing/gt_dispatcher.py).
+
+Recomputes the dispatch permutation directly from the partition definition
+with plain Python indexing — the oracle the optimized perm/unperm index
+arithmetic is checked against.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..meta.dispatch_meta import DispatchMeta
+
+
+class GroundTruthDispatcher:
+    def __init__(self, meta: DispatchMeta):
+        self.meta = meta
+
+    def dispatch(self, x: np.ndarray) -> np.ndarray:
+        """Rank-major concatenation of each rank's chunks, naively."""
+        cs = self.meta.chunk_size
+        pieces = []
+        for rank in range(self.meta.cp_size):
+            for c in self.meta.partitions[rank]:
+                pieces.append(x[c * cs : (c + 1) * cs])
+        return np.concatenate(pieces, axis=0)
+
+    def undispatch(self, y: np.ndarray) -> np.ndarray:
+        cs = self.meta.chunk_size
+        out = np.empty_like(y)
+        pos = 0
+        for rank in range(self.meta.cp_size):
+            for c in self.meta.partitions[rank]:
+                out[c * cs : (c + 1) * cs] = y[pos : pos + cs]
+                pos += cs
+        return out
+
+    def shard(self, x: np.ndarray, rank: int) -> np.ndarray:
+        cs = self.meta.chunk_size
+        return np.concatenate(
+            [x[c * cs : (c + 1) * cs] for c in self.meta.partitions[rank]],
+            axis=0,
+        )

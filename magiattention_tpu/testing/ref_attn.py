@@ -75,6 +75,51 @@ def ref_attn(
     return o, jnp.transpose(lse, (1, 0)), max_logits  # lse → [tq, hq]
 
 
+def ref_attn_online(
+    q: jax.Array,  # [tq, hq, d]
+    k: jax.Array,
+    v: jax.Array,
+    mask: np.ndarray | jax.Array,
+    *,
+    scale: float | None = None,
+    block: int = 128,
+    compute_dtype=jnp.float32,
+) -> tuple[jax.Array, jax.Array]:
+    """Block-wise online-softmax reference (reference sdpa_online.py role):
+    the same numerics path shape as the kernels — lower memory than the
+    dense oracle, second opinion for the streaming-softmax math.
+    Returns (out, lse)."""
+    tq, hq, d = q.shape
+    tk, hk, _ = k.shape
+    group = hq // hk
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    kf = jnp.repeat(k.astype(compute_dtype), group, axis=1)
+    vf = jnp.repeat(v.astype(compute_dtype), group, axis=1)
+    qf = q.astype(compute_dtype)
+    mask_arr = jnp.asarray(np.asarray(mask), dtype=bool)
+
+    m = jnp.full((tq, hq), NEG_INF, compute_dtype)
+    l = jnp.zeros((tq, hq), compute_dtype)
+    acc = jnp.zeros((tq, hq, d), compute_dtype)
+    for s0 in range(0, tk, block):
+        s1 = min(s0 + block, tk)
+        sblk = jnp.einsum("qhd,khd->qhk", qf, kf[s0:s1]) * scale
+        sblk = jnp.where(mask_arr[:, s0:s1][:, None, :], sblk, NEG_INF)
+        m_new = jnp.maximum(m, sblk.max(axis=-1))
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        p = jnp.exp(sblk - m_safe[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "qhk,khd->qhd", p, vf[s0:s1]
+        )
+        m = m_new
+    lse = jnp.where(l > 0, jnp.where(jnp.isneginf(m), 0.0, m) + jnp.log(jnp.maximum(l, 1e-300)), NEG_INF)
+    out = acc / jnp.where(l > 0, l, 1.0)[..., None]
+    return out, lse
+
+
 def ref_attn_from_ranges(
     q: jax.Array,
     k: jax.Array,

@@ -100,7 +100,8 @@ def test_end_to_end_with_padding(cp):
 
     def step(q, k, v):
         qd, kd, vd = dispatch(q, key), dispatch(k, key), dispatch(v, key)
-        out_d, lse_d = calc_attn(qd, kd, vd, key)
+        out_d, fwd_meta = calc_attn(qd, kd, vd, key)
+        assert fwd_meta.lse.shape == qd.shape[:2]
         return undispatch(out_d, key)
 
     out = jax.jit(step)(q, k, v)

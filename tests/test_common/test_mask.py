@@ -83,3 +83,51 @@ def test_make_attn_mask_union():
     # rows 4-7: causal bottom-right over k [0,8)
     for i, row in enumerate(mask[4:]):
         assert row.sum() == 5 + i
+
+
+def test_online_oracle_matches_dense():
+    import jax.numpy as jnp
+    from magiattention_tpu.testing import ref_attn, ref_attn_online
+
+    rng = np.random.default_rng(11)
+    tq = tk = 160
+    q = jnp.asarray(rng.standard_normal((tq, 4, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((tk, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((tk, 2, 32)), jnp.float32)
+    mask = make_attn_mask_from_ranges(
+        AttnRanges.from_ranges([(0, 100), (100, 160)]),
+        AttnRanges.from_ranges([(0, 100), (0, 160)]),
+        [AttnMaskType.CAUSAL, AttnMaskType.CAUSAL], tq, tk,
+    )
+    out_d, lse_d, _ = ref_attn(q, k, v, mask)
+    out_o, lse_o = ref_attn_online(q, k, v, mask, block=48)
+    np.testing.assert_allclose(np.asarray(out_o), np.asarray(out_d), atol=2e-6, rtol=2e-6)
+    finite = ~np.isneginf(np.asarray(lse_d))
+    np.testing.assert_allclose(
+        np.asarray(lse_o)[finite], np.asarray(lse_d)[finite], atol=2e-6, rtol=2e-6)
+
+
+def test_gt_dispatcher_matches_meta():
+    from magiattention_tpu.meta import make_dispatch_meta_from_qk_ranges
+    from magiattention_tpu.testing import GroundTruthDispatcher
+
+    q = AttnRanges.from_ranges([(0, 128)])
+    mq, _, _ = make_dispatch_meta_from_qk_ranges(q, q, [1], 128, 128, chunk_size=16, cp_size=4)
+    gt = GroundTruthDispatcher(mq)
+    x = np.arange(128)
+    np.testing.assert_array_equal(gt.dispatch(x), x[mq.perm_idx])
+    np.testing.assert_array_equal(gt.undispatch(gt.dispatch(x)), x)
+    for r in range(4):
+        np.testing.assert_array_equal(gt.shard(x, r), x[mq.position_ids(r)])
+
+
+def test_flag_comb_generator():
+    from magiattention_tpu.testing import FlagCombGenerator
+
+    space = {"a": [1, 2, 3], "b": [True, False]}
+    seq = list(FlagCombGenerator(space, mode="sequential"))
+    assert len(seq) == 6
+    heur = list(FlagCombGenerator(space, mode="heuristic"))
+    assert len(heur) == 1 + 2 + 1  # base + |a|-1 + |b|-1
+    legal = lambda c: not (c["a"] == 3 and c["b"])
+    assert all(legal(c) for c in FlagCombGenerator(space, legal, mode="sequential"))

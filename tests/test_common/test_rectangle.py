@@ -1,0 +1,87 @@
+"""AttnRectangle cut operations vs dense-mask brute force."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.common import AttnMaskType, AttnRange
+from magiattention_tpu.common.mask import slice_mask
+from magiattention_tpu.common.rectangle import AttnRectangle, AttnRectangles
+
+TYPES = list(AttnMaskType)
+SPAN = 48
+
+
+def _dense(rect: AttnRectangle) -> np.ndarray:
+    return slice_mask(
+        rect.q_range.start,
+        rect.q_range.end,
+        rect.k_range.start,
+        rect.k_range.end,
+        rect.mask_type,
+        SPAN,
+        SPAN,
+    )
+
+
+def _dense_list(rects) -> np.ndarray:
+    m = np.zeros((SPAN, SPAN), bool)
+    for r in rects:
+        m |= _dense(r)
+    return m
+
+
+def _rand_rect(rng, mt):
+    qs = int(rng.integers(0, SPAN - 2))
+    qe = int(rng.integers(qs + 1, SPAN))
+    ks = int(rng.integers(0, SPAN - 2))
+    ke = int(rng.integers(ks + 1, SPAN))
+    return AttnRectangle(AttnRange(qs, qe), AttnRange(ks, ke), mt)
+
+
+@pytest.mark.parametrize("mt", TYPES)
+@pytest.mark.parametrize("seed", range(6))
+def test_cut_q_exact(mt, seed):
+    rng = np.random.default_rng(seed)
+    rect = _rand_rect(rng, mt)
+    pos = int(rng.integers(0, SPAN))
+    top, bottom = rect.cut_q(pos)
+    m = np.zeros((SPAN, SPAN), bool)
+    for piece, rows in ((top, slice(0, pos)), (bottom, slice(pos, SPAN))):
+        if piece is None:
+            continue
+        pm = _dense(piece)
+        # piece must stay within its row half
+        outside = pm.copy()
+        outside[rows] = False
+        assert not outside.any()
+        m |= pm
+    np.testing.assert_array_equal(m, _dense(rect))
+    # areas partition
+    assert (top.area if top else 0) + (bottom.area if bottom else 0) == rect.area
+
+
+@pytest.mark.parametrize("mt", TYPES)
+@pytest.mark.parametrize("seed", range(6))
+def test_cut_k_exact(mt, seed):
+    rng = np.random.default_rng(100 + seed)
+    rect = _rand_rect(rng, mt)
+    pos = int(rng.integers(0, SPAN))
+    left, right = rect.cut_k_multi(pos)
+    ml = _dense_list(left)
+    mr = _dense_list(right)
+    assert not ml[:, pos:].any(), "left pieces leak right of the cut"
+    assert not mr[:, :pos].any(), "right pieces leak left of the cut"
+    np.testing.assert_array_equal(ml | mr, _dense(rect))
+    assert not (ml & mr).any()
+
+
+def test_rectangles_aggregate():
+    rects = AttnRectangles.from_ranges(
+        [(0, 16), (16, 32)], [(0, 16), (0, 32)],
+        [AttnMaskType.CAUSAL, AttnMaskType.CAUSAL],
+    )
+    total = rects.area
+    top, bottom = rects.cut_q(16)
+    assert top.area + bottom.area == total
+    left, right = rects.cut_k(8)
+    assert left.area + right.area == total

@@ -1,0 +1,52 @@
+"""Dynamic (rectangle) solver: exact partition + balance."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.common import AttnMaskType
+from magiattention_tpu.common.mask import make_attn_mask_from_ranges
+from magiattention_tpu.common.rectangle import AttnRectangles
+from magiattention_tpu.meta.solver.dynamic_attn_solver import DynamicAttnSolver
+
+C = AttnMaskType.CAUSAL
+F = AttnMaskType.FULL
+
+
+CASES = [
+    ("causal", 256, [(0, 256)], [(0, 256)], [C]),
+    (
+        "varlen_mixed",
+        256,
+        [(0, 96), (96, 224), (224, 256)],
+        [(0, 96), (0, 224), (96, 256)],
+        [C, C, F],
+    ),
+]
+
+
+@pytest.mark.parametrize("cp", [2, 4, 8])
+@pytest.mark.parametrize("name,total,qr,kr,ts", CASES, ids=[c[0] for c in CASES])
+def test_partition_exact_and_balanced(name, total, qr, kr, ts, cp):
+    rects = AttnRectangles.from_ranges(qr, kr, ts)
+    total_area = rects.area
+    sol = DynamicAttnSolver().solve(rects, cp)
+
+    # exact partition: areas sum, dense masks disjoint + union == original
+    assert sum(sol.areas) == total_area
+    ref = make_attn_mask_from_ranges(qr, kr, ts, total, total)
+    acc = np.zeros_like(ref, dtype=np.int32)
+    for rr in sol.rank_rects:
+        for rect in rr:
+            sub = make_attn_mask_from_ranges(
+                [rect.q_range.to_naive_range()],
+                [rect.k_range.to_naive_range()],
+                [rect.mask_type],
+                total,
+                total,
+            )
+            acc += sub.astype(np.int32)
+    np.testing.assert_array_equal(acc > 0, ref)
+    assert (acc <= 1).all(), "rank regions overlap"
+
+    # balance: within 25% of ideal for these workloads
+    assert sol.balance_ratio < 1.25, sol.areas
