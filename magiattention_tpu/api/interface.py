@@ -343,6 +343,69 @@ def get_position_ids(key: DistAttnRuntimeKey):
     return get_runtime_mgr(key).get_position_ids()
 
 
+def make_flex_key_for_new_mask_after_dispatch(
+    q_ranges: AttnRanges | Sequence[Sequence[int]],
+    k_ranges: AttnRanges | Sequence[Sequence[int]],
+    attn_type_map: Sequence[AttnMaskType | int],
+    old_key: DistAttnRuntimeKey,
+) -> DistAttnRuntimeKey:
+    """Plan a NEW mask on the EXISTING dispatch of ``old_key``
+    (reference make_varlen_key_for_new_mask_after_dispatch,
+    api/magi_attn_interface.py:1167 — hybrid attention: several masks per
+    layer stack reuse one token permutation, so dispatched activations are
+    shared and only the attention plan differs).
+
+    The chunk->rank partition (and thus dispatch/undispatch/position_ids)
+    is inherited; the comm routing and kernel tables are re-planned for the
+    new mask.
+    """
+    global _most_recent_key
+    old_mgr = get_runtime_mgr(old_key)
+    if not isinstance(q_ranges, AttnRanges):
+        q_ranges = AttnRanges.from_ranges(q_ranges)
+    if not isinstance(k_ranges, AttnRanges):
+        k_ranges = AttnRanges.from_ranges(k_ranges)
+    types = tuple(int(t) for t in attn_type_map)
+    new_key = dataclasses.replace(
+        old_key,
+        q_ranges=tuple(q_ranges.to_naive_ranges()),
+        k_ranges=tuple(k_ranges.to_naive_ranges()),
+        attn_type_map=types,
+    )
+    if new_key in _runtime_dict:
+        _most_recent_key = new_key
+        return new_key
+
+    from ..meta.dispatch_meta import make_global_bucket_from_qk_ranges
+
+    meta = old_mgr.dispatch_meta
+    bucket = make_global_bucket_from_qk_ranges(
+        q_ranges,
+        k_ranges,
+        [AttnMaskType(t) for t in types],
+        new_key.total_seqlen_q,
+        meta.chunk_size,
+    )
+    plan = build_dist_attn_plan(
+        meta, bucket, block_q=env.block_q(), block_k=env.block_k()
+    )
+    params = make_attn_params(
+        plan,
+        new_key.head_dim,
+        softcap=new_key.softcap,
+        has_sink=False,
+        out_dtype=new_key.out_dtype,
+        interpret=new_key.interpret,
+    )
+    attn_fn = make_dist_attn_fn(plan, old_mgr.mesh, params, axis_name=new_key.cp_axis)
+    _runtime_dict.put(
+        new_key,
+        DistAttnRuntimeMgr(new_key, old_mgr.mesh, meta, plan, attn_fn),
+    )
+    _most_recent_key = new_key
+    return new_key
+
+
 def roll(x: jax.Array, key: DistAttnRuntimeKey, shift: int, axis: int = 0):
     """Distributed roll along the global sequence of a dispatched tensor
     (reference api.roll :960 — MTP label shifting)."""

@@ -6,9 +6,12 @@ from .group_collective import (
     group_reduce_lse,
     group_reduce_sum,
 )
+from .hier import HierGroupCollectiveMeta, group_cast_hier
 
 __all__ = [
     "GroupCollectiveMeta",
+    "HierGroupCollectiveMeta",
+    "group_cast_hier",
     "group_cast",
     "group_reduce_lse",
     "group_reduce_sum",

@@ -1,0 +1,237 @@
+"""Hierarchical (2-level) GroupCast: inter-node dedup over a (dcn, ici) mesh.
+
+Role of reference ``comm/primitive/grpcoll/_group_collective_hier.py``
+(HierGroupCastMetaSolver + 2-level a2av impl): when several ranks of one
+node need the same KV row from a remote node, send it across the slow
+inter-node link ONCE to a gateway rank, then multicast within the node over
+the fast links. On TPU the two levels are mesh axes — typically
+('dcn', 'ici') — and each hop is a statically-routed padded all_to_all over
+one axis (the same machinery as the flat GroupCollectiveMeta).
+
+Routing: src rank s = (Sn, si) sends the union of rows needed by any rank
+of dst node Dn to gateway g = (Dn, si) (its own intra position, over the
+inter axis); the gateway forwards each row to its final consumers over the
+intra axis. The final receive layout at rank d = (Dn, di) is
+(gateway si asc, src node Sn asc, gateway-buffer position) — exposed to the
+planner through :meth:`recv_row_sources`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .group_collective import GroupCollectiveMeta, group_cast
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class HierGroupCollectiveMeta:
+    """Two-hop routing plan. Rank index = inter * n_intra + intra."""
+
+    n_inter: int
+    n_intra: int
+    # hop 1: over the inter axis; per-rank routing rows, world = n_inter
+    inter_send_idx: np.ndarray  # [n, n_inter, S1]
+    inter_recv_sel: np.ndarray  # [n, R1]
+    inter_recv_valid: np.ndarray  # [n, R1]
+    # hop 2: over the intra axis; world = n_intra; sends rows of the
+    # gateway buffer (hop-1 output)
+    intra_send_idx: np.ndarray  # [n, n_intra, S2]
+    intra_recv_sel: np.ndarray  # [n, R2]
+    intra_recv_valid: np.ndarray  # [n, R2]
+    recv_total: tuple[int, ...]  # valid final rows per rank
+    inter_rows_total: tuple[int, ...]  # hop-1 payload rows per rank (dedup'd)
+
+    @property
+    def max_recv(self) -> int:
+        return int(self.intra_recv_sel.shape[1])
+
+    def device_arrays(self):
+        return tuple(
+            jnp.asarray(a)
+            for a in (
+                self.inter_send_idx,
+                self.inter_recv_sel,
+                self.inter_recv_valid,
+                self.intra_send_idx,
+                self.intra_recv_sel,
+                self.intra_recv_valid,
+            )
+        )
+
+    @staticmethod
+    def build(
+        send_map: list[list[np.ndarray]],  # [src rank][dst rank] local rows
+        num_local_rows: list[int],
+        n_inter: int,
+        n_intra: int,
+        pad_to: int = 8,
+    ) -> tuple["HierGroupCollectiveMeta", list[list[tuple[int, np.ndarray]]]]:
+        """Build the two-hop plan.
+
+        Returns (meta, recv_sources) where ``recv_sources[d]`` lists
+        (src_rank, src_local_rows) in the FINAL receive order at rank d —
+        what the planner needs to lay out runs (global ids =
+        pos_ids[src][rows]).
+        """
+        n = n_inter * n_intra
+        assert len(send_map) == n
+
+        def rank(node, intra):
+            return node * n_intra + intra
+
+        # hop 1: union rows per (src rank, dst node), sorted by src-local idx
+        s1 = [[np.empty(0, np.int64) for _ in range(n_inter)] for _ in range(n)]
+        for s in range(n):
+            for dn in range(n_inter):
+                rows = np.unique(
+                    np.concatenate(
+                        [send_map[s][rank(dn, di)] for di in range(n_intra)]
+                        + [np.empty(0, np.int64)]
+                    )
+                )
+                s1[s][dn] = rows.astype(np.int64)
+
+        S1 = max(1, max(len(s1[s][dn]) for s in range(n) for dn in range(n_inter)))
+        S1 = -(-S1 // pad_to) * pad_to
+        # gateway buffer at g=(Dn, si): concat over Sn of s1[(Sn, si)][Dn]
+        gw_rows: list[list[tuple[int, np.ndarray]]] = [[] for _ in range(n)]
+        gw_len = [0] * n
+        gw_offsets: dict[tuple[int, int], int] = {}  # (gateway, src rank) -> base
+        for dn in range(n_inter):
+            for si in range(n_intra):
+                g = rank(dn, si)
+                pos = 0
+                for sn in range(n_inter):
+                    s = rank(sn, si)
+                    rows = s1[s][dn]
+                    gw_offsets[(g, s)] = pos
+                    gw_rows[g].append((s, rows))
+                    pos += len(rows)
+                gw_len[g] = pos
+
+        inter_send = np.zeros((n, n_inter, S1), np.int32)
+        R1 = max(1, max(gw_len))
+        R1 = -(-R1 // pad_to) * pad_to
+        inter_sel = np.full((n, R1), n_inter * S1, np.int32)
+        inter_valid = np.zeros((n, R1), bool)
+        for s in range(n):
+            for dn in range(n_inter):
+                rows = s1[s][dn]
+                inter_send[s, dn, : len(rows)] = rows
+        for g in range(n):
+            pos = 0
+            for sn in range(n_inter):
+                s = rank(sn, g % n_intra)
+                rows = s1[s][g // n_intra]
+                inter_sel[g, pos : pos + len(rows)] = sn * S1 + np.arange(
+                    len(rows)
+                )
+                inter_valid[g, pos : pos + len(rows)] = True
+                pos += len(rows)
+
+        # hop 2: gateway g=(Dn, si) -> local dst (Dn, di): the gateway-buffer
+        # positions of the rows dst needs from each src (Sn, si)
+        s2 = [[np.empty(0, np.int64) for _ in range(n_intra)] for _ in range(n)]
+        for dn in range(n_inter):
+            for di in range(n_intra):
+                d = rank(dn, di)
+                for si in range(n_intra):
+                    g = rank(dn, si)
+                    idx_parts = []
+                    for sn in range(n_inter):
+                        s = rank(sn, si)
+                        need = send_map[s][d]
+                        if len(need) == 0:
+                            continue
+                        union = s1[s][dn]
+                        loc = np.searchsorted(union, need)
+                        idx_parts.append(gw_offsets[(g, s)] + loc)
+                    s2[g][di] = (
+                        np.concatenate(
+                            [s2[g][di]] + [p.astype(np.int64) for p in idx_parts]
+                        )
+                        if idx_parts
+                        else s2[g][di]
+                    )
+
+        S2 = max(1, max(len(s2[g][di]) for g in range(n) for di in range(n_intra)))
+        S2 = -(-S2 // pad_to) * pad_to
+        intra_send = np.zeros((n, n_intra, S2), np.int32)
+        recv_tot = [0] * n
+        for g in range(n):
+            for di in range(n_intra):
+                rows = s2[g][di]
+                intra_send[g, di, : len(rows)] = rows
+        for dn in range(n_inter):
+            for di in range(n_intra):
+                d = rank(dn, di)
+                recv_tot[d] = sum(
+                    len(s2[rank(dn, si)][di]) for si in range(n_intra)
+                )
+        R2 = max(1, max(recv_tot))
+        R2 = -(-R2 // pad_to) * pad_to
+        intra_sel = np.full((n, R2), n_intra * S2, np.int32)
+        intra_valid = np.zeros((n, R2), bool)
+        for dn in range(n_inter):
+            for di in range(n_intra):
+                d = rank(dn, di)
+                pos = 0
+                for si in range(n_intra):
+                    g = rank(dn, si)
+                    ln = len(s2[g][di])
+                    intra_sel[d, pos : pos + ln] = si * S2 + np.arange(ln)
+                    intra_valid[d, pos : pos + ln] = True
+                    pos += ln
+
+        meta = HierGroupCollectiveMeta(
+            n_inter=n_inter,
+            n_intra=n_intra,
+            inter_send_idx=inter_send,
+            inter_recv_sel=inter_sel,
+            inter_recv_valid=inter_valid,
+            intra_send_idx=intra_send,
+            intra_recv_sel=intra_sel,
+            intra_recv_valid=intra_valid,
+            recv_total=tuple(recv_tot),
+            inter_rows_total=tuple(
+                sum(len(s1[s][dn]) for dn in range(n_inter)) for s in range(n)
+            ),
+        )
+        # reorder recv_sources to the actual final layout: (si asc, sn asc)
+        ordered: list[list[tuple[int, np.ndarray]]] = [[] for _ in range(n)]
+        for dn in range(n_inter):
+            for di in range(n_intra):
+                d = rank(dn, di)
+                for si in range(n_intra):
+                    for sn in range(n_inter):
+                        s = rank(sn, si)
+                        need = send_map[s][d]
+                        if len(need):
+                            ordered[d].append((s, np.asarray(need, np.int64)))
+        return meta, ordered
+
+
+def group_cast_hier(
+    x: jax.Array,  # [T_local, ...] rank-local rows (inside shard_map)
+    tables,  # the 6 per-rank routing slices (leading dim 1)
+    *,
+    axis_inter: str = "dcn",
+    axis_intra: str = "ici",
+):
+    """Two-hop multicast: dedup'd inter-axis a2a, then intra-axis a2a."""
+    (
+        inter_send,
+        inter_sel,
+        inter_valid,
+        intra_send,
+        intra_sel,
+        intra_valid,
+    ) = tables
+    gw = group_cast(x, inter_send, inter_sel, inter_valid, axis_name=axis_inter)
+    return group_cast(
+        gw, intra_send, intra_sel, intra_valid, axis_name=axis_intra
+    )

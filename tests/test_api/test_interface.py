@@ -169,3 +169,37 @@ def test_roll_matches_global_roll():
     for shift in [1, -1, 7]:
         got = np.asarray(undispatch(roll(xd, key, shift), key))
         np.testing.assert_array_equal(got, np.roll(np.arange(total), shift))
+
+
+def test_new_mask_after_dispatch_reuses_partition():
+    """Hybrid attention: two masks share one dispatch (reference
+    make_varlen_key_for_new_mask_after_dispatch)."""
+    from magiattention_tpu.api import make_flex_key_for_new_mask_after_dispatch
+    from magiattention_tpu.common import AttnMaskType
+
+    mesh = _mesh(4)
+    total, hq, hk, d = 512, 2, 2, 32
+    key1 = magi_attn_varlen_key(
+        [0, 256, 512], total, mesh, num_heads=(hq, hk), head_dim=d,
+        chunk_size=32, out_dtype="float32",
+    )
+    # second mask: full attention within each doc (same docs, different type)
+    qr, kr, _ = infer_attn_mask_from_cu_seqlens([0, 256, 512])
+    key2 = make_flex_key_for_new_mask_after_dispatch(
+        qr, kr, [AttnMaskType.FULL, AttnMaskType.FULL], key1,
+    )
+    assert key2 != key1
+    m1, m2 = get_runtime_mgr(key1), get_runtime_mgr(key2)
+    assert m1.dispatch_meta is m2.dispatch_meta  # the partition is shared
+
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    # dispatched ONCE with key1, attended with key2's mask
+    qd, kd, vd = dispatch(q, key1), dispatch(k, key1), dispatch(v, key1)
+    out = undispatch(calc_attn(qd, kd, vd, key2)[0], key2)
+    ref_out, _, _ = ref_attn_from_ranges(
+        q, k, v, qr, kr, [AttnMaskType.FULL, AttnMaskType.FULL]
+    )
+    assert_close(out, ref_out, atol=2e-5, rtol=2e-5)

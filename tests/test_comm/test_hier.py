@@ -1,0 +1,101 @@
+"""Hierarchical 2-level group_cast vs flat oracle + dedup accounting."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from magiattention_tpu.comm.hier import HierGroupCollectiveMeta, group_cast_hier
+
+NI, NJ = 2, 4  # inter x intra
+N = NI * NJ
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N]).reshape(NI, NJ), ("dcn", "ici"))
+
+
+def _random_send_map(rng, t_local):
+    send_map = []
+    for s in range(N):
+        rows = [[] for _ in range(N)]
+        for r in range(t_local):
+            for d in rng.choice(N, size=rng.integers(0, 4), replace=False):
+                rows[int(d)].append(r)
+        send_map.append([np.asarray(x, dtype=np.int64) for x in rows])
+    return send_map
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_hier_cast_matches_expected(seed):
+    mesh = _mesh()
+    rng = np.random.default_rng(seed)
+    t_local, d_feat = 10, 8
+    send_map = _random_send_map(rng, t_local)
+    meta, recv_sources = HierGroupCollectiveMeta.build(
+        send_map, [t_local] * N, NI, NJ
+    )
+
+    x_all = [
+        rng.standard_normal((t_local, d_feat)).astype(np.float32)
+        for _ in range(N)
+    ]
+    x = jax.device_put(
+        jnp.asarray(np.stack(x_all)).reshape(NI, NJ, t_local, d_feat),
+        NamedSharding(mesh, P("dcn", "ici")),
+    )
+    tabs = tuple(
+        jax.device_put(
+            jnp.asarray(np.asarray(a)).reshape((NI, NJ) + a.shape[1:]),
+            NamedSharding(mesh, P("dcn", "ici")),
+        )
+        for a in meta.device_arrays()
+    )
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("dcn", "ici"),) * 7,
+        out_specs=P("dcn", "ici"),
+        check_vma=False,
+    )
+    def run(x, *tabs):
+        flat = tuple(t.reshape((1,) + t.shape[2:]) for t in tabs)
+        y = group_cast_hier(x[0, 0], flat)
+        return y[None, None]
+
+    y = np.asarray(jax.jit(run)(x, *tabs)).reshape(N, meta.max_recv, d_feat)
+
+    # oracle: final layout given by recv_sources
+    for d in range(N):
+        pos = 0
+        for s, rows in recv_sources[d]:
+            expect = x_all[s][rows]
+            np.testing.assert_allclose(
+                y[d, pos : pos + len(rows)], expect, rtol=1e-6,
+                err_msg=f"dst {d} src {s}",
+            )
+            pos += len(rows)
+        assert pos == meta.recv_total[d]
+
+
+def test_hier_dedups_inter_traffic():
+    """Rows consumed by the whole dst node cross the inter link once."""
+    rng = np.random.default_rng(7)
+    t_local = 16
+    # every rank of node 1 wants ALL rows of rank 0 (node 0)
+    send_map = [
+        [np.empty(0, np.int64) for _ in range(N)] for _ in range(N)
+    ]
+    for di in range(NJ):
+        send_map[0][1 * NJ + di] = np.arange(t_local, dtype=np.int64)
+    meta, _ = HierGroupCollectiveMeta.build(send_map, [t_local] * N, NI, NJ)
+    # flat routing would move t_local * NJ rows across the inter link;
+    # hierarchical moves t_local once
+    assert meta.inter_rows_total[0] == t_local
+    # and the intra hop fans out NJ copies inside the node
+    assert meta.recv_total[1 * NJ] == t_local

@@ -222,3 +222,60 @@ def test_load_balanced_plan_beats_sequential():
     plan_b = build_dist_attn_plan(mq_b, bucket_b, block_q=64, block_k=64)
     plan_s = build_dist_attn_plan(mq_s, bucket_s, block_q=64, block_k=64)
     assert plan_b.max_rank_area < plan_s.max_rank_area
+
+
+def test_large_varlen_block_causal_cp8():
+    """Scaled version of the reference's varlen_block_causal_144k flagship
+    scenario: 4k tokens, 5 docs, cp=8, chunk 64."""
+    total, cp = 4096, 8
+    hq, hk, d = 2, 2, 64
+    mesh = _mesh(cp)
+    cu = [0, 640, 1536, 2048, 3328, 4096]
+    q_ranges = AttnRanges.from_cu_seqlens(cu, total)
+    k_ranges = AttnRanges.from_ranges([(0, e) for e in cu[1:]])
+    ts = [C] * 5
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        q_ranges, k_ranges, ts, total, total, chunk_size=64, cp_size=cp,
+    )
+    plan = build_dist_attn_plan(mq, bucket, block_q=64, block_k=64)
+    # load balance must beat the naive contiguous split on block-causal
+    assert plan.max_rank_area / (plan.total_area / cp) < 1.2
+    params = make_attn_params(plan, d, out_dtype="float32")
+    attn_fn = make_dist_attn_fn(plan, mesh, params)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    out = jax.jit(
+        lambda q, k, v: undispatch(
+            attn_fn(dispatch(q, mq), dispatch(k, mq), dispatch(v, mq))[0], mq
+        )
+    )(q, k, v)
+    ref_out, _, _ = ref_attn_from_ranges(q, k, v, q_ranges, k_ranges, ts)
+    assert_close(out, ref_out, atol=3e-5, rtol=3e-5, msg="large cp8")
+
+
+def test_bf16_distributed_reasonable():
+    """bf16 end-to-end CP attention stays within bf16-scale error."""
+    total, cp = 1024, 4
+    hq, hk, d = 2, 2, 64
+    mesh = _mesh(cp)
+    q_ranges = AttnRanges.from_ranges([(0, total)])
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        q_ranges, q_ranges, [C], total, total, chunk_size=64, cp_size=cp,
+    )
+    plan = build_dist_attn_plan(mq, bucket, block_q=64, block_k=64)
+    params = make_attn_params(plan, d, out_dtype="bfloat16")
+    attn_fn = make_dist_attn_fn(plan, mesh, params)
+    rng = np.random.default_rng(1)
+    qf = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    kf = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    vf = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (qf, kf, vf))
+    out = undispatch(
+        attn_fn(dispatch(q, mq), dispatch(k, mq), dispatch(v, mq))[0], mq
+    )
+    ref_out, _, _ = ref_attn_from_ranges(qf, kf, vf, q_ranges, q_ranges, [C])
+    assert_close(
+        out.astype(jnp.float32), ref_out, atol=3e-2, rtol=3e-2, msg="bf16 cp4"
+    )
