@@ -80,11 +80,13 @@ class DistAttnRuntimeMgr:
         dispatch_meta: DispatchMeta,
         plan: DistAttnPlan,
         attn_fn,
+        dist_attn_config=None,
     ):
         self.key = key
         self.mesh = mesh
         self.dispatch_meta = dispatch_meta
         self.plan = plan
+        self.dist_attn_config = dist_attn_config
         self._attn_fn = attn_fn
 
     # -- data movement -----------------------------------------------------
@@ -291,7 +293,9 @@ def magi_attn_flex_key(
     attn_fn = make_dist_attn_fn(
         plan, mesh, params, axis_name=cp_axis, sink=sink
     )
-    mgr = DistAttnRuntimeMgr(key, mesh, mq, plan, attn_fn)
+    mgr = DistAttnRuntimeMgr(
+        key, mesh, mq, plan, attn_fn, dist_attn_config=dist_attn_config
+    )
     _runtime_dict.put(key, mgr)
     _most_recent_key = key
     return key
@@ -361,6 +365,10 @@ def make_flex_key_for_new_mask_after_dispatch(
     """
     global _most_recent_key
     old_mgr = get_runtime_mgr(old_key)
+    assert not old_key.has_sink, (
+        "key reuse with an attention sink is not supported: re-key with "
+        "magi_attn_flex_key(sink=...) instead"
+    )
     if not isinstance(q_ranges, AttnRanges):
         q_ranges = AttnRanges.from_ranges(q_ranges)
     if not isinstance(k_ranges, AttnRanges):
@@ -386,8 +394,14 @@ def make_flex_key_for_new_mask_after_dispatch(
         new_key.total_seqlen_q,
         meta.chunk_size,
     )
+    old_cfg = old_mgr.dist_attn_config
+    overlap = old_cfg.overlap_config if old_cfg is not None else None
     plan = build_dist_attn_plan(
-        meta, bucket, block_q=env.block_q(), block_k=env.block_k()
+        meta,
+        bucket,
+        block_q=env.block_q(),
+        block_k=env.block_k(),
+        overlap_config=overlap,
     )
     params = make_attn_params(
         plan,
@@ -400,7 +414,9 @@ def make_flex_key_for_new_mask_after_dispatch(
     attn_fn = make_dist_attn_fn(plan, old_mgr.mesh, params, axis_name=new_key.cp_axis)
     _runtime_dict.put(
         new_key,
-        DistAttnRuntimeMgr(new_key, old_mgr.mesh, meta, plan, attn_fn),
+        DistAttnRuntimeMgr(
+            new_key, old_mgr.mesh, meta, plan, attn_fn, dist_attn_config=old_cfg
+        ),
     )
     _most_recent_key = new_key
     return new_key

@@ -12,8 +12,9 @@ Routing: src rank s = (Sn, si) sends the union of rows needed by any rank
 of dst node Dn to gateway g = (Dn, si) (its own intra position, over the
 inter axis); the gateway forwards each row to its final consumers over the
 intra axis. The final receive layout at rank d = (Dn, di) is
-(gateway si asc, src node Sn asc, gateway-buffer position) — exposed to the
-planner through :meth:`recv_row_sources`.
+(gateway si asc, src node Sn asc, gateway-buffer position) — returned to the
+planner as the second value of :meth:`HierGroupCollectiveMeta.build`
+(``recv_sources``).
 """
 
 from __future__ import annotations
@@ -82,6 +83,13 @@ class HierGroupCollectiveMeta:
 
         def rank(node, intra):
             return node * n_intra + intra
+
+        for s in range(n):
+            for d in range(n):
+                rows = send_map[s][d]
+                assert len(rows) == 0 or (
+                    np.asarray(rows) < num_local_rows[s]
+                ).all(), f"send_map[{s}][{d}] rows exceed local count"
 
         # hop 1: union rows per (src rank, dst node), sorted by src-local idx
         s1 = [[np.empty(0, np.int64) for _ in range(n_inter)] for _ in range(n)]

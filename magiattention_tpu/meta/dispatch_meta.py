@@ -121,6 +121,102 @@ def make_global_bucket_from_qk_ranges(
     return bucket
 
 
+
+
+def _solve_q_partitions(
+    bucket: AttnBucket,
+    num_chunks: int,
+    cp_size: int,
+    dispatch_config: DispatchConfig,
+) -> list[list[int]]:
+    """Area-balanced chunk->rank assignment shared by the self- and
+    cross-attention meta builders (incl. the partition-validity guards)."""
+    if cp_size == 1:
+        return [list(range(num_chunks))]
+    workloads = [float(c.area) for c in bucket.q_chunks]
+    affinities = None
+    if dispatch_config.alg.is_affinity_considered:
+        affinities = [
+            IOUAffinity.from_ranges(c.k_ranges.merge()) for c in bucket.q_chunks
+        ]
+    solution = DispatchSolver(dispatch_config.alg).solve(
+        DispatchData(
+            jobs=DispatchJob.from_job_list(workloads, affinities),
+            num_buckets=cp_size,
+        )
+    )
+    assert solution.bucket_partitions, (
+        f"{dispatch_config.alg.type} does not return partitions; "
+        "choose a partition-returning algorithm for dispatch"
+    )
+    partitions = [sorted(p) for p in solution.bucket_partitions]
+    assert sorted(x for p in partitions for x in p) == list(range(num_chunks))
+    return partitions
+
+
+def make_cross_attn_dispatch_meta(
+    q_ranges: AttnRanges,
+    k_ranges: AttnRanges,
+    attn_mask_type: Sequence[AttnMaskType],
+    total_seqlen_q: int,
+    total_seqlen_k: int,
+    chunk_size_q: int,
+    chunk_size_k: int,
+    cp_size: int,
+    dispatch_config: DispatchConfig | None = None,
+) -> tuple[DispatchMeta, DispatchMeta, AttnBucket]:
+    """Cross-attention dispatch (reference dispatch_qo/dispatch_kv split):
+    queries are chunk-balanced by mask area; keys/values get their own
+    sequential partition over [0, total_seqlen_k) — the memory side has no
+    per-row cost imbalance to solve, only ownership for the group cast.
+    """
+    if dispatch_config is None:
+        dispatch_config = DispatchConfig()
+    num_chunks_k = total_seqlen_k // chunk_size_k
+    assert total_seqlen_k % chunk_size_k == 0, (
+        f"total_seqlen_k {total_seqlen_k} must be a chunk_size_k "
+        f"{chunk_size_k} multiple"
+    )
+    assert num_chunks_k % cp_size == 0, (
+        f"k chunks {num_chunks_k} must be divisible by cp_size {cp_size}"
+    )
+    num_chunks_q = total_seqlen_q // chunk_size_q
+    assert total_seqlen_q % chunk_size_q == 0, (
+        f"total_seqlen_q {total_seqlen_q} must be a chunk_size_q "
+        f"{chunk_size_q} multiple"
+    )
+    assert num_chunks_q % cp_size == 0, (
+        f"q chunks {num_chunks_q} must be divisible by cp_size {cp_size}"
+    )
+
+    bucket = make_global_bucket_from_qk_ranges(
+        q_ranges, k_ranges, attn_mask_type, total_seqlen_q, chunk_size_q
+    )
+    partitions = _solve_q_partitions(
+        bucket, num_chunks_q, cp_size, dispatch_config
+    )
+
+    meta_q = DispatchMeta(
+        total_seqlen=total_seqlen_q,
+        chunk_size=chunk_size_q,
+        num_chunks=num_chunks_q,
+        cp_size=cp_size,
+        partitions=tuple(tuple(p) for p in partitions),
+    )
+    per_rank_k = num_chunks_k // cp_size
+    meta_k = DispatchMeta(
+        total_seqlen=total_seqlen_k,
+        chunk_size=chunk_size_k,
+        num_chunks=num_chunks_k,
+        cp_size=cp_size,
+        partitions=tuple(
+            tuple(range(r * per_rank_k, (r + 1) * per_rank_k))
+            for r in range(cp_size)
+        ),
+    )
+    return meta_q, meta_k, bucket
+
+
 def make_dispatch_meta_from_qk_ranges(
     q_ranges: AttnRanges,
     k_ranges: AttnRanges,
@@ -151,25 +247,9 @@ def make_dispatch_meta_from_qk_ranges(
     bucket = make_global_bucket_from_qk_ranges(
         q_ranges, k_ranges, attn_mask_type, total_seqlen_q, chunk_size
     )
-
-    if cp_size == 1:  # shortcut (reference :408-447)
-        partitions: list[list[int]] = [list(range(num_chunks))]
-    else:
-        workloads = [float(c.area) for c in bucket.q_chunks]
-        affinities = None
-        if dispatch_config.alg.is_affinity_considered:
-            affinities = [
-                IOUAffinity.from_ranges(c.k_ranges.merge()) for c in bucket.q_chunks
-            ]
-        jobs = DispatchJob.from_job_list(workloads, affinities)
-        solver = DispatchSolver(dispatch_config.alg)
-        solution = solver.solve(DispatchData(jobs=jobs, num_buckets=cp_size))
-        assert solution.bucket_partitions, (
-            f"{dispatch_config.alg.type} does not return partitions; "
-            "choose a partition-returning algorithm for dispatch"
-        )
-        partitions = [sorted(p) for p in solution.bucket_partitions]
-        assert sorted(x for p in partitions for x in p) == list(range(num_chunks))
+    partitions = _solve_q_partitions(
+        bucket, num_chunks, cp_size, dispatch_config
+    )
 
     meta = DispatchMeta(
         total_seqlen=total_seqlen_q,

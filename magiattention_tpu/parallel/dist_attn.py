@@ -219,18 +219,28 @@ def build_dist_attn_plan(
     dispatch_meta: DispatchMeta,
     bucket: AttnBucket,
     *,
+    kv_dispatch_meta: DispatchMeta | None = None,
     block_q: int = 128,
     block_k: int = 128,
     overlap_config: OverlapConfig | None = None,
 ) -> DistAttnPlan:
-    """Plan the distributed attention for one dispatched mask (self-attn)."""
+    """Plan the distributed attention for one dispatched mask.
+
+    Self-attention by default (K/V follow the Q partition); pass a separate
+    ``kv_dispatch_meta`` for cross-attention (reference dispatch_qo/kv:
+    queries are balanced by mask area, keys dispatched by their own meta).
+    """
     cp = dispatch_meta.cp_size
     shard_len = dispatch_meta.shard_seqlen
+    kv_meta = kv_dispatch_meta or dispatch_meta
+    assert kv_meta.cp_size == cp
+    shard_k_len = kv_meta.shard_seqlen
     overlap_config = overlap_config or OverlapConfig()
     degree = overlap_config.degree
 
     pos_ids = [dispatch_meta.position_ids(r) for r in range(cp)]
-    host_ranges = dispatch_meta.host_ranges_per_rank()
+    pos_ids_k = [kv_meta.position_ids(r) for r in range(cp)]
+    host_ranges = kv_meta.host_ranges_per_rank()  # K-side ownership
 
     # per-rank slices (global coords) + needed K sets
     slices_per_rank: list[np.ndarray] = []
@@ -274,10 +284,13 @@ def build_dist_attn_plan(
             send_map[s][d] = (
                 np.concatenate(idx_parts) if idx_parts else np.empty(0, np.int64)
             )
-            recv_segments[d].append((s, pos_ids[s][send_map[s][d]]))
+            recv_segments[d].append((s, pos_ids_k[s][send_map[s][d]]))
 
     shard_q_pad = _round_up(shard_len, block_q)
     q_runs_per_rank = [runs_from_position_ids(pos_ids[r]) for r in range(cp)]
+    k_own_runs_per_rank = [
+        runs_from_position_ids(pos_ids_k[r]) for r in range(cp)
+    ]
     total_area = bucket.area
 
     def _recv_global_ids(r) -> np.ndarray:
@@ -299,14 +312,14 @@ def build_dist_attn_plan(
         return runs
 
     if degree == 0:
-        comm = GroupCollectiveMeta.build(send_map, [shard_len] * cp)
-        kv_buf_pad = _round_up(shard_len + comm.max_recv, block_k)
+        comm = GroupCollectiveMeta.build(send_map, [shard_k_len] * cp)
+        kv_buf_pad = _round_up(shard_k_len + comm.max_recv, block_k)
         metas = []
         for r in range(cp):
-            k_runs = list(q_runs_per_rank[r])
+            k_runs = list(k_own_runs_per_rank[r])
             gids = _recv_global_ids(r)
             # received rows sit right after the own shard, in recv order
-            k_runs += _runs_from_recv_rows(gids, shard_len)
+            k_runs += _runs_from_recv_rows(gids, shard_k_len)
             metas.append(
                 build_block_meta_general(
                     slices_per_rank[r],
@@ -336,12 +349,12 @@ def build_dist_attn_plan(
 
     # ---- staged path -----------------------------------------------------
     # host stage: own shard only
-    host_kv_pad = _round_up(shard_len, block_k)
+    host_kv_pad = _round_up(shard_k_len, block_k)
     host_metas = [
         build_block_meta_general(
             slices_per_rank[r],
             q_runs_per_rank[r],
-            q_runs_per_rank[r],  # own rows double as K rows (self-attn)
+            k_own_runs_per_rank[r],  # the rank's own K/V shard
             shard_q_pad,
             host_kv_pad,
             block_q=block_q,
@@ -378,7 +391,7 @@ def build_dist_attn_plan(
     rank_area = [host_metas[r].total_area for r in range(cp)]
     stages: list[StagePlan] = []
     for st in range(num_stages):
-        st_comm = GroupCollectiveMeta.build(staged_maps[st], [shard_len] * cp)
+        st_comm = GroupCollectiveMeta.build(staged_maps[st], [shard_k_len] * cp)
         st_kv_pad = _round_up(max(st_comm.max_recv, block_k), block_k)
         st_metas = []
         for r in range(cp):
@@ -387,7 +400,7 @@ def build_dist_attn_plan(
             for s, gids in recv_segments[r]:
                 rows = staged_maps[st][s][r]
                 if len(rows):
-                    gids_parts.append(pos_ids[s][rows])
+                    gids_parts.append(pos_ids_k[s][rows])
             gids = (
                 np.concatenate(gids_parts)
                 if gids_parts
