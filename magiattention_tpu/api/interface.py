@@ -219,6 +219,10 @@ def magi_attn_flex_key(
     if not isinstance(k_ranges, AttnRanges):
         k_ranges = AttnRanges.from_ranges(k_ranges)
     types = tuple(int(t) for t in attn_type_map)
+    if env.is_sanity_check_enabled():
+        from ..common.sanity import check_slices_non_overlapping
+
+        check_slices_non_overlapping(q_ranges, k_ranges, types)
     cp_size = mesh.shape[cp_axis]
 
     if chunk_size is None:
@@ -374,6 +378,10 @@ def make_flex_key_for_new_mask_after_dispatch(
     if not isinstance(k_ranges, AttnRanges):
         k_ranges = AttnRanges.from_ranges(k_ranges)
     types = tuple(int(t) for t in attn_type_map)
+    if env.is_sanity_check_enabled():
+        from ..common.sanity import check_slices_non_overlapping
+
+        check_slices_non_overlapping(q_ranges, k_ranges, types)
     new_key = dataclasses.replace(
         old_key,
         q_ranges=tuple(q_ranges.to_naive_ranges()),

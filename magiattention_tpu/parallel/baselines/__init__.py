@@ -9,10 +9,15 @@ from .ulysses import (
     make_ulysses_attn_fn,
     ulysses_attn_local,
 )
+from .usp import USPPlan, build_usp_plan, make_usp_attn_fn, usp_attn_local
 
 __all__ = [
     "RingAttnPlan",
     "UlyssesPlan",
+    "USPPlan",
+    "build_usp_plan",
+    "make_usp_attn_fn",
+    "usp_attn_local",
     "build_ring_attn_plan",
     "build_ulysses_plan",
     "make_ring_attn_fn",

@@ -19,6 +19,21 @@ from ...ops.flex_attn import FlexAttnParams, flex_attn_headmajor, fwd_tables, bw
 from ..dist_attn import _headmajor_to_seq, _hm
 
 
+def seq_to_heads_a2a(x, axis_name: str):
+    """[t_loc, h, d] -> [t_glob, h/axis, d]; tiled all_to_all keeps rank
+    blocks in order (global-token-major) and transposes cleanly under AD."""
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=1, concat_axis=0, tiled=True
+    )
+
+
+def heads_to_seq_a2a(x, axis_name: str):
+    """Inverse of :func:`seq_to_heads_a2a`."""
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=0, concat_axis=1, tiled=True
+    )
+
+
 @dataclasses.dataclass(frozen=True, eq=False)
 class UlyssesPlan:
     cp_size: int
@@ -71,17 +86,10 @@ def ulysses_attn_local(
     )
 
     def seq_to_heads(x):
-        # [t_loc, h, d] -> [t_glob, h/cp, d]; tiled all_to_all keeps rank
-        # blocks in order (global-token-major) and transposes cleanly
-        return jax.lax.all_to_all(
-            x, axis_name, split_axis=1, concat_axis=0, tiled=True
-        )
+        return seq_to_heads_a2a(x, axis_name)
 
     def heads_to_seq(x):
-        # [t_glob, h/cp, d] -> [t_loc, h, d] (inverse of seq_to_heads)
-        return jax.lax.all_to_all(
-            x, axis_name, split_axis=0, concat_axis=1, tiled=True
-        )
+        return heads_to_seq_a2a(x, axis_name)
 
     qg = seq_to_heads(q)  # [total, hq/cp, d]
     kg = seq_to_heads(k)

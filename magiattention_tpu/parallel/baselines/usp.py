@@ -1,0 +1,124 @@
+"""USP: unified sequence parallelism (Ulysses x ring) baseline.
+
+Role of reference ``exps/dist_attn/baselines/usp.py``: the 2-D scheme —
+heads are all-to-all-sharded over one mesh axis (Ulysses, typically
+intra-node) while the sequence rings over the other (typically inter-node).
+Composes this package's two baselines: a tiled all_to_all head<->seq swap
+over the 'ulysses' axis, then ring attention over the 'ring' axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ring import RingAttnPlan, build_ring_attn_plan, ring_attn_local
+from .ulysses import heads_to_seq_a2a, seq_to_heads_a2a
+from ...ops.flex_attn import FlexAttnParams
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class USPPlan:
+    ulysses_size: int
+    ring_plan: RingAttnPlan  # over the ring axis, seq length = total
+
+
+def build_usp_plan(
+    slices: np.ndarray,  # [S, 5] global (qs, qe, ks, ke, type)
+    total_seqlen: int,
+    ulysses_size: int,
+    ring_size: int,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> USPPlan:
+    ring_plan = build_ring_attn_plan(
+        slices, total_seqlen, ring_size, block_q=block_q, block_k=block_k
+    )
+    return USPPlan(ulysses_size=ulysses_size, ring_plan=ring_plan)
+
+
+def usp_attn_local(
+    q: jax.Array,  # [total/(u*r), hq, d] — sharded over both axes on tokens
+    k: jax.Array,
+    v: jax.Array,
+    tables,  # ring step tables (9 per ring step)
+    plan: USPPlan,
+    params: FlexAttnParams,
+    *,
+    axis_ulysses: str = "ulysses",
+    axis_ring: str = "ring",
+):
+    """Inside shard_map over (ulysses, ring) axes."""
+    u = plan.ulysses_size
+    hq = q.shape[1]
+    assert hq % u == 0 and k.shape[1] % u == 0, (
+        f"USP needs heads divisible by ulysses axis: hq={hq} hk={k.shape[1]} u={u}"
+    )
+
+    qg = seq_to_heads_a2a(q, axis_ulysses)  # [total/r, hq/u, d]
+    kg = seq_to_heads_a2a(k, axis_ulysses)
+    vg = seq_to_heads_a2a(v, axis_ulysses)
+    out_g, lse_g = ring_attn_local(
+        qg, kg, vg, tables, plan.ring_plan, params, axis_name=axis_ring
+    )
+    out = heads_to_seq_a2a(out_g, axis_ulysses)
+    lse = heads_to_seq_a2a(lse_g[..., None], axis_ulysses)[..., 0]
+    return out, lse
+
+
+def make_usp_attn_fn(
+    plan: USPPlan,
+    mesh: jax.sharding.Mesh,
+    params: FlexAttnParams,
+    *,
+    axis_ulysses: str = "ulysses",
+    axis_ring: str = "ring",
+):
+    """Jittable fn over [total, h, d] arrays sharded (ring, ulysses)-major
+    on tokens (contiguous global order)."""
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert mesh.shape[axis_ulysses] == plan.ulysses_size, (
+        f"mesh {axis_ulysses}={mesh.shape[axis_ulysses]} != plan "
+        f"ulysses_size={plan.ulysses_size}"
+    )
+    assert mesh.shape[axis_ring] == plan.ring_plan.cp_size, (
+        f"mesh {axis_ring}={mesh.shape[axis_ring]} != plan "
+        f"ring_size={plan.ring_plan.cp_size}"
+    )
+    spec = P((axis_ring, axis_ulysses))
+    tables = tuple(
+        jax.device_put(t, NamedSharding(mesh, P(axis_ring)))
+        for t in plan.ring_plan.device_tables()
+    )
+    n_tab = len(tables)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec,) * 3 + (P(axis_ring),) * n_tab,
+        out_specs=(spec, spec),
+        check_vma=False,
+    )
+    def _local(q, k, v, *tabs):
+        return usp_attn_local(
+            q,
+            k,
+            v,
+            tabs,
+            plan,
+            params,
+            axis_ulysses=axis_ulysses,
+            axis_ring=axis_ring,
+        )
+
+    def fn(q, k, v):
+        return _local(q, k, v, *tables)
+
+    return fn

@@ -1,0 +1,46 @@
+"""Disjoint-coverage sanity check vs dense-mask brute force."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.common import AttnMaskType
+from magiattention_tpu.common.mask import slice_mask
+from magiattention_tpu.common.sanity import check_slices_non_overlapping
+
+SPAN = 64
+
+
+def _brute_overlap(qr, kr, ts):
+    acc = np.zeros((SPAN, SPAN), np.int32)
+    for (qs, qe), (ks, ke), t in zip(qr, kr, ts):
+        acc += slice_mask(qs, qe, ks, ke, t, SPAN, SPAN).astype(np.int32)
+    return (acc > 1).any()
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_matches_brute_force(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(2, 5))
+    qr, kr, ts = [], [], []
+    for _ in range(n):
+        qs = int(rng.integers(0, SPAN - 2)); qe = int(rng.integers(qs + 1, SPAN))
+        ks = int(rng.integers(0, SPAN - 2)); ke = int(rng.integers(ks + 1, SPAN))
+        qr.append((qs, qe)); kr.append((ks, ke)); ts.append(int(rng.integers(0, 4)))
+    expect_overlap = _brute_overlap(qr, kr, ts)
+    if expect_overlap:
+        with pytest.raises(ValueError):
+            check_slices_non_overlapping(qr, kr, ts)
+    else:
+        check_slices_non_overlapping(qr, kr, ts)
+
+
+def test_known_cases():
+    # disjoint: causal + inv-causal band above the diagonal
+    check_slices_non_overlapping(
+        [(0, 64), (16, 48)], [(0, 64), (32, 64)], [1, 2]
+    )
+    # overlapping: causal covers the full slice's band
+    with pytest.raises(ValueError, match="double-count"):
+        check_slices_non_overlapping(
+            [(0, 64), (16, 48)], [(0, 64), (0, 16)], [1, 0]
+        )

@@ -100,3 +100,49 @@ def test_ulysses_attention(name, total, qr, kr, ts, cp):
         lambda v: (ref_attn_from_ranges(q, k, v, qr, kr, ts)[0] * do).sum()
     )(v)
     assert_close(g, gr, atol=1e-4, rtol=1e-4, msg=f"ulysses {name} dv")
+
+
+@pytest.mark.parametrize("u,r", [(2, 2), (4, 2), (2, 4)])
+def test_usp_attention(u, r):
+    """USP = ulysses (heads) x ring (seq) over a 2-D mesh."""
+    from magiattention_tpu.parallel.baselines import build_usp_plan, make_usp_attn_fn
+
+    n = u * r
+    total, hq, d = 512, 4, 32
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(r, u), ("ring", "ulysses"))
+    qr = [(0, 192), (192, 512)]
+    kr = qr
+    ts = [C, C]
+    slices = np.asarray(
+        [(q0, q1, q0, q1, 1) for q0, q1 in qr], np.int64
+    )
+    plan = build_usp_plan(slices, total, u, r, block_q=64, block_k=64)
+    fn = make_usp_attn_fn(plan, mesh, _params(d))
+
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    out, lse = jax.jit(fn)(q, k, v)
+    ref_out, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out, ref_out, atol=3e-5, rtol=3e-5, msg=f"usp u{u} r{r}")
+
+    do = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    g = jax.jit(
+        jax.grad(
+            lambda q, k, v: (fn(q, k, v)[0] * do).sum(), argnums=(0, 1, 2)
+        )
+    )(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: (ref_attn_from_ranges(q, k, v, qr, kr, ts)[0] * do).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, nm in zip(g, gr, ["dq", "dk", "dv"]):
+        assert_close(a, b, atol=1e-4, rtol=1e-4, msg=f"usp u{u} r{r} {nm}")
+    # plan/mesh mismatch -> clear precondition error
+    bad_mesh = Mesh(
+        np.array(jax.devices()[:n]).reshape(u, r), ("ring", "ulysses")
+    )
+    if u != r:
+        with pytest.raises(AssertionError, match="plan"):
+            make_usp_attn_fn(plan, bad_mesh, _params(d))
