@@ -60,7 +60,7 @@ def main() -> None:
 
     fwd = jax.jit(
         lambda q, k, v: flex_flash_attn_func(
-            q, k, v, qr, kr, ts, block_q=128, block_k=512
+            q, k, v, qr, kr, ts, block_q=128, block_k=256, head_block=8
         )[0]
     )
     dt = _timeit(fwd, q, k, v)

@@ -43,7 +43,13 @@ LANES = 128
 
 @dataclasses.dataclass(frozen=True)
 class FlexAttnParams:
-    """Static parameters closed over by the kernels (hashable)."""
+    """Static parameters closed over by the kernels (hashable).
+
+    ``head_block``: q heads processed per grid step (1 = head-per-step).
+    Batching heads amortizes per-step grid overhead — the dominant cost on
+    small tiles — at the price of head_block x VMEM. Must be 1 or a
+    multiple of the GQA group size.
+    """
 
     block_q: int
     block_k: int
@@ -52,6 +58,7 @@ class FlexAttnParams:
     has_sink: bool
     out_dtype: str
     interpret: bool
+    head_block: int = 1
 
     @property
     def out_jnp_dtype(self):
@@ -130,6 +137,194 @@ def _scores(q, k, scale, softcap):
     if softcap > 0.0:
         return jnp.float32(softcap) * jnp.tanh(z / jnp.float32(softcap))
     return z
+
+
+# ---------------------------------------------------------------------------
+# forward (head-batched variant)
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel_hb(
+    qblk,
+    kblk,
+    sid,
+    runs,
+    bounds,
+    q_ref,  # (HBG, bq, d)
+    k_ref,  # (HB, bk, d)
+    v_ref,
+    sink_ref,
+    out_ref,
+    lse_ref,
+    rowmax_ref,
+    m_scr,  # (HB, G*bq, LANES)
+    l_scr,
+    acc_scr,  # (HB, G*bq, d)
+    *,
+    params: FlexAttnParams,
+    group: int,
+):
+    """Head-batched forward: HB kv heads x their G q heads per grid step.
+
+    q rows of the G heads sharing one kv head are stacked ((HB, G*bq, d))
+    so the QK^T and PV products are single batched MXU calls; the mask is
+    computed once per tile and broadcast over (HB, G).
+    """
+    bq, bk = params.block_q, params.block_k
+    hbg = q_ref.shape[0]
+    hb = k_ref.shape[0]
+    h = pl.program_id(0)
+    e = pl.program_id(1)
+    num_e = pl.num_programs(1)
+
+    cur_q = qblk[e]
+    prev_q = jnp.where(e == 0, -1, qblk[jnp.maximum(e - 1, 0)])
+    next_q = jnp.where(e == num_e - 1, -1, qblk[jnp.minimum(e + 1, num_e - 1)])
+    is_first = prev_q != cur_q
+    is_last = next_q != cur_q
+
+    @pl.when(is_first)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[...].reshape(hb, group * bq, q_ref.shape[2])
+    s = jax.lax.dot_general(
+        q,
+        k_ref[...],
+        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * jnp.float32(params.scale)  # (HB, G*bq, bk)
+    if params.softcap > 0.0:
+        s = jnp.float32(params.softcap) * jnp.tanh(
+            s / jnp.float32(params.softcap)
+        )
+
+    def _apply_mask(s):
+        mask = _entry_mask(
+            bounds, runs, sid[e], e, cur_q * bq, kblk[e] * bk, bq, bk
+        )
+        s4 = s.reshape(hb, group, bq, bk)
+        s4 = jnp.where(mask[None, None], s4, NEG_INF)
+        return s4.reshape(hb, group * bq, bk)
+
+    s = jax.lax.cond(
+        runs[e * RUN_FIELDS + 6] == 1, _apply_mask, lambda s: s, s
+    )
+
+    m_prev = m_scr[:, :, :1]  # (HB, G*bq, 1)
+    m_cur = jnp.max(s, axis=2, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+    alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
+    p = jnp.exp(s - m_safe)
+    l_new = l_scr[:, :, :1] * alpha + jnp.sum(p, axis=2, keepdims=True)
+    acc = acc_scr[...] * alpha + jax.lax.dot_general(
+        p.astype(v_ref.dtype),
+        v_ref[...],
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:, :, :1] = m_new
+    l_scr[:, :, :1] = l_new
+    acc_scr[...] = acc
+
+    @pl.when(is_last)
+    def _finalize():
+        m = m_scr[:, :, :1]
+        l = l_scr[:, :, :1]
+        if params.has_sink:
+            # per-q-head sink: rows of q head (h*hbg + i) use sink[i]
+            sink_col = jnp.array(
+                [[0.0]], jnp.float32
+            )  # placeholder; built below
+            sinks = jnp.stack(
+                [
+                    jnp.full((bq, 1), sink_ref[h * hbg + i, 0], jnp.float32)
+                    for i in range(hbg)
+                ],
+                axis=0,
+            ).reshape(hb, group * bq, 1)
+            del sink_col
+            m_tot = jnp.maximum(m, sinks)
+            m_tot_safe = jnp.where(m_tot == NEG_INF, 0.0, m_tot)
+            resc = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - m_tot_safe))
+            l_tot = l * resc + jnp.exp(sinks - m_tot_safe)
+            acc_fin = acc_scr[...] * resc
+        else:
+            m_tot_safe = jnp.where(m == NEG_INF, 0.0, m)
+            l_tot = l
+            acc_fin = acc_scr[...]
+        covered = l_tot > 0.0
+        inv = jnp.where(covered, 1.0 / jnp.where(covered, l_tot, 1.0), 0.0)
+        out_ref[...] = (
+            (acc_fin * inv)
+            .reshape(hbg, bq, out_ref.shape[2])
+            .astype(out_ref.dtype)
+        )
+        lse = jnp.where(
+            covered, m_tot_safe + jnp.log(jnp.where(covered, l_tot, 1.0)), NEG_INF
+        )
+        lse_ref[...] = jnp.broadcast_to(
+            lse.reshape(hbg, bq, 1), (hbg, bq, LANES)
+        )
+        rowmax_ref[...] = jnp.broadcast_to(
+            m.reshape(hbg, bq, 1), (hbg, bq, LANES)
+        )
+
+
+def _fwd_pallas_hb(q, k, v, sink2d, tables, params: FlexAttnParams):
+    """Head-batched launcher: grid (hq/HBG, E)."""
+    qblk, kblk, sid, runs, bounds = tables
+    hq, tqp, d = q.shape
+    hk = k.shape[0]
+    group = hq // hk
+    hbg = params.head_block
+    assert hbg % group == 0 and hq % hbg == 0, (
+        f"head_block {hbg} must be a multiple of the GQA group {group} and "
+        f"divide hq {hq}"
+    )
+    hb = hbg // group
+    bq, bk = params.block_q, params.block_k
+    E = qblk.shape[0]
+
+    def qmap(h, e, qb, kb, si, ru, bo):
+        return (h, qb[e], 0)
+
+    def kmap(h, e, qb, kb, si, ru, bo):
+        return (h, kb[e], 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(hq // hbg, E),
+        in_specs=[
+            pl.BlockSpec((hbg, bq, d), qmap),
+            pl.BlockSpec((hb, bk, d), kmap),
+            pl.BlockSpec((hb, bk, d), kmap),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((hbg, bq, d), qmap),
+            pl.BlockSpec((hbg, bq, LANES), qmap),
+            pl.BlockSpec((hbg, bq, LANES), qmap),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((hb, group * bq, LANES), jnp.float32),
+            pltpu.VMEM((hb, group * bq, LANES), jnp.float32),
+            pltpu.VMEM((hb, group * bq, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel_hb, params=params, group=group),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hq, tqp, d), params.out_jnp_dtype),
+            jax.ShapeDtypeStruct((hq, tqp, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((hq, tqp, LANES), jnp.float32),
+        ],
+        interpret=params.interpret,
+    )(qblk, kblk, sid, runs, bounds, q, k, v, sink2d)
 
 
 # ---------------------------------------------------------------------------
@@ -534,13 +729,19 @@ def _zero_tangents(tables):
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
-def _flex_attn_core(q, k, v, sink2d, ftab, btab, params: FlexAttnParams):
+def _fwd_dispatch(q, k, v, sink2d, ftab, params: FlexAttnParams):
+    if params.head_block > 1:
+        return _fwd_pallas_hb(q, k, v, sink2d, ftab, params)
     return _fwd_pallas(q, k, v, sink2d, ftab, params)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _flex_attn_core(q, k, v, sink2d, ftab, btab, params: FlexAttnParams):
+    return _fwd_dispatch(q, k, v, sink2d, ftab, params)
+
+
 def _flex_attn_core_fwd(q, k, v, sink2d, ftab, btab, params: FlexAttnParams):
-    out, lse_lanes, rowmax_lanes = _fwd_pallas(q, k, v, sink2d, ftab, params)
+    out, lse_lanes, rowmax_lanes = _fwd_dispatch(q, k, v, sink2d, ftab, params)
     return (out, lse_lanes, rowmax_lanes), (
         q,
         k,
@@ -637,6 +838,7 @@ def flex_attn_with_meta(
     softcap: float = 0.0,
     sink: jax.Array | None = None,
     out_dtype=None,
+    head_block: int = 1,
     return_max_logits: bool = False,
     interpret: bool | None = None,
 ):
@@ -671,6 +873,7 @@ def flex_attn_with_meta(
         has_sink=sink is not None,
         out_dtype=str(out_dtype),
         interpret=bool(interpret),
+        head_block=int(head_block),
     )
     out_h, lse_lanes, rowmax_lanes = flex_attn_headmajor(
         qh, kh, vh, fwd_tables(meta), bwd_tables(meta), params, sink=sink
@@ -719,6 +922,7 @@ def flex_flash_attn_func(
     out_dtype=None,
     block_q: int = 128,
     block_k: int = 128,
+    head_block: int = 1,
     return_max_logits: bool = False,
     interpret: bool | None = None,
 ):
@@ -750,6 +954,7 @@ def flex_flash_attn_func(
         softcap=softcap,
         sink=sink,
         out_dtype=out_dtype,
+        head_block=head_block,
         return_max_logits=return_max_logits,
         interpret=interpret,
     )
