@@ -8,9 +8,19 @@ from .dist_attn import (
     make_attn_params,
     make_dist_attn_fn,
 )
+from .qo_comm import (
+    QoCommPlan,
+    build_qo_comm_plan,
+    make_qo_comm_attn_fn,
+    qo_comm_attn_local,
+)
 
 __all__ = [
     "DistAttnPlan",
+    "QoCommPlan",
+    "build_qo_comm_plan",
+    "make_qo_comm_attn_fn",
+    "qo_comm_attn_local",
     "build_dist_attn_plan",
     "dispatch",
     "dist_attn_local",

@@ -1,0 +1,291 @@
+"""qo-comm runtime: execute a dynamic (attention-plane) partition.
+
+Role of reference ``meta/solver/dynamic_attn_solver.py`` emit stages +
+the qo-comm paths of ``functional/dist_attn.py`` (_fetch_remote_q,
+_reduce_partial_out_lse with reduce_op='lse'): the generalized mode where
+**both Q/O and KV move**. The DynamicAttnSolver cuts the attention plane
+into cp equal-area regions; each region owner group-casts in the Q rows and
+KV rows its region touches, computes partial attention, and the partial
+(out, lse) rows are group-reduced (LSE op) back to the Q owners.
+
+Everything is differentiable: the O-return reduce is the lse-weighted
+segment merge (comm/group_collective.group_reduce_lse), the Q/KV casts
+transpose into the dQ/dKV returns automatically, and the kernel vjp's
+first-class lse cotangent makes the partial-merge backward exact.
+
+Token ownership is the contiguous (sequential) shard — qo-comm layers on
+top of an existing natural sharding rather than the chunk-permuted
+dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.range import AttnRange
+from ..common.ranges import AttnRanges
+from ..common.rectangle import AttnRectangles
+from ..comm.group_collective import (
+    GroupCollectiveMeta,
+    group_cast,
+    group_reduce_lse,
+)
+from ..meta.solver.dynamic_attn_solver import DynamicAttnSolver
+from ..ops.block_meta import Run, build_block_meta_general, runs_from_position_ids
+from ..ops.flex_attn import FlexAttnParams
+from .dist_attn import StageTables, _call_kernel, _headmajor_to_seq, _hm, _round_up
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class QoCommPlan:
+    cp_size: int
+    shard_len: int  # contiguous token shard per rank (q == kv side)
+    q_buf_pad: int  # padded received-Q buffer rows
+    kv_buf_pad: int
+    block_q: int
+    block_k: int
+    comm_q: GroupCollectiveMeta  # Q cast out / O lse-reduce back
+    comm_kv: GroupCollectiveMeta
+    tables: StageTables
+    rank_areas: tuple[int, ...]
+
+    def device_tables(self):
+        arrs = list(self.tables.arrays())
+        arrs += [
+            self.comm_q.send_idx,
+            self.comm_q.recv_sel,
+            self.comm_q.recv_valid,
+            self.comm_q.seg_ids,
+            self.comm_kv.send_idx,
+            self.comm_kv.recv_sel,
+            self.comm_kv.recv_valid,
+        ]
+        return tuple(jnp.asarray(a) for a in arrs)
+
+
+def _ranges_to_send_map(
+    need: list[AttnRanges], shard: int, cp: int
+) -> tuple[list[list[np.ndarray]], list[list[tuple[int, np.ndarray]]]]:
+    """send_map[s][d] = s-local rows of need[d] owned by s (contiguous
+    ownership); recv_segments[d] = (src, global ids) in recv order."""
+    send_map = [
+        [np.empty(0, np.int64) for _ in range(cp)] for _ in range(cp)
+    ]
+    recv_segments: list[list[tuple[int, np.ndarray]]] = [[] for _ in range(cp)]
+    for d in range(cp):
+        for s in range(cp):
+            own = AttnRanges.from_ranges([(s * shard, (s + 1) * shard)])
+            inter = need[d].find_overlap_ranges(own)
+            if inter.is_empty():
+                continue
+            rows = np.concatenate(
+                [
+                    np.arange(r.start - s * shard, r.end - s * shard, dtype=np.int64)
+                    for r in inter
+                ]
+            )
+            send_map[s][d] = rows
+            recv_segments[d].append((s, rows + s * shard))
+    return send_map, recv_segments
+
+
+def _runs_from_segments(
+    segments: list[tuple[int, np.ndarray]]
+) -> list[Run]:
+    runs: list[Run] = []
+    base = 0
+    for _, gids in segments:
+        for r in runs_from_position_ids(gids):
+            runs.append(
+                Run(
+                    local_start=base + r.local_start,
+                    global_start=r.global_start,
+                    length=r.length,
+                )
+            )
+        base += len(gids)
+    return runs
+
+
+def build_qo_comm_plan(
+    slices: np.ndarray,  # [S, 5] global (qs, qe, ks, ke, type)
+    total_seqlen: int,
+    cp_size: int,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    solver: DynamicAttnSolver | None = None,
+) -> QoCommPlan:
+    assert total_seqlen % cp_size == 0, (
+        f"total_seqlen {total_seqlen} must be divisible by cp_size {cp_size}"
+    )
+    sl = np.asarray(slices, dtype=np.int64).reshape(-1, 5)
+    assert (sl[:, :4] >= 0).all() and (
+        sl[:, [1, 3]] <= total_seqlen
+    ).all(), (
+        f"slice ranges must lie within [0, {total_seqlen}): got "
+        f"{sl[:, :4].min()}..{sl[:, [1, 3]].max()} (out-of-range tokens "
+        "would silently never be cast)"
+    )
+    shard = total_seqlen // cp_size
+    solver = solver or DynamicAttnSolver()
+
+    rects = AttnRectangles.from_ranges(
+        [(int(s[0]), int(s[1])) for s in slices],
+        [(int(s[2]), int(s[3])) for s in slices],
+        [int(s[4]) for s in slices],
+    )
+    sol = solver.solve(rects, cp_size)
+
+    q_need: list[AttnRanges] = []
+    k_need: list[AttnRanges] = []
+    rank_slices: list[np.ndarray] = []
+    for rr in sol.rank_rects:
+        qs = AttnRanges()
+        ks = AttnRanges()
+        rows = []
+        for rect in rr:
+            qs.append(rect.q_range.clone())
+            ks.append(rect.k_range.clone())
+            rows.append(
+                (
+                    rect.q_range.start,
+                    rect.q_range.end,
+                    rect.k_range.start,
+                    rect.k_range.end,
+                    int(rect.mask_type),
+                )
+            )
+        q_need.append(qs.merge())
+        k_need.append(ks.merge())
+        rank_slices.append(np.asarray(rows, dtype=np.int64).reshape(-1, 5))
+
+    send_q, recv_q = _ranges_to_send_map(q_need, shard, cp_size)
+    send_kv, recv_kv = _ranges_to_send_map(k_need, shard, cp_size)
+    comm_q = GroupCollectiveMeta.build(send_q, [shard] * cp_size)
+    comm_kv = GroupCollectiveMeta.build(send_kv, [shard] * cp_size)
+
+    q_buf_pad = _round_up(max(comm_q.max_recv, block_q), block_q)
+    kv_buf_pad = _round_up(max(comm_kv.max_recv, block_k), block_k)
+
+    metas = []
+    for r in range(cp_size):
+        metas.append(
+            build_block_meta_general(
+                rank_slices[r],
+                _runs_from_segments(recv_q[r]),
+                _runs_from_segments(recv_kv[r]),
+                q_buf_pad,
+                kv_buf_pad,
+                block_q=block_q,
+                block_k=block_k,
+            )
+        )
+    tables = StageTables.from_rank_metas(metas, kv_buf_pad)
+    return QoCommPlan(
+        cp_size=cp_size,
+        shard_len=shard,
+        q_buf_pad=q_buf_pad,
+        kv_buf_pad=kv_buf_pad,
+        block_q=block_q,
+        block_k=block_k,
+        comm_q=comm_q,
+        comm_kv=comm_kv,
+        tables=tables,
+        rank_areas=sol.areas,
+    )
+
+
+def qo_comm_attn_local(
+    q: jax.Array,  # [shard, hq, d] contiguous token shard
+    k: jax.Array,
+    v: jax.Array,
+    tables,  # 9 kernel arrays + 4 q-comm + 3 kv-comm (per-rank slices)
+    plan: QoCommPlan,
+    params: FlexAttnParams,
+    *,
+    axis_name: str = "cp",
+):
+    """Inside shard_map: cast Q + KV to region owners, partial attn,
+    lse-reduce O back to Q owners. Returns (out [shard, hq, d], lse)."""
+    assert not params.has_sink, (
+        "attention sink is not supported by the qo-comm runtime (the sink "
+        "must join the softmax exactly once; region partials cannot carry it)"
+    )
+    assert (
+        params.block_q == plan.block_q and params.block_k == plan.block_k
+    ), (
+        f"params blocks ({params.block_q},{params.block_k}) != plan blocks "
+        f"({plan.block_q},{plan.block_k}) — entry tables would be misread; "
+        "derive params with make_attn_params(plan, head_dim)"
+    )
+    kt = tables
+    ktab = kt[:9]
+    q_send, q_sel, q_valid, q_seg = kt[9:13]
+    kv_send, kv_sel, kv_valid = kt[13:16]
+
+    hq = q.shape[1]
+    qb = group_cast(q, q_send, q_sel, q_valid, axis_name=axis_name)
+    kv = jnp.stack([k, v], axis=1)
+    kvb = group_cast(kv, kv_send, kv_sel, kv_valid, axis_name=axis_name)
+
+    fp32 = dataclasses.replace(params, out_dtype="float32")
+    qh = _hm(qb, plan.q_buf_pad)
+    out_h, lse_lanes, _ = _call_kernel(
+        qh, kvb[:, 0], kvb[:, 1], ktab, plan.kv_buf_pad, fp32, None
+    )
+    out_p, lse_p = _headmajor_to_seq(out_h, lse_lanes, plan.comm_q.max_recv)
+
+    out_acc = jnp.zeros((plan.shard_len, hq, q.shape[2]), jnp.float32)
+    lse_acc = jnp.full((plan.shard_len, hq), -jnp.inf, jnp.float32)
+    out, lse = group_reduce_lse(
+        out_p,
+        lse_p,
+        out_acc,
+        lse_acc,
+        q_sel,
+        q_valid,
+        q_seg,
+        axis_name=axis_name,
+    )
+    return out.astype(params.out_jnp_dtype), lse
+
+
+def make_qo_comm_attn_fn(
+    plan: QoCommPlan,
+    mesh: jax.sharding.Mesh,
+    params: FlexAttnParams,
+    *,
+    axis_name: str = "cp",
+):
+    """Jittable fn over contiguously sharded [total, h, d] arrays."""
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tables = tuple(
+        jax.device_put(t, NamedSharding(mesh, P(axis_name)))
+        for t in plan.device_tables()
+    )
+    n_tab = len(tables)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis_name),) * 3 + (P(axis_name),) * n_tab,
+        out_specs=(P(axis_name), P(axis_name)),
+        check_vma=False,
+    )
+    def _local(q, k, v, *tabs):
+        return qo_comm_attn_local(
+            q, k, v, tabs, plan, params, axis_name=axis_name
+        )
+
+    def fn(q, k, v):
+        return _local(q, k, v, *tables)
+
+    return fn
