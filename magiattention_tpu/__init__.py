@@ -18,6 +18,28 @@ Layering (mirrors reference SURVEY.md layer map, re-designed TPU-first):
 
 __version__ = "0.1.0"
 
-from . import common  # noqa: F401
+import logging as _logging
+import os as _os
+
+# reference magi_attention/__init__.py:61-83 — attach a formatted handler
+# when MAGI_ATTENTION_LOG_LEVEL is set; unknown values degrade to WARNING
+# (reference env/general.py:66-67) instead of crashing the import
+_level_name = _os.environ.get("MAGI_ATTENTION_LOG_LEVEL")
+logger = _logging.getLogger("magiattention_tpu")
+if _level_name:
+    _level = getattr(_logging, _level_name.strip().upper(), None)
+    if not isinstance(_level, int):
+        _level = _logging.WARNING
+    _h = _logging.StreamHandler()
+    _h.setFormatter(
+        _logging.Formatter(
+            "[%(asctime)s][%(name)s][%(levelname)s] %(message)s"
+        )
+    )
+    logger.addHandler(_h)
+    logger.setLevel(_level)
+    logger.propagate = False
+
+from . import common  # noqa: F401,E402
 
 __all__ = ["common", "__version__"]

@@ -19,6 +19,7 @@ Typical flow::
 from __future__ import annotations
 
 import dataclasses
+import logging
 from collections import OrderedDict
 from typing import Optional, Sequence
 
@@ -40,6 +41,8 @@ from ..parallel.dist_attn import (
 from ..parallel.dispatch import dispatch as _dispatch_op
 from ..parallel.dispatch import undispatch as _undispatch_op
 from .functools import compute_pad_size, pad_at_dim
+
+logger = logging.getLogger("magiattention_tpu")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -286,6 +289,13 @@ def magi_attn_flex_key(
         block_k=env.block_k(),
         overlap_config=dist_attn_config.overlap_config,
     )
+    if logger.isEnabledFor(logging.INFO):
+        logger.info(
+            "planned runtime for mask with %d slices, total=%d:\n%s",
+            len(types),
+            total_seqlen_q + pad,
+            plan.describe(),
+        )
     params = make_attn_params(
         plan,
         head_dim,

@@ -160,6 +160,37 @@ class DistAttnPlan:
             send_total=tuple(st),
         )
 
+    def describe(self) -> str:
+        """Multi-line plan summary (role of the reference's detailed plan
+        dump, dist_attn_runtime_mgr.py:655-1014)."""
+        lines = [
+            f"DistAttnPlan: cp={self.cp_size} shard_q={self.shard_q_len} "
+            f"(pad {self.shard_q_pad}) blocks=({self.block_q},{self.block_k}) "
+            f"overlap_degree={self.overlap_degree}",
+            f"  mask area total={self.total_area} max_rank={self.max_rank_area} "
+            f"imbalance={self.max_rank_area / max(self.total_area / self.cp_size, 1):.3f}",
+        ]
+        if self.overlap_degree == 0:
+            c = self.merged_comm
+            lines.append(
+                f"  comm (merged): recv_rows/rank={list(c.recv_total)} "
+                f"send_rows/rank={list(c.send_total)} "
+                f"padded_payload_rows={c.comm_bytes_per_rank}"
+            )
+            lines.append(
+                f"  tables: E_fwd={self.merged_tables.fwd_qblk.shape[1]} "
+                f"E_bwd={self.merged_tables.bwd_kblk.shape[1]} "
+                f"kv_buf_pad={self.merged_tables.kv_pad}"
+            )
+        else:
+            for i, sp in enumerate(self.stages):
+                lines.append(
+                    f"  stage {i}: recv_rows/rank={list(sp.comm.recv_total)} "
+                    f"E_fwd={sp.tables.fwd_qblk.shape[1]} "
+                    f"kv_pad={sp.tables.kv_pad}"
+                )
+        return "\n".join(lines)
+
     def device_tables(self):
         """Flattened sharded operands, deterministic order (see
         ``dist_attn_local`` for the consuming cursor)."""
