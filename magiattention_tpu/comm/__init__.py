@@ -7,11 +7,15 @@ from .group_collective import (
     group_reduce_sum,
 )
 from .hier import HierGroupCollectiveMeta, group_cast_hier
+from .primitives import all2all_v, all_gather_v, scatter_v
 
 __all__ = [
     "GroupCollectiveMeta",
     "HierGroupCollectiveMeta",
     "group_cast_hier",
+    "all2all_v",
+    "all_gather_v",
+    "scatter_v",
     "group_cast",
     "group_reduce_lse",
     "group_reduce_sum",

@@ -1,0 +1,91 @@
+"""Simple collectives: variable-size gather/scatter on a mesh axis.
+
+Role of reference ``comm/primitive/_all_gather_v.py`` / ``_scatter_v.py`` /
+``_all2all_v.py``: thin building blocks under the group collectives. With
+static per-rank sizes (host-known, like all routing here), variable splits
+are realized by padding to the max size — the same convention as
+GroupCollectiveMeta.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def all_gather_v(
+    x: jax.Array,  # [pad, ...] rank-local rows, padded to max(sizes)
+    sizes: Sequence[int],  # static per-rank valid row counts
+    *,
+    axis_name: str,
+) -> jax.Array:
+    """Concatenate every rank's valid rows in rank order -> [sum(sizes), ...].
+
+    Call inside shard_map; ``x`` must be padded to max(sizes) rows.
+    """
+    sizes = [int(s) for s in sizes]
+    pad = max(sizes)
+    assert x.shape[0] == pad, f"x must be padded to {pad}, got {x.shape[0]}"
+    gathered = jax.lax.all_gather(x, axis_name)  # [cp, pad, ...]
+    sel = np.concatenate(
+        [r * pad + np.arange(s) for r, s in enumerate(sizes)]
+    ).astype(np.int32)
+    flat = gathered.reshape((-1,) + x.shape[1:])
+    return jnp.take(flat, jnp.asarray(sel), axis=0)
+
+
+def scatter_v(
+    x_global: jax.Array,  # [sum(sizes), ...] replicated global rows
+    sizes: Sequence[int],
+    *,
+    axis_name: str,
+) -> jax.Array:
+    """Each rank takes its slice of the concatenation, padded to max(sizes)."""
+    sizes = [int(s) for s in sizes]
+    assert x_global.shape[0] == sum(sizes), (
+        f"x_global has {x_global.shape[0]} rows, expected sum(sizes)="
+        f"{sum(sizes)} (jit would silently clamp out-of-range gathers)"
+    )
+    pad = max(sizes)
+    offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    rank = jax.lax.axis_index(axis_name)
+    # static gather table per rank: [cp, pad] indices (pad rows repeat row 0)
+    tab = np.zeros((len(sizes), pad), dtype=np.int32)
+    for r, s in enumerate(sizes):
+        tab[r, :s] = offsets[r] + np.arange(s)
+    idx = jnp.asarray(tab)[rank]
+    out = jnp.take(x_global, idx, axis=0)
+    valid = jnp.asarray(
+        np.arange(pad)[None, :] < np.asarray(sizes)[:, None]
+    )[rank]
+    shape = (pad,) + (1,) * (x_global.ndim - 1)
+    return jnp.where(valid.reshape(shape), out, 0)
+
+
+def all2all_v(
+    x: jax.Array,  # [cp, pad, ...] per-dst padded send rows
+    send_sizes: Sequence[Sequence[int]],  # [src][dst] static counts
+    *,
+    axis_name: str,
+) -> jax.Array:
+    """Variable all-to-all; returns the [cp, pad, ...] receive buffer.
+
+    Block ``recv[s]`` holds the rows src rank s sent to the executing rank:
+    ``send_sizes[s][my_rank]`` valid rows, the rest padding. Per-rank valid
+    counts are host-static, so SPMD callers consume them the same way the
+    rest of the framework does — via precomputed per-rank index tables
+    (see comm.group_collective, the general-routing superset that packs
+    valid rows for you).
+    """
+    cp = len(send_sizes)
+    assert x.shape[0] == cp, f"x leading dim {x.shape[0]} != world {cp}"
+    pad = int(max(max(int(v) for v in row) for row in send_sizes))
+    assert x.shape[1] >= pad, (
+        f"x per-dst rows {x.shape[1]} < max send size {pad}"
+    )
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=0, concat_axis=0, tiled=False
+    )
