@@ -1,10 +1,13 @@
 """Pallas TPU kernels + host-side kernel planning."""
 
 from .block_meta import FlexAttnBlockMeta, build_block_meta
+from .block_sparse import block_sparse_attn_func, build_block_meta_from_block_mask
 from .flex_attn import flex_attn_with_meta, flex_flash_attn_func
 
 __all__ = [
     "FlexAttnBlockMeta",
+    "block_sparse_attn_func",
+    "build_block_meta_from_block_mask",
     "build_block_meta",
     "flex_attn_with_meta",
     "flex_flash_attn_func",

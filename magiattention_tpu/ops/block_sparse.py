@@ -1,0 +1,138 @@
+"""Block-sparse attention: drive the flex kernel from a block mask.
+
+Role of reference block-sparse / sparse-load modes (flex_flash_attn.py
+sparse options :1110-1123, utils/sparse_utils.py, tests/
+test_block_sparse_attn.py): attention where a boolean block mask
+[num_q_blocks, num_k_blocks] says which tiles compute. The entry-table
+kernel is natively block-sparse — each True block becomes one entry (a FULL
+slice covering exactly that tile), so this is a thin planning adapter with
+zero kernel changes. Optionally a causal constraint is applied on top
+(diagonal blocks get the causal mask type).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .block_meta import FlexAttnBlockMeta, Run, build_block_meta_general
+
+
+def build_block_meta_from_block_mask(
+    block_mask: np.ndarray,  # [nq, nk] bool: which tiles attend
+    total_q: int,
+    total_k: int,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    causal: bool = False,
+) -> FlexAttnBlockMeta:
+    """One slice per True tile; with ``causal``, tiles strictly above the
+    token diagonal are dropped and diagonal-crossing tiles become CAUSAL
+    (bottom-right aligned to the global diagonal — standard block-causal
+    semantics for square masks)."""
+    bm = np.asarray(block_mask, dtype=bool)
+    nq = -(-total_q // block_q)
+    nk = -(-total_k // block_k)
+    assert bm.shape == (nq, nk), (
+        f"block_mask shape {bm.shape} != blocks ({nq}, {nk}) for "
+        f"({total_q}, {total_k}) at ({block_q}, {block_k})"
+    )
+    slices = []
+    for i in range(nq):
+        q0, q1 = i * block_q, min((i + 1) * block_q, total_q)
+        for j in range(nk):
+            if not bm[i, j]:
+                continue
+            k0, k1 = j * block_k, min((j + 1) * block_k, total_k)
+            if causal:
+                # token-level causal on the square global diagonal:
+                # keep iff some (q, k <= q + (total_k - total_q)) in tile
+                off = total_k - total_q
+                if k0 > q1 - 1 + off:
+                    continue  # fully above the diagonal
+                if k1 - 1 <= q0 + off:
+                    mt = 0  # fully below: FULL
+                else:
+                    mt = 1  # crosses the diagonal: CAUSAL, aligned so the
+                    # slice's bottom-right matches the global diagonal
+                    slices.append((q0, q1, k0, min(k1, q1 + off), mt))
+                    continue
+            else:
+                mt = 0
+            slices.append((q0, q1, k0, k1, mt))
+    sl = (
+        np.asarray(slices, dtype=np.int64)
+        if slices
+        else np.empty((0, 5), dtype=np.int64)
+    )
+    return build_block_meta_general(
+        sl,
+        [Run(0, 0, total_q)],
+        [Run(0, 0, total_k)],
+        total_q,
+        total_k,
+        block_q=block_q,
+        block_k=block_k,
+    )
+
+
+@functools.lru_cache(maxsize=128)
+def _cached_bm_meta(mask_bytes, nq, nk, total_q, total_k, bq, bk, causal):
+    return build_block_meta_from_block_mask(
+        np.frombuffer(mask_bytes, dtype=bool).reshape(nq, nk),
+        total_q,
+        total_k,
+        block_q=bq,
+        block_k=bk,
+        causal=causal,
+    )
+
+
+def block_sparse_attn_func(
+    q,
+    k,
+    v,
+    block_mask: np.ndarray,  # [nq, nk] host bool array — static per mask
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    sink=None,
+    out_dtype=None,
+    block_q: int = 128,
+    block_k: int = 128,
+    head_block: int = 1,
+    interpret: bool | None = None,
+):
+    """Single-device block-sparse attention (reference block-sparse mode).
+
+    q [tq, hq, d], k/v [tk, hk, d]; the block mask is host-side and the
+    plan is cached per unique mask.
+    """
+    from .flex_attn import flex_attn_with_meta
+
+    bm = np.ascontiguousarray(np.asarray(block_mask, dtype=bool))
+    meta = _cached_bm_meta(
+        bm.tobytes(),
+        bm.shape[0],
+        bm.shape[1],
+        int(q.shape[0]),
+        int(k.shape[0]),
+        int(block_q),
+        int(block_k),
+        bool(causal),
+    )
+    return flex_attn_with_meta(
+        q,
+        k,
+        v,
+        meta,
+        scale=scale,
+        softcap=softcap,
+        sink=sink,
+        out_dtype=out_dtype,
+        head_block=head_block,
+        interpret=interpret,
+    )

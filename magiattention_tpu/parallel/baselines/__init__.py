@@ -2,6 +2,12 @@
 ring attention and Ulysses — the comparison points for the benchmark-parity
 story. USP (Ulysses x ring over a 2-D mesh) composes the two."""
 
+from .loongtrain import (
+    DoubleRingPlan,
+    build_double_ring_plan,
+    double_ring_attn_local,
+    make_double_ring_attn_fn,
+)
 from .ring import RingAttnPlan, build_ring_attn_plan, make_ring_attn_fn, ring_attn_local
 from .ulysses import (
     UlyssesPlan,
@@ -12,7 +18,11 @@ from .ulysses import (
 from .usp import USPPlan, build_usp_plan, make_usp_attn_fn, usp_attn_local
 
 __all__ = [
+    "DoubleRingPlan",
     "RingAttnPlan",
+    "build_double_ring_plan",
+    "double_ring_attn_local",
+    "make_double_ring_attn_fn",
     "UlyssesPlan",
     "USPPlan",
     "build_usp_plan",
