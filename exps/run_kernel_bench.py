@@ -1,0 +1,153 @@
+"""Kernel benchmark sweep: flex-flash-attention across mask types x seqlens.
+
+Role of reference ``exps/attn/run_benchmark.py`` (the kernel sweep behind
+cp_benchmark.md:78-86): measures TFLOPs/s of the Pallas flex kernel on the
+reference's six headline mask families, against jax's official
+flash_attention where it can express the mask (full/causal only — the flex
+masks have no official-kernel equivalent, which is the point).
+
+Run on a real TPU:  python exps/run_kernel_bench.py [--seqlens 2048,4096]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def mask_families(total: int):
+    """The six reference mask families (cp_benchmark.md:78-86), as slices."""
+    import numpy as np
+
+    third = total // 3
+    doc = [0, third, 2 * third, total]
+    w = max(total // 8, 256)
+    from magiattention_tpu.api import infer_attn_mask_from_sliding_window
+
+    swa_q, swa_k, swa_t = infer_attn_mask_from_sliding_window(total, w)
+    fams = {
+        "full": ([(0, total)], [(0, total)], [0]),
+        "causal": ([(0, total)], [(0, total)], [1]),
+        "varlen_full": (
+            [(a, b) for a, b in zip(doc, doc[1:])],
+            [(a, b) for a, b in zip(doc, doc[1:])],
+            [0] * 3,
+        ),
+        "varlen_causal": (
+            [(a, b) for a, b in zip(doc, doc[1:])],
+            [(a, b) for a, b in zip(doc, doc[1:])],
+            [1] * 3,
+        ),
+        "varlen_block_causal": (
+            [(a, b) for a, b in zip(doc, doc[1:])],
+            [(0, b) for b in doc[1:]],
+            [1] * 3,
+        ),
+        "swa_causal": (
+            swa_q.to_naive_ranges(),
+            swa_k.to_naive_ranges(),
+            [int(t) for t in swa_t],
+        ),
+    }
+    return fams
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seqlens", default="2048,4096,8192")
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--block-q", type=int, default=128)
+    p.add_argument("--block-k", type=int, default=256)
+    p.add_argument("--head-block", type=int, default=8)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from magiattention_tpu.benchmarking import do_bench, perf_report
+    from magiattention_tpu.common.mask import total_area as slices_area
+    from magiattention_tpu.common.ranges import AttnRanges
+    from magiattention_tpu.ops import flex_flash_attn_func
+
+    rows = []
+    for total in [int(s) for s in args.seqlens.split(",")]:
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(
+            rng.standard_normal((total, args.heads, args.head_dim)), jnp.bfloat16
+        )
+        k = jnp.asarray(
+            rng.standard_normal((total, args.kv_heads, args.head_dim)),
+            jnp.bfloat16,
+        )
+        v = jnp.asarray(
+            rng.standard_normal((total, args.kv_heads, args.head_dim)),
+            jnp.bfloat16,
+        )
+        for name, (qr, kr, ts) in mask_families(total).items():
+            area = slices_area(
+                AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr), ts
+            )
+            flops = 4 * area * args.heads * args.head_dim
+            fwd = jax.jit(
+                lambda q, k, v, qr=qr, kr=kr, ts=ts: flex_flash_attn_func(
+                    q,
+                    k,
+                    v,
+                    qr,
+                    kr,
+                    ts,
+                    block_q=args.block_q,
+                    block_k=args.block_k,
+                    head_block=args.head_block,
+                )[0]
+            )
+            r = do_bench(fwd, q, k, v, warmup=2, rep=3, inner=10)
+            rows.append(
+                {
+                    "mask": name,
+                    "seqlen": total,
+                    "ms": round(r.median_ms, 2),
+                    "tflops": round(r.tflops(flops), 2),
+                    "area_frac": round(area / (total * total), 3),
+                }
+            )
+            print(rows[-1], file=sys.stderr, flush=True)
+
+        # official-kernel reference points (full + causal only)
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention,
+            )
+
+            qb = q.transpose(1, 0, 2)[None]
+            kb = k.transpose(1, 0, 2)[None]
+            vb = v.transpose(1, 0, 2)[None]
+            for causal in (False, True):
+                ref = jax.jit(
+                    lambda q, k, v, c=causal: flash_attention(q, k, v, causal=c)
+                )
+                r = do_bench(ref, qb, kb, vb, warmup=2, rep=3, inner=10)
+                area = total * (total + 1) // 2 if causal else total * total
+                flops = 4 * area * args.heads * args.head_dim
+                rows.append(
+                    {
+                        "mask": f"jax_flash_{'causal' if causal else 'full'}",
+                        "seqlen": total,
+                        "ms": round(r.median_ms, 2),
+                        "tflops": round(r.tflops(flops), 2),
+                        "area_frac": 0.5 if causal else 1.0,
+                    }
+                )
+                print(rows[-1], file=sys.stderr, flush=True)
+        except Exception as e:  # pragma: no cover
+            print(f"jax reference kernel failed: {e}", file=sys.stderr)
+
+    print(perf_report(rows))
+
+
+if __name__ == "__main__":
+    main()
