@@ -16,10 +16,21 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _block_causal(doc, block):
+    qr, kr, ts = [], [], []
+    for a, b in zip(doc, doc[1:]):
+        c = a
+        while c < b:
+            e = min(c + block, b)
+            qr.append((c, e))
+            kr.append((a, e))
+            ts.append(0)  # FULL: the block sees its whole own block
+            c = e
+    return qr, kr, ts
+
+
 def mask_families(total: int):
     """The six reference mask families (cp_benchmark.md:78-86), as slices."""
-    import numpy as np
-
     third = total // 3
     doc = [0, third, 2 * third, total]
     w = max(total // 8, 256)
@@ -39,11 +50,10 @@ def mask_families(total: int):
             [(a, b) for a, b in zip(doc, doc[1:])],
             [1] * 3,
         ),
-        "varlen_block_causal": (
-            [(a, b) for a, b in zip(doc, doc[1:])],
-            [(0, b) for b in doc[1:]],
-            [1] * 3,
-        ),
+        # block-causal: causal at block granularity within each doc — every
+        # q block attends FULLY from its doc's start through its own block
+        # (reference exps block-causal construction: FULL slices per block)
+        "varlen_block_causal": _block_causal(doc, max(total // 16, 128)),
         "swa_causal": (
             swa_q.to_naive_ranges(),
             swa_k.to_naive_ranges(),
