@@ -116,19 +116,22 @@ class DistAttnRuntimeMgr:
 
     # -- attention ---------------------------------------------------------
 
-    def calc_attn(self, q, k, v):
+    def calc_attn(self, q, k, v, sink=None):
         """Distributed flex attention on dispatched tensors.
 
         q [total_padded, hq, d], k/v [total_padded, hk, d] in dispatch order
         (sharded P(cp_axis) or to-be-sharded). Returns
         ``(out, AttnForwardMeta(lse=...))`` in the same layout (reference
-        calc_attn returns the forward meta alongside out). A sink, if any,
-        was baked in at key-creation time (its values are part of the cache
-        key; pass updated sinks by re-keying).
+        calc_attn returns the forward meta alongside out).
+
+        ``sink``: optional [hq] array overriding the sink captured at
+        key-creation time. It is a *traced* argument — pass the live
+        (trainable) sink here each step so gradients flow to it without
+        re-keying; requires the key to have been created with a sink.
         """
         from ..common.forward_meta import AttnForwardMeta
 
-        out, lse = self._attn_fn(q, k, v)
+        out, lse = self._attn_fn(q, k, v, sink)
         return out, AttnForwardMeta(lse=lse)
 
 
@@ -351,9 +354,13 @@ def undispatch(y: jax.Array, key: DistAttnRuntimeKey):
     return get_runtime_mgr(key).undispatch(y)
 
 
-def calc_attn(q, k, v, key: DistAttnRuntimeKey):
-    """Reference api.calc_attn :1041 — returns (out, AttnForwardMeta)."""
-    return get_runtime_mgr(key).calc_attn(q, k, v)
+def calc_attn(q, k, v, key: DistAttnRuntimeKey, sink=None):
+    """Reference api.calc_attn :1041 — returns (out, AttnForwardMeta).
+
+    ``sink`` (optional, traced): overrides the key's captured sink so a
+    learned sink receives gradients (the reference's sink is trainable).
+    """
+    return get_runtime_mgr(key).calc_attn(q, k, v, sink=sink)
 
 
 def get_position_ids(key: DistAttnRuntimeKey):

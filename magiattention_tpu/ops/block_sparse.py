@@ -47,21 +47,29 @@ def build_block_meta_from_block_mask(
                 continue
             k0, k1 = j * block_k, min((j + 1) * block_k, total_k)
             if causal:
-                # token-level causal on the square global diagonal:
-                # keep iff some (q, k <= q + (total_k - total_q)) in tile
+                # token-level causal on the global diagonal:
+                # keep (q, k) iff k <= q + (total_k - total_q)
                 off = total_k - total_q
                 if k0 > q1 - 1 + off:
                     continue  # fully above the diagonal
                 if k1 - 1 <= q0 + off:
-                    mt = 0  # fully below: FULL
+                    slices.append((q0, q1, k0, k1, 0))  # fully below: FULL
+                elif k1 >= q1 + off:
+                    # diagonal exits through the bottom edge: one CAUSAL
+                    # slice whose bottom-right corner (q1-1, q1-1+off) sits
+                    # on the diagonal, so k <= q + (ke - qe) == q + off
+                    slices.append((q0, q1, k0, q1 + off, 1))
                 else:
-                    mt = 1  # crosses the diagonal: CAUSAL, aligned so the
-                    # slice's bottom-right matches the global diagonal
-                    slices.append((q0, q1, k0, min(k1, q1 + off), mt))
-                    continue
-            else:
-                mt = 0
-            slices.append((q0, q1, k0, k1, mt))
+                    # diagonal exits through the right edge (k1 < q1 + off,
+                    # e.g. block_k < block_q or a ragged last k tile): rows
+                    # q >= k1 - off already see the full tile width; rows
+                    # above them form a CAUSAL slice whose bottom-right
+                    # corner (k1-off-1, k1-1) sits on the diagonal
+                    qsplit = k1 - off
+                    slices.append((q0, qsplit, k0, k1, 1))
+                    slices.append((qsplit, q1, k0, k1, 0))
+                continue
+            slices.append((q0, q1, k0, k1, 0))
     sl = (
         np.asarray(slices, dtype=np.int64)
         if slices

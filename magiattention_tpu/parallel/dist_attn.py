@@ -153,9 +153,23 @@ class DistAttnPlan:
             for r in range(self.cp_size):
                 rt[r] += sp.comm.recv_total[r]
                 st[r] += sp.comm.send_total[r]
-        c0 = self.stages[0].comm if self.stages else None
+        if not self.stages:
+            # degree>=1 plan whose stages were all filtered out (fully-local
+            # mask, e.g. block-diagonal varlen): zero comm volume
+            cp = self.cp_size
+            return GroupCollectiveMeta(
+                cp_size=cp,
+                max_send=0,
+                max_recv=0,
+                send_total=tuple(st),
+                recv_total=tuple(rt),
+                send_idx=np.zeros((cp, cp, 0), np.int32),
+                recv_sel=np.zeros((cp, 0), np.int32),
+                recv_valid=np.zeros((cp, 0), bool),
+                seg_ids=np.zeros((cp, cp, 0), np.int32),
+            )
         return dataclasses.replace(
-            c0,
+            self.stages[0].comm,
             recv_total=tuple(rt),
             send_total=tuple(st),
         )
@@ -635,8 +649,16 @@ def make_dist_attn_fn(
             q, k, v, tabs, plan, params, axis_name=axis_name, sink=s
         )
 
-    def fn(q, k, v):
-        extra = (sink,) if sink is not None else ()
+    def fn(q, k, v, sink_override=None):
+        # sink is a *traced* argument: callers may pass an updated (e.g.
+        # trainable) sink array per call so gradients flow through it; the
+        # array captured at plan time is only the default. The has-sink
+        # structure itself is static (fixed at plan time).
+        s = sink if sink_override is None else sink_override
+        assert (s is None) == (sink is None), (
+            "sink override requires a plan built with has_sink=True"
+        )
+        extra = (s,) if s is not None else ()
         return _local(q, k, v, *tables, *extra)
 
     return fn

@@ -57,6 +57,48 @@ def test_block_sparse_matches_oracle(causal, seed):
     assert_close(g, gr, atol=1e-4, rtol=1e-4, msg=f"bs dk causal={causal}")
 
 
+def test_block_sparse_causal_area_unequal_blocks():
+    """Advisor regression: with block_k < block_q, diagonal-crossing tiles
+    must not under-attend (128x128 all-True causal area is 8256)."""
+    from magiattention_tpu.ops.block_sparse import (
+        build_block_meta_from_block_mask,
+    )
+
+    total = 128
+    for bq, bk in [(128, 64), (64, 128), (128, 32), (32, 128), (64, 64)]:
+        bm = np.ones((-(-total // bq), -(-total // bk)), bool)
+        meta = build_block_meta_from_block_mask(
+            bm, total, total, block_q=bq, block_k=bk, causal=True
+        )
+        expect = total * (total + 1) // 2
+        assert meta.total_area == expect, (bq, bk, meta.total_area, expect)
+
+
+@pytest.mark.parametrize("bq,bk", [(128, 64), (64, 128), (128, 32)])
+def test_block_sparse_causal_unequal_blocks_oracle(bq, bk):
+    """Advisor regression: causal block-sparse with block_q != block_k vs
+    the dense oracle (crossing tiles with k1 < q1 + off)."""
+    total = 256
+    hq, hk, d = 2, 2, 32
+    rng = np.random.default_rng(7)
+    bm = rng.random((-(-total // bq), -(-total // bk))) < 0.6
+    bm[:, 0] = True  # keep every row attending something below the diagonal
+    q = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    out, lse = block_sparse_attn_func(
+        q, k, v, bm, causal=True, block_q=bq, block_k=bk
+    )
+    mask = _dense_mask_from_blocks(bm, total, total, bq, bk, True)
+    ref_out, ref_lse, _ = ref_attn(q, k, v, mask)
+    assert_close(out, ref_out, atol=2e-5, rtol=2e-5, msg=f"bq={bq} bk={bk}")
+    finite = ~np.isneginf(np.asarray(ref_lse))
+    assert_close(
+        np.asarray(lse)[finite], np.asarray(ref_lse)[finite],
+        atol=2e-5, rtol=2e-5,
+    )
+
+
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("tq,tk", [(256, 512), (512, 256)])
 def test_block_sparse_rect_cross(tq, tk, causal):

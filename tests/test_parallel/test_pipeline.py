@@ -207,6 +207,30 @@ def test_zero_redundancy_comm_volume():
     assert plan.comm.recv_total[-1] == (cp - 1) * shard
 
 
+def test_union_comm_empty_stages():
+    """Advisor regression: a degree>=1 plan on a fully-local mask
+    (block-diagonal varlen aligned to the rank shards) filters out every
+    stage; ``plan.comm`` must report zero volume instead of crashing."""
+    from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+
+    cp, total, chunk = 4, 512, 128
+    docs = [(i * chunk, (i + 1) * chunk) for i in range(cp)]
+    r = AttnRanges.from_ranges(docs)
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        r, r, [F] * cp, total, total, chunk_size=chunk, cp_size=cp,
+        dispatch_config=DispatchConfig(alg=SequentialDispatchAlg()),
+    )
+    plan = build_dist_attn_plan(
+        mq, bucket, block_q=64, block_k=64,
+        overlap_config=OverlapConfig(degree=2, min_stage_rows=64),
+    )
+    c = plan.comm  # advisor repro: raised TypeError before the fix
+    assert tuple(c.recv_total) == (0,) * cp
+    assert tuple(c.send_total) == (0,) * cp
+    assert c.max_recv == 0 and c.max_send == 0
+    assert isinstance(plan.describe(), str)
+
+
 def test_load_balanced_plan_beats_sequential():
     total, cp, chunk = 2048, 4, 128
     q_ranges = AttnRanges.from_ranges([(0, total)])
