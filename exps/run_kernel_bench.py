@@ -65,14 +65,25 @@ def mask_families(total: int):
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--seqlens", default="2048,4096,8192")
+    p.add_argument("--seqlens", default="4096,8192,16384,32768,65536")
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--kv-heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=128)
     p.add_argument("--block-q", type=int, default=128)
     p.add_argument("--block-k", type=int, default=256)
     p.add_argument("--head-block", type=int, default=8)
+    p.add_argument(
+        "--mode",
+        default="fwd,bwd",
+        help="comma set of {fwd,bwd}: bwd times jit(grad) and derives the "
+        "pure-backward cost as (fwd+bwd) - fwd at 2.5x fwd FLOPs "
+        "(reference cp_benchmark.md:45)",
+    )
+    p.add_argument(
+        "--masks", default="", help="comma subset of mask families (all if empty)"
+    )
     args = p.parse_args()
+    modes = set(args.mode.split(","))
 
     import jax
     import jax.numpy as jnp
@@ -97,13 +108,25 @@ def main() -> None:
             rng.standard_normal((total, args.kv_heads, args.head_dim)),
             jnp.bfloat16,
         )
-        for name, (qr, kr, ts) in mask_families(total).items():
+        do = jnp.asarray(
+            rng.standard_normal((total, args.heads, args.head_dim)), jnp.bfloat16
+        )
+        fams = mask_families(total)
+        if args.masks:
+            fams = {k_: fams[k_] for k_ in args.masks.split(",")}
+        for name, (qr, kr, ts) in fams.items():
             area = slices_area(
                 AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr), ts
             )
             flops = 4 * area * args.heads * args.head_dim
-            fwd = jax.jit(
-                lambda q, k, v, qr=qr, kr=kr, ts=ts: flex_flash_attn_func(
+            row = {
+                "mask": name,
+                "seqlen": total,
+                "area_frac": round(area / (total * total), 3),
+            }
+
+            def attn(q, k, v, qr=qr, kr=kr, ts=ts):
+                return flex_flash_attn_func(
                     q,
                     k,
                     v,
@@ -114,18 +137,32 @@ def main() -> None:
                     block_k=args.block_k,
                     head_block=args.head_block,
                 )[0]
-            )
+
+            fwd = jax.jit(attn)
             r = do_bench(fwd, q, k, v, warmup=2, rep=3, inner=10)
-            rows.append(
-                {
-                    "mask": name,
-                    "seqlen": total,
-                    "ms": round(r.median_ms, 2),
-                    "tflops": round(r.tflops(flops), 2),
-                    "area_frac": round(area / (total * total), 3),
-                }
-            )
-            print(rows[-1], file=sys.stderr, flush=True)
+            row["ms_fwd"] = round(r.median_ms, 2)
+            row["tf_fwd"] = round(r.tflops(flops), 2)
+            if "bwd" in modes:
+                fb = jax.jit(
+                    jax.grad(
+                        lambda q, k, v, a=attn: (a(q, k, v) * do).sum().astype(
+                            jnp.float32
+                        ),
+                        argnums=(0, 1, 2),
+                    )
+                )
+                rb = do_bench(fb, q, k, v, warmup=2, rep=3, inner=10)
+                bwd_ms = rb.median_ms - r.median_ms
+                row["ms_fb"] = round(rb.median_ms, 2)
+                # pure backward at 2.5x fwd FLOPs (5 matmuls w/ recompute);
+                # None when timing noise makes fwd+bwd <= fwd (unmeasurable)
+                row["tf_bwd"] = (
+                    round(2.5 * flops / (bwd_ms * 1e-3) / 1e12, 2)
+                    if bwd_ms > 0.05 * r.median_ms
+                    else None
+                )
+            rows.append(row)
+            print(row, file=sys.stderr, flush=True)
 
         # official-kernel reference points (full + causal only)
         try:
@@ -136,23 +173,42 @@ def main() -> None:
             qb = q.transpose(1, 0, 2)[None]
             kb = k.transpose(1, 0, 2)[None]
             vb = v.transpose(1, 0, 2)[None]
+            dob = do.transpose(1, 0, 2)[None]
             for causal in (False, True):
+                area = total * (total + 1) // 2 if causal else total * total
+                flops = 4 * area * args.heads * args.head_dim
+                row = {
+                    "mask": f"jax_flash_{'causal' if causal else 'full'}",
+                    "seqlen": total,
+                    "area_frac": 0.5 if causal else 1.0,
+                }
                 ref = jax.jit(
                     lambda q, k, v, c=causal: flash_attention(q, k, v, causal=c)
                 )
                 r = do_bench(ref, qb, kb, vb, warmup=2, rep=3, inner=10)
-                area = total * (total + 1) // 2 if causal else total * total
-                flops = 4 * area * args.heads * args.head_dim
-                rows.append(
-                    {
-                        "mask": f"jax_flash_{'causal' if causal else 'full'}",
-                        "seqlen": total,
-                        "ms": round(r.median_ms, 2),
-                        "tflops": round(r.tflops(flops), 2),
-                        "area_frac": 0.5 if causal else 1.0,
-                    }
-                )
-                print(rows[-1], file=sys.stderr, flush=True)
+                row["ms_fwd"] = round(r.median_ms, 2)
+                row["tf_fwd"] = round(r.tflops(flops), 2)
+                if "bwd" in modes:
+                    fb = jax.jit(
+                        jax.grad(
+                            lambda q, k, v, c=causal: (
+                                flash_attention(q, k, v, causal=c) * dob
+                            )
+                            .sum()
+                            .astype(jnp.float32),
+                            argnums=(0, 1, 2),
+                        )
+                    )
+                    rb = do_bench(fb, qb, kb, vb, warmup=2, rep=3, inner=10)
+                    bwd_ms = rb.median_ms - r.median_ms
+                    row["ms_fb"] = round(rb.median_ms, 2)
+                    row["tf_bwd"] = (
+                        round(2.5 * flops / (bwd_ms * 1e-3) / 1e12, 2)
+                        if bwd_ms > 0.05 * r.median_ms
+                        else None
+                    )
+                rows.append(row)
+                print(row, file=sys.stderr, flush=True)
         except Exception as e:  # pragma: no cover
             print(f"jax reference kernel failed: {e}", file=sys.stderr)
 

@@ -886,6 +886,66 @@ def flex_attn_with_meta(
     return out, lse
 
 
+_AUTO_BLOCK_CONFIGS: tuple[tuple[int, int, int], ...] = (
+    # (block_q, block_k, head_block) in preference order, all measured to fit
+    # v5e limits (16 MB scoped vmem) at head_dim 128. Larger block_k shrinks
+    # the entry table (the scalar-prefetch smem arrays are ~40 B/entry
+    # against a 1 MB smem budget) and amortizes grid-step overhead.
+    (128, 512, 8),
+    (256, 512, 4),
+    (256, 1024, 2),
+)
+_MAX_SMEM_ENTRIES = 24000
+
+
+def _est_entries(q_ranges, k_ranges, bq: int, bk: int) -> int:
+    """Upper bound on kernel entries: per-slice tile-grid coverage."""
+    total = 0
+    for (q0, q1), (k0, k1) in zip(q_ranges, k_ranges):
+        nq = -(-(max(q1 - q0, 0)) // bq) + 1  # +1 for block misalignment
+        nk = -(-(max(k1 - k0, 0)) // bk) + 1
+        total += nq * nk
+    return total
+
+
+def _auto_head_block(pref: int, hq: int, group: int) -> int:
+    """Largest head_block <= pref that divides hq and is a multiple of the
+    GQA group (falls back to the group itself)."""
+    best = group if hq % group == 0 else 1
+    c = group
+    while c <= min(pref, hq):
+        if hq % c == 0:
+            best = c
+        c += group
+    return best
+
+
+def auto_block_config(
+    q_ranges,
+    k_ranges,
+    hq: int,
+    hk: int,
+    *,
+    fixed_block_q: int | None = None,
+    fixed_block_k: int | None = None,
+) -> tuple[int, int, int]:
+    """Pick (block_q, block_k, head_block) for a mask: the fastest measured
+    config whose entry-table estimate fits the smem scalar-prefetch budget.
+
+    Caller-fixed block sizes are honored: the entry estimate and head_block
+    choice are computed against the blocking the kernel will actually use.
+    """
+    group = max(hq // max(hk, 1), 1)
+    last = None
+    for bq, bk, hb in _AUTO_BLOCK_CONFIGS:
+        bq = fixed_block_q if fixed_block_q is not None else bq
+        bk = fixed_block_k if fixed_block_k is not None else bk
+        last = (bq, bk, _auto_head_block(hb, hq, group))
+        if _est_entries(q_ranges, k_ranges, bq, bk) <= _MAX_SMEM_ENTRIES:
+            return last
+    return last
+
+
 @functools.lru_cache(maxsize=256)
 def _cached_meta(
     q_ranges_b: bytes,
@@ -920,9 +980,9 @@ def flex_flash_attn_func(
     softcap: float = 0.0,
     sink: jax.Array | None = None,
     out_dtype=None,
-    block_q: int = 128,
-    block_k: int = 128,
-    head_block: int = 1,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    head_block: int | None = None,
     return_max_logits: bool = False,
     interpret: bool | None = None,
 ):
@@ -931,10 +991,24 @@ def flex_flash_attn_func(
     The ranges are host-side values: the kernel plan is built once per unique
     (mask, shape, blocking) and cached — the TPU-idiomatic replacement for the
     reference's runtime q_ranges device tensors + persistent-kernel scheduler.
+
+    ``block_q``/``block_k``/``head_block`` default to an automatic choice
+    (:func:`auto_block_config`) keyed on the mask and head counts.
     """
     q_arr = np.ascontiguousarray(np.asarray(q_ranges, dtype=np.int64).reshape(-1, 2))
     k_arr = np.ascontiguousarray(np.asarray(k_ranges, dtype=np.int64).reshape(-1, 2))
     t_arr = np.ascontiguousarray(np.asarray(attn_type_map, dtype=np.int64).reshape(-1))
+    if block_q is None or block_k is None or head_block is None:
+        abq, abk, ahb = auto_block_config(
+            q_arr.tolist(),
+            k_arr.tolist(),
+            int(q.shape[1]),
+            int(k.shape[1]),
+            fixed_block_q=block_q,
+            fixed_block_k=block_k,
+        )
+        block_q, block_k = abq, abk
+        head_block = ahb if head_block is None else head_block
     meta = _cached_meta(
         q_arr.tobytes(),
         k_arr.tobytes(),
