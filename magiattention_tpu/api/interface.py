@@ -98,10 +98,12 @@ class DistAttnRuntimeMgr:
         """Global natural-order [total, ...] -> dispatched order (pad+permute).
 
         Shard the result P(cp_axis) along tokens for the rank-local layout.
+        ``pad_value`` fills both the chunk-multiple tail and (uneven shard)
+        the per-rank physical pad slots.
         """
         if self.key.pad_size:
             x = pad_at_dim(x, 0, self.key.pad_size, pad_value)
-        return _dispatch_op(x, self.dispatch_meta)
+        return _dispatch_op(x, self.dispatch_meta, pad_value=pad_value)
 
     def undispatch(self, y: jax.Array) -> jax.Array:
         """Dispatched order -> global natural order (pad rows dropped)."""
@@ -111,8 +113,11 @@ class DistAttnRuntimeMgr:
         return out
 
     def get_position_ids(self) -> jax.Array:
-        """Global position of each dispatched slot [total_padded] int32."""
-        return jnp.asarray(self.dispatch_meta.perm_idx)
+        """Global position of each dispatched slot [cp*shard] int32 (pad
+        slots of an uneven shard read 0; their values are never used)."""
+        from ..parallel.dispatch import position_ids as _position_ids
+
+        return _position_ids(self.dispatch_meta)
 
     # -- attention ---------------------------------------------------------
 
@@ -193,7 +198,7 @@ def magi_attn_flex_key(
     *,
     num_heads: tuple[int, int],  # (hq, hkv)
     head_dim: int,
-    cp_axis: str = "cp",
+    cp_axis: "str | tuple[str, str]" = "cp",  # (inter, intra) -> hier comm
     chunk_size: int | None = None,
     softcap: float = 0.0,
     has_sink: bool = False,
@@ -220,6 +225,31 @@ def magi_attn_flex_key(
         dist_attn_config = DistAttnConfig()
     if dispatch_config is None:
         dispatch_config = dist_attn_config.dispatch_config
+    hq, hkv = num_heads
+    oc = dist_attn_config.overlap_config
+    if (
+        oc.degree is None
+        and oc.calc_cost_factor == 1.0
+        and oc.comm_cost_factor == 1.0
+    ):
+        # auto-degree with default factors: fill in the real hardware cost
+        # model (reference get_calc/comm_cost_factor, utils/_utils.py)
+        from ..utils.cost import get_calc_cost_factor, get_comm_cost_factor
+
+        gen = env.tpu_generation()
+        dist_attn_config = dataclasses.replace(
+            dist_attn_config,
+            overlap_config=dataclasses.replace(
+                oc,
+                calc_cost_factor=get_calc_cost_factor(hq, head_dim, gen),
+                comm_cost_factor=get_comm_cost_factor(hkv, head_dim, gen),
+                comm_cost_factor_inter=(
+                    get_comm_cost_factor(hkv, head_dim, gen, link="dcn")
+                    if isinstance(cp_axis, (tuple, list))
+                    else None
+                ),
+            ),
+        )
     if not isinstance(q_ranges, AttnRanges):
         q_ranges = AttnRanges.from_ranges(q_ranges)
     if not isinstance(k_ranges, AttnRanges):
@@ -229,15 +259,29 @@ def magi_attn_flex_key(
         from ..common.sanity import check_slices_non_overlapping
 
         check_slices_non_overlapping(q_ranges, k_ranges, types)
-    cp_size = mesh.shape[cp_axis]
+    if isinstance(cp_axis, (tuple, list)):
+        # 2-D cp mesh (inter, intra) -> hierarchical 2-level comm
+        # (reference env/comm.py:31-41 + api:617-637)
+        cp_axis = tuple(cp_axis)
+        assert len(cp_axis) == 2, "hierarchical cp needs (inter, intra) axes"
+        cp_mesh_shape = tuple(int(mesh.shape[a]) for a in cp_axis)
+        cp_size = cp_mesh_shape[0] * cp_mesh_shape[1]
+    else:
+        cp_mesh_shape = None
+        cp_size = mesh.shape[cp_axis]
 
     if chunk_size is None:
         # auto: total / (min_chunks_per_rank * cp), floored to a sane block
         chunk_size = max(
             total_seqlen_q // (env.min_chunks_per_rank() * cp_size), 128
         )
-    pad = compute_pad_size(total_seqlen_q, cp_size, chunk_size)
-    hq, hkv = num_heads
+    # uneven shard (reference api:639-676): pad only to a chunk multiple —
+    # ranks absorb the chunk-count remainder via per-rank valid lengths
+    pad = compute_pad_size(
+        total_seqlen_q,
+        1 if dispatch_config.uneven_shard else cp_size,
+        chunk_size,
+    )
     has_sink = has_sink or sink is not None
     assert not (has_sink and sink is None), (
         "has_sink=True requires the sink array at key-creation time"
@@ -291,6 +335,7 @@ def magi_attn_flex_key(
         block_q=env.block_q(),
         block_k=env.block_k(),
         overlap_config=dist_attn_config.overlap_config,
+        cp_mesh_shape=cp_mesh_shape,
     )
     if logger.isEnabledFor(logging.INFO):
         logger.info(
@@ -427,6 +472,7 @@ def make_flex_key_for_new_mask_after_dispatch(
         block_q=env.block_q(),
         block_k=env.block_k(),
         overlap_config=overlap,
+        cp_mesh_shape=old_mgr.plan.hier,
     )
     params = make_attn_params(
         plan,

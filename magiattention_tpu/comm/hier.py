@@ -45,10 +45,19 @@ class HierGroupCollectiveMeta:
     intra_recv_valid: np.ndarray  # [n, R2]
     recv_total: tuple[int, ...]  # valid final rows per rank
     inter_rows_total: tuple[int, ...]  # hop-1 payload rows per rank (dedup'd)
+    send_total: tuple[int, ...] = ()  # = inter_rows_total (diagnostics)
 
     @property
     def max_recv(self) -> int:
         return int(self.intra_recv_sel.shape[1])
+
+    @property
+    def comm_bytes_per_rank(self) -> int:
+        """Padded payload rows across both hops (volume accounting)."""
+        return int(
+            self.n_inter * self.inter_send_idx.shape[2]
+            + self.n_intra * self.intra_send_idx.shape[2]
+        )
 
     def device_arrays(self):
         return tuple(
@@ -195,6 +204,9 @@ class HierGroupCollectiveMeta:
                     intra_valid[d, pos : pos + ln] = True
                     pos += ln
 
+        inter_rows = tuple(
+            sum(len(s1[s][dn]) for dn in range(n_inter)) for s in range(n)
+        )
         meta = HierGroupCollectiveMeta(
             n_inter=n_inter,
             n_intra=n_intra,
@@ -205,9 +217,10 @@ class HierGroupCollectiveMeta:
             intra_recv_sel=intra_sel,
             intra_recv_valid=intra_valid,
             recv_total=tuple(recv_tot),
-            inter_rows_total=tuple(
-                sum(len(s1[s][dn]) for dn in range(n_inter)) for s in range(n)
-            ),
+            inter_rows_total=inter_rows,
+            # duck-types GroupCollectiveMeta diagnostics: what a rank "sends"
+            # is its dedup'd inter-hop payload
+            send_total=inter_rows,
         )
         # reorder recv_sources to the actual final layout: (si asc, sn asc)
         ordered: list[list[tuple[int, np.ndarray]]] = [[] for _ in range(n)]
@@ -243,3 +256,31 @@ def group_cast_hier(
     return group_cast(
         gw, intra_send, intra_sel, intra_valid, axis_name=axis_intra
     )
+
+
+def group_reduce_hier(
+    y: jax.Array,  # [R2, ...] partial rows (layout of group_cast_hier output)
+    acc: jax.Array,  # [T_local, ...] buffer to accumulate into
+    tables,  # same 6 routing slices as the cast
+    *,
+    axis_inter: str = "dcn",
+    axis_intra: str = "ici",
+):
+    """Hierarchical sum-reduce: the exact reverse of :func:`group_cast_hier`
+    (role of reference HierGroupReduceMetaSolver,
+    _group_collective_hier.py:804). Partials flow dst -> gateway over the
+    intra axis, are PRE-REDUCED at the gateway (rows destined to the same
+    source row sum locally — that is the inter-traffic dedup), then cross
+    the inter axis once per unique row and accumulate onto the owner.
+
+    Implemented as the linear transpose of the cast — the routing tables
+    guarantee the transpose is exactly the two-hop reduce with gateway
+    pre-reduction, so both directions share one source of truth.
+    """
+    T = acc.shape[0]
+    cast = lambda x: group_cast_hier(
+        x, tables, axis_inter=axis_inter, axis_intra=axis_intra
+    )
+    spec = jax.ShapeDtypeStruct((T,) + y.shape[1:], y.dtype)
+    (contrib,) = jax.linear_transpose(cast, spec)(y)
+    return acc + contrib.astype(acc.dtype)

@@ -67,6 +67,11 @@ def block_k() -> int:
     return _env_int("MAGI_ATTENTION_BLOCK_K", 128)
 
 
+def tpu_generation() -> str:
+    """TPU generation key for the cost model (utils/cost.py specs)."""
+    return _env_str("MAGI_ATTENTION_TPU_GENERATION", "v5e")
+
+
 def flags_fingerprint() -> tuple:
     """The behavior-influencing flags, folded into runtime-key hashing."""
     return (
@@ -74,4 +79,5 @@ def flags_fingerprint() -> tuple:
         kernel_backend(),
         block_q(),
         block_k(),
+        tpu_generation(),
     )

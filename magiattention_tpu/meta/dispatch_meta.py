@@ -33,6 +33,15 @@ class DispatchMeta:
     ``partitions[rank]`` lists the chunk ids owned by that rank (ascending).
     Tokens of a rank are the concatenation of its chunks' rows in chunk order;
     ``position_ids(rank)`` maps local slot -> global position.
+
+    Uneven shard (reference _make_dispatch_meta.py:368-377 +
+    api/magi_attn_interface.py:639-676, no-padding dispatch with per-rank
+    split sizes): ranks may own different chunk counts. SPMD arrays must
+    stay uniform, so the *physical* shard is ``max_chunks_per_rank x
+    chunk_size``; ranks with fewer chunks carry trailing pad slots that no
+    mask slice covers (kernel emits out=0 / lse=-inf there, no comm rows
+    reference them, and undispatch drops them). The global sequence itself
+    is only padded to a chunk multiple — never to a cp x chunk multiple.
     """
 
     total_seqlen: int
@@ -42,12 +51,33 @@ class DispatchMeta:
     partitions: tuple[tuple[int, ...], ...]
 
     @property
+    def max_chunks_per_rank(self) -> int:
+        return max(len(p) for p in self.partitions)
+
+    @property
+    def is_uneven(self) -> bool:
+        return any(
+            len(p) != self.max_chunks_per_rank for p in self.partitions
+        )
+
+    @property
     def shard_seqlen(self) -> int:
-        assert self.num_chunks % self.cp_size == 0
-        return (self.num_chunks // self.cp_size) * self.chunk_size
+        """Physical per-rank rows (uniform across ranks)."""
+        return self.max_chunks_per_rank * self.chunk_size
+
+    def rank_valid_len(self, rank: int) -> int:
+        """Valid (non-pad) rows on this rank."""
+        return len(self.partitions[rank]) * self.chunk_size
+
+    @property
+    def rank_valid_lens(self) -> tuple[int, ...]:
+        return tuple(
+            self.rank_valid_len(r) for r in range(self.cp_size)
+        )
 
     def position_ids(self, rank: int) -> np.ndarray:
-        """Global positions of rank's local tokens, int32 [shard_seqlen]."""
+        """Global positions of rank's VALID local tokens, int32
+        [rank_valid_len(rank)]."""
         cs = self.chunk_size
         out = np.empty(len(self.partitions[rank]) * cs, dtype=np.int32)
         for i, c in enumerate(self.partitions[rank]):
@@ -67,17 +97,34 @@ class DispatchMeta:
 
     @property
     def perm_idx(self) -> np.ndarray:
-        """Global gather indices: dispatched[i] = x[perm_idx[i]], int32 [total]."""
-        return np.concatenate(
-            [self.position_ids(r) for r in range(self.cp_size)]
-        )
+        """Global gather indices: dispatched[i] = x[perm_idx[i]], int32
+        [cp * shard_seqlen]. Pad slots (uneven shard only) carry the
+        out-of-bounds sentinel ``total_seqlen`` — gather with fill."""
+        parts = []
+        shard = self.shard_seqlen
+        for r in range(self.cp_size):
+            ids = self.position_ids(r)
+            if ids.shape[0] < shard:
+                ids = np.concatenate(
+                    [
+                        ids,
+                        np.full(
+                            shard - ids.shape[0],
+                            self.total_seqlen,
+                            np.int32,
+                        ),
+                    ]
+                )
+            parts.append(ids)
+        return np.concatenate(parts)
 
     @property
     def unperm_idx(self) -> np.ndarray:
-        """Inverse permutation: x[i] = dispatched[unperm_idx[i]]."""
+        """Inverse map: x[i] = dispatched[unperm_idx[i]], int32 [total]."""
         perm = self.perm_idx
-        inv = np.empty_like(perm)
-        inv[perm] = np.arange(perm.shape[0], dtype=np.int32)
+        valid = perm < self.total_seqlen
+        inv = np.empty(self.total_seqlen, dtype=np.int32)
+        inv[perm[valid]] = np.arange(perm.shape[0], dtype=np.int32)[valid]
         return inv
 
 
@@ -239,9 +286,9 @@ def make_dispatch_meta_from_qk_ranges(
     if dispatch_config is None:
         dispatch_config = DispatchConfig()
     num_chunks = total_seqlen_q // chunk_size
-    assert num_chunks % cp_size == 0, (
+    assert dispatch_config.uneven_shard or num_chunks % cp_size == 0, (
         f"num_chunks {num_chunks} must be divisible by cp_size {cp_size} "
-        "(apply padding first)"
+        "(apply padding first, or set DispatchConfig(uneven_shard=True))"
     )
 
     bucket = make_global_bucket_from_qk_ranges(

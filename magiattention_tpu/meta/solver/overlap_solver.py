@@ -24,13 +24,41 @@ from ...common.enum import OverlapAlgType
 class OverlapConfig:
     """degree=0: no-overlap blocking path (single merged kernel call);
     degree>=1: that many remote stages (1 reproduces degree-0 compute with
-    async comm; >=2 is true multi-stage overlap)."""
+    async comm; >=2 is true multi-stage overlap); degree=None: auto — the
+    plan builder picks the degree that minimizes a pipelined timeline cost
+    model built from the cost factors (reference OverlapConfig degree=None +
+    dynamic_max_degree, overlap_solver.py:71-157)."""
 
-    degree: int = 0
+    degree: int | None = 0
     alg: OverlapAlgType = OverlapAlgType.UNIFORM
     min_stage_rows: int = 512  # don't create stages smaller than this
     calc_cost_factor: float = 1.0  # sec per unit area (relative ok)
     comm_cost_factor: float = 1.0  # sec per row (relative ok)
+    # auto-degree (degree=None) knobs:
+    dynamic_max_degree: int = 8  # search 1..this for the best stage count
+    max_num_chunks: int = 64  # cap on stage-granularity blocks per rank
+    stage_overhead_s: float = 30e-6  # fixed cost per extra stage (launch)
+    # sec per row over the slow inter hop of a hierarchical (2-D cp) cast;
+    # None = single-level comm (comm_cost_factor covers everything)
+    comm_cost_factor_inter: float | None = None
+
+
+def simulate_overlap_timeline(
+    host_calc_s: float,
+    stage_comm_s: Sequence[float],
+    stage_calc_s: Sequence[float],
+    stage_overhead_s: float,
+) -> float:
+    """Pipelined timeline: casts issue back-to-back in stage order while the
+    kernel chain runs concurrently (XLA latency-hiding scheduler model);
+    stage i's kernel starts when its cast has landed AND the previous
+    kernel finished. Returns the makespan."""
+    t_comm_end = 0.0
+    t_kernel_end = host_calc_s
+    for c, a in zip(stage_comm_s, stage_calc_s):
+        t_comm_end += c
+        t_kernel_end = max(t_kernel_end, t_comm_end) + a + stage_overhead_s
+    return t_kernel_end
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,9 +80,19 @@ class OverlapSolver:
     def __init__(self, config: OverlapConfig):
         self.config = config
 
-    def solve(self, chunk_costs: Sequence[OverlapStageCost]) -> OverlapSolution:
+    def solve(
+        self,
+        chunk_costs: Sequence[OverlapStageCost],
+        degree: int | None = None,
+    ) -> OverlapSolution:
         n = len(chunk_costs)
-        degree = max(1, self.config.degree)
+        if degree is None:
+            degree = self.config.degree
+        assert degree is not None, (
+            "degree=None (auto) must be resolved by the plan builder before "
+            "calling OverlapSolver.solve"
+        )
+        degree = max(1, degree)
         degree = min(degree, max(n, 1))
         if n == 0:
             return OverlapSolution(stage_of=(), num_stages=degree)

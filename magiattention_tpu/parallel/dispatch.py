@@ -18,13 +18,20 @@ import numpy as np
 from ..meta.dispatch_meta import DispatchMeta
 
 
-def dispatch(x: jax.Array, meta: DispatchMeta, axis: int = 0) -> jax.Array:
+def dispatch(
+    x: jax.Array, meta: DispatchMeta, axis: int = 0, pad_value=0
+) -> jax.Array:
     """Permute the global tensor into dispatch order (rank-major chunks).
 
     Shard the result on the cp mesh axis along ``axis`` to realize the
     rank-local layout; position ids follow meta.position_ids(rank).
+    Uneven shard: pad slots (sentinel indices) gather ``pad_value``.
     """
     perm = jnp.asarray(meta.perm_idx)
+    if meta.is_uneven:
+        return jnp.take(
+            x, perm, axis=axis, mode="fill", fill_value=pad_value
+        )
     return jnp.take(x, perm, axis=axis)
 
 
@@ -35,19 +42,26 @@ def undispatch(y: jax.Array, meta: DispatchMeta, axis: int = 0) -> jax.Array:
 
 
 def position_ids(meta: DispatchMeta) -> jax.Array:
-    """Global position of every dispatched slot, [total] int32 (sharded the
-    same way as dispatched activations; used for RoPE etc.)."""
-    return jnp.asarray(meta.perm_idx)
+    """Global position of every dispatched slot, [cp*shard] int32 (sharded
+    the same way as dispatched activations; used for RoPE etc.). Pad slots
+    of an uneven shard read position 0 (their values are never consumed)."""
+    perm = meta.perm_idx
+    if meta.is_uneven:
+        perm = np.where(perm < meta.total_seqlen, perm, 0).astype(np.int32)
+    return jnp.asarray(perm)
 
 
 def roll(x: jax.Array, meta: DispatchMeta, shift: int, axis: int = 0) -> jax.Array:
     """Distributed roll along the *global* sequence of a dispatched tensor
     (reference functional/roll.py roll_p2p — MTP label shifting): in global
     order, y[i] = x[(i - shift) mod total], computed directly in dispatch
-    space as one static gather (GSPMD inserts the point-to-point comm)."""
+    space as one static gather (GSPMD inserts the point-to-point comm).
+    Uneven shard: pad slots keep their own (pad) value."""
     perm = meta.perm_idx.astype(np.int64)
     unperm = meta.unperm_idx.astype(np.int64)
-    total = perm.shape[0]
-    src_global = (perm - shift) % total
-    gather = unperm[src_global].astype(np.int32)
+    total = meta.total_seqlen
+    slots = np.arange(perm.shape[0], dtype=np.int64)
+    valid = perm < total
+    src_global = (np.where(valid, perm, 0) - shift) % total
+    gather = np.where(valid, unperm[src_global], slots).astype(np.int32)
     return jnp.take(x, jnp.asarray(gather), axis=axis)

@@ -40,12 +40,14 @@ import numpy as np
 
 from ..common.ranges import AttnRanges
 from ..comm.group_collective import GroupCollectiveMeta, group_cast
+from ..comm.hier import HierGroupCollectiveMeta, group_cast_hier
 from ..meta.containers import AttnBucket
 from ..meta.dispatch_meta import DispatchMeta
 from ..meta.solver.overlap_solver import (
     OverlapConfig,
     OverlapSolver,
     OverlapStageCost,
+    simulate_overlap_timeline,
 )
 from ..ops.block_meta import (
     FlexAttnBlockMeta,
@@ -138,6 +140,10 @@ class DistAttnPlan:
     host_tables: StageTables | None
     stages: tuple[StagePlan, ...]
 
+    # hierarchical 2-level comm over a (inter, intra) cp mesh (reference
+    # _group_collective_hier.py); None = flat single-axis group collectives
+    hier: tuple[int, int] | None = None
+
     @property
     def comm(self) -> GroupCollectiveMeta:
         """Primary comm meta (diagnostics; degree-0 path or stage union)."""
@@ -205,6 +211,22 @@ class DistAttnPlan:
                 )
         return "\n".join(lines)
 
+    def _comm_arrays(self, comm):
+        if self.hier is not None:
+            return (
+                comm.inter_send_idx,
+                comm.inter_recv_sel,
+                comm.inter_recv_valid,
+                comm.intra_send_idx,
+                comm.intra_recv_sel,
+                comm.intra_recv_valid,
+            )
+        return (comm.send_idx, comm.recv_sel, comm.recv_valid)
+
+    @property
+    def num_comm_arrays(self) -> int:
+        return 6 if self.hier is not None else 3
+
     def device_tables(self):
         """Flattened sharded operands, deterministic order (see
         ``dist_attn_local`` for the consuming cursor)."""
@@ -212,21 +234,13 @@ class DistAttnPlan:
         if self.overlap_degree == 0:
             assert self.merged_tables is not None and self.merged_comm
             arrs.extend(self.merged_tables.arrays())
-            arrs.extend(
-                (
-                    self.merged_comm.send_idx,
-                    self.merged_comm.recv_sel,
-                    self.merged_comm.recv_valid,
-                )
-            )
+            arrs.extend(self._comm_arrays(self.merged_comm))
         else:
             assert self.host_tables is not None
             arrs.extend(self.host_tables.arrays())
             for sp in self.stages:
                 arrs.extend(sp.tables.arrays())
-                arrs.extend(
-                    (sp.comm.send_idx, sp.comm.recv_sel, sp.comm.recv_valid)
-                )
+                arrs.extend(self._comm_arrays(sp.comm))
         return tuple(jnp.asarray(a) for a in arrs)
 
 
@@ -260,6 +274,103 @@ def _split_send_map_by_stage(
     return out
 
 
+def _slice_area_within_k(
+    qs: int, qe: int, ks: int, ke: int, mt: int, intervals
+) -> int:
+    """Exact unmasked area of one slice restricted to k in the interval
+    union (mask-type-aware, via rectangle k-cuts)."""
+    from ..common.enum import AttnMaskType
+    from ..common.range import AttnRange
+    from ..common.rectangle import AttnRectangle
+
+    rect = AttnRectangle(
+        AttnRange(qs, qe), AttnRange(ks, ke), AttnMaskType(mt)
+    )
+    total = 0
+    for a, b in intervals:
+        _, right = rect.cut_k_multi(a)
+        for piece in right:
+            left, _ = piece.cut_k_multi(b)
+            total += sum(p.area for p in left)
+    return total
+
+
+def _choose_overlap_degree(
+    cp: int,
+    slices_per_rank,
+    host_ranges,
+    recv_rows,
+    config: OverlapConfig,
+    block_k: int,
+    inter_frac: float | None = None,
+) -> int:
+    """Auto overlap degree: simulate the staged pipeline per candidate
+    degree with the config's cost factors and return the argmin over the
+    slowest rank (ties -> fewer stages). Mirrors the UNIFORM contiguous
+    row split the staged builder will actually apply.
+
+    ``inter_frac``: for hierarchical plans, the fraction of recv rows that
+    also cross the slow inter hop after dedup — comm is then priced as
+    one intra hop per row plus inter_frac of an inter hop."""
+    from ..common.mask import slice_area
+
+    cf = config.calc_cost_factor
+    cmf = config.comm_cost_factor
+    if inter_frac is not None and config.comm_cost_factor_inter is not None:
+        cmf = cmf + inter_frac * config.comm_cost_factor_inter
+    per_rank: list[tuple[float, float, int]] = []  # (host_s, remote_s, rows)
+    for r in range(cp):
+        own = [
+            (rng.start, rng.end) for rng in host_ranges[r]
+        ]
+        area_total = 0
+        area_host = 0
+        for qs, qe, ks, ke, mt in slices_per_rank[r].tolist():
+            area_total += slice_area(qs, qe, ks, ke, mt)
+            area_host += _slice_area_within_k(qs, qe, ks, ke, mt, own)
+        per_rank.append(
+            (
+                area_host * cf,
+                max(area_total - area_host, 0) * cf,
+                int(recv_rows[r]),
+            )
+        )
+
+    max_d = max(1, config.dynamic_max_degree)
+    best_d, best_t = 1, float("inf")
+    for d in range(1, max_d + 1):
+        t = 0.0
+        for host_s, remote_s, rows in per_rank:
+            if rows == 0:
+                t = max(t, host_s)
+                continue
+            gran = max(
+                config.min_stage_rows, block_k, -(-rows // config.max_num_chunks)
+            )
+            n_blocks = -(-rows // gran)
+            per = -(-n_blocks // min(d, n_blocks))
+            stage_rows = []
+            done = 0
+            for s in range(min(d, n_blocks)):
+                blocks = min(per, n_blocks - s * per)
+                if blocks <= 0:
+                    break
+                r_rows = min(blocks * gran, rows - done)
+                stage_rows.append(r_rows)
+                done += r_rows
+            comm_s = [x * cmf for x in stage_rows]
+            calc_s = [remote_s * (x / rows) for x in stage_rows]
+            t = max(
+                t,
+                simulate_overlap_timeline(
+                    host_s, comm_s, calc_s, config.stage_overhead_s
+                ),
+            )
+        if t < best_t * (1.0 - 1e-9):
+            best_d, best_t = d, t
+    return best_d
+
+
 def build_dist_attn_plan(
     dispatch_meta: DispatchMeta,
     bucket: AttnBucket,
@@ -268,12 +379,17 @@ def build_dist_attn_plan(
     block_q: int = 128,
     block_k: int = 128,
     overlap_config: OverlapConfig | None = None,
+    cp_mesh_shape: tuple[int, int] | None = None,
 ) -> DistAttnPlan:
     """Plan the distributed attention for one dispatched mask.
 
     Self-attention by default (K/V follow the Q partition); pass a separate
     ``kv_dispatch_meta`` for cross-attention (reference dispatch_qo/kv:
     queries are balanced by mask area, keys dispatched by their own meta).
+
+    ``cp_mesh_shape``: (n_inter, n_intra) for hierarchical 2-level comm over
+    a 2-D cp mesh (rank = inter * n_intra + intra; reference
+    _group_collective_hier.py): casts dedup rows across the inter hop.
     """
     cp = dispatch_meta.cp_size
     shard_len = dispatch_meta.shard_seqlen
@@ -282,6 +398,10 @@ def build_dist_attn_plan(
     shard_k_len = kv_meta.shard_seqlen
     overlap_config = overlap_config or OverlapConfig()
     degree = overlap_config.degree
+    if cp_mesh_shape is not None:
+        assert cp_mesh_shape[0] * cp_mesh_shape[1] == cp, (
+            f"cp_mesh_shape {cp_mesh_shape} != cp {cp}"
+        )
 
     pos_ids = [dispatch_meta.position_ids(r) for r in range(cp)]
     pos_ids_k = [kv_meta.position_ids(r) for r in range(cp)]
@@ -338,11 +458,52 @@ def build_dist_attn_plan(
     ]
     total_area = bucket.area
 
-    def _recv_global_ids(r) -> np.ndarray:
-        parts = [g for _, g in recv_segments[r]]
-        return (
-            np.concatenate(parts) if parts else np.empty(0, np.int64)
+    if degree is None:
+        # auto-tune (reference OverlapConfig degree=None + dynamic_max_degree,
+        # overlap_solver.py:71-157): pick the stage count minimizing the
+        # pipelined timeline cost model over the critical rank
+        recv_rows = [
+            sum(len(g) for _, g in recv_segments[r]) for r in range(cp)
+        ]
+        inter_frac = None
+        if cp_mesh_shape is not None:
+            probe, _ = HierGroupCollectiveMeta.build(
+                send_map, [shard_k_len] * cp, *cp_mesh_shape
+            )
+            tot = sum(probe.recv_total)
+            inter_frac = (
+                sum(probe.inter_rows_total) / tot if tot else 0.0
+            )
+        degree = _choose_overlap_degree(
+            cp,
+            slices_per_rank,
+            host_ranges,
+            recv_rows,
+            overlap_config,
+            block_k,
+            inter_frac=inter_frac,
         )
+
+    def _build_comm(smap):
+        """(comm meta, per-rank recv-order global k ids) for one send map —
+        flat single-axis or hierarchical two-hop routing."""
+        if cp_mesh_shape is None:
+            comm = GroupCollectiveMeta.build(smap, [shard_k_len] * cp)
+            sources = [
+                [(s, smap[s][d]) for s in range(cp) if len(smap[s][d])]
+                for d in range(cp)
+            ]
+        else:
+            comm, sources = HierGroupCollectiveMeta.build(
+                smap, [shard_k_len] * cp, cp_mesh_shape[0], cp_mesh_shape[1]
+            )
+        gids = []
+        for d in range(cp):
+            parts = [pos_ids_k[s][rows] for s, rows in sources[d]]
+            gids.append(
+                np.concatenate(parts) if parts else np.empty(0, np.int64)
+            )
+        return comm, gids
 
     def _runs_from_recv_rows(global_ids: np.ndarray, base: int) -> list[Run]:
         runs = []
@@ -357,14 +518,13 @@ def build_dist_attn_plan(
         return runs
 
     if degree == 0:
-        comm = GroupCollectiveMeta.build(send_map, [shard_k_len] * cp)
+        comm, comm_gids = _build_comm(send_map)
         kv_buf_pad = _round_up(shard_k_len + comm.max_recv, block_k)
         metas = []
         for r in range(cp):
             k_runs = list(k_own_runs_per_rank[r])
-            gids = _recv_global_ids(r)
             # received rows sit right after the own shard, in recv order
-            k_runs += _runs_from_recv_rows(gids, shard_k_len)
+            k_runs += _runs_from_recv_rows(comm_gids[r], shard_k_len)
             metas.append(
                 build_block_meta_general(
                     slices_per_rank[r],
@@ -390,6 +550,7 @@ def build_dist_attn_plan(
             merged_tables=tables,
             host_tables=None,
             stages=(),
+            hier=cp_mesh_shape,
         )
 
     # ---- staged path -----------------------------------------------------
@@ -410,18 +571,24 @@ def build_dist_attn_plan(
     host_tables = StageTables.from_rank_metas(host_metas, host_kv_pad)
 
     # assign each rank's remote recv rows to stages via the overlap solver,
-    # at row-block granularity in recv order
-    gran = max(overlap_config.min_stage_rows, block_k)
+    # at row-block granularity in recv order (granularity honors
+    # min_stage_rows and the max_num_chunks cap, matching the auto-degree
+    # timeline model)
     stage_row_of: list[np.ndarray] = []
     solver = OverlapSolver(overlap_config)
     for r in range(cp):
         n_rows = sum(len(g) for _, g in recv_segments[r])
+        gran = max(
+            overlap_config.min_stage_rows,
+            block_k,
+            -(-n_rows // overlap_config.max_num_chunks) if n_rows else 0,
+        )
         n_blocks = -(-n_rows // gran) if n_rows else 0
         costs = [
             OverlapStageCost(comm_cost=float(min(gran, n_rows - b * gran)), calc_cost=1.0)
             for b in range(n_blocks)
         ]
-        sol = solver.solve(costs)
+        sol = solver.solve(costs, degree=degree)
         row_stage = np.zeros(n_rows, dtype=np.int64)
         for b in range(n_blocks):
             row_stage[b * gran : (b + 1) * gran] = (
@@ -436,22 +603,11 @@ def build_dist_attn_plan(
     rank_area = [host_metas[r].total_area for r in range(cp)]
     stages: list[StagePlan] = []
     for st in range(num_stages):
-        st_comm = GroupCollectiveMeta.build(staged_maps[st], [shard_k_len] * cp)
+        st_comm, st_gids = _build_comm(staged_maps[st])
         st_kv_pad = _round_up(max(st_comm.max_recv, block_k), block_k)
         st_metas = []
         for r in range(cp):
-            # global ids of this rank's stage-st recv rows, in recv order
-            gids_parts = []
-            for s, gids in recv_segments[r]:
-                rows = staged_maps[st][s][r]
-                if len(rows):
-                    gids_parts.append(pos_ids_k[s][rows])
-            gids = (
-                np.concatenate(gids_parts)
-                if gids_parts
-                else np.empty(0, np.int64)
-            )
-            k_runs = _runs_from_recv_rows(gids, 0)
+            k_runs = _runs_from_recv_rows(st_gids[r], 0)
             st_metas.append(
                 build_block_meta_general(
                     slices_per_rank[r],
@@ -481,6 +637,7 @@ def build_dist_attn_plan(
         block_q=block_q,
         block_k=block_k,
         overlap_degree=num_stages,
+        hier=cp_mesh_shape,
         total_area=total_area,
         max_rank_area=max(rank_area),
         merged_comm=None,
@@ -569,10 +726,23 @@ def dist_attn_local(
         cur += n
         return out
 
+    def cast(payload, comm_arrays):
+        if plan.hier is not None:
+            inter_name, intra_name = axis_name
+            return group_cast_hier(
+                payload,
+                comm_arrays,
+                axis_inter=inter_name,
+                axis_intra=intra_name,
+            )
+        send_idx, recv_sel, recv_valid = comm_arrays
+        return group_cast(
+            payload, send_idx, recv_sel, recv_valid, axis_name=axis_name
+        )
+
     if plan.overlap_degree == 0:
         tab = take(9)
-        send_idx, recv_sel, recv_valid = take(3)
-        recv = group_cast(kv, send_idx, recv_sel, recv_valid, axis_name=axis_name)
+        recv = cast(kv, take(plan.num_comm_arrays))
         k_full = jnp.concatenate([k, recv[:, 0]], axis=0)
         v_full = jnp.concatenate([v, recv[:, 1]], axis=0)
         out_h, lse_lanes, _ = _call_kernel(
@@ -597,10 +767,7 @@ def dist_attn_local(
     )
     for sp in plan.stages:
         tab = take(9)
-        send_idx, recv_sel, recv_valid = take(3)
-        recv = group_cast(
-            kv, send_idx, recv_sel, recv_valid, axis_name=axis_name
-        )
+        recv = cast(kv, take(plan.num_comm_arrays))
         out_i_h, lse_i_lanes, _ = _call_kernel(
             qh, recv[:, 0], recv[:, 1], tab, sp.tables.kv_pad, stage_params, None
         )

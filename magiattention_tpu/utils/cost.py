@@ -17,6 +17,7 @@ class TpuPeakSpec:
     hbm_gbps: float  # HBM bandwidth GB/s
     ici_gbps: float  # per-link ICI bandwidth GB/s (one direction)
     mfu: float = 0.5  # achievable fraction for attention workloads
+    dcn_gbps: float = 25.0  # inter-slice data-center network GB/s per host
 
 
 # public-spec numbers for common TPU generations
@@ -50,13 +51,17 @@ def get_comm_cost_factor(
     generation: str = "v5p",
     bytes_per_elt: int = 2,
     bwu: float = 0.6,
+    link: str = "ici",
 ) -> float:
-    """Seconds per KV *token row* moved over ICI (K and V), from peak specs.
+    """Seconds per KV *token row* moved over the given link (K and V).
 
     bytes per row = 2 (K+V) * nh_kv * hd * dtype bytes; seconds = bytes /
-    (ici bandwidth * utilization) — the reference's A2A_BWU analogue.
+    (link bandwidth * utilization) — the reference's A2A_BWU analogue.
+    ``link``: 'ici' (intra-slice) or 'dcn' (inter-slice hop of the
+    hierarchical cast).
     """
     spec = TPU_PEAK_SPECS[generation]
+    bw = spec.ici_gbps if link == "ici" else spec.dcn_gbps
     return (2.0 * num_heads_kv * head_dim * bytes_per_elt) / (
-        spec.ici_gbps * 1e9 * bwu
+        bw * 1e9 * bwu
     )

@@ -1,0 +1,142 @@
+"""Auto overlap-degree selection (reference OverlapConfig degree=None +
+dynamic_max_degree + timeline cost model, overlap_solver.py:71-157)."""
+
+import jax
+import numpy as np
+import pytest
+
+from magiattention_tpu.common import AttnMaskType, AttnRanges
+from magiattention_tpu.meta import (
+    DispatchConfig,
+    SequentialDispatchAlg,
+    make_dispatch_meta_from_qk_ranges,
+)
+from magiattention_tpu.meta.solver.overlap_solver import (
+    OverlapConfig,
+    simulate_overlap_timeline,
+)
+from magiattention_tpu.parallel import build_dist_attn_plan
+
+F = AttnMaskType.FULL
+C = AttnMaskType.CAUSAL
+
+
+def test_timeline_simulator_closed_forms():
+    # no stages: just the host kernel
+    assert simulate_overlap_timeline(5.0, [], [], 0.1) == 5.0
+    # one stage: cast lands at 2, host kernel ends at 1 -> wait for cast
+    assert simulate_overlap_timeline(1.0, [2.0], [3.0], 0.0) == 5.0
+    # comm fully hidden under host calc
+    assert simulate_overlap_timeline(10.0, [2.0], [3.0], 0.0) == 13.0
+    # two stages pipeline: casts at 2,4; kernels chain off max(prev, cast)
+    t = simulate_overlap_timeline(1.0, [2.0, 2.0], [3.0, 3.0], 0.0)
+    assert t == max(max(1.0, 2.0) + 3.0, 4.0) + 3.0
+
+
+def _plan_for(total, cp, chunk, qr, kr, ts, overlap_config):
+    q_ranges = AttnRanges.from_ranges(qr)
+    k_ranges = AttnRanges.from_ranges(kr)
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        q_ranges, k_ranges, ts, total, total, chunk_size=chunk, cp_size=cp,
+        dispatch_config=DispatchConfig(alg=SequentialDispatchAlg()),
+    )
+    return build_dist_attn_plan(
+        mq, bucket, block_q=64, block_k=64, overlap_config=overlap_config
+    )
+
+
+def test_auto_degree_fully_local_picks_one():
+    """Block-diagonal mask aligned to shards: no remote rows -> degree 1
+    (minimum), all stages filtered out."""
+    cp, chunk = 4, 128
+    docs = [(i * chunk, (i + 1) * chunk) for i in range(cp)]
+    plan = _plan_for(
+        512, cp, chunk, docs, docs, [F] * cp,
+        OverlapConfig(degree=None, min_stage_rows=64),
+    )
+    assert plan.overlap_degree == 1
+    assert plan.stages == ()
+
+
+def test_auto_degree_comm_heavy_picks_multi():
+    """Full attention, comm cost comparable to calc: pipelining several
+    stages beats one blocking stage in the timeline model."""
+    cp, chunk, total = 4, 128, 4096
+    cfg = OverlapConfig(
+        degree=None,
+        min_stage_rows=64,
+        # per-row comm as expensive as a full row of attention calc
+        calc_cost_factor=1.0,
+        comm_cost_factor=float(total),
+        stage_overhead_s=1.0,
+        dynamic_max_degree=8,
+    )
+    plan = _plan_for(
+        total, cp, chunk, [(0, total)], [(0, total)], [F], cfg
+    )
+    assert plan.overlap_degree > 1
+    # and the plan still executes the full mask area across host + stages
+    assert plan.total_area == total * total
+
+
+def test_auto_degree_overhead_dominates_picks_one():
+    """Same mask, but a huge per-stage overhead: auto must fall back to a
+    single remote stage."""
+    cp, chunk, total = 4, 128, 4096
+    cfg = OverlapConfig(
+        degree=None,
+        min_stage_rows=64,
+        calc_cost_factor=1.0,
+        comm_cost_factor=1e-9,
+        stage_overhead_s=1e12,
+        dynamic_max_degree=8,
+    )
+    plan = _plan_for(
+        total, cp, chunk, [(0, total)], [(0, total)], [F], cfg
+    )
+    assert plan.overlap_degree == 1
+
+
+@pytest.mark.parametrize("mask", ["causal", "varlen"])
+def test_auto_degree_end_to_end_correct(mask):
+    """Auto-degree plans stay numerically correct through the keyed API."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from magiattention_tpu.api import (
+        calc_attn,
+        dispatch,
+        get_runtime_mgr,
+        magi_attn_flex_key,
+        undispatch,
+    )
+    from magiattention_tpu.config import DistAttnConfig
+    from magiattention_tpu.testing import assert_close, ref_attn_from_ranges
+
+    total, cp, hq, hk, d = 1024, 4, 2, 2, 32
+    if mask == "causal":
+        qr, kr, ts = [(0, total)], [(0, total)], [C]
+    else:
+        qr = [(0, 384), (384, 1024)]
+        kr = [(0, 384), (0, 1024)]
+        ts = [C, C]
+    mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
+    key = magi_attn_flex_key(
+        qr, kr, ts, total, total, mesh,
+        num_heads=(hq, hk), head_dim=d, chunk_size=64, out_dtype="float32",
+        dist_attn_config=DistAttnConfig(
+            overlap_config=OverlapConfig(degree=None, min_stage_rows=64)
+        ),
+    )
+    plan = get_runtime_mgr(key).plan
+    assert plan.overlap_degree >= 1  # auto resolved to a concrete degree
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    out = undispatch(
+        calc_attn(dispatch(q, key), dispatch(k, key), dispatch(v, key), key)[0],
+        key,
+    )
+    ref_out, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out, ref_out, atol=2e-5, rtol=2e-5, msg=f"auto {mask}")
