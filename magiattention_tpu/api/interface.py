@@ -246,7 +246,8 @@ def magi_attn_flex_key(
                 comm_cost_factor_inter=(
                     get_comm_cost_factor(hkv, head_dim, gen, link="dcn")
                     if isinstance(cp_axis, (tuple, list))
-                    else None
+                    and oc.comm_cost_factor_inter is None
+                    else oc.comm_cost_factor_inter
                 ),
             ),
         )

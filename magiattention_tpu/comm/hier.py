@@ -73,6 +73,33 @@ class HierGroupCollectiveMeta:
         )
 
     @staticmethod
+    def inter_crossing_rows(
+        send_map, n_inter: int, n_intra: int
+    ) -> int:
+        """Total hop-1 union rows that physically cross the inter link
+        (destination node != source node) — the quantity the overlap cost
+        model prices at DCN bandwidth. Same-node hop-1 slots are local
+        copies and excluded. Cheap: only the hop-1 unions are formed."""
+        n = n_inter * n_intra
+        total = 0
+        for s in range(n):
+            sn = s // n_intra
+            for dn in range(n_inter):
+                if dn == sn:
+                    continue
+                rows = np.unique(
+                    np.concatenate(
+                        [
+                            np.asarray(send_map[s][dn * n_intra + di])
+                            for di in range(n_intra)
+                        ]
+                        + [np.empty(0, np.int64)]
+                    )
+                )
+                total += len(rows)
+        return total
+
+    @staticmethod
     def build(
         send_map: list[list[np.ndarray]],  # [src rank][dst rank] local rows
         num_local_rows: list[int],

@@ -274,6 +274,19 @@ def _split_send_map_by_stage(
     return out
 
 
+def _stage_granularity(
+    n_rows: int, config: OverlapConfig, block_k: int
+) -> int:
+    """Row-block granularity for stage assignment — shared by the staged
+    builder and the auto-degree timeline model so the model prices exactly
+    the split that will execute."""
+    return max(
+        config.min_stage_rows,
+        block_k,
+        -(-n_rows // config.max_num_chunks) if n_rows else 0,
+    )
+
+
 def _slice_area_within_k(
     qs: int, qe: int, ks: int, ke: int, mt: int, intervals
 ) -> int:
@@ -344,9 +357,7 @@ def _choose_overlap_degree(
             if rows == 0:
                 t = max(t, host_s)
                 continue
-            gran = max(
-                config.min_stage_rows, block_k, -(-rows // config.max_num_chunks)
-            )
+            gran = _stage_granularity(rows, config, block_k)
             n_blocks = -(-rows // gran)
             per = -(-n_blocks // min(d, n_blocks))
             stage_rows = []
@@ -467,12 +478,14 @@ def build_dist_attn_plan(
         ]
         inter_frac = None
         if cp_mesh_shape is not None:
-            probe, _ = HierGroupCollectiveMeta.build(
-                send_map, [shard_k_len] * cp, *cp_mesh_shape
-            )
-            tot = sum(probe.recv_total)
+            tot = sum(recv_rows)
             inter_frac = (
-                sum(probe.inter_rows_total) / tot if tot else 0.0
+                HierGroupCollectiveMeta.inter_crossing_rows(
+                    send_map, *cp_mesh_shape
+                )
+                / tot
+                if tot
+                else 0.0
             )
         degree = _choose_overlap_degree(
             cp,
@@ -578,11 +591,7 @@ def build_dist_attn_plan(
     solver = OverlapSolver(overlap_config)
     for r in range(cp):
         n_rows = sum(len(g) for _, g in recv_segments[r])
-        gran = max(
-            overlap_config.min_stage_rows,
-            block_k,
-            -(-n_rows // overlap_config.max_num_chunks) if n_rows else 0,
-        )
+        gran = _stage_granularity(n_rows, overlap_config, block_k)
         n_blocks = -(-n_rows // gran) if n_rows else 0
         costs = [
             OverlapStageCost(comm_cost=float(min(gran, n_rows - b * gran)), calc_cost=1.0)

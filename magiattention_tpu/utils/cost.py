@@ -29,6 +29,17 @@ TPU_PEAK_SPECS = {
 }
 
 
+def _spec(generation: str) -> TpuPeakSpec:
+    spec = TPU_PEAK_SPECS.get(generation)
+    if spec is None:
+        raise ValueError(
+            f"unknown TPU generation {generation!r}; known: "
+            f"{sorted(TPU_PEAK_SPECS)} "
+            "(set MAGI_ATTENTION_TPU_GENERATION accordingly)"
+        )
+    return spec
+
+
 def get_calc_cost_factor(
     num_heads_q: int,
     head_dim: int,
@@ -40,7 +51,7 @@ def get_calc_cost_factor(
     FLOPs per area unit = 4 * nh_q * hd (2 matmuls); seconds = flops /
     (peak * mfu). Relative magnitudes are what the solvers consume.
     """
-    spec = TPU_PEAK_SPECS[generation]
+    spec = _spec(generation)
     eff = spec.bf16_tflops * 1e12 * (mfu if mfu is not None else spec.mfu)
     return 4.0 * num_heads_q * head_dim / eff
 
@@ -60,7 +71,7 @@ def get_comm_cost_factor(
     ``link``: 'ici' (intra-slice) or 'dcn' (inter-slice hop of the
     hierarchical cast).
     """
-    spec = TPU_PEAK_SPECS[generation]
+    spec = _spec(generation)
     bw = spec.ici_gbps if link == "ici" else spec.dcn_gbps
     return (2.0 * num_heads_kv * head_dim * bytes_per_elt) / (
         bw * 1e9 * bwu

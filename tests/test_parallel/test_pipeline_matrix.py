@@ -1,0 +1,294 @@
+"""Scaled pipeline matrix: the flagship coverage axes of reference
+tests/test_pipeline.py:403-857 — larger named scenarios, sink through the
+distributed path, q-overlap at scale, world-size sweep incl. non-powers of
+two, and an env/config flag matrix driven by FlagCombGenerator — on the
+virtual CPU mesh (token counts scaled to CPU-sim budget; the coverage axes,
+not the absolute lengths, are the parity target)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from magiattention_tpu.api import (
+    calc_attn,
+    dispatch,
+    get_runtime_mgr,
+    magi_attn_flex_key,
+    undispatch,
+)
+from magiattention_tpu.common import AttnMaskType
+from magiattention_tpu.config import DistAttnConfig
+from magiattention_tpu.common.enum import OverlapAlgType
+from magiattention_tpu.meta import (
+    DispatchConfig,
+    MinHeapDispatchAlg,
+    SequentialDispatchAlg,
+    ToppHeapDispatchAlg,
+)
+from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+from magiattention_tpu.testing import (
+    FlagCombGenerator,
+    assert_close,
+    assert_close_to_ref,
+    ref_attn_from_ranges,
+)
+
+F = AttnMaskType.FULL
+C = AttnMaskType.CAUSAL
+I = AttnMaskType.INVCAUSAL
+
+
+def _mesh(cp):
+    return Mesh(np.array(jax.devices()[:cp]), ("cp",))
+
+
+def _roundtrip(key):
+    def fn(q, k, v):
+        qd, kd, vd = dispatch(q, key), dispatch(k, key), dispatch(v, key)
+        out, fm = calc_attn(qd, kd, vd, key)
+        return undispatch(out, key), undispatch(fm.lse, key)
+
+    return fn
+
+
+def _rand_qkv(rng, total, hq, hk, d, dtype=jnp.float32):
+    q = jnp.asarray(rng.standard_normal((total, hq, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((total, hk, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((total, hk, d)), dtype)
+    return q, k, v
+
+
+def _doc_lengths(rng, total, mean_len):
+    """Varlen doc cuts (role of the reference benchmark's doc-length
+    distribution sampling, capped at total/4)."""
+    cuts = [0]
+    while cuts[-1] < total:
+        ln = int(
+            np.clip(rng.exponential(mean_len), 256, total // 4)
+        )
+        cuts.append(min(cuts[-1] + ln, total))
+    return cuts
+
+
+def test_flagship_varlen_block_causal_16k_cp8():
+    """Scaled flagship (reference varlen_block_causal_144k): 16k tokens,
+    realistic doc lengths, block-causal mask, cp=8."""
+    total, cp, chunk = 16384, 8, 512
+    hq = hk = 1
+    d = 64
+    rng = np.random.default_rng(42)
+    cuts = _doc_lengths(rng, total, 2048)
+    qr, kr, ts = [], [], []
+    block = 1024
+    for a, b in zip(cuts, cuts[1:]):
+        c = a
+        while c < b:
+            e = min(c + block, b)
+            qr.append((c, e))
+            kr.append((a, e))
+            ts.append(int(F))  # block-causal: FULL up through own block
+            c = e
+    mesh = _mesh(cp)
+    key = magi_attn_flex_key(
+        qr, kr, ts, total, total, mesh,
+        num_heads=(hq, hk), head_dim=d, chunk_size=chunk,
+        out_dtype="float32",
+    )
+    q, k, v = _rand_qkv(rng, total, hq, hk, d)
+    out, lse = jax.jit(_roundtrip(key))(q, k, v)
+    ref_out, ref_lse, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out, ref_out, atol=5e-5, rtol=5e-5, msg="16k out")
+    finite = ~np.isneginf(np.asarray(ref_lse))
+    assert_close(
+        np.asarray(lse)[finite], np.asarray(ref_lse)[finite],
+        atol=5e-5, rtol=5e-5, msg="16k lse",
+    )
+
+
+# flag space mirroring the reference's FlagCombGenerator-driven sweep
+# (testing/flag_generator.py + dist_common.py:42-201): first value of each
+# axis is the default; heuristic mode covers every value of every axis.
+_FLAG_SPACE = {
+    "degree": [0, 1, 2, None],
+    "overlap_alg": [OverlapAlgType.UNIFORM, OverlapAlgType.GREEDY],
+    "dispatch": ["minheap", "sequential", "topp"],
+    "uneven": [False, True],
+    "dtype": ["float32", "bfloat16"],
+}
+
+_DISPATCH_ALGS = {
+    "minheap": MinHeapDispatchAlg,
+    "sequential": SequentialDispatchAlg,
+    "topp": lambda: ToppHeapDispatchAlg(top_p=0.5),
+}
+
+
+def _legal(c):
+    # GREEDY stage assignment needs a staged plan
+    if c["overlap_alg"] == OverlapAlgType.GREEDY and c["degree"] == 0:
+        return False
+    return True
+
+
+_COMBOS = list(FlagCombGenerator(_FLAG_SPACE, _legal, mode="heuristic"))
+
+
+@pytest.mark.parametrize(
+    "combo", _COMBOS, ids=[
+        f"d{c['degree']}-{c['overlap_alg'].name[:3]}-{c['dispatch']}"
+        f"-{'uneven' if c['uneven'] else 'even'}-{c['dtype'][:4]}"
+        for c in _COMBOS
+    ],
+)
+def test_flag_matrix(combo):
+    """Every value of every behavior flag exercised end-to-end against the
+    oracle on a mixed varlen mask (cp=4)."""
+    total, cp, chunk = 1152, 4, 64  # 18 chunks -> uneven-capable
+    hq, hk, d = 2, 2, 32
+    qr = [(0, 384), (384, 896), (896, 1152)]
+    kr = [(0, 384), (0, 896), (384, 1152)]
+    ts = [int(C), int(C), int(I)]
+    mesh = _mesh(cp)
+    key = magi_attn_flex_key(
+        qr, kr, ts, total, total, mesh,
+        num_heads=(hq, hk), head_dim=d, chunk_size=chunk,
+        out_dtype=combo["dtype"],
+        dist_attn_config=DistAttnConfig(
+            dispatch_config=DispatchConfig(
+                uneven_shard=combo["uneven"],
+                alg=_DISPATCH_ALGS[combo["dispatch"]](),
+            ),
+            overlap_config=OverlapConfig(
+                degree=combo["degree"],
+                alg=combo["overlap_alg"],
+                min_stage_rows=64,
+            ),
+        ),
+    )
+    rng = np.random.default_rng(17)
+    dtype = jnp.bfloat16 if combo["dtype"] == "bfloat16" else jnp.float32
+    q, k, v = _rand_qkv(rng, total, hq, hk, d, dtype)
+    out, lse = jax.jit(_roundtrip(key))(q, k, v)
+
+    ref_hp = ref_attn_from_ranges(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        qr, kr, ts,
+    )
+    if combo["dtype"] == "bfloat16":
+        # precision-ratio philosophy (reference testing/precision.py:92):
+        # compare our bf16 error against a bf16 reference's error
+        ref_lp = ref_attn_from_ranges(
+            q, k, v, qr, kr, ts, compute_dtype=jnp.bfloat16
+        )
+        assert_close_to_ref(
+            out, ref_lp[0].astype(jnp.float32), ref_hp[0], msg=str(combo)
+        )
+    else:
+        assert_close(out, ref_hp[0], atol=2e-5, rtol=2e-5, msg=str(combo))
+        # backward on the fp32 base path
+        do = jnp.asarray(rng.standard_normal(out.shape), jnp.float32)
+        g = jax.jit(
+            jax.grad(lambda k: (_roundtrip(key)(q, k, v)[0] * do).sum())
+        )(k)
+        gr = jax.grad(
+            lambda k: (ref_attn_from_ranges(q, k, v, qr, kr, ts)[0] * do).sum()
+        )(k)
+        assert_close(g, gr, atol=5e-5, rtol=5e-5, msg=f"dk {combo}")
+
+
+@pytest.mark.parametrize("degree", [0, 2])
+def test_sink_through_distributed_path(degree):
+    """Attention sink exercised through build_dist_attn_plan's merged AND
+    staged paths (the sink joins the softmax denominator exactly once, in
+    the host stage), incl. dsink gradients."""
+    total, cp = 1024, 4
+    hq, hk, d = 2, 2, 32
+    qr, kr, ts = [(0, total)], [(0, total)], [int(C)]
+    rng = np.random.default_rng(23)
+    sink = jnp.asarray(rng.standard_normal(hq), jnp.float32)
+    mesh = _mesh(cp)
+    key = magi_attn_flex_key(
+        qr, kr, ts, total, total, mesh,
+        num_heads=(hq, hk), head_dim=d, chunk_size=64, out_dtype="float32",
+        sink=sink,
+        dist_attn_config=DistAttnConfig(
+            overlap_config=OverlapConfig(degree=degree, min_stage_rows=64)
+        ),
+    )
+    assert get_runtime_mgr(key).plan.overlap_degree == degree
+    q, k, v = _rand_qkv(rng, total, hq, hk, d)
+    out, lse = jax.jit(_roundtrip(key))(q, k, v)
+    ref_out, ref_lse, _ = ref_attn_from_ranges(
+        q, k, v, qr, kr, ts, sink=sink
+    )
+    assert_close(out, ref_out, atol=2e-5, rtol=2e-5, msg=f"sink d{degree}")
+    assert_close(lse, ref_lse, atol=2e-5, rtol=2e-5, msg=f"sink lse d{degree}")
+
+    do = jnp.asarray(rng.standard_normal(out.shape), jnp.float32)
+
+    def loss(s):
+        qd, kd, vd = dispatch(q, key), dispatch(k, key), dispatch(v, key)
+        return (undispatch(calc_attn(qd, kd, vd, key, sink=s)[0], key) * do).sum()
+
+    g = jax.jit(jax.grad(loss))(sink)
+    gr = jax.grad(
+        lambda s: (
+            ref_attn_from_ranges(q, k, v, qr, kr, ts, sink=s)[0] * do
+        ).sum()
+    )(sink)
+    assert_close(g, gr, atol=5e-5, rtol=5e-5, msg=f"dsink d{degree}")
+
+
+def test_q_overlap_at_scale():
+    """Overlapping q ranges with disjoint (q,k) coverage at 4k, cp=8
+    (reference q-overlap scenarios at scale)."""
+    total, cp = 4096, 8
+    hq, hk, d = 2, 2, 32
+    qr = [(0, total), (1024, 3072)]
+    kr = [(0, total), (3072, 4096)]
+    ts = [int(C), int(I)]
+    mesh = _mesh(cp)
+    key = magi_attn_flex_key(
+        qr, kr, ts, total, total, mesh,
+        num_heads=(hq, hk), head_dim=d, chunk_size=128, out_dtype="float32",
+    )
+    rng = np.random.default_rng(31)
+    q, k, v = _rand_qkv(rng, total, hq, hk, d)
+    out, _ = jax.jit(_roundtrip(key))(q, k, v)
+    ref_out, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out, ref_out, atol=5e-5, rtol=5e-5, msg="q_overlap 4k")
+
+    do = jnp.asarray(rng.standard_normal(out.shape), jnp.float32)
+    g = jax.jit(
+        jax.grad(lambda q: (_roundtrip(key)(q, k, v)[0] * do).sum())
+    )(q)
+    gr = jax.grad(
+        lambda q: (ref_attn_from_ranges(q, k, v, qr, kr, ts)[0] * do).sum()
+    )(q)
+    assert_close(g, gr, atol=5e-5, rtol=5e-5, msg="q_overlap dq")
+
+
+@pytest.mark.parametrize("cp", [1, 2, 3, 5, 6, 8])
+def test_world_sizes(cp):
+    """World sizes 1-8 including non-powers-of-two; sizes that do not
+    divide the chunk count exercise the uneven shard automatically."""
+    total, chunk = 960, 32  # 30 chunks
+    hq, hk, d = 2, 2, 32
+    qr, kr, ts = [(0, total)], [(0, total)], [int(C)]
+    mesh = _mesh(cp)
+    uneven = (total // chunk) % cp != 0
+    key = magi_attn_flex_key(
+        qr, kr, ts, total, total, mesh,
+        num_heads=(hq, hk), head_dim=d, chunk_size=chunk,
+        out_dtype="float32",
+        dispatch_config=DispatchConfig(
+            uneven_shard=uneven, alg=MinHeapDispatchAlg()
+        ),
+    )
+    rng = np.random.default_rng(cp)
+    q, k, v = _rand_qkv(rng, total, hq, hk, d)
+    out, _ = jax.jit(_roundtrip(key))(q, k, v)
+    ref_out, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out, ref_out, atol=2e-5, rtol=2e-5, msg=f"cp={cp}")
