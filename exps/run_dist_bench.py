@@ -1,0 +1,272 @@
+"""Distributed CP benchmark harness.
+
+Role of reference ``exps/dist_attn/`` (main.py + benchmark/mask.py +
+metric.py): generate realistic varlen masks from a document-length
+distribution, then race magi-CP against the classic CP baselines. On this
+single-chip image the comparison has two tiers:
+
+1. **Plan tier (any platform, CPU ok):** exact per-rank communication
+   volume and load balance for magi's zero-redundancy plan vs the
+   analytic volumes of ring / ulysses / USP / LoongTrain (whose comm is
+   mask-oblivious), plus the cost-model step-time estimate for each.
+2. **Kernel tier (``--wallclock``, real TPU):** single-chip wall-clock of
+   the flex kernel on the same generated mask — the cp=1 end of the
+   reference's TFLOPs/s/device sweep (fwd and fwd+bwd).
+
+Usage:  python exps/run_dist_bench.py [--cp 8] [--total 65536] [--wallclock]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def sample_doc_cuts(
+    total: int, rng: np.random.Generator, mean_len: float = 4096.0
+) -> list[int]:
+    """Document cut points from a heavy-tailed length distribution, each
+    sample capped at total/4 (the reference's benchmark convention,
+    cp_benchmark.md:63-76)."""
+    cuts = [0]
+    while cuts[-1] < total:
+        ln = int(np.clip(rng.lognormal(np.log(mean_len), 1.0), 128, total // 4))
+        cuts.append(min(cuts[-1] + ln, total))
+    return cuts
+
+
+def doc_mask(cuts: list[int], causal: bool = True):
+    qr, kr, ts = [], [], []
+    for a, b in zip(cuts, cuts[1:]):
+        qr.append((a, b))
+        kr.append((a, b))
+        ts.append(1 if causal else 0)
+    return qr, kr, ts
+
+
+def analytic_baseline_rows(name: str, cp: int, shard: int, hk_frac: float = 1.0):
+    """Per-rank K+V rows moved per step by the mask-oblivious baselines.
+
+    - ring / LoongTrain: every rank forwards the full remote KV around the
+      ring(s): (cp-1) * shard rows received per rank.
+    - ulysses: head-scatter a2a moves (cp-1)/cp of q+k+v+out rows; in KV-row
+      units that is ~2 * shard * (cp-1)/cp * (1 + hq/hkv/2) — reported here
+      in the same K+V row unit as magi (q/out traffic folded via hk_frac).
+    - USP: ulysses inside a node x ring across nodes (geometric mean used
+      for the summary row; exact split depends on the 2-D factorization).
+    """
+    if name in ("ring", "loongtrain"):
+        return (cp - 1) * shard
+    if name == "ulysses":
+        return int(2 * shard * (cp - 1) / cp * (1 + hk_frac))
+    if name == "usp":
+        import math
+
+        inner = max(int(math.sqrt(cp)), 1)
+        outer = cp // inner
+        ring_rows = (outer - 1) * shard
+        uly_rows = int(2 * shard * (inner - 1) / inner * (1 + hk_frac))
+        return ring_rows + uly_rows
+    raise ValueError(name)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--cp", type=int, default=8)
+    p.add_argument("--total", type=int, default=65536)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--chunk", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--mean-doc", type=float, default=4096.0)
+    p.add_argument("--causal", action="store_true", default=True)
+    p.add_argument(
+        "--wallclock",
+        action="store_true",
+        help="also measure single-chip kernel wall-clock on the mask (TPU)",
+    )
+    args = p.parse_args()
+
+    from magiattention_tpu.benchmarking import perf_report
+    from magiattention_tpu.common import AttnMaskType, AttnRanges
+    from magiattention_tpu.common.mask import total_area as slices_area
+    from magiattention_tpu.meta import (
+        DispatchConfig,
+        MinHeapDispatchAlg,
+        SequentialDispatchAlg,
+        make_dispatch_meta_from_qk_ranges,
+    )
+    from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+    from magiattention_tpu.parallel import build_dist_attn_plan
+    from magiattention_tpu.utils.cost import (
+        get_calc_cost_factor,
+        get_comm_cost_factor,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    cuts = sample_doc_cuts(args.total, rng, args.mean_doc)
+    qr, kr, ts = doc_mask(cuts, causal=args.causal)
+    total = args.total
+    cp = args.cp
+    chunk = args.chunk or max(total // (8 * cp), 128)
+    q_ranges = AttnRanges.from_ranges(qr)
+    k_ranges = AttnRanges.from_ranges(kr)
+    area = slices_area(q_ranges, k_ranges, ts)
+    shard = total // cp
+
+    cf = get_calc_cost_factor(args.heads, args.head_dim, "v5e")
+    cmf = get_comm_cost_factor(args.kv_heads, args.head_dim, "v5e")
+    print(
+        f"mask: {len(qr)} docs, total={total}, area_frac="
+        f"{area / (total * total):.3f}, cp={cp}, chunk={chunk}",
+        file=sys.stderr,
+    )
+
+    rows = []
+
+    def magi_row(label, dispatch_alg, degree):
+        mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+            q_ranges, k_ranges, [AttnMaskType(t) for t in ts], total, total,
+            chunk_size=chunk, cp_size=cp,
+            dispatch_config=DispatchConfig(alg=dispatch_alg()),
+        )
+        plan = build_dist_attn_plan(
+            mq, bucket, block_q=128, block_k=512,
+            overlap_config=OverlapConfig(
+                degree=degree,
+                calc_cost_factor=cf,
+                comm_cost_factor=cmf,
+            ),
+        )
+        comm_rows = max(plan.comm.recv_total)
+        balance = plan.max_rank_area / max(area / cp, 1)
+        # step-time estimate: critical rank calc + unhidden comm
+        calc_s = plan.max_rank_area * cf
+        comm_s = comm_rows * cmf
+        est = max(calc_s, comm_s) if plan.overlap_degree else calc_s + comm_s
+        rows.append(
+            {
+                "method": label,
+                "recv_rows_max": comm_rows,
+                "balance": round(balance, 3),
+                "est_ms": round(est * 1e3, 2),
+                "degree": plan.overlap_degree,
+            }
+        )
+
+    from magiattention_tpu.meta import ToppHeapDispatchAlg
+
+    magi_row("magi_minheap_d0", MinHeapDispatchAlg, 0)
+    magi_row("magi_minheap_auto", MinHeapDispatchAlg, None)
+    magi_row("magi_topp_auto", lambda: ToppHeapDispatchAlg(top_p=0.5), None)
+    magi_row("magi_sequential_d0", SequentialDispatchAlg, 0)
+
+    from magiattention_tpu.common.mask import slice_area
+
+    def contig_max_area(n_splits: int) -> int:
+        """Max per-split mask area when q rows are cut into n contiguous
+        equal token groups (the ring-family layout; causal row-clips keep
+        the bottom-right anchor)."""
+        if n_splits <= 1:
+            return area
+        span = total // n_splits
+        worst = 0
+        for r in range(n_splits):
+            lo, hi = r * span, (r + 1) * span
+            a = 0
+            for (qs, qe), (ks, ke), mt in zip(qr, kr, ts):
+                s0, s1 = max(qs, lo), min(qe, hi)
+                if s0 >= s1:
+                    continue
+                if mt == 1:
+                    a += slice_area(s0, s1, ks, ke - (qe - s1), 1)
+                else:
+                    a += slice_area(s0, s1, ks, ke, mt)
+            worst = max(worst, a)
+        return worst
+
+    import math
+
+    for name in ("ring", "ulysses", "usp", "loongtrain"):
+        comm_rows = analytic_baseline_rows(
+            name, cp, shard, hk_frac=args.heads / max(args.kv_heads, 1) / 2
+        )
+        # per-chip critical calc: ring/LoongTrain split tokens contiguously
+        # (mask-shape imbalance); ulysses splits heads (perfectly balanced);
+        # USP rings over `outer` contiguous groups with ulysses inside
+        if name in ("ring", "loongtrain"):
+            crit = contig_max_area(cp)
+        elif name == "ulysses":
+            crit = area / cp
+        else:  # usp
+            inner = max(int(math.sqrt(cp)), 1)
+            outer = cp // inner
+            crit = contig_max_area(outer) / inner
+        calc_s = crit * cf
+        comm_s = comm_rows * cmf
+        rows.append(
+            {
+                "method": name,
+                "recv_rows_max": comm_rows,
+                "balance": round(crit / max(area / cp, 1), 3),
+                "est_ms": round(max(calc_s, comm_s) * 1e3, 2),
+                "degree": "-",
+            }
+        )
+
+    if args.wallclock:
+        import jax
+        import jax.numpy as jnp
+
+        from magiattention_tpu.benchmarking import do_bench
+        from magiattention_tpu.ops import flex_flash_attn_func
+
+        qx = jnp.asarray(
+            rng.standard_normal((total, args.heads, args.head_dim)),
+            jnp.bfloat16,
+        )
+        kx = jnp.asarray(
+            rng.standard_normal((total, args.kv_heads, args.head_dim)),
+            jnp.bfloat16,
+        )
+        vx = jnp.asarray(
+            rng.standard_normal((total, args.kv_heads, args.head_dim)),
+            jnp.bfloat16,
+        )
+        fwd = jax.jit(
+            lambda q, k, v: flex_flash_attn_func(q, k, v, qr, kr, ts)[0]
+        )
+        r = do_bench(fwd, qx, kx, vx, warmup=2, rep=3, inner=10)
+        flops = 4 * area * args.heads * args.head_dim
+        rows.append(
+            {
+                "method": "kernel_cp1_wallclock",
+                "recv_rows_max": 0,
+                "balance": 1.0,
+                "est_ms": round(r.median_ms, 2),
+                "degree": f"{r.tflops(flops):.1f}TF",
+            }
+        )
+
+    print(perf_report(rows))
+    print(
+        json.dumps(
+            {
+                "total": total,
+                "cp": cp,
+                "area_frac": round(area / (total * total), 4),
+                "rows": rows,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -69,9 +69,12 @@ def main() -> None:
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--kv-heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=128)
-    p.add_argument("--block-q", type=int, default=128)
-    p.add_argument("--block-k", type=int, default=256)
-    p.add_argument("--head-block", type=int, default=8)
+    p.add_argument(
+        "--block-q", type=int, default=None,
+        help="default: kernel auto_block_config per mask",
+    )
+    p.add_argument("--block-k", type=int, default=None)
+    p.add_argument("--head-block", type=int, default=None)
     p.add_argument(
         "--mode",
         default="fwd,bwd",

@@ -133,11 +133,14 @@ class DistAttnRuntimeMgr:
         key-creation time. It is a *traced* argument — pass the live
         (trainable) sink here each step so gradients flow to it without
         re-keying; requires the key to have been created with a sink.
+
+        The forward meta carries the lse and the globally max-reduced
+        per-head max logit (reference reduce_max_logits — Muon QK-Clip).
         """
         from ..common.forward_meta import AttnForwardMeta
 
-        out, lse = self._attn_fn(q, k, v, sink)
-        return out, AttnForwardMeta(lse=lse)
+        out, lse, max_logits = self._attn_fn(q, k, v, sink)
+        return out, AttnForwardMeta(lse=lse, max_logits=max_logits)
 
 
 class DistAttnRuntimeDict:
@@ -222,7 +225,16 @@ def magi_attn_flex_key(
     from ..config import DistAttnConfig
 
     if dist_attn_config is None:
-        dist_attn_config = DistAttnConfig()
+        from ..meta.solver.overlap_solver import OverlapConfig
+
+        # env-default overlap knobs (reference env/general.py defaults)
+        dist_attn_config = DistAttnConfig(
+            overlap_config=OverlapConfig(
+                degree=env.overlap_degree_default(),
+                min_stage_rows=env.min_stage_rows(),
+                dynamic_max_degree=env.dynamic_max_degree(),
+            )
+        )
     if dispatch_config is None:
         dispatch_config = dist_attn_config.dispatch_config
     hq, hkv = num_heads
@@ -256,6 +268,19 @@ def magi_attn_flex_key(
     if not isinstance(k_ranges, AttnRanges):
         k_ranges = AttnRanges.from_ranges(k_ranges)
     types = tuple(int(t) for t in attn_type_map)
+    if env.is_auto_range_merge_enable():
+        # canonicalize the slice list before keying/planning (reference
+        # AUTO_RANGE_MERGE path, flex_flash_attn.py:79-178)
+        from ..ops.range_merge import merge_ranges
+
+        qa, ka, ta = merge_ranges(
+            np.asarray(q_ranges.to_naive_ranges(), np.int64),
+            np.asarray(k_ranges.to_naive_ranges(), np.int64),
+            np.asarray(types, np.int64),
+        )
+        q_ranges = AttnRanges.from_ranges([tuple(r) for r in qa.tolist()])
+        k_ranges = AttnRanges.from_ranges([tuple(r) for r in ka.tolist()])
+        types = tuple(int(t) for t in ta)
     if env.is_sanity_check_enabled():
         from ..common.sanity import check_slices_non_overlapping
 
@@ -345,6 +370,8 @@ def magi_attn_flex_key(
             total_seqlen_q + pad,
             plan.describe(),
         )
+    from ..ops.flex_attn import _auto_head_block
+
     params = make_attn_params(
         plan,
         head_dim,
@@ -352,9 +379,11 @@ def magi_attn_flex_key(
         has_sink=has_sink,
         out_dtype=out_dtype,
         interpret=interpret,
+        head_block=_auto_head_block(env.head_block(), hq, hq // hkv),
     )
     attn_fn = make_dist_attn_fn(
-        plan, mesh, params, axis_name=cp_axis, sink=sink
+        plan, mesh, params, axis_name=cp_axis, sink=sink,
+        with_max_logits=True,
     )
     mgr = DistAttnRuntimeMgr(
         key, mesh, mq, plan, attn_fn, dist_attn_config=dist_attn_config
@@ -475,6 +504,8 @@ def make_flex_key_for_new_mask_after_dispatch(
         overlap_config=overlap,
         cp_mesh_shape=old_mgr.plan.hier,
     )
+    from ..ops.flex_attn import _auto_head_block
+
     params = make_attn_params(
         plan,
         new_key.head_dim,
@@ -482,8 +513,16 @@ def make_flex_key_for_new_mask_after_dispatch(
         has_sink=False,
         out_dtype=new_key.out_dtype,
         interpret=new_key.interpret,
+        head_block=_auto_head_block(
+            env.head_block(),
+            new_key.num_heads_q,
+            new_key.num_heads_q // new_key.num_heads_kv,
+        ),
     )
-    attn_fn = make_dist_attn_fn(plan, old_mgr.mesh, params, axis_name=new_key.cp_axis)
+    attn_fn = make_dist_attn_fn(
+        plan, old_mgr.mesh, params, axis_name=new_key.cp_axis,
+        with_max_logits=True,
+    )
     _runtime_dict.put(
         new_key,
         DistAttnRuntimeMgr(

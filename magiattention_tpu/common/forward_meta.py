@@ -8,10 +8,12 @@ from typing import Optional
 import jax
 
 
+@jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class AttnForwardMeta:
     """Auxiliary outputs of every forward path: the log-sum-exp per (token,
-    head) and optionally the per-head max logit (Muon QK-Clip)."""
+    head) and optionally the per-head max logit (Muon QK-Clip). Registered
+    as a pytree so it can cross jit/grad boundaries."""
 
     lse: Optional[jax.Array] = None  # [tokens, heads_q] f32
     max_logits: Optional[jax.Array] = None  # [heads_q] f32

@@ -48,11 +48,9 @@ def get_lib() -> ctypes.CDLL | None:
         if _LIB is not None or _TRIED:
             return _LIB
         _TRIED = True
-        if os.environ.get("MAGI_ATTENTION_CPP_BACKEND", "1").strip().lower() in (
-            "0",
-            "false",
-            "off",
-        ):
+        from .. import env
+
+        if not env.is_cpp_backend_enabled():
             return None
         if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
             _SRC

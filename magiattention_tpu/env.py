@@ -67,9 +67,77 @@ def block_k() -> int:
     return _env_int("MAGI_ATTENTION_BLOCK_K", 128)
 
 
+def head_block() -> int:
+    """Q heads batched per kernel grid step in the distributed runtime
+    (clamped to a divisor of hq that is a GQA-group multiple)."""
+    return _env_int("MAGI_ATTENTION_HEAD_BLOCK", 8)
+
+
 def tpu_generation() -> str:
     """TPU generation key for the cost model (utils/cost.py specs)."""
     return _env_str("MAGI_ATTENTION_TPU_GENERATION", "v5e")
+
+
+def overlap_degree_default() -> int | None:
+    """Default multi-stage-overlap degree when no DistAttnConfig is given:
+    an integer, or 'auto' for the degree=None cost-model search."""
+    v = _env_str("MAGI_ATTENTION_OVERLAP_DEGREE", "0").strip().lower()
+    return None if v == "auto" else int(v)
+
+
+def min_stage_rows() -> int:
+    return _env_int("MAGI_ATTENTION_MIN_STAGE_ROWS", 512)
+
+
+def dynamic_max_degree() -> int:
+    """Auto-degree search cap (reference OverlapConfig.dynamic_max_degree)."""
+    return _env_int("MAGI_ATTENTION_DYNAMIC_MAX_DEGREE", 8)
+
+
+def is_forward_high_precision_reduce() -> bool:
+    """Keep the staged out/lse merge accumulator in fp32 (reference
+    MAGI_ATTENTION_FORWARD_HIGH_PRECISION_REDUCE; default on)."""
+    return _env_bool("MAGI_ATTENTION_FORWARD_HIGH_PRECISION_REDUCE", True)
+
+
+def is_backward_high_precision_reduce() -> bool:
+    """Carry the KV cast payload in fp32 so the transposed dKV reduce
+    accumulates in fp32 (2x comm volume; reference
+    MAGI_ATTENTION_BACKWARD_HIGH_PRECISION_REDUCE; default off)."""
+    return _env_bool("MAGI_ATTENTION_BACKWARD_HIGH_PRECISION_REDUCE", False)
+
+
+def is_qo_comm_enable() -> bool:
+    """Informational pointer flag (reference MAGI_ATTENTION_QO_COMM): the
+    qo-comm runtime is entered programmatically via
+    parallel.qo_comm.make_qo_comm_attn_fn."""
+    return _env_bool("MAGI_ATTENTION_QO_COMM", False)
+
+
+def is_hierarchical_comm_enable() -> bool:
+    """Informational on TPU (reference MAGI_ATTENTION_HIERARCHICAL_COMM):
+    hierarchical comm is selected structurally by passing a 2-D
+    (inter, intra) cp_axis to magi_attn_flex_key."""
+    return _env_bool("MAGI_ATTENTION_HIERARCHICAL_COMM", False)
+
+
+def is_auto_range_merge_enable() -> bool:
+    """Sort/merge overlapping k-ranges during kernel planning (reference
+    MAGI_ATTENTION_AUTO_RANGE_MERGE)."""
+    return _env_bool("MAGI_ATTENTION_AUTO_RANGE_MERGE", False)
+
+
+def is_cpp_backend_enabled() -> bool:
+    """Use the native C++ planning accelerators (parity-tested against the
+    python fallback, so not part of the key fingerprint)."""
+    return _env_bool("MAGI_ATTENTION_CPP_BACKEND", True)
+
+
+def is_profile_mode() -> bool:
+    """Informational (reference MAGI_ATTENTION_PROFILE_MODE): the profiler
+    helpers in utils/instrument.py are invoked programmatically; named
+    scopes are always annotated."""
+    return _env_bool("MAGI_ATTENTION_PROFILE_MODE", False)
 
 
 def flags_fingerprint() -> tuple:
@@ -79,5 +147,12 @@ def flags_fingerprint() -> tuple:
         kernel_backend(),
         block_q(),
         block_k(),
+        head_block(),
         tpu_generation(),
+        overlap_degree_default(),
+        min_stage_rows(),
+        dynamic_max_degree(),
+        is_forward_high_precision_reduce(),
+        is_backward_high_precision_reduce(),
+        is_auto_range_merge_enable(),
     )

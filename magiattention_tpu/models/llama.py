@@ -121,7 +121,7 @@ def _layer_local(
     v = (h @ layer["wv"].astype(dt)).reshape(t, cfg.n_kv_heads, cfg.head_dim)
     q = _rope(q, pos, cfg.rope_theta, cfg.head_dim)
     k = _rope(k, pos, cfg.rope_theta, cfg.head_dim)
-    out, _ = dist_attn_local(
+    out, _, _ = dist_attn_local(
         q, k, v, tables, plan, attn_params, axis_name=axis_name
     )
     x = x + out.reshape(t, -1) @ layer["wo"].astype(dt)

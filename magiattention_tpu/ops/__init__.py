@@ -3,6 +3,8 @@
 from .block_meta import FlexAttnBlockMeta, build_block_meta
 from .block_sparse import block_sparse_attn_func, build_block_meta_from_block_mask
 from .flex_attn import flex_attn_with_meta, flex_flash_attn_func
+from .index_attn import index_attn_func, sparse_load_attn_func
+from .range_merge import merge_ranges
 
 __all__ = [
     "FlexAttnBlockMeta",
@@ -11,4 +13,7 @@ __all__ = [
     "build_block_meta",
     "flex_attn_with_meta",
     "flex_flash_attn_func",
+    "index_attn_func",
+    "merge_ranges",
+    "sparse_load_attn_func",
 ]

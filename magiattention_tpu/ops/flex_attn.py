@@ -998,6 +998,15 @@ def flex_flash_attn_func(
     q_arr = np.ascontiguousarray(np.asarray(q_ranges, dtype=np.int64).reshape(-1, 2))
     k_arr = np.ascontiguousarray(np.asarray(k_ranges, dtype=np.int64).reshape(-1, 2))
     t_arr = np.ascontiguousarray(np.asarray(attn_type_map, dtype=np.int64).reshape(-1))
+    from .. import env as _env
+
+    if _env.is_auto_range_merge_enable():
+        from .range_merge import merge_ranges
+
+        q_arr, k_arr, t_arr = (
+            np.ascontiguousarray(a)
+            for a in merge_ranges(q_arr, k_arr, t_arr)
+        )
     if block_q is None or block_k is None or head_block is None:
         abq, abk, ahb = auto_block_config(
             q_arr.tolist(),

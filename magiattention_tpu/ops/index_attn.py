@@ -1,0 +1,190 @@
+"""Index-list and sparse-load attention modes.
+
+Role of reference flex_flash_attn sparse options (flex_flash_attn.py:
+1110-1123 ``index_attn``/``sparse_load`` + csrc preprocess_sparse_load.cu):
+attend only a *selected subset* of KV — chosen per q-block (NSA-style
+top-k block selection) or as global row ranges loaded into a compact
+buffer.
+
+TPU redesign: no gather kernels are needed —
+- per-q-block block selection becomes a boolean block mask driving the
+  natively block-sparse entry-table kernel (ops/block_sparse.py);
+- range selection becomes the entry table's *run* mechanism: the compact
+  gathered KV buffer is described by (local window, local->global offset)
+  runs, so the kernel evaluates the ORIGINAL global mask semantics
+  (incl. causal against global positions) on the compact buffer.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .block_meta import Run, build_block_meta_general
+
+
+def index_attn_func(
+    q,
+    k,
+    v,
+    kv_block_indices: np.ndarray,  # [num_q_blocks, topk] host int, -1 = none
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    sink=None,
+    out_dtype=None,
+    head_block: int = 1,
+    interpret: bool | None = None,
+):
+    """Per-q-block KV-block selection (reference index_attn: NSA-style
+    selected-block attention). ``kv_block_indices[i]`` lists the k blocks
+    q block i attends (entries < 0 are padding)."""
+    from .block_sparse import block_sparse_attn_func
+
+    idx = np.asarray(kv_block_indices, dtype=np.int64)
+    tq, tk = int(q.shape[0]), int(k.shape[0])
+    nq = -(-tq // block_q)
+    nk = -(-tk // block_k)
+    assert idx.shape[0] == nq, (
+        f"kv_block_indices rows {idx.shape[0]} != q blocks {nq}"
+    )
+    bm = np.zeros((nq, nk), dtype=bool)
+    for i in range(nq):
+        sel = idx[i][idx[i] >= 0]
+        assert (sel < nk).all(), f"block index out of range at q block {i}"
+        bm[i, sel] = True
+    return block_sparse_attn_func(
+        q,
+        k,
+        v,
+        bm,
+        causal=causal,
+        scale=scale,
+        softcap=softcap,
+        sink=sink,
+        out_dtype=out_dtype,
+        block_q=block_q,
+        block_k=block_k,
+        head_block=head_block,
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _sparse_load_plan(
+    ranges_b: bytes, n_ranges: int, tq: int, causal: bool, bq: int, bk: int
+):
+    """(gather indices, block meta over the compact buffer)."""
+    ranges = np.frombuffer(ranges_b, dtype=np.int64).reshape(n_ranges, 2)
+    # compact buffer = concatenation of the selected ranges (sorted,
+    # assumed disjoint — the sanity check rejects overlaps)
+    order = np.argsort(ranges[:, 0], kind="stable")
+    ranges = ranges[order]
+    k_runs: list[Run] = []
+    slices: list[tuple[int, int, int, int, int]] = []
+    pos = 0
+    for ks, ke in ranges.tolist():
+        assert ke > ks, f"empty selected range ({ks}, {ke})"
+        if k_runs:
+            prev = k_runs[-1]
+            assert ks >= prev.global_start + prev.length, (
+                "selected k ranges must be disjoint"
+            )
+        k_runs.append(Run(local_start=pos, global_start=ks, length=ke - ks))
+        pos += ke - ks
+        if not causal:
+            slices.append((0, tq, ks, ke, 0))
+        else:
+            # causal against GLOBAL positions k <= q: same 3-way split as
+            # block-sparse tiles (diagonal may exit bottom or right edge)
+            if ks > tq - 1:
+                continue  # fully above the diagonal
+            if ke - 1 <= 0:
+                slices.append((0, tq, ks, ke, 0))
+            elif ke >= tq:
+                slices.append((0, tq, ks, tq, 1))
+            else:
+                slices.append((0, ke, ks, ke, 1))
+                slices.append((ke, tq, ks, ke, 0))
+    total_sel = pos
+    gather = np.concatenate(
+        [np.arange(ks, ke, dtype=np.int32) for ks, ke in ranges.tolist()]
+    ) if len(ranges) else np.empty(0, np.int32)
+    sl = (
+        np.asarray(slices, dtype=np.int64)
+        if slices
+        else np.empty((0, 5), dtype=np.int64)
+    )
+    meta = build_block_meta_general(
+        sl,
+        [Run(0, 0, tq)],
+        k_runs if k_runs else [Run(0, 0, max(total_sel, 1))],
+        tq,
+        max(total_sel, 1),
+        block_q=bq,
+        block_k=bk,
+    )
+    return gather, meta
+
+
+def sparse_load_attn_func(
+    q,
+    k,
+    v,
+    selected_k_ranges,  # [R, 2] host ranges of global k rows to load
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    sink=None,
+    out_dtype=None,
+    block_q: int = 128,
+    block_k: int = 128,
+    head_block: int = 1,
+    interpret: bool | None = None,
+):
+    """Sparse-load attention (reference sparse_load preprocessing): gather
+    the selected global k ranges into a compact KV buffer and attend it —
+    the mask (incl. ``causal`` against *global* positions) is evaluated on
+    the compact buffer through the entry table's run translation, so no
+    dense-length buffers are ever materialized."""
+    import jax.numpy as jnp
+
+    from .flex_attn import flex_attn_with_meta
+
+    ranges = np.ascontiguousarray(
+        np.asarray(selected_k_ranges, dtype=np.int64).reshape(-1, 2)
+    )
+    assert ranges.shape[0] > 0, "sparse_load needs at least one k range"
+    tk = int(k.shape[0])
+    assert (ranges[:, 0] >= 0).all() and (ranges[:, 1] <= tk).all(), (
+        f"selected k ranges must lie within [0, {tk}): got "
+        f"{ranges[(ranges[:, 0] < 0) | (ranges[:, 1] > tk)].tolist()}"
+    )
+    gather, meta = _sparse_load_plan(
+        ranges.tobytes(),
+        int(ranges.shape[0]),
+        int(q.shape[0]),
+        bool(causal),
+        int(block_q),
+        int(block_k),
+    )
+    idx = jnp.asarray(gather)
+    kc = jnp.take(k, idx, axis=0)
+    vc = jnp.take(v, idx, axis=0)
+    return flex_attn_with_meta(
+        q,
+        kc,
+        vc,
+        meta,
+        scale=scale,
+        softcap=softcap,
+        sink=sink,
+        out_dtype=out_dtype,
+        head_block=head_block,
+        interpret=interpret,
+    )

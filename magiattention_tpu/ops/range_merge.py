@@ -1,0 +1,70 @@
+"""Auto range merge: canonicalize a user slice list before planning.
+
+Role of reference ``flex_flash_attn.py:79-178`` (merge_ranges + the
+MAGI_ATTENTION_AUTO_RANGE_MERGE path, csrc sort_and_reorder_ranges.cu):
+user-supplied (q_range, k_range) lists may contain duplicates and
+overlapping k-ranges for the same q rows; the kernel sums one softmax
+contribution per slice, so overlaps double-count keys. Merging rewrites
+the list into an equivalent non-overlapping one and shrinks the entry
+table. Host-side numpy here — the list is static per mask and the result
+is cached with the kernel plan.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..common.enum import AttnMaskType
+
+
+def merge_ranges(
+    q_ranges: np.ndarray,  # [S, 2]
+    k_ranges: np.ndarray,  # [S, 2]
+    attn_type_map: np.ndarray,  # [S]
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Sort slices, drop exact duplicates, and union overlapping/adjacent
+    k-ranges of FULL slices that share one q-range.
+
+    Only transformations that provably preserve the mask's (q, k) coverage
+    without changing any slice's geometry-dependent semantics are applied:
+    - exact duplicate (q, k, type) triples collapse to one;
+    - FULL slices with identical q_range and overlapping or adjacent
+      k_ranges merge into their k-union (FULL has no diagonal alignment,
+      so the union covers exactly the same pairs).
+    Causal-family slices are never geometry-merged (their diagonals are
+    anchored to the slice corners); they are only deduplicated.
+    """
+    q = np.asarray(q_ranges, dtype=np.int64).reshape(-1, 2)
+    k = np.asarray(k_ranges, dtype=np.int64).reshape(-1, 2)
+    t = np.asarray(attn_type_map, dtype=np.int64).reshape(-1)
+    assert q.shape[0] == k.shape[0] == t.shape[0]
+
+    # drop empty + exact duplicates, keeping first-occurrence order of the
+    # sorted canonical form
+    # canonical order (qs, qe, type, ks, ke): slices sharing one q-range
+    # and type become contiguous, so FULL k-union chains never break
+    rows = sorted(
+        {
+            (int(qs), int(qe), int(mt), int(ks), int(ke))
+            for (qs, qe), (ks, ke), mt in zip(q, k, t)
+            if qe > qs and ke > ks
+        }
+    )
+
+    merged: list[tuple[int, int, int, int, int]] = []
+    for qs, qe, mt, ks, ke in rows:
+        if (
+            merged
+            and mt == int(AttnMaskType.FULL)
+            and merged[-1][2] == int(AttnMaskType.FULL)
+            and merged[-1][0] == qs
+            and merged[-1][1] == qe
+            and merged[-1][4] >= ks  # overlapping or adjacent in k
+        ):
+            prev = merged[-1]
+            merged[-1] = (qs, qe, mt, prev[3], max(prev[4], ke))
+        else:
+            merged.append((qs, qe, mt, ks, ke))
+
+    arr = np.asarray(merged, dtype=np.int64).reshape(-1, 5)
+    return arr[:, 0:2], arr[:, 3:5], arr[:, 2]

@@ -670,12 +670,14 @@ def make_attn_params(
     has_sink: bool = False,
     out_dtype="bfloat16",
     interpret: bool | None = None,
+    head_block: int = 1,
 ) -> FlexAttnParams:
     if scale is None:
         scale = 1.0 / math.sqrt(head_dim)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return FlexAttnParams(
+        head_block=int(head_block),
         block_q=plan.block_q,
         block_k=plan.block_k,
         scale=float(scale),
@@ -723,10 +725,18 @@ def dist_attn_local(
 ):
     """The SPMD hot path — call inside shard_map over the cp axis.
 
-    Returns (out [shard_q_len, hq, d], lse [shard_q_len, hq]).
+    Returns (out [shard_q_len, hq, d], lse [shard_q_len, hq], and the
+    rank-local per-head max logit [hq] — pmax it across the cp axis for
+    the global value).
     """
+    from .. import env
+
     qh = _hm(q, plan.shard_q_pad)
     kv = jnp.stack([k, v], axis=1)  # one all_to_all payload for K and V
+    if env.is_backward_high_precision_reduce():
+        # fp32 payload -> the transposed dKV reduce accumulates in fp32
+        # (2x comm; reference BACKWARD_HIGH_PRECISION_REDUCE)
+        kv = kv.astype(jnp.float32)
     cur = 0
 
     def take(n):
@@ -749,40 +759,61 @@ def dist_attn_local(
             payload, send_idx, recv_sel, recv_valid, axis_name=axis_name
         )
 
+    def cast_kv(comm_arrays):
+        # downcast received KV to the kernel dtype; with the fp32 payload
+        # the astype transpose upcasts each dKV cotangent before the
+        # reduce, giving the high-precision accumulate
+        return cast(kv, comm_arrays).astype(k.dtype)
+
+    def _head_max(rowmax_lanes):
+        # per-head max of masked logits over this rank's rows (pads carry
+        # -inf); callers pmax across ranks (reference reduce_max_logits,
+        # dist_attn.py:532 + :3168 all_reduce MAX — Muon QK-Clip support)
+        return jnp.max(rowmax_lanes[:, :, 0], axis=1)
+
     if plan.overlap_degree == 0:
         tab = take(9)
-        recv = cast(kv, take(plan.num_comm_arrays))
+        recv = cast_kv(take(plan.num_comm_arrays))
         k_full = jnp.concatenate([k, recv[:, 0]], axis=0)
         v_full = jnp.concatenate([v, recv[:, 1]], axis=0)
-        out_h, lse_lanes, _ = _call_kernel(
+        out_h, lse_lanes, rowmax_lanes = _call_kernel(
             qh, k_full, v_full, tab, plan.merged_tables.kv_pad, params, sink
         )
-        return _headmajor_to_seq(out_h, lse_lanes, plan.shard_q_len)
+        out, lse = _headmajor_to_seq(out_h, lse_lanes, plan.shard_q_len)
+        return out, lse, _head_max(rowmax_lanes)
 
     # staged path: host stage + D lse-merged remote stages.
     # The sink joins the softmax denominator exactly once — in the host
     # stage; remote partials are sink-free. The running accumulator stays
-    # fp32 across merges (reference fwd_out_lse_use_acc semantics); a single
+    # fp32 across merges (reference fwd_out_lse_use_acc /
+    # FORWARD_HIGH_PRECISION_REDUCE semantics, default on); a single
     # downcast happens at the end.
-    host_params = dataclasses.replace(params, out_dtype="float32")
+    acc_dtype = (
+        "float32"
+        if env.is_forward_high_precision_reduce()
+        else params.out_dtype
+    )
+    host_params = dataclasses.replace(params, out_dtype=acc_dtype)
     host_tab = take(9)
-    out_h, lse_lanes, _ = _call_kernel(
+    out_h, lse_lanes, rowmax_lanes = _call_kernel(
         qh, k, v, host_tab, plan.host_tables.kv_pad, host_params, sink
     )
     out, lse = _headmajor_to_seq(out_h, lse_lanes, plan.shard_q_len)
+    mx = _head_max(rowmax_lanes)
 
     stage_params = dataclasses.replace(
-        params, has_sink=False, out_dtype="float32"
+        params, has_sink=False, out_dtype=acc_dtype
     )
     for sp in plan.stages:
         tab = take(9)
-        recv = cast(kv, take(plan.num_comm_arrays))
-        out_i_h, lse_i_lanes, _ = _call_kernel(
+        recv = cast_kv(take(plan.num_comm_arrays))
+        out_i_h, lse_i_lanes, rowmax_i = _call_kernel(
             qh, recv[:, 0], recv[:, 1], tab, sp.tables.kv_pad, stage_params, None
         )
         out_i, lse_i = _headmajor_to_seq(out_i_h, lse_i_lanes, plan.shard_q_len)
         out, lse = correct_attn_out_lse(out, lse, out_i, lse_i)
-    return out.astype(params.out_jnp_dtype), lse
+        mx = jnp.maximum(mx, _head_max(rowmax_i))
+    return out.astype(params.out_jnp_dtype), lse, mx
 
 
 def make_dist_attn_fn(
@@ -792,9 +823,15 @@ def make_dist_attn_fn(
     *,
     axis_name: str = "cp",
     sink: jax.Array | None = None,  # [hq] learned sink logits (replicated)
+    with_max_logits: bool = False,
 ):
     """Convenience: a jittable fn over *dispatched global* arrays sharded
-    P(axis_name) along tokens."""
+    P(axis_name) along tokens.
+
+    ``with_max_logits``: also return the globally-reduced per-head max
+    logit [hq] (pmax over the cp axis; reference reduce_max_logits) as a
+    third output.
+    """
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -807,6 +844,13 @@ def make_dist_attn_fn(
     )
     n_tab = len(tables)
     sink_specs = (P(),) if sink is not None else ()
+    out_specs = (P(axis_name), P(axis_name))
+    if with_max_logits:
+        # per-rank [1, hq] maxes, globally max-reduced OUTSIDE shard_map
+        # (pmax has no differentiation rule; jnp.max over the gathered
+        # axis is equivalent and transparently differentiable — the
+        # kernel vjp drops rowmax cotangents anyway)
+        out_specs = out_specs + (P(axis_name),)
 
     @functools.partial(
         shard_map,
@@ -814,16 +858,19 @@ def make_dist_attn_fn(
         in_specs=(P(axis_name), P(axis_name), P(axis_name))
         + (P(axis_name),) * n_tab
         + sink_specs,
-        out_specs=(P(axis_name), P(axis_name)),
+        out_specs=out_specs,
         # pallas_call out_shapes carry no vma info; skip the static check
         check_vma=False,
     )
     def _local(q, k, v, *rest):
         tabs = rest[:n_tab]
         s = rest[n_tab] if len(rest) > n_tab else None
-        return dist_attn_local(
+        out, lse, mx = dist_attn_local(
             q, k, v, tabs, plan, params, axis_name=axis_name, sink=s
         )
+        if not with_max_logits:
+            return out, lse
+        return out, lse, mx[None]
 
     def fn(q, k, v, sink_override=None):
         # sink is a *traced* argument: callers may pass an updated (e.g.
@@ -835,6 +882,10 @@ def make_dist_attn_fn(
             "sink override requires a plan built with has_sink=True"
         )
         extra = (s,) if s is not None else ()
-        return _local(q, k, v, *tables, *extra)
+        res = _local(q, k, v, *tables, *extra)
+        if not with_max_logits:
+            return res
+        out, lse, mxs = res
+        return out, lse, jnp.max(mxs, axis=0)
 
     return fn

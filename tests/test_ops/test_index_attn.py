@@ -1,0 +1,129 @@
+"""Index-attention / sparse-load modes + auto range merge vs the oracle
+(reference flex_flash_attn.py:79-178, :1110-1123 sparse options)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.ops import (
+    flex_flash_attn_func,
+    index_attn_func,
+    merge_ranges,
+    sparse_load_attn_func,
+)
+from magiattention_tpu.testing import assert_close, ref_attn
+
+
+def test_merge_ranges_dedup_and_union():
+    q = [(0, 128), (0, 128), (0, 128), (128, 256), (0, 128)]
+    k = [(0, 64), (0, 64), (64, 128), (0, 256), (32, 80)]
+    t = [0, 0, 0, 1, 1]
+    qm, km, tm = merge_ranges(np.array(q), np.array(k), np.array(t))
+    rows = sorted(zip(qm[:, 0], qm[:, 1], km[:, 0], km[:, 1], tm))
+    # FULL slices with equal q ranges union their k ranges; the causal
+    # slices are only deduplicated, never geometry-merged
+    assert (0, 128, 0, 128, 0) in [tuple(int(x) for x in r) for r in rows]
+    assert len(rows) == 3
+
+
+def test_auto_range_merge_reduces_entries(monkeypatch):
+    """With MAGI_ATTENTION_AUTO_RANGE_MERGE the kernel plan for an
+    overlapping-FULL-range mask shrinks and stays numerically identical to
+    the canonical mask."""
+    from magiattention_tpu.ops.block_meta import build_block_meta
+
+    total = 512
+    # 4 overlapping FULL slices covering (0,512)x(0,512)
+    q = np.array([[0, 512]] * 4)
+    k = np.array([[0, 200], [100, 300], [300, 512], [200, 330]])
+    t = np.array([0, 0, 0, 0])
+    qm, km, tm = merge_ranges(q, k, t)
+    assert qm.shape[0] == 1 and tuple(km[0]) == (0, 512)
+
+    raw = build_block_meta(q, k, t, total, total, block_q=64, block_k=64)
+    merged = build_block_meta(qm, km, tm, total, total, block_q=64, block_k=64)
+    assert merged.num_fwd_entries < raw.num_fwd_entries
+
+    rng = np.random.default_rng(0)
+    qq = jnp.asarray(rng.standard_normal((total, 2, 32)), jnp.float32)
+    kk = jnp.asarray(rng.standard_normal((total, 2, 32)), jnp.float32)
+    vv = jnp.asarray(rng.standard_normal((total, 2, 32)), jnp.float32)
+    monkeypatch.setenv("MAGI_ATTENTION_AUTO_RANGE_MERGE", "1")
+    out, _ = flex_flash_attn_func(qq, kk, vv, q, k, t, block_q=64, block_k=64)
+    ref_out, _, _ = ref_attn(qq, kk, vv, np.ones((total, total), bool))
+    assert_close(out, ref_out, atol=2e-5, rtol=2e-5, msg="merged full")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_index_attn_matches_oracle(causal):
+    """Per-q-block top-k KV-block selection (NSA-style index attention)."""
+    total, bq, bk, topk = 512, 64, 64, 3
+    hq, hk, d = 2, 2, 32
+    nq, nk = total // bq, total // bk
+    rng = np.random.default_rng(3)
+    idx = np.full((nq, topk), -1, np.int64)
+    for i in range(nq):
+        lim = i + 1 if causal else nk  # keep selections near/below diagonal
+        sel = rng.choice(lim, size=min(topk, lim), replace=False)
+        idx[i, : len(sel)] = sel
+    q = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    out, lse = index_attn_func(
+        q, k, v, idx, causal=causal, block_q=bq, block_k=bk
+    )
+    mask = np.zeros((total, total), bool)
+    for i in range(nq):
+        for j in idx[i][idx[i] >= 0]:
+            mask[i * bq : (i + 1) * bq, j * bk : (j + 1) * bk] = True
+    if causal:
+        mask &= np.tril(np.ones((total, total), bool))
+    ref_out, ref_lse, _ = ref_attn(q, k, v, mask)
+    assert_close(out, ref_out, atol=2e-5, rtol=2e-5, msg=f"idx c={causal}")
+    finite = ~np.isneginf(np.asarray(ref_lse))
+    assert_close(
+        np.asarray(lse)[finite], np.asarray(ref_lse)[finite],
+        atol=2e-5, rtol=2e-5,
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_sparse_load_matches_oracle(causal):
+    """Selected global k ranges gathered to a compact buffer; the mask is
+    evaluated against GLOBAL positions through run translation."""
+    total = 512
+    hq, hk, d = 2, 2, 32
+    sel = [(0, 96), (160, 288), (384, 512)]
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    out, lse = sparse_load_attn_func(
+        q, k, v, sel, causal=causal, block_q=64, block_k=64
+    )
+    mask = np.zeros((total, total), bool)
+    for a, b in sel:
+        mask[:, a:b] = True
+    if causal:
+        mask &= np.tril(np.ones((total, total), bool))
+    ref_out, ref_lse, _ = ref_attn(q, k, v, mask)
+    assert_close(out, ref_out, atol=2e-5, rtol=2e-5, msg=f"sl c={causal}")
+    finite = ~np.isneginf(np.asarray(ref_lse))
+    assert_close(
+        np.asarray(lse)[finite], np.asarray(ref_lse)[finite],
+        atol=2e-5, rtol=2e-5,
+    )
+
+    # grads flow through the gather + compact-buffer attention
+    do = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    g = jax.grad(
+        lambda k: (
+            sparse_load_attn_func(
+                q, k, v, sel, causal=causal, block_q=64, block_k=64
+            )[0]
+            * do
+        ).sum()
+    )(k)
+    gr = jax.grad(lambda k: (ref_attn(q, k, v, mask)[0] * do).sum())(k)
+    assert_close(g, gr, atol=1e-4, rtol=1e-4, msg=f"sl dk c={causal}")

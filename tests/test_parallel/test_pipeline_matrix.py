@@ -133,6 +133,17 @@ def _legal(c):
 
 
 _COMBOS = list(FlagCombGenerator(_FLAG_SPACE, _legal, mode="heuristic"))
+# the GREEDY one-hot pairs with the (illegal) base degree=0 and is dropped
+# by _legal; add it back against a staged degree so every value really runs
+_COMBOS.append(
+    {
+        "degree": 2,
+        "overlap_alg": OverlapAlgType.GREEDY,
+        "dispatch": "minheap",
+        "uneven": False,
+        "dtype": "float32",
+    }
+)
 
 
 @pytest.mark.parametrize(
@@ -268,6 +279,34 @@ def test_q_overlap_at_scale():
         lambda q: (ref_attn_from_ranges(q, k, v, qr, kr, ts)[0] * do).sum()
     )(q)
     assert_close(g, gr, atol=5e-5, rtol=5e-5, msg="q_overlap dq")
+
+
+@pytest.mark.parametrize("degree", [0, 2])
+def test_distributed_max_logits(degree):
+    """Per-head max logit reduced across ranks (reference
+    reduce_max_logits, dist_attn.py:532 + :3168 all_reduce MAX): the keyed
+    API's forward meta must match the single-device oracle at cp=4."""
+    total, cp = 1024, 4
+    hq, hk, d = 4, 2, 32
+    qr = [(0, 512), (512, 1024)]
+    kr = [(0, 512), (0, 1024)]
+    ts = [int(C), int(C)]
+    mesh = _mesh(cp)
+    key = magi_attn_flex_key(
+        qr, kr, ts, total, total, mesh,
+        num_heads=(hq, hk), head_dim=d, chunk_size=64, out_dtype="float32",
+        dist_attn_config=DistAttnConfig(
+            overlap_config=OverlapConfig(degree=degree, min_stage_rows=64)
+        ),
+    )
+    rng = np.random.default_rng(41)
+    q, k, v = _rand_qkv(rng, total, hq, hk, d)
+    qd, kd, vd = dispatch(q, key), dispatch(k, key), dispatch(v, key)
+    _, fm = jax.jit(lambda a, b, c: calc_attn(a, b, c, key))(qd, kd, vd)
+    assert fm.max_logits is not None and fm.max_logits.shape == (hq,)
+    _, _, ref_mx = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(fm.max_logits, ref_mx, atol=2e-5, rtol=2e-5,
+                 msg=f"max_logits d{degree}")
 
 
 @pytest.mark.parametrize("cp", [1, 2, 3, 5, 6, 8])
