@@ -86,7 +86,12 @@ def main() -> None:
     p.add_argument("--chunk", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--mean-doc", type=float, default=4096.0)
-    p.add_argument("--causal", action="store_true", default=True)
+    p.add_argument(
+        "--causal",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="per-doc causal (default) or --no-causal for full varlen",
+    )
     p.add_argument(
         "--wallclock",
         action="store_true",

@@ -129,8 +129,12 @@ def is_auto_range_merge_enable() -> bool:
 
 def is_cpp_backend_enabled() -> bool:
     """Use the native C++ planning accelerators (parity-tested against the
-    python fallback, so not part of the key fingerprint)."""
-    return _env_bool("MAGI_ATTENTION_CPP_BACKEND", True)
+    python fallback, so not part of the key fingerprint). Default-on:
+    only an explicit 0/false/off/no disables it."""
+    v = os.environ.get("MAGI_ATTENTION_CPP_BACKEND")
+    if v is None:
+        return True
+    return v.strip().lower() not in ("0", "false", "off", "no")
 
 
 def is_profile_mode() -> bool:

@@ -1,17 +1,25 @@
 """DynamicAttnSolver: partition the attention plane itself across ranks.
 
 Role of reference ``meta/solver/dynamic_attn_solver.py`` + the
-``meta/algorithms`` family (BinaryGreedyParallel default, _make_attn_meta.py
-:81): instead of assigning whole q-chunks (the static solver), model the
-workload as AttnRectangles in the (q, k) plane and cut it into cp
-equal-area regions — the planning core of qo-comm mode, where both Q/O and
-KV can move. The default algorithm here is the binary-greedy KD split:
-recursively halve the rank set, alternating q-line and k-line cuts placed
-by binary search so area divides proportionally.
+``meta/algorithms`` family (snf/fast_snf/grg/ncq + BinaryGreedyParallel
+default, _make_attn_meta.py:81): instead of assigning whole q-chunks (the
+static solver), model the workload as AttnRectangles in the (q, k) plane
+and cut it into cp equal-area regions — the planning core of qo-comm mode,
+where both Q/O and KV can move.
 
-This module provides the geometric solver + balance accounting; wiring its
-output into a qo-comm execution runtime (group-casting Q and group-reducing
-O with the lse op) is the planned extension of parallel/dist_attn.py.
+Three algorithm styles are provided (independent TPU re-designs of the
+reference family's *roles*, not its implementations):
+
+- :class:`DynamicAttnSolver` — binary-greedy KD split (default): recursive
+  halving with alternating q/k cut lines placed by binary search. Best
+  pure area balance; placement-oblivious.
+- :class:`NCQDynamicSolver` — zero-Q/O-comm (role of reference ncq.py):
+  cut only along the host q-shard boundaries so every rank computes
+  exactly its own q rows; only KV moves.
+- :class:`LocalityGreedySolver` — balance/locality tradeoff (role of the
+  snf/fast_snf/grg family): cut work units at host boundaries, then
+  greedily assign largest-first to the rank minimizing
+  load + penalty x non-local Q/KV rows.
 """
 
 from __future__ import annotations
@@ -47,7 +55,7 @@ class DynamicAttnSolver:
         self.alternate = alternate
 
     def solve(
-        self, rects: AttnRectangles, cp_size: int
+        self, rects: AttnRectangles, cp_size: int, total_seqlen: int | None = None
     ) -> DynamicAttnSolution:
         parts = self._split(rects, cp_size, axis_q=True)
         assert len(parts) == cp_size
@@ -99,3 +107,115 @@ class DynamicAttnSolver:
         if abs(area_left(lo) - target) < best_err:
             best_pos = lo
         return cut(best_pos)
+
+
+def _infer_total(rects: AttnRectangles, total_seqlen: int | None) -> int:
+    if total_seqlen is not None:
+        return total_seqlen
+    return max((r.q_range.end for r in rects), default=0)
+
+
+class NCQDynamicSolver:
+    """Zero-Q/O-communication partition (role of reference ncq.py): every
+    rank keeps exactly the attention rows of its own contiguous q shard,
+    so Q and O never move — only KV is cast. Area balance is whatever the
+    mask shape dictates."""
+
+    def solve(
+        self, rects: AttnRectangles, cp_size: int, total_seqlen: int | None = None
+    ) -> DynamicAttnSolution:
+        total = _infer_total(rects, total_seqlen)
+        shard = -(-total // cp_size)
+        parts: list[AttnRectangles] = []
+        rest = rects
+        for r in range(cp_size - 1):
+            left, rest = rest.cut_q((r + 1) * shard)
+            parts.append(left)
+        parts.append(rest)
+        return DynamicAttnSolution(rank_rects=tuple(parts))
+
+
+class LocalityGreedySolver:
+    """Balance/locality tradeoff (role of the reference snf / fast_snf /
+    grg algorithms): work units are the mask rectangles cut at host
+    q-shard boundaries (each unit has a home rank); units are assigned
+    largest-first to the rank minimizing
+
+        load[rank] + penalty_qo * qo_rows + penalty_kv * kv_rows
+
+    where qo_rows is the unit's q extent when placed off its home rank and
+    kv_rows the part of its k extent outside the rank's k shard. With both
+    penalties 0 this degenerates to pure greedy balance; with a dominant
+    qo penalty it reproduces :class:`NCQDynamicSolver` placement.
+    """
+
+    def __init__(
+        self,
+        penalty_qo_rows_to_area: float | None = None,
+        penalty_kv_rows_to_area: float | None = None,
+        max_unit_frac: float = 0.25,
+    ):
+        self.penalty_qo = penalty_qo_rows_to_area
+        self.penalty_kv = penalty_kv_rows_to_area
+        self.max_unit_frac = max_unit_frac
+
+    def solve(
+        self, rects: AttnRectangles, cp_size: int, total_seqlen: int | None = None
+    ) -> DynamicAttnSolution:
+        total = _infer_total(rects, total_seqlen)
+        shard = -(-total // cp_size)
+        # default penalties: moving one row costs as much area as attending
+        # ~1/8 of a shard (comm is cheap relative to compute on ICI); Q/O
+        # movement also pays the O lse-reduce return trip, so weight it 2x
+        pkv = (
+            self.penalty_kv if self.penalty_kv is not None else shard / 8
+        )
+        pqo = (
+            self.penalty_qo if self.penalty_qo is not None else shard / 4
+        )
+
+        # work units cut at host boundaries, each tagged with its home rank
+        units: list[tuple[int, object]] = []
+        rest = rects
+        for r in range(cp_size):
+            left, rest = rest.cut_q(min((r + 1) * shard, total))
+            for rect in left:
+                units.append((r, rect))
+        # refine: halve oversized units along q so balance is reachable
+        cap = max(rects.area * self.max_unit_frac / cp_size, 1)
+        refined: list[tuple[int, object]] = []
+        stack = units
+        while stack:
+            home, rect = stack.pop()
+            if rect.area > cap and rect.q_range.seqlen > 1:
+                mid = (rect.q_range.start + rect.q_range.end) // 2
+                top, bottom = rect.cut_q(mid)
+                for piece in (top, bottom):
+                    if piece is not None and piece.area > 0:
+                        stack.append((home, piece))
+            else:
+                refined.append((home, rect))
+
+        refined.sort(key=lambda u: -u[1].area)
+        loads = [0.0] * cp_size
+        buckets: list[list] = [[] for _ in range(cp_size)]
+        for home, rect in refined:
+            k0, k1 = rect.k_range.start, rect.k_range.end
+
+            def cost(r: int) -> float:
+                qo = 0 if r == home else rect.q_range.seqlen
+                k_lo, k_hi = r * shard, (r + 1) * shard
+                local_k = max(0, min(k1, k_hi) - max(k0, k_lo))
+                kv = (k1 - k0) - local_k
+                return loads[r] + pqo * qo + pkv * kv
+
+            best = min(range(cp_size), key=cost)
+            loads[best] += rect.area
+            buckets[best].append(rect)
+        parts = []
+        for b in buckets:
+            rr = AttnRectangles()
+            for rect in b:
+                rr.append(rect)
+            parts.append(rr)
+        return DynamicAttnSolution(rank_rects=tuple(parts))

@@ -1,13 +1,24 @@
 """CP baseline implementations (reference exps/dist_attn/baselines/):
-ring attention and Ulysses — the comparison points for the benchmark-parity
-story. USP (Ulysses x ring over a 2-D mesh) composes the two."""
+ring attention, Ulysses, USP (Ulysses x ring over a 2-D mesh), LoongTrain
+double ring, Megatron-style Hybrid CP (zigzag all-gather), and the NSA /
+USP-NSA sparse-attention baselines — the comparison points for the
+benchmark-parity story."""
 
+from .hybrid_dcp import (
+    HybridDcpPlan,
+    build_hybrid_dcp_plan,
+    hybrid_dcp_attn_local,
+    make_hybrid_dcp_attn_fn,
+    zigzag_dispatch,
+    zigzag_undispatch,
+)
 from .loongtrain import (
     DoubleRingPlan,
     build_double_ring_plan,
     double_ring_attn_local,
     make_double_ring_attn_fn,
 )
+from .nsa import NsaConfig, make_usp_nsa_attn_fn, nsa_attn
 from .ring import RingAttnPlan, build_ring_attn_plan, make_ring_attn_fn, ring_attn_local
 from .ulysses import (
     UlyssesPlan,
@@ -19,19 +30,28 @@ from .usp import USPPlan, build_usp_plan, make_usp_attn_fn, usp_attn_local
 
 __all__ = [
     "DoubleRingPlan",
+    "HybridDcpPlan",
+    "NsaConfig",
     "RingAttnPlan",
-    "build_double_ring_plan",
-    "double_ring_attn_local",
-    "make_double_ring_attn_fn",
     "UlyssesPlan",
     "USPPlan",
-    "build_usp_plan",
-    "make_usp_attn_fn",
-    "usp_attn_local",
+    "build_double_ring_plan",
+    "build_hybrid_dcp_plan",
     "build_ring_attn_plan",
     "build_ulysses_plan",
+    "build_usp_plan",
+    "double_ring_attn_local",
+    "hybrid_dcp_attn_local",
+    "make_double_ring_attn_fn",
+    "make_hybrid_dcp_attn_fn",
     "make_ring_attn_fn",
     "make_ulysses_attn_fn",
+    "make_usp_attn_fn",
+    "make_usp_nsa_attn_fn",
+    "nsa_attn",
     "ring_attn_local",
     "ulysses_attn_local",
+    "usp_attn_local",
+    "zigzag_dispatch",
+    "zigzag_undispatch",
 ]

@@ -1,0 +1,196 @@
+"""NSA (Native Sparse Attention) and USP-NSA baselines.
+
+Role of reference ``exps/dist_attn/baselines/nsa.py`` + ``usp_nsa.py``:
+the sparse-attention baseline in the distributed benchmark — per query,
+attention is the gated sum of three branches over block-compressed KV:
+
+1. **cmp** — attend mean-pooled (compressed) KV blocks, causal at block
+   granularity;
+2. **slc** — attend the top-k *selected* full-resolution KV blocks, ranked
+   by the compressed-branch scores (data-dependent);
+3. **win** — a sliding window of recent tokens.
+
+TPU-native form: the selection is data-dependent, so it cannot feed the
+host-built entry tables; instead the selected blocks are gathered with a
+static-shape ``jnp.take`` ([nq_blocks, topk] indices from an in-graph
+top-k) and the branch is a batched dense attention over [topk * block]
+keys per q block — static shapes, MXU-friendly, fully differentiable.
+Gates are fixed equal weights (the benchmark baseline; the trainable gate
+MLP of the NSA paper is a model-level concern).
+
+USP-NSA = ulysses head-scatter a2a around the NSA kernel (the reference
+composes NSA with USP the same way).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+NEG_INF = float("-inf")
+
+
+def _block_pool(x: jax.Array, block: int) -> jax.Array:
+    """[t, h, d] -> [t/block, h, d] mean pooling."""
+    t, h, d = x.shape
+    return x.reshape(t // block, block, h, d).mean(axis=1)
+
+
+def _dense_softmax_rows(s, v, mask):
+    """Row softmax: s [..., q, n] masked scores, v [..., n, d] values ->
+    (out [..., q, d], lse [..., q])."""
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("...qn,...nd->...qd", p, v) / jnp.maximum(l, 1e-30)
+    lse = jnp.where(
+        l[..., 0] > 0,
+        m_safe[..., 0] + jnp.log(jnp.maximum(l[..., 0], 1e-30)),
+        NEG_INF,
+    )
+    return out, lse
+
+
+@dataclasses.dataclass(frozen=True)
+class NsaConfig:
+    block: int = 64  # compression / selection block size
+    topk: int = 8  # selected full-resolution blocks per q block
+    window: int = 256  # sliding-window branch width
+
+
+def nsa_attn(
+    q: jax.Array,  # [t, hq, d]
+    k: jax.Array,  # [t, hk, d]
+    v: jax.Array,
+    cfg: NsaConfig = NsaConfig(),
+    *,
+    scale: float | None = None,
+) -> jax.Array:
+    """Single-device NSA forward: (cmp + slc + win) / 3, causal.
+
+    Returns out [t, hq, d]. All branches share the q projections; GQA is
+    handled by repeating KV heads.
+    """
+    t, hq, d = q.shape
+    hk = k.shape[1]
+    assert t % cfg.block == 0, f"t {t} must be a multiple of block {cfg.block}"
+    group = hq // hk
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    kf = jnp.repeat(k, group, axis=1)  # [t, hq, d]
+    vf = jnp.repeat(v, group, axis=1)
+    nb = t // cfg.block
+
+    # ---- cmp branch: mean-pooled blocks, causal at block granularity ----
+    # a block becomes visible only once it is FULLY in the past (bi < qi):
+    # the pooled value of the query's own block would average future
+    # tokens; the win branch covers the recent context instead.
+    # memory: scores are [hq, t, t/block] — a 1/block fraction of dense.
+    kc = _block_pool(kf, cfg.block)  # [nb, hq, d]
+    vc = _block_pool(vf, cfg.block)
+    s_cmp = jnp.einsum("qhd,bhd->hqb", q, kc) * scale  # [hq, t, nb]
+    qi = jnp.arange(t)[:, None] // cfg.block
+    bi = jnp.arange(nb)[None, :]
+    cmp_mask = (bi < qi)[None]
+    out_cmp, _ = _dense_softmax_rows(
+        s_cmp, vc.transpose(1, 0, 2), cmp_mask
+    )  # vc as [hq, nb, d] -> out [hq, t, d]
+    out_cmp = out_cmp.transpose(1, 0, 2)  # [t, hq, d]
+
+    # ---- slc branch: top-k blocks by compressed scores, full resolution --
+    # ranking is PER HEAD (each head's selection is self-contained, so a
+    # head-sharded ulysses run selects identically to single-device)
+    kk = min(cfg.topk, nb)
+    sb = s_cmp.reshape(hq, nb, cfg.block, nb).sum(axis=2)  # [hq, qb, nb]
+    sb = jnp.where(
+        jnp.arange(nb)[None, None, :] <= jnp.arange(nb)[None, :, None],
+        sb,
+        NEG_INF,
+    )
+    _, top_idx = jax.lax.top_k(sb, kk)  # [hq, qb, topk]
+    top_idx = jax.lax.stop_gradient(top_idx)
+    row_idx = (
+        top_idx[..., None] * cfg.block
+        + jnp.arange(cfg.block)[None, None, None, :]
+    ).reshape(hq, nb, kk * cfg.block)  # selected global rows per (h, qb)
+    khm = kf.transpose(1, 0, 2)  # [hq, t, d]
+    vhm = vf.transpose(1, 0, 2)
+    flat = row_idx.reshape(hq, -1)[..., None]
+    k_sel = jnp.take_along_axis(khm, flat, axis=1).reshape(
+        hq, nb, kk * cfg.block, d
+    )
+    v_sel = jnp.take_along_axis(vhm, flat, axis=1).reshape(
+        hq, nb, kk * cfg.block, d
+    )
+    qhm = q.transpose(1, 0, 2).reshape(hq, nb, cfg.block, d)
+    s_slc = jnp.einsum("hbrd,hbnd->hbrn", qhm, k_sel) * scale
+    # causal vs the selected rows' global positions
+    qpos = (
+        jnp.arange(nb)[:, None] * cfg.block + jnp.arange(cfg.block)[None, :]
+    )  # [qb, block]
+    slc_mask = row_idx[:, :, None, :] <= qpos[None, :, :, None]
+    out_slc, _ = _dense_softmax_rows(s_slc, v_sel, slc_mask)
+    out_slc = out_slc.reshape(hq, t, d).transpose(1, 0, 2)
+
+    # ---- win branch: sliding window via the flex kernel (O(t*window)) ---
+    from ...api.functools import infer_attn_mask_from_sliding_window
+    from ...ops import flex_flash_attn_func
+
+    swa_q, swa_k, swa_t = infer_attn_mask_from_sliding_window(
+        t, min(cfg.window, t)
+    )
+    out_win, _ = flex_flash_attn_func(
+        q,
+        k,
+        v,
+        swa_q.to_naive_ranges(),
+        swa_k.to_naive_ranges(),
+        [int(x) for x in swa_t],
+        scale=scale,
+        out_dtype="float32",
+    )
+
+    return ((out_cmp + out_slc + out_win) / 3.0).astype(q.dtype)
+
+
+def make_usp_nsa_attn_fn(
+    total_seqlen: int,
+    mesh: jax.sharding.Mesh,
+    cfg: NsaConfig = NsaConfig(),
+    *,
+    axis_name: str = "cp",
+):
+    """USP-NSA: ulysses seq->head a2a, full-sequence NSA per head subset,
+    a2a back (reference usp_nsa.py composition)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from .ulysses import heads_to_seq_a2a, seq_to_heads_a2a
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis_name),) * 3,
+        out_specs=P(axis_name),
+        check_vma=False,
+    )
+    def _local(q, k, v):
+        cp = mesh.shape[axis_name]
+        hq, hk = q.shape[1], k.shape[1]
+        assert hq % cp == 0 and hk % cp == 0, (
+            f"USP-NSA needs heads divisible by cp: hq={hq} hk={hk} cp={cp}"
+        )
+        qg = seq_to_heads_a2a(q, axis_name)
+        kg = seq_to_heads_a2a(k, axis_name)
+        vg = seq_to_heads_a2a(v, axis_name)
+        out_g = nsa_attn(qg, kg, vg, cfg)
+        return heads_to_seq_a2a(out_g, axis_name)
+
+    return _local

@@ -140,7 +140,7 @@ def build_qo_comm_plan(
         [(int(s[2]), int(s[3])) for s in slices],
         [int(s[4]) for s in slices],
     )
-    sol = solver.solve(rects, cp_size)
+    sol = solver.solve(rects, cp_size, total_seqlen=total_seqlen)
 
     q_need: list[AttnRanges] = []
     k_need: list[AttnRanges] = []

@@ -1,4 +1,6 @@
-"""Dynamic (rectangle) solver: exact partition + balance."""
+"""Dynamic (rectangle) solver family: exact partition + balance +
+algorithm-specific properties (reference meta/algorithms: binary-greedy,
+ncq, snf/grg-style locality greedy)."""
 
 import numpy as np
 import pytest
@@ -6,7 +8,11 @@ import pytest
 from magiattention_tpu.common import AttnMaskType
 from magiattention_tpu.common.mask import make_attn_mask_from_ranges
 from magiattention_tpu.common.rectangle import AttnRectangles
-from magiattention_tpu.meta.solver.dynamic_attn_solver import DynamicAttnSolver
+from magiattention_tpu.meta.solver.dynamic_attn_solver import (
+    DynamicAttnSolver,
+    LocalityGreedySolver,
+    NCQDynamicSolver,
+)
 
 C = AttnMaskType.CAUSAL
 F = AttnMaskType.FULL
@@ -50,3 +56,67 @@ def test_partition_exact_and_balanced(name, total, qr, kr, ts, cp):
 
     # balance: within 25% of ideal for these workloads
     assert sol.balance_ratio < 1.25, sol.areas
+
+
+def _coverage_exact(sol, qr, kr, ts, total):
+    ref = make_attn_mask_from_ranges(qr, kr, ts, total, total)
+    acc = np.zeros_like(ref, dtype=np.int32)
+    for rr in sol.rank_rects:
+        for rect in rr:
+            acc += make_attn_mask_from_ranges(
+                [rect.q_range.to_naive_range()],
+                [rect.k_range.to_naive_range()],
+                [rect.mask_type],
+                total,
+                total,
+            ).astype(np.int32)
+    np.testing.assert_array_equal(acc > 0, ref)
+    assert (acc <= 1).all(), "rank regions overlap"
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+@pytest.mark.parametrize("name,total,qr,kr,ts", CASES, ids=[c[0] for c in CASES])
+def test_ncq_zero_qo_comm(name, total, qr, kr, ts, cp):
+    """NCQ: every rank's rects stay inside its own contiguous q shard —
+    no Q/O ever moves — and the partition is still exact."""
+    rects = AttnRectangles.from_ranges(qr, kr, ts)
+    sol = NCQDynamicSolver().solve(rects, cp, total_seqlen=total)
+    assert sum(sol.areas) == rects.area
+    shard = -(-total // cp)
+    for r, rr in enumerate(sol.rank_rects):
+        for rect in rr:
+            assert rect.q_range.start >= r * shard
+            assert rect.q_range.end <= (r + 1) * shard
+    _coverage_exact(sol, qr, kr, ts, total)
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+@pytest.mark.parametrize("name,total,qr,kr,ts", CASES, ids=[c[0] for c in CASES])
+def test_locality_greedy_balances_and_covers(name, total, qr, kr, ts, cp):
+    rects = AttnRectangles.from_ranges(qr, kr, ts)
+    sol = LocalityGreedySolver().solve(rects, cp, total_seqlen=total)
+    assert sum(sol.areas) == rects.area
+    _coverage_exact(sol, qr, kr, ts, total)
+    # strictly better balance than the zero-comm partition on causal masks
+    ncq = NCQDynamicSolver().solve(rects, cp, total_seqlen=total)
+    assert sol.balance_ratio <= ncq.balance_ratio + 1e-9
+
+
+def test_locality_penalty_extremes():
+    """penalty=0 -> pure balance (matches KD-level balance); huge penalty
+    -> identical placement to NCQ (zero moved rows)."""
+    total, cp = 256, 4
+    qr, kr, ts = [(0, 256)], [(0, 256)], [C]
+    rects = AttnRectangles.from_ranges(qr, kr, ts)
+    bal = LocalityGreedySolver(
+        penalty_qo_rows_to_area=0.0, penalty_kv_rows_to_area=0.0
+    ).solve(rects, cp, total_seqlen=total)
+    assert bal.balance_ratio < 1.3
+    sticky = LocalityGreedySolver(
+        penalty_qo_rows_to_area=1e12, penalty_kv_rows_to_area=0.0
+    ).solve(rects, cp, total_seqlen=total)
+    shard = total // cp
+    for r, rr in enumerate(sticky.rank_rects):
+        for rect in rr:
+            assert rect.q_range.start >= r * shard
+            assert rect.q_range.end <= (r + 1) * shard
