@@ -41,5 +41,6 @@ if _level_name:
     logger.propagate = False
 
 from . import common  # noqa: F401,E402
+from .env import recommended_compiler_options  # noqa: F401,E402
 
-__all__ = ["common", "__version__"]
+__all__ = ["common", "recommended_compiler_options", "__version__"]

@@ -45,6 +45,56 @@ from .functools import compute_pad_size, pad_at_dim
 logger = logging.getLogger("magiattention_tpu")
 
 
+def check_flag_comb(
+    *,
+    has_sink: bool = False,
+    cp_axis="cp",
+    uneven_shard: bool = False,
+) -> None:
+    """Central validator of illegal env-flag / argument combinations
+    (reference ``check_flag_comb``, dist_attn_runtime_mgr.py:452-481).
+
+    Raises ``ValueError`` with an explanation instead of letting an
+    unsupported combination fail deep inside planning or — worse —
+    silently compute the wrong thing.
+    """
+    qo = env.is_qo_comm_enable()
+    hier_flag = env.is_hierarchical_comm_enable()
+    hier_axis = isinstance(cp_axis, (tuple, list))
+    backend = env.kernel_backend()
+
+    if backend not in ("pallas", "jnp"):
+        raise ValueError(
+            f"MAGI_ATTENTION_KERNEL_BACKEND={backend!r} is not one of "
+            "('pallas', 'jnp')"
+        )
+    if hier_flag and not hier_axis:
+        raise ValueError(
+            "MAGI_ATTENTION_HIERARCHICAL_COMM=1 requires a 2-D "
+            "(inter, intra) cp_axis tuple — hierarchical comm is selected "
+            "structurally on TPU (pass cp_axis=('dcn', 'ici') over a 2-D "
+            "mesh)"
+        )
+    if qo and hier_axis:
+        raise ValueError(
+            "qo-comm cannot be combined with hierarchical comm (reference "
+            "check_flag_comb forbids MAGI_ATTENTION_QO_COMM x "
+            "MAGI_ATTENTION_HIERARCHICAL_COMM)"
+        )
+    if qo and has_sink:
+        raise ValueError(
+            "qo-comm does not support an attention sink: the sink must "
+            "join the softmax exactly once and qo region partials cannot "
+            "carry it (parallel/qo_comm.py)"
+        )
+    if qo and uneven_shard:
+        raise ValueError(
+            "qo-comm requires an even contiguous shard "
+            "(uneven_shard=False): the dynamic plane partition is built "
+            "over equal per-rank token shards"
+        )
+
+
 @dataclasses.dataclass(frozen=True)
 class DistAttnRuntimeKey:
     """Frozen hash key for one planned runtime
@@ -312,6 +362,20 @@ def magi_attn_flex_key(
     assert not (has_sink and sink is None), (
         "has_sink=True requires the sink array at key-creation time"
     )
+    check_flag_comb(
+        has_sink=has_sink,
+        cp_axis=cp_axis,
+        uneven_shard=dispatch_config.uneven_shard,
+    )
+    if env.is_qo_comm_enable():
+        # qo-comm needs the contiguous shard its plane partition is built
+        # over: force Sequential dispatch (reference qo-comm path keeps the
+        # dispatch meta; our dynamic solver plans in global coordinates)
+        from ..meta.solver.dispatch_solver import SequentialDispatchAlg
+
+        dispatch_config = dataclasses.replace(
+            dispatch_config, alg=SequentialDispatchAlg()
+        )
     sink_fp = (
         hash(np.asarray(jax.device_get(sink), np.float32).tobytes())
         if sink is not None
@@ -355,6 +419,51 @@ def magi_attn_flex_key(
         cp_size=cp_size,
         dispatch_config=dispatch_config,
     )
+    if env.is_qo_comm_enable():
+        # qo-comm mode (reference _make_attn_meta.py:40: DynamicAttnSolver
+        # iff MAGI_ATTENTION_QO_COMM): dynamic plane partition moving Q/O
+        # as well as KV, over the contiguous shard forced above.
+        from ..parallel.qo_comm import (
+            build_qo_comm_plan,
+            make_qo_comm_attn_fn,
+        )
+
+        slices = np.array(
+            [
+                (qr_.start, qr_.end, kr_.start, kr_.end, int(t))
+                for qr_, kr_, t in zip(q_ranges, k_ranges, types)
+            ],
+            dtype=np.int64,
+        )
+        qo_plan = build_qo_comm_plan(
+            slices,
+            total_seqlen_q + pad,
+            cp_size,
+            block_q=env.block_q(),
+            block_k=env.block_k(),
+        )
+        params = make_attn_params(
+            qo_plan,
+            head_dim,
+            softcap=softcap,
+            out_dtype=out_dtype,
+            interpret=interpret,
+        )
+        qo_fn = make_qo_comm_attn_fn(
+            qo_plan, mesh, params, axis_name=cp_axis
+        )
+
+        def attn_fn(q, k, v, sink_override=None):
+            assert sink_override is None, "qo-comm does not support sink"
+            out, lse = qo_fn(q, k, v)
+            return out, lse, None
+
+        mgr = DistAttnRuntimeMgr(
+            key, mesh, mq, qo_plan, attn_fn, dist_attn_config=dist_attn_config
+        )
+        _runtime_dict.put(key, mgr)
+        _most_recent_key = key
+        return key
     plan = build_dist_attn_plan(
         mq,
         bucket,
@@ -465,6 +574,14 @@ def make_flex_key_for_new_mask_after_dispatch(
         "key reuse with an attention sink is not supported: re-key with "
         "magi_attn_flex_key(sink=...) instead"
     )
+    from ..parallel.qo_comm import QoCommPlan
+
+    if isinstance(old_mgr.plan, QoCommPlan):
+        raise ValueError(
+            "key reuse is not supported for qo-comm keys: the dynamic "
+            "plane partition is mask-specific, so there is no dispatch to "
+            "share — create a fresh key with magi_attn_flex_key"
+        )
     if not isinstance(q_ranges, AttnRanges):
         q_ranges = AttnRanges.from_ranges(q_ranges)
     if not isinstance(k_ranges, AttnRanges):

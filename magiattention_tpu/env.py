@@ -108,16 +108,21 @@ def is_backward_high_precision_reduce() -> bool:
 
 
 def is_qo_comm_enable() -> bool:
-    """Informational pointer flag (reference MAGI_ATTENTION_QO_COMM): the
-    qo-comm runtime is entered programmatically via
-    parallel.qo_comm.make_qo_comm_attn_fn."""
+    """Route magi_attn_flex_key through the qo-comm runtime (dynamic
+    plane partition moving Q/O as well as KV — reference
+    MAGI_ATTENTION_QO_COMM, selecting DynamicAttnSolver at
+    _make_attn_meta.py:40). Incompatible with sink / hierarchical comm /
+    uneven shard (check_flag_comb)."""
     return _env_bool("MAGI_ATTENTION_QO_COMM", False)
 
 
 def is_hierarchical_comm_enable() -> bool:
-    """Informational on TPU (reference MAGI_ATTENTION_HIERARCHICAL_COMM):
-    hierarchical comm is selected structurally by passing a 2-D
-    (inter, intra) cp_axis to magi_attn_flex_key."""
+    """Assert-only companion of the structural selection (reference
+    MAGI_ATTENTION_HIERARCHICAL_COMM): hierarchical comm is chosen by
+    passing a 2-D (inter, intra) cp_axis to magi_attn_flex_key; setting
+    this flag with a 1-D cp_axis is rejected by check_flag_comb so a
+    reference-style deployment script fails loudly instead of silently
+    running flat comm."""
     return _env_bool("MAGI_ATTENTION_HIERARCHICAL_COMM", False)
 
 
@@ -144,6 +149,28 @@ def is_profile_mode() -> bool:
     return _env_bool("MAGI_ATTENTION_PROFILE_MODE", False)
 
 
+def recommended_compiler_options() -> dict:
+    """XLA compile options the multi-stage overlap design depends on.
+
+    The runtime's central bet (parallel/dist_attn.py docstring) is that
+    XLA hides the per-stage KV group_cast under the Pallas kernel — the
+    role the reference plays with sm_margin SM reservation and
+    KernelBarrier stream ordering (reference functional/dist_attn.py:
+    1073-1103, :3053-3116). On current TPU toolchains the all-to-all that
+    group_cast lowers to stays *synchronous* unless
+    ``xla_tpu_enable_async_all_to_all`` is set — measured in
+    exps/run_overlap_proof.py: without it zero kernels are scheduled in
+    the collective's in-flight window, with it the host-stage kernel is.
+
+    Pass to jit: ``jax.jit(fn, compiler_options=...)`` (or
+    ``fn.lower(...).compile(compiler_options=...)``).
+    """
+    return {
+        "xla_tpu_enable_latency_hiding_scheduler": "true",
+        "xla_tpu_enable_async_all_to_all": "true",
+    }
+
+
 def flags_fingerprint() -> tuple:
     """The behavior-influencing flags, folded into runtime-key hashing."""
     return (
@@ -159,4 +186,6 @@ def flags_fingerprint() -> tuple:
         is_forward_high_precision_reduce(),
         is_backward_high_precision_reduce(),
         is_auto_range_merge_enable(),
+        is_qo_comm_enable(),
+        is_hierarchical_comm_enable(),
     )

@@ -241,7 +241,14 @@ class MagiLlama:
             )
             return params, opt_state, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        # on TPU, multi-stage overlap needs async all-to-all
+        # (docs/overlap.md; exps/run_overlap_proof.py measures this)
+        opts = None
+        if jax.default_backend() == "tpu":
+            from ..env import recommended_compiler_options
+
+            opts = recommended_compiler_options()
+        return jax.jit(step, donate_argnums=(0, 1), compiler_options=opts)
 
     def make_forward(self):
         tables = self.sharded_tables()

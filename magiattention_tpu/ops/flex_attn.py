@@ -806,6 +806,90 @@ def _pad_tokens(x, target, axis):
     return jnp.pad(x, cfg)
 
 
+def _dense_mask_from_tables(ftab, tqp, tkp, bq, bk):
+    """Materialize the [tqp, tkp] boolean mask the forward entry table
+    describes — the jnp-backend analogue of the kernel's per-tile
+    ``_entry_mask`` walk. Entries of different slices touching the same
+    tile OR together; dummy entries point at the all-zero sentinel slice
+    and contribute nothing."""
+    qblk, kblk, sid, runs, bounds = ftab
+    E = qblk.shape[0]
+
+    def body(e, dense):
+        row0 = qblk[e] * bq
+        col0 = kblk[e] * bk
+        tile = _entry_mask(bounds, runs, sid[e], e, row0, col0, bq, bk)
+        cur = jax.lax.dynamic_slice(dense, (row0, col0), (bq, bk))
+        return jax.lax.dynamic_update_slice(dense, cur | tile, (row0, col0))
+
+    return jax.lax.fori_loop(
+        0, E, body, jnp.zeros((tqp, tkp), jnp.bool_)
+    )
+
+
+def _fwd_jnp(q, k, v, sink2d, ftab, params: FlexAttnParams):
+    """Reference-backend forward (MAGI_ATTENTION_KERNEL_BACKEND=jnp): dense
+    attention over the mask the entry table encodes, in plain jnp.
+
+    Role of the reference's SDPA/SDPA-online backends
+    (functional/sdpa.py, :145/:379): an any-platform, any-dtype (fp64 with
+    jax_enable_x64) path through the *distributed* runtime for precision
+    auditing — it consumes the same tables, casts, and LSE-merge as the
+    Pallas path, swapping only the kernel. Differentiable by construction
+    (no custom vjp), mirroring the Pallas epilogue's exact semantics:
+    uncovered rows read out=0 / lse=-inf (lse=sink when has_sink);
+    rowmax excludes the sink and is non-differentiable.
+    """
+    hq, tqp, d = q.shape
+    hk = k.shape[0]
+    tkp = k.shape[1]
+    group = hq // hk
+    mask = _dense_mask_from_tables(ftab, tqp, tkp, params.block_q, params.block_k)
+
+    acc_t = jnp.promote_types(q.dtype, jnp.float32)
+    kf = jnp.repeat(k, group, axis=0)  # GQA: kv head = h // group
+    vf = jnp.repeat(v, group, axis=0)
+    z = jnp.einsum(
+        "hqd,hkd->hqk", q.astype(acc_t), kf.astype(acc_t)
+    ) * jnp.asarray(params.scale, acc_t)
+    if params.softcap > 0.0:
+        cap = jnp.asarray(params.softcap, acc_t)
+        z = cap * jnp.tanh(z / cap)
+
+    neg = jnp.asarray(NEG_INF, acc_t)
+    s = jnp.where(mask[None], z, neg)
+    m = jnp.max(s, axis=-1)  # [hq, tqp]; -inf where uncovered
+    m_safe = jax.lax.stop_gradient(jnp.where(jnp.isneginf(m), 0.0, m))
+    p = jnp.where(mask[None], jnp.exp(s - m_safe[..., None]), 0.0)
+    l = p.sum(axis=-1)
+    acc = jnp.einsum("hqk,hkd->hqd", p, vf.astype(acc_t))
+    if params.has_sink:
+        sinkc = sink2d[:, :1].astype(acc_t)  # [hq, 1]
+        m_tot = jnp.maximum(m, sinkc)
+        m_tot_safe = jax.lax.stop_gradient(
+            jnp.where(jnp.isneginf(m_tot), 0.0, m_tot)
+        )
+        resc = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m_safe - m_tot_safe))
+        l_tot = l * resc + jnp.exp(sinkc - m_tot_safe)
+        acc = acc * resc[..., None]
+    else:
+        m_tot_safe = m_safe
+        l_tot = l
+    covered = l_tot > 0.0
+    inv = jnp.where(covered, 1.0 / jnp.where(covered, l_tot, 1.0), 0.0)
+    out = acc * inv[..., None]
+    lse = jnp.where(
+        covered,
+        m_tot_safe + jnp.log(jnp.where(covered, l_tot, 1.0)),
+        neg,
+    )
+    lse_lanes = jnp.broadcast_to(lse[..., None], (hq, tqp, LANES))
+    rowmax_lanes = jax.lax.stop_gradient(
+        jnp.broadcast_to(m[..., None], (hq, tqp, LANES))
+    ).astype(jnp.float32)
+    return out.astype(params.out_jnp_dtype), lse_lanes, rowmax_lanes
+
+
 def flex_attn_headmajor(
     q: jax.Array,  # [hq, tq_pad, d] (block-multiple padded)
     k: jax.Array,  # [hk, tk_pad, d]
@@ -819,12 +903,21 @@ def flex_attn_headmajor(
 
     Returns (out [hq, tqp, d], lse_lanes [hq, tqp, LANES], rowmax_lanes).
     Table arrays may be traced (per-rank, sharded) values.
+
+    ``MAGI_ATTENTION_KERNEL_BACKEND=jnp`` swaps the Pallas kernels for the
+    dense jnp reference path (:func:`_fwd_jnp`) — same tables, same
+    semantics, plain-autodiff backward (reference SDPA backend switch,
+    functional/dist_attn.py:1215).
     """
+    from .. import env
+
     hq = q.shape[0]
     if sink is not None:
         sink2d = sink.astype(jnp.float32).reshape(hq, 1)
     else:
         sink2d = jnp.zeros((hq, 1), jnp.float32)
+    if env.kernel_backend() == "jnp":
+        return _fwd_jnp(q, k, v, sink2d, tuple(ftab), params)
     return _flex_attn_core(q, k, v, sink2d, tuple(ftab), tuple(btab), params)
 
 

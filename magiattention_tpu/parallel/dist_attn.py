@@ -839,9 +839,16 @@ def make_dist_attn_fn(
         "params.has_sink must match whether a sink array is provided"
     )
     tables = plan.device_tables()
-    tables = tuple(
-        jax.device_put(t, NamedSharding(mesh, P(axis_name))) for t in tables
-    )
+    if all(d.process_index == jax.process_index() for d in mesh.devices.flat):
+        tables = tuple(
+            jax.device_put(t, NamedSharding(mesh, P(axis_name)))
+            for t in tables
+        )
+    else:
+        # AOT-compilation meshes (jax.experimental.topologies) have
+        # non-addressable devices: keep the tables as host constants and
+        # let jit embed them. Placement is a per-call-cost nicety only.
+        tables = tuple(tables)
     n_tab = len(tables)
     sink_specs = (P(),) if sink is not None else ()
     out_specs = (P(axis_name), P(axis_name))

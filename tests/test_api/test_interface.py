@@ -243,3 +243,82 @@ def test_new_mask_after_dispatch_reuses_partition():
         q, k, v, qr, kr, [AttnMaskType.FULL, AttnMaskType.FULL]
     )
     assert_close(out, ref_out, atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# check_flag_comb + env-flag routing (reference dist_attn_runtime_mgr:452-481)
+# ---------------------------------------------------------------------------
+
+
+def test_check_flag_comb_rejects_illegal_combos(monkeypatch):
+    from magiattention_tpu.api.interface import check_flag_comb
+
+    # legal default
+    check_flag_comb()
+
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "cuda")
+    with pytest.raises(ValueError, match="KERNEL_BACKEND"):
+        check_flag_comb()
+    monkeypatch.delenv("MAGI_ATTENTION_KERNEL_BACKEND")
+
+    monkeypatch.setenv("MAGI_ATTENTION_HIERARCHICAL_COMM", "1")
+    with pytest.raises(ValueError, match="2-D"):
+        check_flag_comb(cp_axis="cp")
+    check_flag_comb(cp_axis=("dcn", "ici"))  # legal with a 2-D axis
+    monkeypatch.delenv("MAGI_ATTENTION_HIERARCHICAL_COMM")
+
+    monkeypatch.setenv("MAGI_ATTENTION_QO_COMM", "1")
+    with pytest.raises(ValueError, match="hierarchical"):
+        check_flag_comb(cp_axis=("dcn", "ici"))
+    with pytest.raises(ValueError, match="sink"):
+        check_flag_comb(has_sink=True)
+    with pytest.raises(ValueError, match="uneven"):
+        check_flag_comb(uneven_shard=True)
+    check_flag_comb()  # qo-comm alone is legal
+
+
+def test_qo_comm_env_flag_routes_api(monkeypatch):
+    """MAGI_ATTENTION_QO_COMM=1 routes magi_attn_flex_key through the
+    dynamic plane-partition runtime (reference _make_attn_meta.py:40)."""
+    monkeypatch.setenv("MAGI_ATTENTION_QO_COMM", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_BLOCK_Q", "64")
+    monkeypatch.setenv("MAGI_ATTENTION_BLOCK_K", "64")
+    cp = 4
+    mesh = _mesh(cp)
+    total = 512
+    hq, hk, d = 4, 2, 32
+    qr = [(0, total)]
+    kr = [(0, total)]
+    ts = [1]
+    key = magi_attn_flex_key(
+        qr, kr, ts, total, total, mesh,
+        num_heads=(hq, hk), head_dim=d, chunk_size=total // (4 * cp),
+        out_dtype="float32",
+    )
+    from magiattention_tpu.parallel.qo_comm import QoCommPlan
+
+    mgr = get_runtime_mgr(key)
+    assert isinstance(mgr.plan, QoCommPlan), "qo flag must select the qo plan"
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+
+    def step(q, k, v):
+        qd, kd, vd = dispatch(q, key), dispatch(k, key), dispatch(v, key)
+        out_d, _ = calc_attn(qd, kd, vd, key)
+        return undispatch(out_d, key)
+
+    out = jax.jit(step)(q, k, v)
+    ref_out, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out, ref_out, atol=2e-5, rtol=2e-5)
+
+    # a distinct (non-qo) key must not collide in the cache
+    monkeypatch.delenv("MAGI_ATTENTION_QO_COMM")
+    key2 = magi_attn_flex_key(
+        qr, kr, ts, total, total, mesh,
+        num_heads=(hq, hk), head_dim=d, chunk_size=total // (4 * cp),
+        out_dtype="float32",
+    )
+    assert key2 != key, "qo flag must be part of the key fingerprint"
