@@ -1,0 +1,177 @@
+"""HF transformers integration: register magiattention-tpu as an attention
+implementation.
+
+Role of reference ``examples/transformers`` (magi_attention_func.py:26-53 +
+run_magi_clm.py:514): the reference registers a custom attention backend
+under ``ALL_ATTENTION_FUNCTIONS`` so any HF model runs MagiAttention by
+setting ``config._attn_implementation`` — model code untouched. This module
+does the same for this framework:
+
+    import examples.transformers_integration as mi
+    mi.register()                       # once per process
+    key = mi.prepare(total, mesh, num_heads, head_dim)   # per mask shape
+    model.set_attn_implementation("magi_attention_tpu")
+
+The registered forward bridges the model's torch tensors to jax, runs the
+key-cached distributed flex attention (``calc_attn``), and returns a torch
+tensor — the ``get_most_recent_key`` convention of the reference
+(magi_attention_func.py:35: the key created most recently for the process
+group is fetched inside the attention call, so the model never sees it).
+
+Scope note, stated honestly: HF's torch models execute on the torch
+device; each attention call crosses host<->device once in each direction.
+That is the right shape for parity demos and CPU validation (this file's
+``main()``), not for TPU production — there, use the jax-native model
+family (``magiattention_tpu/models``) or an HF Flax model. The reference
+has the same split: its transformers example is the integration story,
+Megatron the performance story (SURVEY.md §2.9 examples)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_REGISTERED = False
+
+
+def magi_attention_forward(
+    module,
+    query,  # torch [b, hq, s, d] (post-RoPE)
+    key,  # torch [b, hk, s, d]
+    value,
+    attention_mask,
+    scaling=None,
+    dropout: float = 0.0,
+    **kwargs,
+):
+    """HF attention-interface conformant forward (same contract as
+    transformers.integrations.sdpa_attention.sdpa_attention_forward:
+    returns (attn_output [b, s, hq, d], attn_weights=None))."""
+    import jax.numpy as jnp
+    import torch
+
+    from magiattention_tpu.api import calc_attn, dispatch, undispatch
+    from magiattention_tpu.api import get_most_recent_key
+
+    assert dropout == 0.0, "attention dropout is not supported"
+    b, hq, s, d = query.shape
+    assert scaling is None or abs(scaling - d ** -0.5) < 1e-9, (
+        f"model uses a non-default attention scale {scaling} (default "
+        f"{d ** -0.5:.6f}); the bridged key is planned with 1/sqrt(d) — "
+        "unsupported, would silently mis-scale logits"
+    )
+    assert b == 1, (
+        "the magi attention backend follows the reference's packed-varlen "
+        "convention: squash the batch into one stream (reference "
+        "squash_batch_dim, api/functools.py) and express samples as a "
+        "varlen mask"
+    )
+    k = get_most_recent_key()
+    assert k.total_seqlen_q - k.pad_size == s, (
+        f"most-recent key plans {k.total_seqlen_q - k.pad_size} tokens, "
+        f"attention got {s}: create the key for this sequence length first"
+    )
+
+    def to_jax(t):  # [1, h, s, d] torch -> [s, h, d] jax
+        return jnp.asarray(
+            t[0].permute(1, 0, 2).detach().cpu().numpy()
+        )
+
+    qj, kj, vj = to_jax(query), to_jax(key), to_jax(value)
+    qd, kd, vd = dispatch(qj, k), dispatch(kj, k), dispatch(vj, k)
+    out_d, _ = calc_attn(qd, kd, vd, k)
+    out = undispatch(out_d, k)  # [s, hq, d]
+    t = torch.from_numpy(__import__("numpy").asarray(out).copy())
+    return t.to(query.dtype).unsqueeze(0), None
+
+
+def register() -> None:
+    """Register 'magi_attention_tpu' with transformers (idempotent)."""
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    from transformers.modeling_utils import ALL_ATTENTION_FUNCTIONS
+
+    ALL_ATTENTION_FUNCTIONS.register(
+        "magi_attention_tpu", magi_attention_forward
+    )
+    _REGISTERED = True
+
+
+def prepare(
+    total: int,
+    mesh,
+    num_heads: tuple[int, int],
+    head_dim: int,
+    *,
+    cu_seqlens=None,
+    chunk_size: int | None = None,
+):
+    """Create (and make most-recent) the runtime key the registered
+    forward will fetch — causal over the full stream by default, or
+    per-document causal when ``cu_seqlens`` is given (the reference
+    example's per-step varlen key, examples/torch_native/main.py:242)."""
+    from magiattention_tpu.api import magi_attn_flex_key
+
+    if cu_seqlens is not None:
+        from magiattention_tpu.api import infer_attn_mask_from_cu_seqlens
+
+        qr, kr, ts = infer_attn_mask_from_cu_seqlens(cu_seqlens)
+        qr, kr = qr.to_naive_ranges(), kr.to_naive_ranges()
+        ts = [int(t) for t in ts]
+    else:
+        qr, kr, ts = [(0, total)], [(0, total)], [1]
+    return magi_attn_flex_key(
+        qr, kr, ts, total, total, mesh,
+        num_heads=num_heads, head_dim=head_dim,
+        chunk_size=chunk_size, out_dtype="float32",
+    )
+
+
+def main() -> None:
+    """CPU demo: tiny HF Llama, magi backend vs eager attention."""
+    import jax
+
+    if os.environ.get("MAGI_EXAMPLE_REAL_DEVICES") != "1":
+        # the axon TPU plugin ignores the JAX_PLATFORMS env var; the
+        # platform must be forced through jax.config before backend init
+        # (same workaround as tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import torch
+    from jax.sharding import Mesh
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    register()
+    cfg = LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=512,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(cfg).eval()
+
+    total = 256
+    mesh = Mesh(np.array(jax.devices()[:1]), ("cp",))
+    prepare(total, mesh, (4, 2), cfg.hidden_size // 4, chunk_size=64)
+
+    ids = torch.randint(0, cfg.vocab_size, (1, total))
+    with torch.no_grad():
+        model.set_attn_implementation("eager")
+        ref = model(ids).logits
+        model.set_attn_implementation("magi_attention_tpu")
+        out = model(ids).logits
+    err = (out - ref).abs().max().item()
+    print(f"max |logits diff| vs eager: {err:.2e}")
+    assert err < 1e-3, "magi attention diverges from eager"
+    print("transformers integration OK")
+
+
+if __name__ == "__main__":
+    main()

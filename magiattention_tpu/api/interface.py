@@ -47,7 +47,6 @@ logger = logging.getLogger("magiattention_tpu")
 
 def check_flag_comb(
     *,
-    has_sink: bool = False,
     cp_axis="cp",
     uneven_shard: bool = False,
 ) -> None:
@@ -80,12 +79,6 @@ def check_flag_comb(
             "qo-comm cannot be combined with hierarchical comm (reference "
             "check_flag_comb forbids MAGI_ATTENTION_QO_COMM x "
             "MAGI_ATTENTION_HIERARCHICAL_COMM)"
-        )
-    if qo and has_sink:
-        raise ValueError(
-            "qo-comm does not support an attention sink: the sink must "
-            "join the softmax exactly once and qo region partials cannot "
-            "carry it (parallel/qo_comm.py)"
         )
     if qo and uneven_shard:
         raise ValueError(
@@ -363,7 +356,6 @@ def magi_attn_flex_key(
         "has_sink=True requires the sink array at key-creation time"
     )
     check_flag_comb(
-        has_sink=has_sink,
         cp_axis=cp_axis,
         uneven_shard=dispatch_config.uneven_shard,
     )
@@ -450,12 +442,11 @@ def magi_attn_flex_key(
             interpret=interpret,
         )
         qo_fn = make_qo_comm_attn_fn(
-            qo_plan, mesh, params, axis_name=cp_axis
+            qo_plan, mesh, params, axis_name=cp_axis, sink=sink
         )
 
         def attn_fn(q, k, v, sink_override=None):
-            assert sink_override is None, "qo-comm does not support sink"
-            out, lse = qo_fn(q, k, v)
+            out, lse = qo_fn(q, k, v, sink_override)
             return out, lse, None
 
         mgr = DistAttnRuntimeMgr(

@@ -1,5 +1,19 @@
 """Benchmark harness (reference ``magi_attention/benchmarking/``)."""
 
-from .bench import BenchResult, do_bench, perf_report
+from .bench import (
+    Benchmark,
+    BenchResult,
+    Mark,
+    do_bench,
+    perf_grid,
+    perf_report,
+)
 
-__all__ = ["BenchResult", "do_bench", "perf_report"]
+__all__ = [
+    "Benchmark",
+    "BenchResult",
+    "Mark",
+    "do_bench",
+    "perf_grid",
+    "perf_report",
+]

@@ -1,0 +1,134 @@
+"""Structural typing protocols for the planning data structures.
+
+Role of reference ``common/protocols.py`` (478 LoC of ``typing.Protocol``
+classes keeping the Python and C++ data-structure backends
+interchangeable): this repo's native seam is narrower by design — the C++
+accelerator (csrc/entry_table.cpp) exposes *functions* over flat numpy
+buffers rather than mirrored classes — so the protocols here pin down
+
+1. the interval-algebra surface the solvers rely on
+   (:class:`RangeProtocol`, :class:`RangesProtocol`,
+   :class:`RectangleProtocol`), and
+2. the callable contracts of the accelerator seam
+   (:class:`EntryEmitter`, :class:`SliceAreaFn`) which both the Python
+   fallback and the ctypes-loaded native implementation must satisfy.
+
+tests/test_common/test_protocols.py asserts conformance of every concrete
+implementation (and, via the byte-parity tests of
+tests/test_ops/test_cpp_ext.py, behavioral equivalence of the two
+accelerator backends)."""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence, runtime_checkable
+
+import numpy as np
+
+
+@runtime_checkable
+class RangeProtocol(Protocol):
+    """[start, end) interval algebra (reference common/range.py)."""
+
+    @property
+    def start(self) -> int: ...
+
+    @property
+    def end(self) -> int: ...
+
+    @property
+    def seqlen(self) -> int: ...
+
+    def clone(self): ...
+
+    def offset(self, offset: int): ...
+
+    def intersect(self, other): ...
+
+    def intersect_size(self, other) -> int: ...
+
+    def union(self, other): ...
+
+    def diff_by(self, other): ...
+
+    def is_subrange_of(self, other) -> bool: ...
+
+    def is_overlap_with(self, other) -> bool: ...
+
+    def is_empty(self) -> bool: ...
+
+
+@runtime_checkable
+class RangesProtocol(Protocol):
+    """Ordered list-of-ranges set algebra (reference common/ranges.py)."""
+
+    def append(self, attn_range, check: bool = False) -> None: ...
+
+    def merge(self): ...
+
+    def chunk(self, chunk_size: int, check: bool = True): ...
+
+    def make_ranges_local(self, ranges, check: bool = False): ...
+
+    def find_hole_ranges(self, other, check: bool = False): ...
+
+    def find_overlap_ranges(self, other): ...
+
+    def to_naive_ranges(self): ...
+
+    def is_sorted(self) -> bool: ...
+
+    def is_non_overlap(self) -> bool: ...
+
+    @property
+    def total_seqlen(self) -> int: ...
+
+
+@runtime_checkable
+class RectangleProtocol(Protocol):
+    """One 2-D (q x k) workload region for the dynamic solver
+    (reference common/rectangle.py)."""
+
+    @property
+    def area(self) -> int: ...
+
+    def cut_q(self, pos: int): ...
+
+    def cut_k_multi(self, positions): ...
+
+
+@runtime_checkable
+class RectanglesProtocol(Protocol):
+    """Rectangle collection with plane-cut partitioning
+    (reference common/rectangles.py)."""
+
+    def area(self) -> int: ...
+
+    def cut_q(self, pos: int): ...
+
+    def cut_k(self, pos: int): ...
+
+
+@runtime_checkable
+class EntryEmitter(Protocol):
+    """The entry-table hot loop: (slices, runs, blocking) -> entry tuples.
+
+    Implementations: ops.block_meta._emit_entries (Python) and
+    csrc.emit_entries_native (C++ via ctypes) — byte-parity-tested."""
+
+    def __call__(
+        self,
+        slices: np.ndarray,
+        q_runs: Sequence,
+        k_runs: Sequence,
+        block_q: int,
+        block_k: int,
+    ) -> list: ...
+
+
+@runtime_checkable
+class SliceAreaFn(Protocol):
+    """Exact-area computation over slices restricted to runs."""
+
+    def __call__(
+        self, slices: np.ndarray, q_runs: Sequence, k_runs: Sequence
+    ) -> int: ...

@@ -111,8 +111,9 @@ def is_qo_comm_enable() -> bool:
     """Route magi_attn_flex_key through the qo-comm runtime (dynamic
     plane partition moving Q/O as well as KV — reference
     MAGI_ATTENTION_QO_COMM, selecting DynamicAttnSolver at
-    _make_attn_meta.py:40). Incompatible with sink / hierarchical comm /
-    uneven shard (check_flag_comb)."""
+    _make_attn_meta.py:40). Incompatible with hierarchical comm and
+    uneven shard (check_flag_comb); sink is supported via the post-merge
+    fold (parallel/qo_comm.py)."""
     return _env_bool("MAGI_ATTENTION_QO_COMM", False)
 
 

@@ -1,0 +1,12 @@
+"""Drop-in attention extensions (reference ``extensions/magi_attn_extensions``):
+sink-augmented standard-attention wrappers and a DSA-style top-k sparse
+attention interface."""
+
+from .dsa import dsa_attn_func, dsa_topk_blocks
+from .sink_attention import flash_attention_with_sink
+
+__all__ = [
+    "dsa_attn_func",
+    "dsa_topk_blocks",
+    "flash_attention_with_sink",
+]

@@ -1,0 +1,121 @@
+"""DSA-style top-k sparse attention (reference ``extensions/magi_attn_
+extensions/dsa_interface.py`` — DeepSeek Sparse Attention interface).
+
+DSA = a cheap *indexer* scores candidate KV regions per query, keeps the
+top-k, and the expensive attention runs only over the selection. The
+reference routes this through FlashMLA's sparse kernels; the TPU design
+routes it through the natively block-sparse entry-table kernel
+(ops/index_attn.py): the indexer works at (block_q x block_k) tile
+granularity — mean-pooled q/k block embeddings score tiles, top-k tiles
+per q-block survive — and the selection drives ``index_attn_func``.
+
+TPU constraint, stated honestly: the entry-table plan is host-side, so the
+*selection* is a host value and each distinct selection compiles its own
+plan (cached). That fits DSA's deployment shape — selection computed once
+per prefill/sequence, reused across layers/steps — but means the indexer
+output must come back to host (one small [nq, topk] transfer), unlike the
+reference's fully on-device path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dsa_topk_blocks(
+    q: jax.Array,  # [tq, hq, d]
+    k: jax.Array,  # [tk, hk, d]
+    topk: int,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    causal: bool = True,
+) -> np.ndarray:
+    """The indexer: score (q-block, k-block) tiles by pooled dot product
+    and keep the top-``topk`` k-blocks per q-block.
+
+    Returns host int [num_q_blocks, topk] (entries -1 where fewer than
+    topk blocks are visible — e.g. early causal rows). Diagonal blocks are
+    always kept under ``causal`` (a row must at least see itself).
+    """
+    tq, hq, d = q.shape
+    tk = k.shape[0]
+    nq = -(-tq // block_q)
+    nk = -(-tk // block_k)
+
+    qp = jnp.pad(q.astype(jnp.float32), ((0, nq * block_q - tq), (0, 0), (0, 0)))
+    kp = jnp.pad(k.astype(jnp.float32), ((0, nk * block_k - tk), (0, 0), (0, 0)))
+    # mean-pool tokens within a block and heads (the "lightning indexer"
+    # role: a few-FLOP proxy for the block's attention mass)
+    qb = qp.reshape(nq, block_q, hq, d).mean(axis=(1, 2))  # [nq, d]
+    kb = kp.reshape(nk, block_k, k.shape[1], d).mean(axis=(1, 2))  # [nk, d]
+    scores = qb @ kb.T  # [nq, nk]
+
+    s = np.array(jax.device_get(scores))  # owned copy: we edit in place
+    if causal:
+        off = tk - tq
+        for i in range(nq):
+            # k blocks fully above the diagonal of q block i are invisible
+            q_hi = min((i + 1) * block_q, tq) - 1
+            for j in range(nk):
+                if j * block_k > q_hi + off:
+                    s[i, j] = -np.inf
+            # the diagonal block is mandatory — unless this q block sees
+            # no keys at all (q_hi + off < 0 when tk < tq)
+            if q_hi + off >= 0:
+                dj = min((q_hi + off) // block_k, nk - 1)
+                s[i, dj] = np.inf
+    kk = min(topk, nk)
+    idx = np.argsort(-s, axis=1)[:, :kk]
+    sel = np.where(
+        np.take_along_axis(s, idx, axis=1) == -np.inf, -1, idx
+    ).astype(np.int64)
+    if kk < topk:
+        sel = np.pad(sel, ((0, 0), (0, topk - kk)), constant_values=-1)
+    return sel
+
+
+def dsa_attn_func(
+    q: jax.Array,  # [tq, hq, d]
+    k: jax.Array,  # [tk, hk, d]
+    v: jax.Array,
+    *,
+    topk: int,
+    causal: bool = True,
+    kv_block_indices: np.ndarray | None = None,  # precomputed selection
+    block_q: int = 128,
+    block_k: int = 128,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    sink: jax.Array | None = None,
+    out_dtype=None,
+    interpret: bool | None = None,
+):
+    """Top-k block-sparse attention: indexer -> selection -> sparse kernel
+    (the DSA pipeline). Pass ``kv_block_indices`` to reuse a selection
+    across layers/steps (the intended DSA deployment shape); otherwise the
+    indexer runs on (q, k) of this call.
+
+    Returns (out [tq, hq, d], lse [tq, hq])."""
+    from ..ops.index_attn import index_attn_func
+
+    if kv_block_indices is None:
+        kv_block_indices = dsa_topk_blocks(
+            q, k, topk, block_q=block_q, block_k=block_k, causal=causal
+        )
+    return index_attn_func(
+        q,
+        k,
+        v,
+        kv_block_indices,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        scale=scale,
+        softcap=softcap,
+        sink=sink,
+        out_dtype=out_dtype,
+        interpret=interpret,
+    )

@@ -1,0 +1,70 @@
+"""Attention-sink wrappers for standard (non-distributed) attention.
+
+Role of reference ``extensions/magi_attn_extensions/fa{2,3,4}_interface_
+with_sink.py``: drop-in replacements for plain flash-attention calls that
+add a learned per-head sink logit to the softmax denominator (GPT-OSS /
+StreamingLLM-style), so frameworks can adopt sinks without touching their
+attention plumbing. The TPU analogue wraps this repo's flex kernel — sink
+is first-class in-kernel here, so the wrapper is a thin layout adapter
+rather than a rescale post-pass."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.flex_attn import flex_flash_attn_func
+
+
+def flash_attention_with_sink(
+    q: jax.Array,  # [batch, seqlen, hq, d] (flash-attention layout)
+    k: jax.Array,  # [batch, seqlen, hk, d]
+    v: jax.Array,
+    sink: jax.Array,  # [hq] learned sink logits
+    *,
+    causal: bool = False,
+    window: int | None = None,  # sliding-window size (causal SWA)
+    softcap: float = 0.0,
+    scale: float | None = None,
+    return_lse: bool = False,
+    interpret: bool | None = None,
+):
+    """Batched standard attention with an attention sink.
+
+    Matches the reference sink-interface contract: same signature shape as
+    a flash-attention call plus ``sink``; a zero-filled sink reproduces
+    plain attention exactly. ``window`` adds causal sliding-window masking
+    (reference SWA benchmark config, cp_benchmark.md:21-29).
+    """
+    assert q.ndim == 4, f"expected [b, s, h, d], got {q.shape}"
+    b, t, hq, d = q.shape
+    assert sink.shape == (hq,), f"sink must be [hq]={hq}, got {sink.shape}"
+
+    if window is not None:
+        from ..api.functools import infer_attn_mask_from_sliding_window
+
+        qr, kr, ts = infer_attn_mask_from_sliding_window(t, window)
+        qr, kr = qr.to_naive_ranges(), kr.to_naive_ranges()
+        ts = [int(x) for x in ts]
+    else:
+        qr, kr, ts = [(0, t)], [(0, t)], [1 if causal else 0]
+
+    def one(qb, kb, vb):
+        out, lse = flex_flash_attn_func(
+            qb,
+            kb,
+            vb,
+            qr,
+            kr,
+            ts,
+            scale=scale,
+            softcap=softcap,
+            sink=sink,
+            interpret=interpret,
+        )[:2]
+        return out, lse
+
+    out, lse = jax.vmap(one)(q, k, v)
+    if return_lse:
+        return out, lse
+    return out

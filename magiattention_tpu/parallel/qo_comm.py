@@ -210,12 +210,22 @@ def qo_comm_attn_local(
     params: FlexAttnParams,
     *,
     axis_name: str = "cp",
+    sink: jax.Array | None = None,  # [hq] learned sink logits (replicated)
 ):
     """Inside shard_map: cast Q + KV to region owners, partial attn,
-    lse-reduce O back to Q owners. Returns (out [shard, hq, d], lse)."""
+    lse-reduce O back to Q owners. Returns (out [shard, hq, d], lse).
+
+    ``sink``: a q row's softmax is split across region partials on
+    different ranks, so the sink cannot ride the kernel (it would join the
+    denominator once per region). Instead the partials are lse-merged
+    sink-free and the owner rank folds the sink in once afterwards via the
+    rescale identity ``lse' = logaddexp(lse, sink)``,
+    ``out' = out * exp(lse - lse')`` — exactly the reference's
+    sink-once-per-row semantics (functional/utils.py:561-677), and
+    differentiable in the sink by plain autodiff."""
     assert not params.has_sink, (
-        "attention sink is not supported by the qo-comm runtime (the sink "
-        "must join the softmax exactly once; region partials cannot carry it)"
+        "qo-comm applies the sink post-merge: build params with "
+        "has_sink=False and pass the sink array to this function instead"
     )
     assert (
         params.block_q == plan.block_q and params.block_k == plan.block_k
@@ -253,6 +263,15 @@ def qo_comm_attn_local(
         q_seg,
         axis_name=axis_name,
     )
+    if sink is not None:
+        s = sink.astype(jnp.float32)[None, :]  # [1, hq]
+        # rows with lse=-inf (uncovered) end at lse'=sink, out stays 0 —
+        # the Pallas epilogue's uncovered-row-with-sink behavior
+        lse_tot = jnp.logaddexp(lse, s)
+        out = out * jnp.where(
+            jnp.isneginf(lse), 0.0, jnp.exp(lse - lse_tot)
+        )[..., None]
+        lse = lse_tot
     return out.astype(params.out_jnp_dtype), lse
 
 
@@ -262,6 +281,7 @@ def make_qo_comm_attn_fn(
     params: FlexAttnParams,
     *,
     axis_name: str = "cp",
+    sink: jax.Array | None = None,  # [hq] default sink (traceable override)
 ):
     """Jittable fn over contiguously sharded [total, h, d] arrays."""
     from jax import shard_map
@@ -272,20 +292,30 @@ def make_qo_comm_attn_fn(
         for t in plan.device_tables()
     )
     n_tab = len(tables)
+    sink_specs = (P(),) if sink is not None else ()
 
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(axis_name),) * 3 + (P(axis_name),) * n_tab,
+        in_specs=(P(axis_name),) * 3 + (P(axis_name),) * n_tab + sink_specs,
         out_specs=(P(axis_name), P(axis_name)),
         check_vma=False,
     )
-    def _local(q, k, v, *tabs):
+    def _local(q, k, v, *rest):
+        tabs = rest[:n_tab]
+        s = rest[n_tab] if len(rest) > n_tab else None
         return qo_comm_attn_local(
-            q, k, v, tabs, plan, params, axis_name=axis_name
+            q, k, v, tabs, plan, params, axis_name=axis_name, sink=s
         )
 
-    def fn(q, k, v):
-        return _local(q, k, v, *tables)
+    def fn(q, k, v, sink_override=None):
+        s = sink if sink_override is None else sink_override
+        if sink is None:
+            assert sink_override is None, (
+                "this qo attn fn was built without a sink: rebuild with "
+                "make_qo_comm_attn_fn(..., sink=...)"
+            )
+            return _local(q, k, v, *tables)
+        return _local(q, k, v, *tables, s)
 
     return fn

@@ -270,11 +270,9 @@ def test_check_flag_comb_rejects_illegal_combos(monkeypatch):
     monkeypatch.setenv("MAGI_ATTENTION_QO_COMM", "1")
     with pytest.raises(ValueError, match="hierarchical"):
         check_flag_comb(cp_axis=("dcn", "ici"))
-    with pytest.raises(ValueError, match="sink"):
-        check_flag_comb(has_sink=True)
     with pytest.raises(ValueError, match="uneven"):
         check_flag_comb(uneven_shard=True)
-    check_flag_comb()  # qo-comm alone is legal
+    check_flag_comb()  # qo-comm alone is legal (sink folds post-merge)
 
 
 def test_qo_comm_env_flag_routes_api(monkeypatch):

@@ -1,0 +1,64 @@
+"""Benchmark harness: grid runner, CSV/plot artifacts, do_bench sanity."""
+
+import os
+
+import jax.numpy as jnp
+
+from magiattention_tpu.benchmarking import (
+    Benchmark,
+    do_bench,
+    perf_grid,
+    perf_report,
+)
+
+
+def test_do_bench_times_and_memory():
+    f = lambda x: jnp.sum(x * x)
+    x = jnp.ones((256, 256), jnp.float32)
+    r = do_bench(f, x, warmup=1, rep=3, inner=2, record_memory=True)
+    assert r.min_ms <= r.median_ms <= r.max_ms
+    assert r.tflops(1e9) > 0
+
+
+def test_perf_grid_runs_and_writes_artifacts(tmp_path):
+    calls = []
+
+    @perf_grid(
+        Benchmark(
+            x_name="seqlen",
+            x_vals=[128, 256],
+            line_arg="impl",
+            line_vals=["a", "b"],
+            plot_name="toy",
+            args={"fixed": 7},
+        )
+    )
+    def bench_fn(seqlen, impl, fixed):
+        calls.append((seqlen, impl, fixed))
+        return float(seqlen) * (1.0 if impl == "a" else 2.0)
+
+    rows = bench_fn.run(print_data=False, save_path=str(tmp_path))
+    assert calls == [
+        (128, "a", 7), (128, "b", 7), (256, "a", 7), (256, "b", 7)
+    ]
+    assert rows[0] == {"seqlen": 128, "a": 128.0, "b": 256.0}
+    assert os.path.exists(tmp_path / "toy.csv")
+    assert os.path.exists(tmp_path / "toy.png")
+    txt = perf_report(rows)
+    assert "seqlen" in txt and "256.0" in txt
+
+
+def test_perf_grid_dict_results():
+    @perf_grid(
+        Benchmark(
+            x_name="n",
+            x_vals=[1],
+            line_arg="impl",
+            line_vals=["x"],
+        )
+    )
+    def bench_fn(n, impl):
+        return {"ms": 1.5, "tflops": 2.0}
+
+    rows = bench_fn.run(print_data=False)
+    assert rows == [{"n": 1, "x_ms": 1.5, "x_tflops": 2.0}]

@@ -1,0 +1,49 @@
+"""Protocol conformance: concrete implementations satisfy the structural
+contracts (reference tests/test_common/test_protocol_conformance.py)."""
+
+from magiattention_tpu.common.protocols import (
+    EntryEmitter,
+    RangeProtocol,
+    RangesProtocol,
+    RectangleProtocol,
+    RectanglesProtocol,
+    SliceAreaFn,
+)
+
+
+def test_range_conformance():
+    from magiattention_tpu.common.range import AttnRange
+
+    assert isinstance(AttnRange(0, 4), RangeProtocol)
+
+
+def test_ranges_conformance():
+    from magiattention_tpu.common.ranges import AttnRanges
+
+    assert isinstance(AttnRanges.from_ranges([(0, 4)]), RangesProtocol)
+
+
+def test_rectangle_conformance():
+    from magiattention_tpu.common.range import AttnRange
+    from magiattention_tpu.common.rectangle import (
+        AttnRectangle,
+        AttnRectangles,
+    )
+
+    r = AttnRectangle(AttnRange(0, 4), AttnRange(0, 4))
+    assert isinstance(r, RectangleProtocol)
+    rs = AttnRectangles.from_ranges([(0, 4)], [(0, 4)], [0])
+    assert isinstance(rs, RectanglesProtocol)
+
+
+def test_entry_emitter_conformance():
+    """Both accelerator backends satisfy the callable contracts."""
+    from magiattention_tpu.csrc import (
+        emit_entries_native,
+        slice_area_runs_native,
+    )
+    from magiattention_tpu.ops.block_meta import _emit_entries
+
+    assert isinstance(_emit_entries, EntryEmitter)
+    assert isinstance(emit_entries_native, EntryEmitter)
+    assert isinstance(slice_area_runs_native, SliceAreaFn)

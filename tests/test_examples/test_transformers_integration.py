@@ -1,0 +1,80 @@
+"""HF transformers attention-backend registration (reference
+examples/transformers: magi_attention_func.py + run_magi_clm.py:514)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def test_registered_backend_matches_eager():
+    import jax
+    from jax.sharding import Mesh
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import examples.transformers_integration as mi
+
+    mi.register()
+    mi.register()  # idempotent
+
+    cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(cfg).eval()
+
+    total = 128
+    mesh = Mesh(np.array(jax.devices()[:2]), ("cp",))
+    # per-document causal over two packed docs — the varlen shape the
+    # reference example builds per training step
+    mi.prepare(
+        total, mesh, (2, 2), cfg.hidden_size // 2,
+        cu_seqlens=[0, 48, 128], chunk_size=16,
+    )
+
+    ids = torch.randint(0, cfg.vocab_size, (1, total))
+    # eager reference with the same per-doc block-causal structure:
+    # document boundaries via a 2-D additive mask is awkward in HF Llama;
+    # instead compare on the magi side against full-stream causal with a
+    # SINGLE doc, where eager is exact
+    mi.prepare(total, mesh, (2, 2), cfg.hidden_size // 2, chunk_size=16)
+    with torch.no_grad():
+        model.set_attn_implementation("eager")
+        ref = model(ids).logits
+        model.set_attn_implementation("magi_attention_tpu")
+        out = model(ids).logits
+    assert (out - ref).abs().max().item() < 1e-3
+
+
+def test_backend_rejects_batched_input():
+    import jax
+    from jax.sharding import Mesh
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import examples.transformers_integration as mi
+
+    mi.register()
+    cfg = LlamaConfig(
+        vocab_size=64,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=1,
+        num_attention_heads=2,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+    )
+    model = LlamaForCausalLM(cfg).eval()
+    mesh = Mesh(np.array(jax.devices()[:1]), ("cp",))
+    mi.prepare(64, mesh, (2, 2), 16, chunk_size=16)
+    model.set_attn_implementation("magi_attention_tpu")
+    ids = torch.randint(0, cfg.vocab_size, (2, 64))
+    with pytest.raises(AssertionError, match="squash"):
+        with torch.no_grad():
+            model(ids)

@@ -1,0 +1,161 @@
+"""Extensions package: sink wrappers + DSA top-k sparse attention
+(reference extensions/magi_attn_extensions tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.extensions import (
+    dsa_attn_func,
+    dsa_topk_blocks,
+    flash_attention_with_sink,
+)
+from magiattention_tpu.testing import assert_close, ref_attn_from_ranges
+
+
+def _qkv(b, t, hq, hk, d, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, t, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, hk, d)), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_sink_wrapper_matches_oracle(causal):
+    b, t, hq, hk, d = 2, 256, 4, 2, 32
+    q, k, v = _qkv(b, t, hq, hk, d)
+    sink = jnp.asarray([0.5, -0.3, 0.1, 0.9], jnp.float32)
+    out = flash_attention_with_sink(q, k, v, sink, causal=causal)
+    qr, kr, ts = [(0, t)], [(0, t)], [1 if causal else 0]
+    for i in range(b):
+        ref, _, _ = ref_attn_from_ranges(
+            q[i], k[i], v[i], qr, kr, ts, sink=sink
+        )
+        assert_close(out[i], ref, atol=3e-5, rtol=3e-5, msg=f"batch {i}")
+
+
+def test_sink_wrapper_zero_sink_is_not_plain_attention():
+    """A sink logit of 0 still contributes exp(0)=1 to the denominator —
+    the wrapper must NOT silently equal sink-free attention."""
+    b, t, hq, hk, d = 1, 128, 2, 2, 32
+    q, k, v = _qkv(b, t, hq, hk, d)
+    sink = jnp.zeros((hq,), jnp.float32)
+    out = flash_attention_with_sink(q, k, v, sink, causal=True)
+    ref_plain, ref_lse, _ = ref_attn_from_ranges(
+        q[0], k[0], v[0], [(0, t)], [(0, t)], [1]
+    )
+    # rescale identity: out_sink = out_plain * exp(lse - logaddexp(lse, 0))
+    resc = jnp.exp(ref_lse - jnp.logaddexp(ref_lse, 0.0))[..., None]
+    assert_close(out[0], ref_plain * resc, atol=3e-5, rtol=3e-5)
+
+
+def test_sink_wrapper_sliding_window():
+    b, t, hq, hk, d = 1, 256, 2, 2, 32
+    q, k, v = _qkv(b, t, hq, hk, d)
+    sink = jnp.asarray([0.2, -0.4], jnp.float32)
+    w = 64
+    out = flash_attention_with_sink(q, k, v, sink, window=w)
+    from magiattention_tpu.api import infer_attn_mask_from_sliding_window
+
+    qr, kr, ts = infer_attn_mask_from_sliding_window(t, w)
+    ref, _, _ = ref_attn_from_ranges(
+        q[0], k[0], v[0],
+        qr.to_naive_ranges(), kr.to_naive_ranges(), [int(x) for x in ts],
+        sink=sink,
+    )
+    assert_close(out[0], ref, atol=3e-5, rtol=3e-5)
+
+
+def test_dsa_full_topk_equals_dense():
+    t, hq, hk, d = 256, 2, 2, 32
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((t, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((t, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((t, hk, d)), jnp.float32)
+    nk = t // 64
+    out, lse = dsa_attn_func(
+        q, k, v, topk=nk, causal=True, block_q=64, block_k=64
+    )
+    ref, ref_lse, _ = ref_attn_from_ranges(q, k, v, [(0, t)], [(0, t)], [1])
+    assert_close(out, ref, atol=3e-5, rtol=3e-5)
+    assert_close(lse, ref_lse, atol=3e-5, rtol=3e-5)
+
+
+def test_dsa_sparse_selection_matches_manual_oracle():
+    t, hq, hk, d = 512, 2, 2, 32
+    bq = bk = 64
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((t, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((t, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((t, hk, d)), jnp.float32)
+    topk = 3
+    sel = dsa_topk_blocks(q, k, topk, block_q=bq, block_k=bk, causal=True)
+    nq, nk = t // bq, t // bk
+    assert sel.shape == (nq, topk)
+    # diagonal block always selected; nothing above the diagonal
+    for i in range(nq):
+        kept = sel[i][sel[i] >= 0]
+        assert i in kept, f"diagonal block missing for q block {i}"
+        assert (kept <= i).all(), "selected a block above the causal diagonal"
+
+    out, _ = dsa_attn_func(
+        q, k, v, topk=topk, causal=True,
+        kv_block_indices=sel, block_q=bq, block_k=bk,
+    )
+
+    # manual oracle over the same selection (token-level causal inside)
+    qr_list, kr_list, ts_list = [], [], []
+    for i in range(nq):
+        for j in sorted(sel[i][sel[i] >= 0]):
+            q0, q1 = i * bq, (i + 1) * bq
+            k0, k1 = int(j) * bk, (int(j) + 1) * bk
+            if k1 - 1 <= q0:
+                ts_ = 0
+            else:
+                ts_ = 1
+                k1 = min(k1, q1)
+            qr_list.append((q0, q1))
+            kr_list.append((k0, k1))
+            ts_list.append(ts_)
+    ref, _, _ = ref_attn_from_ranges(q, k, v, qr_list, kr_list, ts_list)
+    assert_close(out, ref, atol=3e-5, rtol=3e-5)
+
+
+def test_dsa_selection_reuse_is_cached():
+    """Passing kv_block_indices reuses the plan cache across calls."""
+    t, hq, hk, d = 256, 2, 2, 32
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((t, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((t, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((t, hk, d)), jnp.float32)
+    sel = dsa_topk_blocks(q, k, 2, block_q=64, block_k=64, causal=True)
+    o1, _ = dsa_attn_func(
+        q, k, v, topk=2, causal=True, kv_block_indices=sel,
+        block_q=64, block_k=64,
+    )
+    o2, _ = dsa_attn_func(
+        q, 2 * k, v, topk=2, causal=True, kv_block_indices=sel,
+        block_q=64, block_k=64,
+    )
+    assert o1.shape == o2.shape
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
+def test_dsa_topk_short_kv_no_causal_leak():
+    """tk < tq: early q blocks see no keys at all — the mandatory-diagonal
+    rule must not wrap to a future block (regression: negative index)."""
+    tq, tk, hq, d = 512, 128, 2, 32
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((tq, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((tk, hq, d)), jnp.float32)
+    sel = dsa_topk_blocks(q, k, 1, block_q=128, block_k=128, causal=True)
+    off = tk - tq
+    for i in range(sel.shape[0]):
+        q_hi = (i + 1) * 128 - 1
+        kept = sel[i][sel[i] >= 0]
+        if q_hi + off < 0:
+            assert len(kept) == 0, f"q block {i} sees no keys but selected"
+        else:
+            assert (kept * 128 <= q_hi + off).all(), "future block selected"

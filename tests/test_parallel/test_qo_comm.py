@@ -99,3 +99,41 @@ def test_qo_comm_pipeline(name, total, slices, cp, solver_kind):
     )(q, k, v)
     for a, b, nm in zip(g, gr, ["dq", "dk", "dv"]):
         assert_close(a, b, atol=1e-4, rtol=1e-4, msg=f"qo {name} cp{cp} {nm}")
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+def test_qo_comm_sink(cp):
+    """Sink through qo-comm: folded in post-merge at the owner rank
+    exactly once (reference composes sink with every path)."""
+    total, hq, hk, d = 512, 2, 2, 64
+    mesh = _mesh(cp)
+    sl = np.asarray(
+        [(0, 192, 0, 192, 1), (192, 448, 0, 448, 1), (448, 512, 192, 512, 0)],
+        np.int64,
+    )
+    plan = build_qo_comm_plan(sl, total, cp, block_q=64, block_k=64)
+    params = _params(d)
+    sink = jnp.asarray([0.3, -0.7], jnp.float32)
+    fn = make_qo_comm_attn_fn(plan, mesh, params, sink=sink)
+
+    qr = [(int(s[0]), int(s[1])) for s in sl]
+    kr = [(int(s[2]), int(s[3])) for s in sl]
+    ts = [int(s[4]) for s in sl]
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    out, lse = jax.jit(fn)(q, k, v)
+    ref_out, ref_lse, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts, sink=sink)
+    assert_close(out, ref_out, atol=3e-5, rtol=3e-5, msg="qo sink out")
+    assert_close(lse, ref_lse, atol=3e-5, rtol=3e-5, msg="qo sink lse")
+
+    # sink gradient flows (traced override argument)
+    do = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    gs = jax.jit(
+        jax.grad(lambda s: (fn(q, k, v, s)[0] * do).sum())
+    )(sink)
+    gr = jax.grad(
+        lambda s: (ref_attn_from_ranges(q, k, v, qr, kr, ts, sink=s)[0] * do).sum()
+    )(sink)
+    assert_close(gs, gr, atol=1e-4, rtol=1e-4, msg="qo dsink")
