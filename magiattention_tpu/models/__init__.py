@@ -1,5 +1,22 @@
 """Model families built on the framework."""
 
+from .dit import (
+    DiTConfig,
+    MagiDiT,
+    build_magi_dit,
+    chunk_causal_mask,
+    init_dit_params,
+)
 from .llama import LlamaConfig, MagiLlama, build_magi_llama, init_params
 
-__all__ = ["LlamaConfig", "MagiLlama", "build_magi_llama", "init_params"]
+__all__ = [
+    "DiTConfig",
+    "LlamaConfig",
+    "MagiDiT",
+    "MagiLlama",
+    "build_magi_dit",
+    "build_magi_llama",
+    "chunk_causal_mask",
+    "init_dit_params",
+    "init_params",
+]

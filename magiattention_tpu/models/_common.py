@@ -1,0 +1,29 @@
+"""Shared model-bundle helpers (one copy for every model family)."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def sharded_plan_tables(plan, mesh, cp_axis: str):
+    """The plan's device tables placed P(cp_axis) — or left as host
+    constants when the mesh has non-addressable devices (AOT-compilation
+    topologies), where placement is impossible and jit embeds them."""
+    tables = plan.device_tables()
+    if all(
+        d.process_index == jax.process_index() for d in mesh.devices.flat
+    ):
+        spec = NamedSharding(mesh, P(cp_axis))
+        return tuple(jax.device_put(t, spec) for t in tables)
+    return tuple(tables)
+
+
+def tpu_compiler_options():
+    """jit compiler options for the train step: async-a2a overlap on TPU
+    (docs/overlap.md), None elsewhere (the options are TPU-only)."""
+    if jax.default_backend() == "tpu":
+        from ..env import recommended_compiler_options
+
+        return recommended_compiler_options()
+    return None

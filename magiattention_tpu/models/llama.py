@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.dist_attn import (
     DistAttnPlan,
@@ -222,10 +222,9 @@ class MagiLlama:
         return _local(params, tokens, labels, pos, *tables)
 
     def sharded_tables(self):
-        spec = NamedSharding(self.mesh, P(self.cp_axis))
-        return tuple(
-            jax.device_put(t, spec) for t in self.plan.device_tables()
-        )
+        from ._common import sharded_plan_tables
+
+        return sharded_plan_tables(self.plan, self.mesh, self.cp_axis)
 
     def make_train_step(self, optimizer):
         """optax-style optimizer -> jitted (params, opt_state, batch) step."""
@@ -241,14 +240,11 @@ class MagiLlama:
             )
             return params, opt_state, loss
 
-        # on TPU, multi-stage overlap needs async all-to-all
-        # (docs/overlap.md; exps/run_overlap_proof.py measures this)
-        opts = None
-        if jax.default_backend() == "tpu":
-            from ..env import recommended_compiler_options
+        from ._common import tpu_compiler_options
 
-            opts = recommended_compiler_options()
-        return jax.jit(step, donate_argnums=(0, 1), compiler_options=opts)
+        return jax.jit(
+            step, donate_argnums=(0, 1), compiler_options=tpu_compiler_options()
+        )
 
     def make_forward(self):
         tables = self.sharded_tables()

@@ -1,0 +1,129 @@
+"""Randomized property tests for the range set-algebra against an
+integer-set oracle (the coverage depth of reference
+tests/test_common/test_attn_ranges.py, 1045 LoC, as properties rather
+than enumerated cases)."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.common.range import AttnRange
+from magiattention_tpu.common.ranges import AttnRanges
+
+
+def _rand_ranges(rng, n, hi, allow_overlap=True):
+    rs = AttnRanges()
+    for _ in range(n):
+        a = int(rng.integers(0, hi - 1))
+        b = int(rng.integers(a + 1, hi + 1))
+        rs.append(AttnRange(a, b))
+    if not allow_overlap:
+        rs = rs.merge()
+    return rs
+
+
+def _as_set(rs: AttnRanges) -> set:
+    out = set()
+    for r in rs:
+        out |= set(range(r.start, r.end))
+    return out
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_merge_equals_set_and_is_canonical(seed):
+    rng = np.random.default_rng(seed)
+    rs = _rand_ranges(rng, int(rng.integers(1, 10)), 200)
+    m = rs.merge()
+    assert _as_set(m) == _as_set(rs)
+    assert m.is_sorted() and m.is_merged() and m.is_non_overlap()
+    # merged ranges are maximal: no two adjacent ranges touch
+    naive = m.to_naive_ranges()
+    for (a0, a1), (b0, b1) in zip(naive, naive[1:]):
+        assert a1 < b0
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_chunk_partitions_exactly(seed):
+    rng = np.random.default_rng(seed)
+    rs = _rand_ranges(rng, int(rng.integers(1, 8)), 300, allow_overlap=False)
+    if rs.total_seqlen == 0:
+        return
+    chunk = int(rng.integers(1, rs.total_seqlen + 1))
+    chunks = rs.chunk(chunk, check=False)
+    # chunks tile the token set exactly, in order, each <= chunk tokens
+    got = []
+    for c in chunks:
+        n = sum(r.seqlen for r in c)
+        assert 0 < n <= chunk
+        for r in c:
+            got.extend(range(r.start, r.end))
+    want = sorted(_as_set(rs))
+    assert got == want
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_find_hole_ranges_is_set_difference(seed):
+    rng = np.random.default_rng(seed)
+    need = _rand_ranges(rng, int(rng.integers(1, 8)), 200, allow_overlap=False)
+    have = _rand_ranges(rng, int(rng.integers(1, 8)), 200, allow_overlap=False)
+    holes = need.find_hole_ranges(have)
+    assert _as_set(holes) == (_as_set(need) - _as_set(have))
+    assert holes.is_non_overlap()
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_find_overlap_ranges_is_set_intersection(seed):
+    rng = np.random.default_rng(seed)
+    a = _rand_ranges(rng, int(rng.integers(1, 8)), 200, allow_overlap=False)
+    b = _rand_ranges(rng, int(rng.integers(1, 8)), 200, allow_overlap=False)
+    ov = a.find_overlap_ranges(b)
+    assert _as_set(ov) == (_as_set(a) & _as_set(b))
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_make_ranges_local_roundtrip(seed):
+    """Local coordinates: position p global -> index of p within the host
+    token list. Translating sub-ranges of the host set must preserve the
+    token multiset under the host's global->local order isomorphism."""
+    rng = np.random.default_rng(seed)
+    host = _rand_ranges(rng, int(rng.integers(1, 8)), 200, allow_overlap=False)
+    host = host.merge()
+    toks = sorted(_as_set(host))
+    if not toks:
+        return
+    # random sub-selection of host tokens, as ranges
+    mask = rng.random(len(toks)) < 0.5
+    sel_tokens = [t for t, m in zip(toks, mask) if m]
+    sub = AttnRanges()
+    i = 0
+    while i < len(sel_tokens):
+        j = i + 1
+        while j < len(sel_tokens) and sel_tokens[j] == sel_tokens[j - 1] + 1:
+            j += 1
+        sub.append(AttnRange(sel_tokens[i], sel_tokens[j - 1] + 1))
+        i = j
+    if len(sub) == 0:
+        return
+    local = host.make_ranges_local(sub)
+    glob_to_loc = {t: i for i, t in enumerate(toks)}
+    want = sorted(glob_to_loc[t] for t in sel_tokens)
+    assert sorted(_as_set(local)) == want
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_merge_with_split_alignment_properties(seed):
+    rng = np.random.default_rng(seed)
+    rs = _rand_ranges(rng, int(rng.integers(1, 8)), 256, allow_overlap=False)
+    align = int(rng.choice([2, 4, 16, 32]))
+    m = rs.merge_with_split_alignment(align)
+    # outward rounding: an aligned, merged SUPERSET of the token set whose
+    # expansion stays within the rounding slack (reference split_alignment
+    # machinery, dist_attn_solver.py:107-179)
+    assert _as_set(m) >= _as_set(rs)
+    assert m.is_sorted() and m.is_non_overlap()
+    for a, b in m.to_naive_ranges():
+        assert a % align == 0 and b % align == 0, (a, b, align)
+    # each aligned range only covers tokens within `align-1` of a real one
+    covered = _as_set(rs)
+    for t in _as_set(m) - covered:
+        lo = t // align * align
+        assert any(lo <= u < lo + align for u in covered), t

@@ -1,0 +1,139 @@
+"""Randomized plan-level invariants: zero-redundancy, exactness, area
+conservation — over random masks x dispatch algs x cp sizes (the property
+form of reference tests/test_attn_solver/test_dist_attn_solver.py's
+expected-meta checks)."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.mask import make_attn_mask_from_ranges
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.meta.dispatch_meta import make_dispatch_meta_from_qk_ranges
+from magiattention_tpu.meta.solver.dispatch_solver import (
+    DispatchConfig,
+    MinHeapDispatchAlg,
+    SequentialDispatchAlg,
+    ToppHeapDispatchAlg,
+)
+from magiattention_tpu.parallel.dist_attn import build_dist_attn_plan
+
+F, C, I, B = (
+    AttnMaskType.FULL,
+    AttnMaskType.CAUSAL,
+    AttnMaskType.INVCAUSAL,
+    AttnMaskType.BICAUSAL,
+)
+
+
+def _rand_mask(rng, total):
+    """Random non-overlapping (q, k, type) slice list: varlen docs with a
+    random type per doc, occasionally a shared-context slice."""
+    cuts = [0]
+    while cuts[-1] < total:
+        cuts.append(
+            min(cuts[-1] + int(rng.integers(1, 5)) * (total // 8), total)
+        )
+    qr, kr, ts = [], [], []
+    for a, b in zip(cuts, cuts[1:]):
+        t = rng.choice([F, C, I, B])
+        k0 = 0 if rng.random() < 0.3 else a  # some docs see a prefix too
+        qr.append((a, b))
+        kr.append((k0, b))
+        ts.append(t)
+    return qr, kr, ts
+
+
+def _decode_recv_rows(meta, dispatch_meta, dst):
+    """Global k rows rank ``dst`` receives, decoded from the comm meta."""
+    S = meta.max_send
+    pos_by_rank = [
+        dispatch_meta.position_ids(r) for r in range(meta.cp_size)
+    ]
+    rows = []
+    for out_pos in range(meta.recv_total[dst]):
+        flat = int(meta.recv_sel[dst, out_pos])
+        src, p = divmod(flat, S)
+        local = int(meta.send_idx[src, dst, p])
+        rows.append(int(pos_by_rank[src][local]))
+    return rows
+
+
+@pytest.mark.parametrize("alg", ["minheap", "sequential", "topp"])
+@pytest.mark.parametrize("seed", range(6))
+def test_plan_zero_redundancy_and_exactness(seed, alg):
+    rng = np.random.default_rng(seed)
+    total = 512
+    cp = int(rng.choice([2, 4]))
+    chunk = int(rng.choice([32, 64]))
+    qr, kr, ts = _rand_mask(rng, total)
+    algo = {
+        "minheap": MinHeapDispatchAlg,
+        "sequential": SequentialDispatchAlg,
+        "topp": lambda: ToppHeapDispatchAlg(top_p=0.5),
+    }[alg]()
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr), ts,
+        total, total, chunk_size=chunk, cp_size=cp,
+        dispatch_config=DispatchConfig(alg=algo),
+    )
+    plan = build_dist_attn_plan(mq, bucket, block_q=32, block_k=32)
+
+    # area conservation: solver areas == dense-mask popcount, globally and
+    # per rank (the FLOPs ledger the load balancing relies on)
+    dense = np.asarray(
+        make_attn_mask_from_ranges(qr, kr, ts, total, total)
+    )
+    assert bucket.area == int(dense.sum())
+    rank_rows = [mq.position_ids(r) for r in range(cp)]
+    per_rank_pop = [int(dense[rows].sum()) for rows in rank_rows]
+    assert sum(per_rank_pop) == int(dense.sum())
+    assert plan.total_area == int(dense.sum())
+    assert plan.max_rank_area == max(per_rank_pop)
+
+    # exact remote set per rank: needed = union of this rank's slice
+    # k-ranges; hole = needed \ host; recv must equal hole EXACTLY
+    chunks_by_id = {c.chunk_id: c for c in bucket.q_chunks}
+    for r in range(cp):
+        host = set(int(x) for x in rank_rows[r])
+        needed = set()
+        for cid in mq.partitions[r]:
+            for s in chunks_by_id[cid].attn_slices:
+                needed.update(range(s.k_range.start, s.k_range.end))
+        hole = needed - host
+        recv = _decode_recv_rows(plan.comm, mq, r)
+        assert len(recv) == len(set(recv)), f"rank {r}: duplicate recv rows"
+        assert set(recv) == hole, (
+            f"rank {r}: recv != exact hole set "
+            f"(extra={sorted(set(recv) - hole)[:5]}, "
+            f"missing={sorted(hole - set(recv))[:5]})"
+        )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_staged_plan_partitions_the_merged_recv(seed):
+    """Degree-N stages: per-rank stage recv sets must be disjoint and
+    union to the degree-0 recv set (stages re-route, never duplicate)."""
+    rng = np.random.default_rng(100 + seed)
+    total, cp, chunk = 512, 4, 32
+    qr, kr, ts = _rand_mask(rng, total)
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr), ts,
+        total, total, chunk_size=chunk, cp_size=cp,
+        dispatch_config=DispatchConfig(alg=MinHeapDispatchAlg()),
+    )
+    from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+
+    plan0 = build_dist_attn_plan(mq, bucket, block_q=32, block_k=32)
+    planN = build_dist_attn_plan(
+        mq, bucket, block_q=32, block_k=32,
+        overlap_config=OverlapConfig(degree=3, min_stage_rows=1),
+    )
+    for r in range(cp):
+        merged = set(_decode_recv_rows(plan0.comm, mq, r))
+        staged = []
+        for sp in planN.stages:
+            staged.append(set(_decode_recv_rows(sp.comm, mq, r)))
+        flat = [x for s in staged for x in s]
+        assert len(flat) == len(set(flat)), f"rank {r}: stage overlap"
+        assert set(flat) == merged, f"rank {r}: staged union != merged"
