@@ -93,6 +93,20 @@ def main() -> None:
         help="per-doc causal (default) or --no-causal for full varlen",
     )
     p.add_argument(
+        "--mask",
+        default="doc",
+        choices=["doc", "video"],
+        help="doc = varlen doc-length-distribution mask (reference "
+        "exps/dist_attn benchmark shape); video = Magi-1 chunked AR "
+        "video mask (chunk_causal_mask, models/dit.py)",
+    )
+    p.add_argument(
+        "--video-chunk",
+        type=int,
+        default=None,
+        help="AR video chunk tokens for --mask video (default total/8)",
+    )
+    p.add_argument(
         "--wallclock",
         action="store_true",
         help="also measure single-chip kernel wall-clock on the mask (TPU)",
@@ -116,8 +130,15 @@ def main() -> None:
     )
 
     rng = np.random.default_rng(args.seed)
-    cuts = sample_doc_cuts(args.total, rng, args.mean_doc)
-    qr, kr, ts = doc_mask(cuts, causal=args.causal)
+    if args.mask == "video":
+        from magiattention_tpu.models import chunk_causal_mask
+
+        qr, kr, ts = chunk_causal_mask(
+            args.total, args.video_chunk or args.total // 8
+        )
+    else:
+        cuts = sample_doc_cuts(args.total, rng, args.mean_doc)
+        qr, kr, ts = doc_mask(cuts, causal=args.causal)
     total = args.total
     cp = args.cp
     chunk = args.chunk or max(total // (8 * cp), 128)
