@@ -133,9 +133,9 @@ def main() -> None:
     if args.mask == "video":
         from magiattention_tpu.models import chunk_causal_mask
 
-        qr, kr, ts = chunk_causal_mask(
-            args.total, args.video_chunk or args.total // 8
-        )
+        vc = args.video_chunk if args.video_chunk is not None else args.total // 8
+        assert vc > 0, f"--video-chunk must be positive, got {vc}"
+        qr, kr, ts = chunk_causal_mask(args.total, vc)
     else:
         cuts = sample_doc_cuts(args.total, rng, args.mean_doc)
         qr, kr, ts = doc_mask(cuts, causal=args.causal)

@@ -19,10 +19,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from run_overlap_proof import analyze_schedule, build_step  # noqa: E402
 
-BASE = {
-    "xla_tpu_enable_latency_hiding_scheduler": "true",
-    "xla_tpu_enable_async_all_to_all": "true",
-}
+def _base_options():
+    from magiattention_tpu.env import recommended_compiler_options
+
+    return dict(recommended_compiler_options())
 
 # candidate option sets layered on BASE; names probed, unknown -> skipped
 CANDIDATES = [
@@ -63,12 +63,12 @@ def main():
 
     rows = []
     for degree in [int(x) for x in args.degrees.split(",")]:
-        fn, shapes, plan = build_step(
+        fn, shapes, _plan = build_step(
             args.total, args.cp, degree, 8, 8, 128, devs
         )
         lowered = fn.lower(*shapes)
         for name, extra in CANDIDATES:
-            opts = dict(BASE)
+            opts = _base_options()
             opts.update(extra)
             try:
                 compiled = lowered.compile(compiler_options=opts)
@@ -83,7 +83,7 @@ def main():
             print(
                 f"degree={degree} {name}: async={r['n_async']} "
                 f"sync={r['n_sync']} overlapped={r['n_overlapped']} "
-                f"windows={[(s, d, i) for s, d, i in r['pairs']]}",
+                f"windows={r['pairs']}",
                 file=sys.stderr,
             )
 
