@@ -987,6 +987,10 @@ _AUTO_BLOCK_CONFIGS: tuple[tuple[int, int, int], ...] = (
     (128, 512, 8),
     (256, 512, 4),
     (256, 1024, 2),
+    # 128k-dense escalation: 256 q-blocks x 64 k-blocks keeps the entry
+    # count (~17k) under the smem budget; head-per-step keeps the K/V
+    # double-buffering within scoped vmem
+    (512, 2048, 1),
 )
 _MAX_SMEM_ENTRIES = 24000
 
@@ -1003,7 +1007,11 @@ def _est_entries(q_ranges, k_ranges, bq: int, bk: int) -> int:
 
 def _auto_head_block(pref: int, hq: int, group: int) -> int:
     """Largest head_block <= pref that divides hq and is a multiple of the
-    GQA group (falls back to the group itself)."""
+    GQA group (falls back to the group itself). pref=1 is always honored:
+    head-per-step is valid for any group and is the vmem floor the large-
+    block escalation rung is sized against."""
+    if pref <= 1:
+        return 1
     best = group if hq % group == 0 else 1
     c = group
     while c <= min(pref, hq):

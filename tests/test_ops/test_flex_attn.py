@@ -246,3 +246,20 @@ def test_head_batched_kernel(hq, hk, hb):
     )(q, k, v)
     for a, b, nm in zip(g, gr, "qkv"):
         assert_close(a, b, atol=5e-5, rtol=5e-5, msg=f"hb{hb} d{nm}")
+
+
+def test_large_block_escalation_config():
+    """The (512, 2048) escalation rung (128k-dense smem fit) computes the
+    same results as default blocking."""
+    t, hq, hk, d = 4096, 2, 2, 32
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((t, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((t, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((t, hk, d)), jnp.float32)
+    qr, kr, ts = [(0, t)], [(0, t)], [C]
+    out, lse = flex_flash_attn_func(
+        q, k, v, qr, kr, ts, block_q=512, block_k=2048, head_block=1
+    )[:2]
+    ref, ref_lse, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out, ref, atol=3e-5, rtol=3e-5)
+    assert_close(lse, ref_lse, atol=3e-5, rtol=3e-5)
