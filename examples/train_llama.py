@@ -8,6 +8,13 @@ shard_map.
 Runs anywhere: with no TPU it simulates an 8-device CPU mesh.
 
     python examples/train_llama.py --steps 5 --total 2048 --cp 4 --dp 2
+
+Optionally composes tensor parallelism (--tp, Megatron-style head/FFN
+sharding) and pipeline parallelism (--pp, GPipe over ppermute) with the
+CP attention — the reference covers these only via a Megatron README
+patch (examples/megatron):
+
+    python examples/train_llama.py --pp 2 --dp 1 --cp 2 --tp 2
 """
 
 import argparse
@@ -24,6 +31,8 @@ def main() -> None:
     p.add_argument("--total", type=int, default=2048, help="tokens per stream")
     p.add_argument("--cp", type=int, default=4)
     p.add_argument("--dp", type=int, default=2)
+    p.add_argument("--tp", type=int, default=1, help="tensor parallel size")
+    p.add_argument("--pp", type=int, default=1, help="pipeline parallel size")
     p.add_argument("--dim", type=int, default=256)
     p.add_argument("--layers", type=int, default=4)
     p.add_argument("--heads", type=int, default=8)
@@ -33,7 +42,7 @@ def main() -> None:
     p.add_argument("--lr", type=float, default=3e-4)
     args = p.parse_args()
 
-    n_dev = args.cp * args.dp
+    n_dev = args.cp * args.dp * args.tp * args.pp
     if "xla_force_host_platform_device_count" not in os.environ.get(
         "XLA_FLAGS", ""
     ):
@@ -59,7 +68,9 @@ def main() -> None:
     from magiattention_tpu.models import (
         LlamaConfig,
         build_magi_llama,
+        build_magi_llama_pp,
         init_params,
+        init_pp_params,
     )
     from magiattention_tpu.parallel import dispatch
 
@@ -73,16 +84,26 @@ def main() -> None:
         ffn_hidden=args.dim * 2,
         dtype="float32" if jax.default_backend() == "cpu" else "bfloat16",
     )
-    mesh = Mesh(
-        np.array(jax.devices()[:n_dev]).reshape(args.dp, args.cp),
-        ("dp", "cp"),
-    )
+    tp_axis = "tp" if args.tp > 1 else None
+    devs = np.array(jax.devices()[:n_dev])
+    if args.pp > 1:
+        mesh = Mesh(
+            devs.reshape(args.pp, args.dp, args.cp, args.tp),
+            ("pp", "dp", "cp", "tp"),
+        )
+    elif args.tp > 1:
+        mesh = Mesh(
+            devs.reshape(args.dp, args.cp, args.tp), ("dp", "cp", "tp")
+        )
+    else:
+        mesh = Mesh(devs.reshape(args.dp, args.cp), ("dp", "cp"))
     print(f"mesh: {mesh}", flush=True)
 
     # a packed varlen batch: three documents per stream (block-causal mask)
     doc_lens = [args.total // 2, args.total // 4, args.total // 4]
     qr, kr, ts = infer_varlen_mask_from_batch(doc_lens)
-    model, meta = build_magi_llama(
+    build = build_magi_llama_pp if args.pp > 1 else build_magi_llama
+    model, meta = build(
         cfg,
         mesh,
         args.total,
@@ -90,6 +111,7 @@ def main() -> None:
         kr,
         ts,
         chunk_size=args.chunk,
+        tp_axis=tp_axis,
         block_q=64,
         block_k=64,
     )
@@ -99,17 +121,25 @@ def main() -> None:
         flush=True,
     )
 
-    params = init_params(jax.random.PRNGKey(0), cfg)
+    if args.pp > 1:
+        params = init_pp_params(jax.random.PRNGKey(0), cfg)
+        batch_rows = args.dp * 2  # two GPipe microbatches per dp rank
+    else:
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        batch_rows = args.dp
     opt = optax.adamw(args.lr)
     opt_state = opt.init(params)
     step_fn = model.make_train_step(opt)
 
     rng = np.random.default_rng(0)
-    pos = jnp.broadcast_to(jnp.asarray(meta.perm_idx), (args.dp, args.total))
+    pos = jnp.broadcast_to(
+        jnp.asarray(meta.perm_idx), (batch_rows, args.total)
+    )
 
     for step in range(args.steps):
         tokens_g = jnp.asarray(
-            rng.integers(0, cfg.vocab_size, (args.dp, args.total)), jnp.int32
+            rng.integers(0, cfg.vocab_size, (batch_rows, args.total)),
+            jnp.int32,
         )
         labels_g = jnp.roll(tokens_g, -1, axis=1)
         tokens = jax.vmap(lambda x: dispatch(x, meta))(tokens_g)

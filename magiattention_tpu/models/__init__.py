@@ -8,15 +8,25 @@ from .dit import (
     init_dit_params,
 )
 from .llama import LlamaConfig, MagiLlama, build_magi_llama, init_params
+from .llama_pp import (
+    MagiLlamaPP,
+    build_magi_llama_pp,
+    init_pp_params,
+    stack_layer_params,
+)
 
 __all__ = [
     "DiTConfig",
     "LlamaConfig",
     "MagiDiT",
     "MagiLlama",
+    "MagiLlamaPP",
     "build_magi_dit",
     "build_magi_llama",
+    "build_magi_llama_pp",
     "chunk_causal_mask",
     "init_dit_params",
     "init_params",
+    "init_pp_params",
+    "stack_layer_params",
 ]

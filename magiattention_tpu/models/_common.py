@@ -3,7 +3,24 @@
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def masked_ce_sums(logits, labels):
+    """(sum of CE over positions with label >= 0, count of them).
+
+    The single definition of the next-token loss — MagiLlama and
+    MagiLlamaPP must stay numerically identical through it.
+    """
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    tok_loss = -jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+    return (
+        jnp.where(valid, tok_loss, 0.0).sum(),
+        valid.sum().astype(jnp.float32),
+    )
 
 
 def sharded_plan_tables(plan, mesh, cp_axis: str):
@@ -17,6 +34,77 @@ def sharded_plan_tables(plan, mesh, cp_axis: str):
         spec = NamedSharding(mesh, P(cp_axis))
         return tuple(jax.device_put(t, spec) for t in tables)
     return tuple(tables)
+
+
+def plan_flex_attn(
+    cfg,
+    mesh,
+    total_seqlen,
+    q_ranges,
+    k_ranges,
+    attn_type_map,
+    *,
+    chunk_size: int,
+    cp_axis: str,
+    tp_axis: str | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+):
+    """Shared builder tail for every Llama-family bundle: validate tp
+    divisibility, build the dispatch meta + CP plan for one mask, and
+    derive the kernel params. Returns (plan, attn_params, dispatch_meta)."""
+    from .. import env
+    from ..common.enum import AttnMaskType
+    from ..meta.dispatch_meta import make_dispatch_meta_from_qk_ranges
+    from ..parallel.dist_attn import build_dist_attn_plan, make_attn_params
+
+    if tp_axis is not None:
+        tp = mesh.shape[tp_axis]
+        if cfg.n_heads % tp or cfg.n_kv_heads % tp:
+            raise ValueError(
+                f"tp={tp} must divide n_heads={cfg.n_heads} and "
+                f"n_kv_heads={cfg.n_kv_heads}"
+            )
+    cp_size = mesh.shape[cp_axis]
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        q_ranges,
+        k_ranges,
+        [AttnMaskType(int(t)) for t in attn_type_map],
+        total_seqlen,
+        total_seqlen,
+        chunk_size=chunk_size,
+        cp_size=cp_size,
+    )
+    plan = build_dist_attn_plan(
+        mq,
+        bucket,
+        block_q=block_q or env.block_q(),
+        block_k=block_k or env.block_k(),
+    )
+    attn_params = make_attn_params(
+        plan, cfg.head_dim, out_dtype=cfg.dtype, interpret=interpret
+    )
+    return plan, attn_params, mq
+
+
+def make_model_train_step(model, optimizer):
+    """optax-style optimizer -> jitted (params, opt_state, batch) step.
+
+    Works for any bundle exposing ``loss_fn`` + ``sharded_tables``."""
+    tables = model.sharded_tables()
+
+    def step(params, opt_state, tokens, labels, pos):
+        loss, grads = jax.value_and_grad(model.loss_fn)(
+            params, tokens, labels, pos, tables
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(
+        step, donate_argnums=(0, 1), compiler_options=tpu_compiler_options()
+    )
 
 
 def tpu_compiler_options():

@@ -30,6 +30,7 @@ from ..parallel.dist_attn import (
     make_attn_params,
 )
 from ..ops.flex_attn import FlexAttnParams
+from ._common import masked_ce_sums
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,24 +113,40 @@ def _layer_local(
     plan: DistAttnPlan,
     attn_params: FlexAttnParams,
     axis_name: str,
+    tp_axis: str | None = None,
 ):
+    """One decoder layer on this rank's dispatched tokens.
+
+    With ``tp_axis``, the layer params arrive column-sharded (wq/wk/wv,
+    w_gate/w_up) / row-sharded (wo, w_down) over that mesh axis —
+    Megatron-style tensor parallelism (reference ships TP only as a
+    README patch, examples/megatron): each tp rank owns a head group and
+    an FFN slice, and the two row-parallel matmuls end in a psum.
+    Head counts are inferred from the (possibly sharded) weight shapes.
+    """
     dt = cfg.jnp_dtype
     h = _rms_norm(x, layer["attn_norm"])
     t = h.shape[0]
-    q = (h @ layer["wq"].astype(dt)).reshape(t, cfg.n_heads, cfg.head_dim)
-    k = (h @ layer["wk"].astype(dt)).reshape(t, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ layer["wv"].astype(dt)).reshape(t, cfg.n_kv_heads, cfg.head_dim)
+    q = (h @ layer["wq"].astype(dt)).reshape(t, -1, cfg.head_dim)
+    k = (h @ layer["wk"].astype(dt)).reshape(t, -1, cfg.head_dim)
+    v = (h @ layer["wv"].astype(dt)).reshape(t, -1, cfg.head_dim)
     q = _rope(q, pos, cfg.rope_theta, cfg.head_dim)
     k = _rope(k, pos, cfg.rope_theta, cfg.head_dim)
     out, _, _ = dist_attn_local(
         q, k, v, tables, plan, attn_params, axis_name=axis_name
     )
-    x = x + out.reshape(t, -1) @ layer["wo"].astype(dt)
+    attn_out = out.reshape(t, -1) @ layer["wo"].astype(dt)
+    if tp_axis is not None:
+        attn_out = jax.lax.psum(attn_out, tp_axis)
+    x = x + attn_out
 
     h = _rms_norm(x, layer["mlp_norm"])
     gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
     up = h @ layer["w_up"].astype(dt)
-    x = x + (gate * up) @ layer["w_down"].astype(dt)
+    mlp_out = (gate * up) @ layer["w_down"].astype(dt)
+    if tp_axis is not None:
+        mlp_out = jax.lax.psum(mlp_out, tp_axis)
+    x = x + mlp_out
     return x
 
 
@@ -142,13 +159,14 @@ def forward_local(
     plan: DistAttnPlan,
     attn_params: FlexAttnParams,
     axis_name: str = "cp",
+    tp_axis: str | None = None,
 ):
     """Per-cp-rank forward over dispatched tokens -> logits [t_loc, vocab]."""
     dt = cfg.jnp_dtype
     x = params["embed"].astype(dt)[tokens]
     for layer in params["layers"]:
         x = _layer_local(
-            x, pos, layer, cfg, tables, plan, attn_params, axis_name
+            x, pos, layer, cfg, tables, plan, attn_params, axis_name, tp_axis
         )
     x = _rms_norm(x, params["final_norm"])
     return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
@@ -168,6 +186,35 @@ class MagiLlama:
     attn_params: FlexAttnParams
     cp_axis: str = "cp"
     dp_axis: str = "dp"
+    tp_axis: str | None = None
+
+    def param_specs(self):
+        """PartitionSpec pytree for the parameter pytree.
+
+        Without tp: everything replicated. With tp: Megatron column/row
+        sharding on the per-layer weights; embed / lm_head / norms stay
+        replicated (vocab is small relative to the layer stack).
+        """
+        if self.tp_axis is None:
+            return P()
+        tp = self.tp_axis
+        layer_spec = {
+            "wq": P(None, tp),
+            "wk": P(None, tp),
+            "wv": P(None, tp),
+            "wo": P(tp, None),
+            "w_gate": P(None, tp),
+            "w_up": P(None, tp),
+            "w_down": P(tp, None),
+            "attn_norm": P(),
+            "mlp_norm": P(),
+        }
+        return {
+            "embed": P(),
+            "layers": [layer_spec] * self.cfg.n_layers,
+            "final_norm": P(),
+            "lm_head": P(),
+        }
 
     def loss_fn(self, params, tokens, labels, pos, tables):
         """Mean next-token CE over valid (label >= 0) positions."""
@@ -178,7 +225,7 @@ class MagiLlama:
             shard_map,
             mesh=self.mesh,
             in_specs=(
-                P(),  # params replicated
+                self.param_specs(),
                 P(self.dp_axis, self.cp_axis),
                 P(self.dp_axis, self.cp_axis),
                 P(self.dp_axis, self.cp_axis),
@@ -198,17 +245,9 @@ class MagiLlama:
                     self.plan,
                     self.attn_params,
                     self.cp_axis,
+                    self.tp_axis,
                 )
-                logp = jax.nn.log_softmax(logits, axis=-1)
-                valid = lab1 >= 0
-                safe_lab = jnp.where(valid, lab1, 0)
-                tok_loss = -jnp.take_along_axis(
-                    logp, safe_lab[:, None], axis=1
-                )[:, 0]
-                return (
-                    jnp.where(valid, tok_loss, 0.0).sum(),
-                    valid.sum().astype(jnp.float32),
-                )
+                return masked_ce_sums(logits, lab1)
 
             loss_sum, count = jax.vmap(one)(tok, lab, pos)
             loss_sum = jax.lax.psum(
@@ -228,23 +267,9 @@ class MagiLlama:
 
     def make_train_step(self, optimizer):
         """optax-style optimizer -> jitted (params, opt_state, batch) step."""
-        tables = self.sharded_tables()
+        from ._common import make_model_train_step
 
-        def step(params, opt_state, tokens, labels, pos):
-            loss, grads = jax.value_and_grad(self.loss_fn)(
-                params, tokens, labels, pos, tables
-            )
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = jax.tree.map(
-                lambda p, u: p + u, params, updates
-            )
-            return params, opt_state, loss
-
-        from ._common import tpu_compiler_options
-
-        return jax.jit(
-            step, donate_argnums=(0, 1), compiler_options=tpu_compiler_options()
-        )
+        return make_model_train_step(self, optimizer)
 
     def make_forward(self):
         tables = self.sharded_tables()
@@ -254,7 +279,7 @@ class MagiLlama:
             shard_map,
             mesh=self.mesh,
             in_specs=(
-                P(),
+                self.param_specs(),
                 P(self.dp_axis, self.cp_axis),
                 P(self.dp_axis, self.cp_axis),
             )
@@ -273,6 +298,7 @@ class MagiLlama:
                     self.plan,
                     self.attn_params,
                     self.cp_axis,
+                    self.tp_axis,
                 )
             )(tok, pos)
 
@@ -293,6 +319,7 @@ def build_magi_llama(
     chunk_size: int,
     cp_axis: str = "cp",
     dp_axis: str = "dp",
+    tp_axis: str | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -301,30 +328,26 @@ def build_magi_llama(
 
     Returns (model, dispatch_meta) — dispatch tokens/labels with
     parallel.dispatch using the meta before feeding the step.
-    """
-    from .. import env
-    from ..common.enum import AttnMaskType
-    from ..meta.dispatch_meta import make_dispatch_meta_from_qk_ranges
-    from ..parallel.dist_attn import build_dist_attn_plan
 
-    cp_size = mesh.shape[cp_axis]
-    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+    ``tp_axis`` turns on Megatron-style tensor parallelism over that mesh
+    axis (head groups + FFN slices; see ``_layer_local``). Requires the
+    head counts to divide by the axis size.
+    """
+    from ._common import plan_flex_attn
+
+    plan, attn_params, mq = plan_flex_attn(
+        cfg,
+        mesh,
+        total_seqlen,
         q_ranges,
         k_ranges,
-        [AttnMaskType(int(t)) for t in attn_type_map],
-        total_seqlen,
-        total_seqlen,
+        attn_type_map,
         chunk_size=chunk_size,
-        cp_size=cp_size,
-    )
-    plan = build_dist_attn_plan(
-        mq,
-        bucket,
-        block_q=block_q or env.block_q(),
-        block_k=block_k or env.block_k(),
-    )
-    attn_params = make_attn_params(
-        plan, cfg.head_dim, out_dtype=cfg.dtype, interpret=interpret
+        cp_axis=cp_axis,
+        tp_axis=tp_axis,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
     )
     model = MagiLlama(
         cfg=cfg,
@@ -333,5 +356,6 @@ def build_magi_llama(
         attn_params=attn_params,
         cp_axis=cp_axis,
         dp_axis=dp_axis,
+        tp_axis=tp_axis,
     )
     return model, mq

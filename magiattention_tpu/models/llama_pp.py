@@ -1,0 +1,266 @@
+"""4-D parallel Llama trainer: pipeline x data x context x tensor.
+
+The reference ships TP/PP only as a Megatron README patch
+(examples/megatron, SURVEY §2.8); here pipeline parallelism is built
+TPU-first instead of via an external trainer:
+
+- The layer stack is ONE pytree leaf with leading dim ``n_layers``,
+  sharded ``P('pp')`` — each pipeline stage holds ``n_layers/pp`` layers
+  and runs them with ``lax.scan``.
+- Microbatches (the per-dp-rank batch dim) flow through the stages via
+  ``lax.ppermute`` inside a ``lax.scan`` over GPipe ticks; reverse-mode
+  autodiff of that scan IS the backward pipeline (transposed ppermute),
+  so no hand-written 1F1B machinery is needed.
+- Context parallelism (the product: ``dist_attn_local`` over the cp
+  axis) and Megatron-style tensor parallelism (``_layer_local``'s psum
+  epilogues over the tp axis) compose orthogonally inside each tick.
+
+Everything is SPMD: every rank executes the same traced program; bubble
+ticks compute on clamped microbatch indices and are masked out of the
+loss. Loss/grad math matches ``MagiLlama`` exactly (oracle-tested).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.flex_attn import FlexAttnParams
+from ..parallel.dist_attn import DistAttnPlan
+from ._common import masked_ce_sums
+from .llama import LlamaConfig, _layer_local, _rms_norm, init_params
+
+
+def stack_layer_params(params: dict) -> dict:
+    """[{k: arr}] * L -> {k: arr[L, ...]}: one stacked leaf per weight so
+    the layer dim can be mesh-sharded and scanned."""
+    layers = params["layers"]
+    stacked = {
+        k: jnp.stack([lyr[k] for lyr in layers]) for k in layers[0]
+    }
+    return {**{k: v for k, v in params.items() if k != "layers"},
+            "layers": stacked}
+
+
+def init_pp_params(rng: jax.Array, cfg: LlamaConfig) -> dict:
+    """Same distribution as ``init_params``, layer-stacked."""
+    return stack_layer_params(init_params(rng, cfg))
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class MagiLlamaPP:
+    """Pipeline-parallel flagship bundle over a (pp, dp, cp[, tp]) mesh.
+
+    ``tokens``/``labels``/``pos`` are [batch, total_padded] in DISPATCH
+    order, batch on 'dp', tokens on 'cp'; each per-dp-rank batch row is
+    one GPipe microbatch.
+    """
+
+    cfg: LlamaConfig
+    mesh: Mesh
+    plan: DistAttnPlan
+    attn_params: FlexAttnParams
+    pp_axis: str = "pp"
+    dp_axis: str = "dp"
+    cp_axis: str = "cp"
+    tp_axis: str | None = None
+
+    @property
+    def pp_size(self) -> int:
+        return self.mesh.shape[self.pp_axis]
+
+    def param_specs(self):
+        tp = self.tp_axis
+        pp = self.pp_axis
+        layer_spec = {
+            "wq": P(pp, None, tp),
+            "wk": P(pp, None, tp),
+            "wv": P(pp, None, tp),
+            "wo": P(pp, tp, None),
+            "w_gate": P(pp, None, tp),
+            "w_up": P(pp, None, tp),
+            "w_down": P(pp, tp, None),
+            "attn_norm": P(pp),
+            "mlp_norm": P(pp),
+        }
+        return {
+            "embed": P(),
+            "layers": layer_spec,
+            "final_norm": P(),
+            "lm_head": P(),
+        }
+
+    def loss_fn(self, params, tokens, labels, pos, tables):
+        """Mean next-token CE over valid (label >= 0) positions —
+        numerically identical to ``MagiLlama.loss_fn``."""
+        cfg = self.cfg
+        tables = tuple(tables)
+        pp = self.pp_size
+        dt = cfg.jnp_dtype
+        data_spec = P(self.dp_axis, self.cp_axis)
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(self.param_specs(), data_spec, data_spec, data_spec)
+            + (P(self.cp_axis),) * len(tables),
+            out_specs=P(),
+            check_vma=False,
+        )
+        def _local(params, tok, lab, pos_all, *tabs):
+            nm, t_loc = tok.shape  # microbatches x local tokens
+            stage = jax.lax.axis_index(self.pp_axis)
+            last = pp - 1
+
+            def run_stage(x, pos1):
+                def body(h, lyr):
+                    h = _layer_local(
+                        h, pos1, lyr, cfg, tabs, self.plan,
+                        self.attn_params, self.cp_axis, self.tp_axis,
+                    )
+                    return h, None
+
+                x, _ = jax.lax.scan(body, x, params["layers"])
+                return x
+
+            def tick(x_in, m):
+                # Stage s processes microbatch m - s this tick; clamp the
+                # index on bubble ticks (their results are masked out).
+                j_in = jnp.clip(m, 0, nm - 1)
+                j_here = jnp.clip(m - stage, 0, nm - 1)
+                j_out = m - last  # microbatch leaving the pipe
+
+                # Stage 0 embeds the entering microbatch; other stages use
+                # the activation ppermuted in from the previous tick.
+                x = jax.lax.cond(
+                    stage == 0,
+                    lambda x_prev: params["embed"].astype(dt)[
+                        jax.lax.dynamic_index_in_dim(
+                            tok, j_in, keepdims=False
+                        )
+                    ],
+                    lambda x_prev: x_prev,
+                    x_in,
+                )
+                pos1 = jax.lax.dynamic_index_in_dim(
+                    pos_all, j_here, keepdims=False
+                )
+                y = run_stage(x, pos1)
+
+                # Only the last stage on in-range ticks pays for the
+                # lm_head matmul + CE; elsewhere the branch is dead and
+                # lax.cond skips it (rank-local predicate is fine SPMD —
+                # every rank still runs the same traced program).
+                emit = (stage == last) & (j_out >= 0) & (j_out < nm)
+                lab1 = jax.lax.dynamic_index_in_dim(
+                    lab, jnp.clip(j_out, 0, nm - 1), keepdims=False
+                )
+
+                def head_loss(args):
+                    y1, lab2 = args
+                    h = _rms_norm(y1, params["final_norm"])
+                    logits = (h @ params["lm_head"].astype(dt)).astype(
+                        jnp.float32
+                    )
+                    return masked_ce_sums(logits, lab2)
+
+                ls, cnt = jax.lax.cond(
+                    emit,
+                    head_loss,
+                    lambda args: (
+                        jnp.zeros((), jnp.float32),
+                        jnp.zeros((), jnp.float32),
+                    ),
+                    (y, lab1),
+                )
+                y_next = jax.lax.ppermute(
+                    y,
+                    self.pp_axis,
+                    [(i, (i + 1) % pp) for i in range(pp)],
+                )
+                return y_next, (ls, cnt)
+
+            x0 = jnp.zeros((t_loc, cfg.dim), dt)
+            _, (loss_sums, counts) = jax.lax.scan(
+                tick, x0, jnp.arange(nm + pp - 1)
+            )
+            loss_sum = loss_sums.sum()
+            count = counts.sum()
+            for ax in (self.pp_axis, self.cp_axis, self.dp_axis):
+                loss_sum = jax.lax.psum(loss_sum, ax)
+                count = jax.lax.psum(count, ax)
+            return loss_sum / jnp.maximum(count, 1.0)
+
+        return _local(params, tokens, labels, pos, *tables)
+
+    def sharded_tables(self):
+        from ._common import sharded_plan_tables
+
+        return sharded_plan_tables(self.plan, self.mesh, self.cp_axis)
+
+    def make_train_step(self, optimizer):
+        from ._common import make_model_train_step
+
+        return make_model_train_step(self, optimizer)
+
+
+def build_magi_llama_pp(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    total_seqlen: int,
+    q_ranges,
+    k_ranges,
+    attn_type_map,
+    *,
+    chunk_size: int,
+    pp_axis: str = "pp",
+    dp_axis: str = "dp",
+    cp_axis: str = "cp",
+    tp_axis: str | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[MagiLlamaPP, Any]:
+    """Plan CP attention for one mask and bundle the 4-D parallel model.
+
+    Requires ``n_layers % mesh.shape[pp_axis] == 0`` (and head counts
+    divisible by tp when ``tp_axis`` is given).
+    """
+    from ._common import plan_flex_attn
+
+    pp = mesh.shape[pp_axis]
+    if cfg.n_layers % pp:
+        raise ValueError(
+            f"pp={pp} must divide n_layers={cfg.n_layers}"
+        )
+    plan, attn_params, mq = plan_flex_attn(
+        cfg,
+        mesh,
+        total_seqlen,
+        q_ranges,
+        k_ranges,
+        attn_type_map,
+        chunk_size=chunk_size,
+        cp_axis=cp_axis,
+        tp_axis=tp_axis,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    model = MagiLlamaPP(
+        cfg=cfg,
+        mesh=mesh,
+        plan=plan,
+        attn_params=attn_params,
+        pp_axis=pp_axis,
+        dp_axis=dp_axis,
+        cp_axis=cp_axis,
+        tp_axis=tp_axis,
+    )
+    return model, mq
