@@ -49,6 +49,7 @@ def check_flag_comb(
     *,
     cp_axis="cp",
     uneven_shard: bool = False,
+    xattn: bool = False,
 ) -> None:
     """Central validator of illegal env-flag / argument combinations
     (reference ``check_flag_comb``, dist_attn_runtime_mgr.py:452-481).
@@ -86,6 +87,13 @@ def check_flag_comb(
             "(uneven_shard=False): the dynamic plane partition is built "
             "over equal per-rank token shards"
         )
+    if xattn and (qo or hier_axis or uneven_shard):
+        raise ValueError(
+            "cross-attention keys support the flat group-cast runtime "
+            "only: qo-comm, hierarchical cp_axis and uneven_shard are "
+            "all self-attention features (reference limits xattn the "
+            "same way via get_xattn_args)"
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,6 +123,21 @@ class DistAttnRuntimeKey:
     flags: tuple
 
 
+@dataclasses.dataclass(frozen=True)
+class XAttnArgs:
+    """Everything a cross-attention module needs about a planned key
+    (role of reference ``get_xattn_args``, dist_attn_runtime_mgr.py — the
+    cross-attn argument derivation; here host planning is global, so the
+    args are read straight off the two dispatch metas)."""
+
+    total_seqlen_q: int  # padded q length (dispatch layout rows)
+    total_seqlen_k: int  # padded kv length
+    shard_q_len: int  # per-rank q rows
+    shard_k_len: int  # per-rank kv rows
+    q_position_ids: jax.Array  # [total_q_padded] global pos per slot
+    k_position_ids: jax.Array  # [total_k_padded]
+
+
 class DistAttnRuntimeMgr:
     """Holds everything planned for one key: dispatch meta, plan, jitted fns
     (reference DistAttnRuntimeMgr, :122-407)."""
@@ -127,13 +150,21 @@ class DistAttnRuntimeMgr:
         plan: DistAttnPlan,
         attn_fn,
         dist_attn_config=None,
+        kv_dispatch_meta: DispatchMeta | None = None,
+        pad_size_k: int = 0,
     ):
         self.key = key
         self.mesh = mesh
         self.dispatch_meta = dispatch_meta
+        self.kv_dispatch_meta = kv_dispatch_meta  # cross-attn only
+        self.pad_size_k = pad_size_k
         self.plan = plan
         self.dist_attn_config = dist_attn_config
         self._attn_fn = attn_fn
+
+    @property
+    def is_cross_attn(self) -> bool:
+        return self.kv_dispatch_meta is not None
 
     # -- data movement -----------------------------------------------------
 
@@ -161,6 +192,40 @@ class DistAttnRuntimeMgr:
         from ..parallel.dispatch import position_ids as _position_ids
 
         return _position_ids(self.dispatch_meta)
+
+    # -- cross-attention (kv side; reference get_xattn_args role) ----------
+
+    def dispatch_kv(self, x: jax.Array, pad_value: float = 0.0) -> jax.Array:
+        """Cross-attn: natural-order memory [total_k, ...] -> the kv
+        dispatch layout expected by ``calc_attn``'s k/v arguments."""
+        assert self.is_cross_attn, "dispatch_kv needs a cross-attn key"
+        if self.pad_size_k:
+            x = pad_at_dim(x, 0, self.pad_size_k, pad_value)
+        return _dispatch_op(x, self.kv_dispatch_meta, pad_value=pad_value)
+
+    def undispatch_kv(self, y: jax.Array) -> jax.Array:
+        """Cross-attn: kv dispatch layout -> natural order (e.g. for
+        gradients inspected on the memory side)."""
+        assert self.is_cross_attn, "undispatch_kv needs a cross-attn key"
+        out = _undispatch_op(y, self.kv_dispatch_meta)
+        if self.pad_size_k:
+            out = out[: self.key.total_seqlen_k - self.pad_size_k]
+        return out
+
+    def get_xattn_args(self) -> XAttnArgs:
+        """Derive the cross-attention call arguments for this key
+        (reference ``get_xattn_args``)."""
+        assert self.is_cross_attn, "get_xattn_args needs a cross-attn key"
+        from ..parallel.dispatch import position_ids as _position_ids
+
+        return XAttnArgs(
+            total_seqlen_q=self.key.total_seqlen_q,
+            total_seqlen_k=self.key.total_seqlen_k,
+            shard_q_len=self.dispatch_meta.shard_seqlen,
+            shard_k_len=self.kv_dispatch_meta.shard_seqlen,
+            q_position_ids=_position_ids(self.dispatch_meta),
+            k_position_ids=_position_ids(self.kv_dispatch_meta),
+        )
 
     # -- attention ---------------------------------------------------------
 
@@ -519,6 +584,171 @@ def magi_attn_varlen_key(
     )
 
 
+def magi_attn_cross_key(
+    q_ranges: AttnRanges | Sequence[Sequence[int]],
+    k_ranges: AttnRanges | Sequence[Sequence[int]],
+    attn_type_map: Sequence[AttnMaskType | int],
+    total_seqlen_q: int,
+    total_seqlen_k: int,
+    mesh: jax.sharding.Mesh,
+    *,
+    num_heads: tuple[int, int],  # (hq, hkv)
+    head_dim: int,
+    cp_axis: str = "cp",
+    chunk_size_q: int | None = None,
+    chunk_size_k: int | None = None,
+    softcap: float = 0.0,
+    out_dtype="bfloat16",
+    dispatch_config: DispatchConfig | None = None,
+    overlap_config=None,
+    interpret: bool | None = None,
+) -> DistAttnRuntimeKey:
+    """Plan (or fetch) a keyed CROSS-attention runtime: queries and memory
+    are different sequences (tq != tk allowed).
+
+    Role of the reference's cross-attn path (``get_xattn_args`` +
+    dispatch_qo/dispatch_kv, dist_attn_runtime_mgr.py): queries are
+    chunk-balanced by mask area, keys/values get their own sequential
+    partition, and the group-cast plan routes the remote memory rows. Use
+    the returned key with ``dispatch`` / ``dispatch_kv`` / ``calc_attn`` /
+    ``undispatch``, and ``get_xattn_args(key)`` for layout/position info.
+
+    No sink, qo-comm, hierarchical or uneven-shard composition — those are
+    self-attention features (``check_flag_comb(xattn=True)``).
+    """
+    global _most_recent_key
+
+    if dispatch_config is None:
+        dispatch_config = DispatchConfig()
+    if overlap_config is None:
+        # same env-derived overlap defaults as magi_attn_flex_key, so the
+        # MAGI_ATTENTION_OVERLAP_* knobs act on cross keys too
+        from ..meta.solver.overlap_solver import OverlapConfig
+
+        overlap_config = OverlapConfig(
+            degree=env.overlap_degree_default(),
+            min_stage_rows=env.min_stage_rows(),
+            dynamic_max_degree=env.dynamic_max_degree(),
+        )
+    hq, hkv = num_heads
+    if (
+        overlap_config.degree is None
+        and overlap_config.calc_cost_factor == 1.0
+        and overlap_config.comm_cost_factor == 1.0
+    ):
+        from ..utils.cost import get_calc_cost_factor, get_comm_cost_factor
+
+        gen = env.tpu_generation()
+        overlap_config = dataclasses.replace(
+            overlap_config,
+            calc_cost_factor=get_calc_cost_factor(hq, head_dim, gen),
+            comm_cost_factor=get_comm_cost_factor(hkv, head_dim, gen),
+        )
+    check_flag_comb(
+        cp_axis=cp_axis,
+        uneven_shard=dispatch_config.uneven_shard,
+        xattn=True,
+    )
+    if not isinstance(q_ranges, AttnRanges):
+        q_ranges = AttnRanges.from_ranges(q_ranges)
+    if not isinstance(k_ranges, AttnRanges):
+        k_ranges = AttnRanges.from_ranges(k_ranges)
+    types = tuple(int(t) for t in attn_type_map)
+    if env.is_sanity_check_enabled():
+        from ..common.sanity import check_slices_non_overlapping
+
+        check_slices_non_overlapping(q_ranges, k_ranges, types)
+    cp_size = mesh.shape[cp_axis]
+    if chunk_size_q is None:
+        chunk_size_q = max(
+            total_seqlen_q // (env.min_chunks_per_rank() * cp_size), 128
+        )
+    if chunk_size_k is None:
+        chunk_size_k = max(
+            total_seqlen_k // (env.min_chunks_per_rank() * cp_size), 128
+        )
+    pad_q = compute_pad_size(total_seqlen_q, cp_size, chunk_size_q)
+    pad_k = compute_pad_size(total_seqlen_k, cp_size, chunk_size_k)
+
+    key = DistAttnRuntimeKey(
+        q_ranges=tuple(q_ranges.to_naive_ranges()),
+        k_ranges=tuple(k_ranges.to_naive_ranges()),
+        attn_type_map=types,
+        total_seqlen_q=total_seqlen_q + pad_q,
+        total_seqlen_k=total_seqlen_k + pad_k,
+        pad_size=pad_q,
+        chunk_size=chunk_size_q,
+        cp_size=cp_size,
+        cp_axis=cp_axis,
+        num_heads_q=hq,
+        num_heads_kv=hkv,
+        head_dim=head_dim,
+        softcap=float(softcap),
+        has_sink=False,
+        sink_fingerprint=0,
+        out_dtype=str(jnp.dtype(out_dtype)),
+        dispatch_config_repr=repr(
+            # pad_k must key the cache: two k-side totals that pad to the
+            # same multiple would otherwise collide and reuse a stale
+            # pad_size_k in dispatch_kv/undispatch_kv
+            ("xattn", chunk_size_k, pad_k, dispatch_config, overlap_config)
+        ),
+        interpret=interpret,
+        mesh_id=id(mesh),
+        flags=env.flags_fingerprint(),
+    )
+    if key in _runtime_dict:
+        _most_recent_key = key
+        return key
+
+    from ..meta.dispatch_meta import make_cross_attn_dispatch_meta
+
+    mq, mk, bucket = make_cross_attn_dispatch_meta(
+        q_ranges,
+        k_ranges,
+        [AttnMaskType(t) for t in types],
+        total_seqlen_q + pad_q,
+        total_seqlen_k + pad_k,
+        chunk_size_q=chunk_size_q,
+        chunk_size_k=chunk_size_k,
+        cp_size=cp_size,
+        dispatch_config=dispatch_config,
+    )
+    plan = build_dist_attn_plan(
+        mq,
+        bucket,
+        kv_dispatch_meta=mk,
+        block_q=env.block_q(),
+        block_k=env.block_k(),
+        overlap_config=overlap_config,
+    )
+    from ..ops.flex_attn import _auto_head_block
+
+    params = make_attn_params(
+        plan,
+        head_dim,
+        softcap=softcap,
+        out_dtype=out_dtype,
+        interpret=interpret,
+        head_block=_auto_head_block(env.head_block(), hq, hq // hkv),
+    )
+    attn_fn = make_dist_attn_fn(
+        plan, mesh, params, axis_name=cp_axis, with_max_logits=True
+    )
+    mgr = DistAttnRuntimeMgr(
+        key,
+        mesh,
+        mq,
+        plan,
+        attn_fn,
+        kv_dispatch_meta=mk,
+        pad_size_k=pad_k,
+    )
+    _runtime_dict.put(key, mgr)
+    _most_recent_key = key
+    return key
+
+
 def dispatch(x: jax.Array, key: DistAttnRuntimeKey, pad_value: float = 0.0):
     """Reference api.dispatch :887."""
     return get_runtime_mgr(key).dispatch(x, pad_value)
@@ -541,6 +771,21 @@ def calc_attn(q, k, v, key: DistAttnRuntimeKey, sink=None):
 def get_position_ids(key: DistAttnRuntimeKey):
     """Reference api.get_position_ids :1112."""
     return get_runtime_mgr(key).get_position_ids()
+
+
+def dispatch_kv(x: jax.Array, key: DistAttnRuntimeKey, pad_value: float = 0.0):
+    """Cross-attn memory-side dispatch (key from ``magi_attn_cross_key``)."""
+    return get_runtime_mgr(key).dispatch_kv(x, pad_value)
+
+
+def undispatch_kv(y: jax.Array, key: DistAttnRuntimeKey):
+    """Cross-attn memory-side undispatch."""
+    return get_runtime_mgr(key).undispatch_kv(y)
+
+
+def get_xattn_args(key: DistAttnRuntimeKey) -> XAttnArgs:
+    """Reference ``get_xattn_args``: cross-attn layout/position arguments."""
+    return get_runtime_mgr(key).get_xattn_args()
 
 
 def make_flex_key_for_new_mask_after_dispatch(

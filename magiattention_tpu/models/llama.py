@@ -24,11 +24,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..parallel.dist_attn import (
-    DistAttnPlan,
-    dist_attn_local,
-    make_attn_params,
-)
+from ..parallel.dist_attn import DistAttnPlan, dist_attn_local
 from ..ops.flex_attn import FlexAttnParams
 from ._common import masked_ce_sums
 

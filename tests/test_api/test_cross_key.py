@@ -1,0 +1,159 @@
+"""Keyed cross-attention API: magi_attn_cross_key + get_xattn_args.
+
+Role of reference get_xattn_args / dispatch_qo-dispatch_kv
+(dist_attn_runtime_mgr.py): a cross-attn key plans two dispatch metas
+(area-balanced queries, sequential memory) and the full keyed workflow —
+dispatch both sides, calc_attn, undispatch — must match the oracle,
+including when neither sequence length is a chunk multiple (padding on
+both sides).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from magiattention_tpu.api import (
+    calc_attn,
+    dispatch,
+    dispatch_kv,
+    get_runtime_mgr,
+    get_xattn_args,
+    magi_attn_cross_key,
+    undispatch,
+)
+from magiattention_tpu.common import AttnMaskType
+from magiattention_tpu.testing import assert_close, ref_attn_from_ranges
+
+C = AttnMaskType.CAUSAL
+F = AttnMaskType.FULL
+
+
+def _mesh(cp):
+    return Mesh(np.array(jax.devices()[:cp]), ("cp",))
+
+
+@pytest.mark.parametrize(
+    "tq,tk,cp",
+    [
+        (512, 1024, 4),  # chunk multiples both sides
+        (448, 960, 4),  # both sides need padding
+    ],
+)
+def test_cross_key_end_to_end(tq, tk, cp):
+    hq, hk, d = 4, 2, 32
+    mesh = _mesh(cp)
+    qr = [(0, tq // 2), (tq // 2, tq)]
+    kr = [(0, tk // 2), (tk // 4, tk)]
+    ts = [F, C]
+    key = magi_attn_cross_key(
+        qr, kr, ts, tq, tk, mesh,
+        num_heads=(hq, hk), head_dim=d,
+        chunk_size_q=64, chunk_size_k=128,
+        out_dtype="float32",
+    )
+    args = get_xattn_args(key)
+    assert args.total_seqlen_q % (cp * 64) == 0
+    assert args.total_seqlen_k % (cp * 128) == 0
+    assert args.shard_q_len * cp == args.total_seqlen_q
+    assert args.shard_k_len * cp == args.total_seqlen_k
+    # position ids cover each original token exactly once
+    qpos = np.asarray(args.q_position_ids)
+    assert sorted(set(qpos.tolist())) == list(range(args.total_seqlen_q))
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((tq, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((tk, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((tk, hk, d)), jnp.float32)
+
+    def step(q, k, v):
+        qd = dispatch(q, key)
+        kd = dispatch_kv(k, key)
+        vd = dispatch_kv(v, key)
+        out_d, meta = calc_attn(qd, kd, vd, key)
+        return undispatch(out_d, key)
+
+    out = jax.jit(step)(q, k, v)
+    ref_out, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out, ref_out, atol=3e-5, rtol=3e-5, msg=f"xkey {tq}x{tk}")
+
+    # grads through the keyed path (q and memory sides)
+    do = jnp.asarray(rng.standard_normal((tq, hq, d)), jnp.float32)
+    g = jax.jit(
+        jax.grad(
+            lambda q, k, v: (step(q, k, v) * do).sum(), argnums=(0, 1, 2)
+        )
+    )(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: (
+            ref_attn_from_ranges(q, k, v, qr, kr, ts)[0] * do
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, nm in zip(g, gr, ["dq", "dk", "dv"]):
+        assert_close(a, b, atol=1e-4, rtol=1e-4, msg=f"xkey {nm}")
+
+
+def test_cross_key_caching_and_guards():
+    mesh = _mesh(2)
+    qr, kr, ts = [(0, 256)], [(0, 512)], [F]
+    k1 = magi_attn_cross_key(
+        qr, kr, ts, 256, 512, mesh, num_heads=(2, 2), head_dim=32,
+        chunk_size_q=64, chunk_size_k=128,
+    )
+    k2 = magi_attn_cross_key(
+        qr, kr, ts, 256, 512, mesh, num_heads=(2, 2), head_dim=32,
+        chunk_size_q=64, chunk_size_k=128,
+    )
+    assert k1 == k2 and get_runtime_mgr(k1) is get_runtime_mgr(k2)
+    mgr = get_runtime_mgr(k1)
+    assert mgr.is_cross_attn
+
+    # self-attn mgr refuses kv-side calls
+    from magiattention_tpu.api import magi_attn_flex_key
+
+    sk = magi_attn_flex_key(
+        [(0, 256)], [(0, 256)], [F], 256, 256, mesh,
+        num_heads=(2, 2), head_dim=32, chunk_size=64,
+    )
+    with pytest.raises(AssertionError, match="cross-attn"):
+        get_runtime_mgr(sk).get_xattn_args()
+
+    # flag guard: qo-comm x cross is rejected
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv("MAGI_ATTENTION_QO_COMM", "1")
+        with pytest.raises(ValueError, match="cross-attention"):
+            magi_attn_cross_key(
+                qr, kr, ts, 256, 512, mesh, num_heads=(2, 2), head_dim=32,
+                chunk_size_q=64, chunk_size_k=128,
+            )
+
+
+def test_cross_key_pad_k_not_aliased():
+    """Two k-side totals that pad to the same multiple must get DISTINCT
+    keys — otherwise the second cache-hits a mgr with a stale pad_size_k
+    and dispatch_kv/undispatch_kv silently corrupt the memory tail."""
+    mesh = _mesh(2)
+    # identical mask slices — ONLY the k-side total (and thus pad_k) differs
+    qr, kr, ts = [(0, 256)], [(0, 512)], [F]
+    k_960 = magi_attn_cross_key(
+        qr, kr, ts, 256, 960, mesh, num_heads=(2, 2), head_dim=32,
+        chunk_size_q=64, chunk_size_k=128,
+    )
+    k_1024 = magi_attn_cross_key(
+        qr, kr, ts, 256, 1024, mesh, num_heads=(2, 2), head_dim=32,
+        chunk_size_q=64, chunk_size_k=128,
+    )
+    assert k_960 != k_1024
+    assert get_runtime_mgr(k_960).pad_size_k == 64
+    assert get_runtime_mgr(k_1024).pad_size_k == 0
+    # roundtrip preserves every original row for both
+    from magiattention_tpu.api import dispatch_kv as dkv, undispatch_kv
+
+    for key, tk in [(k_960, 960), (k_1024, 1024)]:
+        x = jnp.arange(tk, dtype=jnp.float32)[:, None, None] * jnp.ones(
+            (1, 2, 4), jnp.float32
+        )
+        rt = undispatch_kv(dkv(x, key), key)
+        np.testing.assert_array_equal(np.asarray(rt), np.asarray(x))
