@@ -283,6 +283,40 @@ _runtime_dict = DistAttnRuntimeDict(maxsize=env.runtime_dict_size())
 _most_recent_key: Optional[DistAttnRuntimeKey] = None
 
 
+def _resolve_overlap_config(oc, hq, hkv, head_dim, *, hier: bool = False):
+    """ONE definition of overlap-config defaulting for every key type:
+    None -> env-default knobs (reference env/general.py defaults); then
+    auto-degree with untouched factors -> the real hardware cost model
+    (reference get_calc/comm_cost_factor, utils/_utils.py)."""
+    from ..meta.solver.overlap_solver import OverlapConfig
+
+    if oc is None:
+        oc = OverlapConfig(
+            degree=env.overlap_degree_default(),
+            min_stage_rows=env.min_stage_rows(),
+            dynamic_max_degree=env.dynamic_max_degree(),
+        )
+    if (
+        oc.degree is None
+        and oc.calc_cost_factor == 1.0
+        and oc.comm_cost_factor == 1.0
+    ):
+        from ..utils.cost import get_calc_cost_factor, get_comm_cost_factor
+
+        gen = env.tpu_generation()
+        oc = dataclasses.replace(
+            oc,
+            calc_cost_factor=get_calc_cost_factor(hq, head_dim, gen),
+            comm_cost_factor=get_comm_cost_factor(hkv, head_dim, gen),
+            comm_cost_factor_inter=(
+                get_comm_cost_factor(hkv, head_dim, gen, link="dcn")
+                if hier and oc.comm_cost_factor_inter is None
+                else oc.comm_cost_factor_inter
+            ),
+        )
+    return oc
+
+
 def get_runtime_mgr(key: DistAttnRuntimeKey) -> DistAttnRuntimeMgr:
     mgr = _runtime_dict.get(key)
     if mgr is None:
@@ -332,45 +366,24 @@ def magi_attn_flex_key(
     global _most_recent_key
     from ..config import DistAttnConfig
 
+    hq, hkv = num_heads
     if dist_attn_config is None:
-        from ..meta.solver.overlap_solver import OverlapConfig
-
-        # env-default overlap knobs (reference env/general.py defaults)
         dist_attn_config = DistAttnConfig(
-            overlap_config=OverlapConfig(
-                degree=env.overlap_degree_default(),
-                min_stage_rows=env.min_stage_rows(),
-                dynamic_max_degree=env.dynamic_max_degree(),
+            overlap_config=_resolve_overlap_config(
+                None, hq, hkv, head_dim,
+                hier=isinstance(cp_axis, (tuple, list)),
             )
+        )
+    else:
+        dist_attn_config = dataclasses.replace(
+            dist_attn_config,
+            overlap_config=_resolve_overlap_config(
+                dist_attn_config.overlap_config, hq, hkv, head_dim,
+                hier=isinstance(cp_axis, (tuple, list)),
+            ),
         )
     if dispatch_config is None:
         dispatch_config = dist_attn_config.dispatch_config
-    hq, hkv = num_heads
-    oc = dist_attn_config.overlap_config
-    if (
-        oc.degree is None
-        and oc.calc_cost_factor == 1.0
-        and oc.comm_cost_factor == 1.0
-    ):
-        # auto-degree with default factors: fill in the real hardware cost
-        # model (reference get_calc/comm_cost_factor, utils/_utils.py)
-        from ..utils.cost import get_calc_cost_factor, get_comm_cost_factor
-
-        gen = env.tpu_generation()
-        dist_attn_config = dataclasses.replace(
-            dist_attn_config,
-            overlap_config=dataclasses.replace(
-                oc,
-                calc_cost_factor=get_calc_cost_factor(hq, head_dim, gen),
-                comm_cost_factor=get_comm_cost_factor(hkv, head_dim, gen),
-                comm_cost_factor_inter=(
-                    get_comm_cost_factor(hkv, head_dim, gen, link="dcn")
-                    if isinstance(cp_axis, (tuple, list))
-                    and oc.comm_cost_factor_inter is None
-                    else oc.comm_cost_factor_inter
-                ),
-            ),
-        )
     if not isinstance(q_ranges, AttnRanges):
         q_ranges = AttnRanges.from_ranges(q_ranges)
     if not isinstance(k_ranges, AttnRanges):
@@ -620,30 +633,10 @@ def magi_attn_cross_key(
 
     if dispatch_config is None:
         dispatch_config = DispatchConfig()
-    if overlap_config is None:
-        # same env-derived overlap defaults as magi_attn_flex_key, so the
-        # MAGI_ATTENTION_OVERLAP_* knobs act on cross keys too
-        from ..meta.solver.overlap_solver import OverlapConfig
-
-        overlap_config = OverlapConfig(
-            degree=env.overlap_degree_default(),
-            min_stage_rows=env.min_stage_rows(),
-            dynamic_max_degree=env.dynamic_max_degree(),
-        )
     hq, hkv = num_heads
-    if (
-        overlap_config.degree is None
-        and overlap_config.calc_cost_factor == 1.0
-        and overlap_config.comm_cost_factor == 1.0
-    ):
-        from ..utils.cost import get_calc_cost_factor, get_comm_cost_factor
-
-        gen = env.tpu_generation()
-        overlap_config = dataclasses.replace(
-            overlap_config,
-            calc_cost_factor=get_calc_cost_factor(hq, head_dim, gen),
-            comm_cost_factor=get_comm_cost_factor(hkv, head_dim, gen),
-        )
+    overlap_config = _resolve_overlap_config(
+        overlap_config, hq, hkv, head_dim
+    )
     check_flag_comb(
         cp_axis=cp_axis,
         uneven_shard=dispatch_config.uneven_shard,
@@ -654,6 +647,18 @@ def magi_attn_cross_key(
     if not isinstance(k_ranges, AttnRanges):
         k_ranges = AttnRanges.from_ranges(k_ranges)
     types = tuple(int(t) for t in attn_type_map)
+    if env.is_auto_range_merge_enable():
+        # canonicalize before keying, same as magi_attn_flex_key
+        from ..ops.range_merge import merge_ranges
+
+        qa, ka, ta = merge_ranges(
+            np.asarray(q_ranges.to_naive_ranges(), np.int64),
+            np.asarray(k_ranges.to_naive_ranges(), np.int64),
+            np.asarray(types, np.int64),
+        )
+        q_ranges = AttnRanges.from_ranges([tuple(r) for r in qa.tolist()])
+        k_ranges = AttnRanges.from_ranges([tuple(r) for r in ka.tolist()])
+        types = tuple(int(t) for t in ta)
     if env.is_sanity_check_enabled():
         from ..common.sanity import check_slices_non_overlapping
 

@@ -277,21 +277,37 @@ class AttnRectangles:
         return total
 
     def area_left_of_k(self, pos: int) -> int:
-        """Area of the sub-region with k < pos (no piece construction)."""
-        import numpy as np
+        """Area of the sub-region with k < pos — closed form per rect
+        (O(1) per rectangle per probe; no row materialization)."""
+        from .mask import slice_area_left_of_k
 
         total = 0
         for r in self._rects:
-            qs, qe = r.q_range.start, r.q_range.end
-            ks, ke = r.k_range.start, r.k_range.end
-            if pos <= ks:
-                continue
-            q = np.arange(qs, qe, dtype=np.int64)
-            lo = (ks + (q - qs)) if r.mask_type.is_inv_causal_bound else np.full_like(q, ks)
-            hi = (ke - qe + q + 1) if r.mask_type.is_causal_bound else np.full_like(q, ke)
-            cnt = np.minimum(hi, pos) - lo
-            total += int(np.maximum(cnt, 0).sum())
+            total += slice_area_left_of_k(
+                r.q_range.start,
+                r.q_range.end,
+                r.k_range.start,
+                r.k_range.end,
+                r.mask_type,
+                pos,
+            )
         return total
+
+    def to_array(self):
+        """[n, 5] int64 (qs, qe, ks, ke, mask_type) — the flat form the
+        native solver accelerators consume."""
+        import numpy as np
+
+        out = np.empty((len(self._rects), 5), dtype=np.int64)
+        for i, r in enumerate(self._rects):
+            out[i] = (
+                r.q_range.start,
+                r.q_range.end,
+                r.k_range.start,
+                r.k_range.end,
+                int(r.mask_type.value),
+            )
+        return out
 
     def __len__(self) -> int:
         return len(self._rects)

@@ -59,20 +59,49 @@ def get_lib() -> ctypes.CDLL | None:
                 return None
         try:
             lib = ctypes.CDLL(_SO)
-        except OSError:
-            return None
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        lib.magi_emit_entries.restype = ctypes.c_int64
-        lib.magi_emit_entries.argtypes = [i64p, ctypes.c_int64] * 3 + [
-            ctypes.c_int64,
-            ctypes.c_int64,
-            i64p,
-            ctypes.c_int64,
-        ]
-        lib.magi_slice_area_runs.restype = ctypes.c_int64
-        lib.magi_slice_area_runs.argtypes = [i64p, ctypes.c_int64] * 3
+            _bind(lib)
+        except (OSError, AttributeError):
+            # unloadable, or a stale .so missing newer symbols (mtime
+            # equality after cp -r / cache extraction skips the rebuild):
+            # rebuild once, else fall back to Python
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_SO)
+                _bind(lib)
+            except (OSError, AttributeError):
+                return None
         _LIB = lib
         return _LIB
+
+
+def _bind(lib: ctypes.CDLL) -> bool:
+    """Declare all expected symbols (raises AttributeError on a stale .so)."""
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.magi_emit_entries.restype = ctypes.c_int64
+    lib.magi_emit_entries.argtypes = [i64p, ctypes.c_int64] * 3 + [
+        ctypes.c_int64,
+        ctypes.c_int64,
+        i64p,
+        ctypes.c_int64,
+    ]
+    lib.magi_slice_area_runs.restype = ctypes.c_int64
+    lib.magi_slice_area_runs.argtypes = [i64p, ctypes.c_int64] * 3
+    lib.magi_area_left.restype = ctypes.c_int64
+    lib.magi_area_left.argtypes = [
+        i64p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.magi_cut_pos.restype = ctypes.c_int64
+    lib.magi_cut_pos.argtypes = [
+        i64p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_double,
+    ]
+    return True
 
 
 def _as_i64(arr: np.ndarray):
@@ -135,3 +164,27 @@ def slice_area_runs_native(
     return int(
         lib.magi_slice_area_runs(sp, s.shape[0], qp, q.shape[0], kp, k.shape[0])
     )
+
+
+def area_left_native(
+    rects: np.ndarray, axis_q: bool, pos: int
+) -> int | None:
+    """Sum of per-rect area left of the q/k=pos line; None when the
+    native backend is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    r, rp = _as_i64(rects.reshape(-1, 5))
+    return int(lib.magi_area_left(rp, r.shape[0], int(axis_q), int(pos)))
+
+
+def cut_pos_native(
+    rects: np.ndarray, frac: float, axis_q: bool
+) -> int | None:
+    """The dynamic solver's binary-search cut position (bit-identical to
+    the Python probe loop); None when the native backend is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    r, rp = _as_i64(rects.reshape(-1, 5))
+    return int(lib.magi_cut_pos(rp, r.shape[0], int(axis_q), float(frac)))

@@ -11,9 +11,132 @@
 // Entry layout (9 int64s, matching the Python tuple):
 //   (q_block, k_block, slice_id, ql0, ql1, kl0, kl1, qoff, koff)
 
+#include <cmath>
 #include <cstdint>
 
+namespace {
+
+// sum of integers lo..hi inclusive (0 if hi < lo)
+inline int64_t tri_sum(int64_t lo, int64_t hi) {
+  if (hi < lo) return 0;
+  return (hi + lo) * (hi - lo + 1) / 2;
+}
+
+// sum_{i=0}^{n-1} clamp(b + i, 0, cap)
+inline int64_t sum_clamp_linear(int64_t n, int64_t b, int64_t cap) {
+  if (cap <= 0 || n <= 0) return 0;
+  int64_t n0 = -b; if (n0 < 0) n0 = 0; if (n0 > n) n0 = n;
+  int64_t n1 = cap - b; if (n1 < 0) n1 = 0; if (n1 > n) n1 = n;
+  return tri_sum(b + n0, b + n1 - 1) + (n - n1) * cap;
+}
+
+// exact unmasked area of one slice (port of common/mask.slice_area)
+inline int64_t slice_area_one(int64_t qs, int64_t qe, int64_t ks, int64_t ke,
+                              int64_t mt) {
+  const int64_t sq = qe - qs, sk = ke - ks;
+  if (sq <= 0 || sk <= 0) return 0;
+  const bool causal = (mt & 1) != 0, inv = (mt & 2) != 0;
+  if (!causal && !inv) return sq * sk;
+  if (causal && !inv) {
+    if (sk >= sq) return tri_sum(sk - sq + 1, sk);
+    return tri_sum(1, sk);
+  }
+  if (inv && !causal) {
+    const int64_t n_pos = sq < sk ? sq : sk;
+    return tri_sum(sk - n_pos + 1, sk);
+  }
+  const int64_t width = sk - sq + 1;
+  return width > 0 ? sq * width : 0;
+}
+
+// area of rows q < pos (port of rectangle._truncate_q + area)
+inline int64_t area_left_q_one(int64_t qs, int64_t qe, int64_t ks, int64_t ke,
+                               int64_t mt, int64_t pos) {
+  if (pos <= qs) return 0;
+  const int64_t b = pos < qe ? pos : qe;
+  int64_t ke2 = ke;
+  if (mt & 1) ke2 = ke - (qe - b);  // causal bound rides the bottom row
+  if (ke2 <= ks) return 0;
+  return slice_area_one(qs, b, ks, ke2, mt);
+}
+
+// area of pairs with k < pos (port of common/mask.slice_area_left_of_k)
+inline int64_t area_left_k_one(int64_t qs, int64_t qe, int64_t ks, int64_t ke,
+                               int64_t mt, int64_t pos) {
+  const int64_t sq = qe - qs, sk = ke - ks;
+  if (sq <= 0 || sk <= 0 || pos <= ks) return 0;
+  const bool causal = (mt & 1) != 0, inv = (mt & 2) != 0;
+  const int64_t pcap = (pos < ke ? pos : ke) - ks;
+  if (!causal && !inv) return sq * pcap;
+  if (causal && !inv) return sum_clamp_linear(sq, sk - sq + 1, pos - ks);
+  if (inv && !causal) {
+    const int64_t n_pos = pcap < sq ? pcap : sq;
+    return tri_sum(pcap - n_pos + 1, pcap);
+  }
+  const int64_t w = sk - sq + 1;
+  if (w <= 0) return 0;
+  const int64_t h0 = ke - sq + 1;
+  int64_t n_const = pos - h0 + 1;
+  if (n_const < 0) n_const = 0; if (n_const > sq) n_const = sq;
+  int64_t total = n_const * w;
+  const int64_t p2 = pos - ks;
+  const int64_t hi_idx = p2 < sq ? p2 : sq;
+  if (hi_idx > n_const) total += tri_sum(p2 - hi_idx + 1, p2 - n_const);
+  return total;
+}
+
+inline int64_t area_left(const int64_t* rects, int64_t n, int64_t axis_q,
+                         int64_t pos) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t* r = rects + i * 5;
+    total += axis_q ? area_left_q_one(r[0], r[1], r[2], r[3], r[4], pos)
+                    : area_left_k_one(r[0], r[1], r[2], r[3], r[4], pos);
+  }
+  return total;
+}
+
+}  // namespace
+
 extern "C" {
+
+// rects: [n, 5] = (qs, qe, ks, ke, mask_type). Area of the sub-region
+// left of the q=pos (axis_q != 0) or k=pos line.
+int64_t magi_area_left(const int64_t* rects, int64_t n, int64_t axis_q,
+                       int64_t pos) {
+  return area_left(rects, n, axis_q, pos);
+}
+
+// Binary-search the cut line so the left side holds ~frac of the total
+// area — the dynamic solver's probe loop (DynamicAttnSolver._cut_for_fraction),
+// bit-identical to the Python implementation (same float target/err math,
+// same tie-breaking). Returns the best cut position.
+int64_t magi_cut_pos(const int64_t* rects, int64_t n, int64_t axis_q,
+                     double frac) {
+  int64_t total = 0, lo = INT64_MAX, hi = INT64_MIN;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t* r = rects + i * 5;
+    total += slice_area_one(r[0], r[1], r[2], r[3], r[4]);
+    const int64_t s = axis_q ? r[0] : r[2];
+    const int64_t e = axis_q ? r[1] : r[3];
+    if (s < lo) lo = s;
+    if (e > hi) hi = e;
+  }
+  if (n == 0 || total == 0) return 0;
+  const double target = frac * (double)total;
+  int64_t best_pos = lo;
+  double best_err = std::fabs((double)area_left(rects, n, axis_q, lo) - target);
+  while (lo < hi) {
+    const int64_t mid = (lo + hi) >> 1;  // floor for non-negative positions
+    const double a = (double)area_left(rects, n, axis_q, mid);
+    const double err = std::fabs(a - target);
+    if (err < best_err) { best_pos = mid; best_err = err; }
+    if (a < target) lo = mid + 1; else hi = mid;
+  }
+  if (std::fabs((double)area_left(rects, n, axis_q, lo) - target) < best_err)
+    best_pos = lo;
+  return best_pos;
+}
 
 // slices: [n_slices, 5] = (qs, qe, ks, ke, mask_type)
 // q_runs / k_runs: [n, 3] = (local_start, global_start, length)

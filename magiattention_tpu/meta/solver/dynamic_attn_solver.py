@@ -81,6 +81,14 @@ class DynamicAttnSolver:
         total = rects.area
         if total == 0 or len(rects) == 0:
             return rects, AttnRectangles()
+        from ...csrc import cut_pos_native
+
+        pos = cut_pos_native(rects.to_array(), frac, axis_q)
+        if pos is not None:
+            # native probe loop (role of reference magi_attn_ext's
+            # dyn_solver acceleration, binary_greedy_parallel.py:30-38);
+            # bit-identical to the Python search below (parity-tested)
+            return rects.cut_q(pos) if axis_q else rects.cut_k(pos)
         if axis_q:
             lo = min(r.q_range.start for r in rects)
             hi = max(r.q_range.end for r in rects)

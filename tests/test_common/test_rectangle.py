@@ -85,3 +85,39 @@ def test_rectangles_aggregate():
     assert top.area + bottom.area == total
     left, right = rects.cut_k(8)
     assert left.area + right.area == total
+
+
+@pytest.mark.parametrize("mt", TYPES)
+@pytest.mark.parametrize("seed", range(8))
+def test_area_left_of_k_closed_form(mt, seed):
+    """slice_area_left_of_k (closed form, the dynamic solver's probe) vs
+    dense-mask popcount, every cut position."""
+    rng = np.random.default_rng(100 + seed)
+    rect = _rand_rect(rng, mt)
+    dense = _dense(rect)
+    rr = AttnRectangles()
+    rr.append(rect)
+    for pos in range(-1, SPAN + 2):
+        expect = int(dense[:, : max(pos, 0)].sum())
+        assert rr.area_left_of_k(pos) == expect, (rect, pos)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_area_left_of_q_vs_dense(seed):
+    """Disjoint q bands (the solver's precondition — mask slices cover
+    disjoint plane regions), so the dense union popcount equals the
+    per-rect area sum."""
+    rng = np.random.default_rng(200 + seed)
+    rects = AttnRectangles()
+    band = SPAN // len(TYPES)
+    for j, mt in enumerate(TYPES):
+        qs = j * band + int(rng.integers(0, band // 2))
+        qe = int(rng.integers(qs + 1, (j + 1) * band))
+        ks = int(rng.integers(0, SPAN - 2))
+        ke = int(rng.integers(ks + 1, SPAN))
+        rects.append(
+            AttnRectangle(AttnRange(qs, qe), AttnRange(ks, ke), mt)
+        )
+    dense = _dense_list(rects)
+    for pos in range(0, SPAN + 1, 5):
+        assert rects.area_left_of_q(pos) == int(dense[:pos].sum())

@@ -89,3 +89,102 @@ def test_area_parity(seed):
         [(r.local_start, r.global_start, r.length) for r in k_runs], np.int64
     )
     assert slice_area_runs_native(slices, q_arr, k_arr) == py_area
+
+def _random_rects(rng, n=8, span=512):
+    from magiattention_tpu.common import AttnMaskType, AttnRange
+    from magiattention_tpu.common.rectangle import (
+        AttnRectangle,
+        AttnRectangles,
+    )
+
+    rects = AttnRectangles()
+    for _ in range(n):
+        qs = int(rng.integers(0, span - 1))
+        qe = int(rng.integers(qs + 1, span + 1))
+        ks = int(rng.integers(0, span - 1))
+        ke = int(rng.integers(ks + 1, span + 1))
+        r = AttnRectangle(
+            AttnRange(qs, qe),
+            AttnRange(ks, ke),
+            AttnMaskType(int(rng.integers(0, 4))),
+        )
+        if r.area > 0:
+            rects.append(r)
+    return rects
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_area_left_parity(seed):
+    """Native magi_area_left == Python area_left_of_q / area_left_of_k."""
+    from magiattention_tpu.csrc import area_left_native
+
+    rng = np.random.default_rng(300 + seed)
+    rects = _random_rects(rng)
+    arr = rects.to_array()
+    for pos in [0, 7, 100, 255, 256, 400, 512, 600]:
+        assert area_left_native(arr, True, pos) == rects.area_left_of_q(pos)
+        assert area_left_native(arr, False, pos) == rects.area_left_of_k(pos)
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("axis_q", [True, False])
+def test_cut_pos_parity(seed, axis_q):
+    """Native binary search returns the identical cut position to the
+    Python probe loop for every fraction the KD solver uses."""
+    from magiattention_tpu.csrc import cut_pos_native
+
+    rng = np.random.default_rng(400 + seed)
+    rects = _random_rects(rng)
+    if rects.area == 0:
+        pytest.skip("degenerate")
+    arr = rects.to_array()
+
+    def python_cut_pos(frac):
+        total = rects.area
+        if axis_q:
+            lo = min(r.q_range.start for r in rects)
+            hi = max(r.q_range.end for r in rects)
+            area_left = rects.area_left_of_q
+        else:
+            lo = min(r.k_range.start for r in rects)
+            hi = max(r.k_range.end for r in rects)
+            area_left = rects.area_left_of_k
+        target = frac * total
+        best_pos, best_err = lo, abs(area_left(lo) - target)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            a = area_left(mid)
+            err = abs(a - target)
+            if err < best_err:
+                best_pos, best_err = mid, err
+            if a < target:
+                lo = mid + 1
+            else:
+                hi = mid
+        if abs(area_left(lo) - target) < best_err:
+            best_pos = lo
+        return best_pos
+
+    for frac in [0.5, 0.25, 1 / 3, 0.125, 2 / 3]:
+        assert cut_pos_native(arr, frac, axis_q) == python_cut_pos(frac), frac
+
+
+def test_dynamic_solver_native_matches_python(monkeypatch):
+    """DynamicAttnSolver with the native probe == pure-Python solve."""
+    from magiattention_tpu.meta.solver.dynamic_attn_solver import (
+        DynamicAttnSolver,
+    )
+
+    rng = np.random.default_rng(42)
+    rects = _random_rects(rng, n=12, span=1024)
+    solver = DynamicAttnSolver()
+    native = solver.solve(rects, cp_size=8)
+
+    import magiattention_tpu.csrc as csrc
+
+    monkeypatch.setattr(csrc, "cut_pos_native", lambda *a, **k: None)
+    pure = solver.solve(rects, cp_size=8)
+    assert native.areas == pure.areas
+    assert [len(r) for r in native.rank_rects] == [
+        len(r) for r in pure.rank_rects
+    ]
