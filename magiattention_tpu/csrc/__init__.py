@@ -26,15 +26,24 @@ _SO = os.path.join(os.path.dirname(__file__), "libmagi_ext.so")
 
 
 def _build() -> bool:
+    # compile to a temp name and rename into place: os.replace gives the
+    # path a fresh inode, so a rebuild after loading a stale library is
+    # actually picked up by dlopen (which caches by (dev, inode))
+    tmp = f"{_SO}.tmp.{os.getpid()}"
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
             check=True,
             capture_output=True,
             timeout=120,
         )
+        os.replace(tmp, _SO)
         return True
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -63,14 +72,30 @@ def get_lib() -> ctypes.CDLL | None:
         except (OSError, AttributeError):
             # unloadable, or a stale .so missing newer symbols (mtime
             # equality after cp -r / cache extraction skips the rebuild):
-            # rebuild once, else fall back to Python
+            # rebuild once, else fall back to Python. dlopen dedupes by
+            # pathname, so the rebuilt library must be loaded under a
+            # fresh unique path to not resolve to the stale mapping.
             if not _build():
                 return None
+            import shutil
+            import tempfile
+
+            alt = None
             try:
-                lib = ctypes.CDLL(_SO)
+                fd, alt = tempfile.mkstemp(suffix=".so", prefix="magi_ext_")
+                os.close(fd)
+                shutil.copy(_SO, alt)
+                lib = ctypes.CDLL(alt)
                 _bind(lib)
             except (OSError, AttributeError):
                 return None
+            finally:
+                # the mapping survives unlink on Linux; never leak the copy
+                if alt is not None:
+                    try:
+                        os.unlink(alt)
+                    except OSError:
+                        pass
         _LIB = lib
         return _LIB
 

@@ -188,3 +188,37 @@ def test_dynamic_solver_native_matches_python(monkeypatch):
     assert [len(r) for r in native.rank_rects] == [
         len(r) for r in pure.rank_rects
     ]
+
+
+def test_stale_so_rebuilds(tmp_path, monkeypatch):
+    """A .so missing newer symbols (mtime-equal after cp -r) must trigger
+    one rebuild instead of crashing get_lib with AttributeError."""
+    import shutil
+    import subprocess
+
+    import magiattention_tpu.csrc as csrc
+
+    src = tmp_path / "entry_table.cpp"
+    so = tmp_path / "libmagi_ext.so"
+    shutil.copy(csrc._SRC, src)
+    # stale library: compiled from an empty TU -> none of our symbols
+    stub = tmp_path / "stub.cpp"
+    stub.write_text("extern \"C\" int magi_nothing() { return 0; }\n")
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", str(stub), "-o", str(so)],
+        check=True,
+        capture_output=True,
+    )
+    # make the .so look newer than the source (skips the mtime rebuild)
+    times = (src.stat().st_mtime + 100, src.stat().st_mtime + 100)
+    import os as _os
+
+    _os.utime(so, times)
+
+    monkeypatch.setattr(csrc, "_SRC", str(src))
+    monkeypatch.setattr(csrc, "_SO", str(so))
+    monkeypatch.setattr(csrc, "_LIB", None)
+    monkeypatch.setattr(csrc, "_TRIED", False)
+    lib = csrc.get_lib()
+    assert lib is not None  # rebuilt from source and bound
+    assert lib.magi_cut_pos is not None
