@@ -82,7 +82,13 @@ def get_lib() -> ctypes.CDLL | None:
 
             alt = None
             try:
-                fd, alt = tempfile.mkstemp(suffix=".so", prefix="magi_ext_")
+                # the package dir is already proven dlopen-able (unlike a
+                # possibly-noexec system /tmp)
+                fd, alt = tempfile.mkstemp(
+                    suffix=".so",
+                    prefix="magi_ext_",
+                    dir=os.path.dirname(_SO),
+                )
                 os.close(fd)
                 shutil.copy(_SO, alt)
                 lib = ctypes.CDLL(alt)

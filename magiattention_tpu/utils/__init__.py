@@ -1,17 +1,31 @@
-"""Utility subpackage: instrumentation, cost factors, misc helpers."""
+"""Utility subpackage: instrumentation, cost factors, packing, checkpointing."""
 
-from .instrument import add_trace_event, instrument_trace, switch_profile
+from .checkpoint import latest_step, restore_train_state, save_train_state
 from .cost import (
     TPU_PEAK_SPECS,
     get_calc_cost_factor,
     get_comm_cost_factor,
 )
+from .instrument import add_trace_event, instrument_trace, switch_profile
+from .packing import (
+    bin_cu_seqlens,
+    pack_corpus,
+    pack_documents,
+    packing_efficiency,
+)
 
 __all__ = [
     "TPU_PEAK_SPECS",
     "add_trace_event",
+    "bin_cu_seqlens",
     "get_calc_cost_factor",
     "get_comm_cost_factor",
     "instrument_trace",
+    "latest_step",
+    "pack_corpus",
+    "pack_documents",
+    "packing_efficiency",
+    "restore_train_state",
+    "save_train_state",
     "switch_profile",
 ]

@@ -1,0 +1,89 @@
+"""Checkpoint/resume for train state (params + opt state + step).
+
+The reference has no checkpoint subsystem (SURVEY §5.4: "None" — its
+trainers lean on external frameworks); a standalone training framework
+needs one, so this provides the minimal orbax-backed save/restore for
+the pytree train states the model bundles produce. Runtime keys are NOT
+checkpointed by design: plans are deterministic functions of
+(mask, mesh, flags) and rebuild from the key arguments — state on disk
+stays portable across topology changes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any
+
+import jax
+
+
+def _mgr(path: str, max_to_keep: int | None):
+    import orbax.checkpoint as ocp
+
+    return contextlib.closing(
+        ocp.CheckpointManager(
+            os.path.abspath(path),
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+    )
+
+
+def save_train_state(
+    path: str,
+    step: int,
+    state: Any,
+    *,
+    max_to_keep: int | None = 3,
+) -> None:
+    """Save a pytree train state (e.g. ``{"params": ..., "opt_state": ...}``)
+    under ``path/<step>``. Durable on return (the manager is closed, which
+    drains orbax's async write)."""
+    import orbax.checkpoint as ocp
+
+    with _mgr(path, max_to_keep) as mgr:
+        mgr.save(int(step), args=ocp.args.StandardSave(state))
+        mgr.wait_until_finished()
+
+
+def latest_step(path: str) -> int | None:
+    """Newest saved step under ``path``, or None when nothing is saved."""
+    if not os.path.isdir(path):
+        return None
+    with _mgr(path, None) as mgr:
+        return mgr.latest_step()
+
+
+def restore_train_state(
+    path: str,
+    *,
+    step: int | None = None,
+    template: Any = None,
+) -> tuple[int, Any]:
+    """Restore ``(step, state)`` from ``path``.
+
+    ``template``: a pytree of like-shaped arrays (e.g. a freshly
+    initialized state) — restoring against it pins dtypes/shardings and
+    catches shape drift at load time instead of mid-training. ``step``
+    defaults to the latest.
+    """
+    import orbax.checkpoint as ocp
+
+    with _mgr(path, None) as mgr:
+        if step is None:
+            step = mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {path}")
+        if template is not None:
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+                ),
+                template,
+            )
+            state = mgr.restore(
+                int(step), args=ocp.args.StandardRestore(abstract)
+            )
+        else:
+            state = mgr.restore(int(step))
+        return int(step), state

@@ -1,0 +1,148 @@
+"""Sample packing: variable-length documents -> fixed token-budget streams.
+
+The reference trainer feeds fixed-shape random batches and builds the
+varlen mask with ``infer_varlen_mask_from_batch`` (examples/torch_native/
+main.py:233); real corpora need the step before that — packing documents
+of uneven length into fixed ``capacity``-token streams so every stream
+can be keyed once (the cu_seqlens list is the mask) and XLA sees one
+static shape. This module provides that step, TPU-first: static stream
+length, deterministic packing, truncation/padding policies explicit.
+
+Typical use::
+
+    bins = pack_documents(doc_lens, capacity=total)
+    for b in bins:
+        cu = bin_cu_seqlens(b, doc_lens, capacity=total)
+        key = magi_attn_varlen_key(cu, total, mesh, ...)
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Sequence
+
+import numpy as np
+
+
+def pack_documents(
+    doc_lengths: Sequence[int],
+    capacity: int,
+    *,
+    truncate_oversized: bool = True,
+) -> list[list[int]]:
+    """First-fit-decreasing bin packing of document indices into
+    ``capacity``-token streams.
+
+    Returns a list of bins, each a list of document indices (original
+    order within a bin follows decreasing length — the mask is
+    permutation-invariant, so order only affects locality). Documents
+    longer than ``capacity`` are truncated to fit when
+    ``truncate_oversized`` (they still occupy a dedicated bin), else
+    raise.
+    """
+    if capacity <= 0:
+        raise ValueError(f"capacity must be positive, got {capacity}")
+    order = sorted(
+        range(len(doc_lengths)), key=lambda i: -int(doc_lengths[i])
+    )
+    bins: list[list[int]] = []
+    space: list[int] = []
+    for i in order:
+        ln = int(doc_lengths[i])
+        if ln <= 0:
+            continue
+        if ln > capacity:
+            if not truncate_oversized:
+                raise ValueError(
+                    f"document {i} ({ln} tokens) exceeds capacity {capacity}"
+                )
+            bins.append([i])
+            space.append(0)
+            continue
+        for b, free in enumerate(space):
+            if free >= ln:
+                bins[b].append(i)
+                space[b] = free - ln
+                break
+        else:
+            bins.append([i])
+            space.append(capacity - ln)
+    return bins
+
+
+def bin_cu_seqlens(
+    bin_docs: Sequence[int],
+    doc_lengths: Sequence[int],
+    capacity: int,
+    *,
+    pad_as_doc: bool = True,
+) -> list[int]:
+    """Cumulative boundaries for one packed stream, clamped to capacity.
+
+    With ``pad_as_doc`` the tail padding becomes one final document (its
+    tokens only attend each other — zero pollution of real docs; feed
+    label -100/-1 there so the loss masks it), keeping the stream length
+    static at ``capacity``.
+    """
+    cu = [0]
+    for i in bin_docs:
+        if int(doc_lengths[i]) <= 0:
+            continue  # empty doc: no boundary, later docs still packed
+        if cu[-1] >= capacity:
+            break  # capacity exhausted
+        ln = min(int(doc_lengths[i]), capacity - cu[-1])
+        cu.append(cu[-1] + ln)
+    if pad_as_doc and cu[-1] < capacity:
+        cu.append(capacity)
+    return cu
+
+
+def packing_efficiency(
+    bins: Sequence[Sequence[int]],
+    doc_lengths: Sequence[int],
+    capacity: int,
+) -> float:
+    """Fraction of stream tokens that are real document tokens."""
+    if not bins:
+        return 0.0
+    used = sum(
+        min(sum(int(doc_lengths[i]) for i in b), capacity) for b in bins
+    )
+    return used / (len(bins) * capacity)
+
+
+def pack_corpus(
+    docs: Iterable[np.ndarray],
+    capacity: int,
+    *,
+    pad_token: int = 0,
+    flush_incomplete: bool = True,
+) -> Iterator[tuple[np.ndarray, list[int]]]:
+    """Streaming packer: yields ``(tokens [capacity], cu_seqlens)`` per
+    full stream, greedily packing documents in arrival order (online
+    first-fit over a single open stream — suits iterable corpora where
+    global FFD isn't possible).
+
+    Oversized documents are split across consecutive streams (standard
+    pretraining practice); ``cu_seqlens`` marks every piece boundary so
+    split pieces never attend each other beyond their own stream.
+    """
+    buf = np.full((capacity,), pad_token, dtype=np.int64)
+    cu = [0]
+    fill = 0
+    for doc in docs:
+        arr = np.asarray(doc).reshape(-1)
+        off = 0
+        while off < len(arr):
+            take = min(len(arr) - off, capacity - fill)
+            buf[fill : fill + take] = arr[off : off + take]
+            fill += take
+            off += take
+            cu.append(fill)
+            if fill == capacity:
+                yield buf.copy(), list(cu)
+                buf[:] = pad_token
+                cu = [0]
+                fill = 0
+    if flush_incomplete and fill > 0:
+        cu.append(capacity)  # pad tail as its own doc
+        yield buf.copy(), list(cu)

@@ -1,0 +1,59 @@
+"""Checkpoint/resume roundtrip for train-state pytrees."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.utils import (
+    latest_step,
+    restore_train_state,
+    save_train_state,
+)
+
+
+def _state(seed):
+    k = jax.random.PRNGKey(seed)
+    return {
+        "params": {
+            "w": jax.random.normal(k, (8, 8), jnp.float32),
+            "b": jnp.zeros((8,), jnp.bfloat16),
+        },
+        "opt": {"mu": jnp.ones((8, 8), jnp.float32)},
+    }
+
+
+def test_roundtrip_and_latest(tmp_path):
+    path = str(tmp_path / "ckpt")
+    assert latest_step(path) is None
+    s1, s2 = _state(1), _state(2)
+    save_train_state(path, 10, s1)
+    save_train_state(path, 20, s2)
+    assert latest_step(path) == 20
+    step, restored = restore_train_state(path, template=_state(0))
+    assert step == 20
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(s2)):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    step1, restored1 = restore_train_state(
+        path, step=10, template=_state(0)
+    )
+    assert step1 == 10
+    np.testing.assert_array_equal(
+        np.asarray(restored1["params"]["w"]),
+        np.asarray(s1["params"]["w"]),
+    )
+
+
+def test_max_to_keep_prunes(tmp_path):
+    path = str(tmp_path / "ckpt")
+    for s in range(5):
+        save_train_state(path, s, _state(s), max_to_keep=2)
+    assert latest_step(path) == 4
+    with pytest.raises(Exception):
+        restore_train_state(path, step=0, template=_state(0))
+
+
+def test_restore_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        restore_train_state(str(tmp_path / "none" / "sub"))

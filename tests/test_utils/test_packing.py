@@ -1,0 +1,103 @@
+"""Sample packing: FFD bins, cu_seqlens emission, streaming packer."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.utils import (
+    bin_cu_seqlens,
+    pack_corpus,
+    pack_documents,
+    packing_efficiency,
+)
+
+
+def test_ffd_bins_respect_capacity():
+    rng = np.random.default_rng(0)
+    lens = rng.integers(1, 900, 200).tolist()
+    cap = 1024
+    bins = pack_documents(lens, cap)
+    seen = sorted(i for b in bins for i in b)
+    assert seen == [i for i, ln in enumerate(lens) if ln > 0]
+    for b in bins:
+        assert sum(lens[i] for i in b) <= cap
+    # FFD should beat one-doc-per-bin by a wide margin
+    assert len(bins) < len(lens) * 0.7
+    assert packing_efficiency(bins, lens, cap) > 0.8
+
+
+def test_oversized_doc_policies():
+    with pytest.raises(ValueError, match="exceeds capacity"):
+        pack_documents([2048], 1024, truncate_oversized=False)
+    bins = pack_documents([2048, 10], 1024)
+    assert [sorted(b) for b in sorted(bins)] in ([[0], [1]], [[1], [0]])
+    cu = bin_cu_seqlens([0], [2048], 1024)
+    assert cu == [0, 1024]  # truncated to capacity, no pad doc needed
+
+
+def test_bin_cu_seqlens_pad_doc():
+    lens = [300, 200, 100]
+    cu = bin_cu_seqlens([0, 1, 2], lens, 1024)
+    assert cu == [0, 300, 500, 600, 1024]  # pad tail is its own doc
+    cu2 = bin_cu_seqlens([0, 1, 2], lens, 1024, pad_as_doc=False)
+    assert cu2 == [0, 300, 500, 600]
+
+
+def test_pack_corpus_streaming_and_split():
+    docs = [np.arange(700), np.arange(700, 1200), np.arange(1200, 1300)]
+    streams = list(pack_corpus(docs, capacity=512, pad_token=-7))
+    # total real tokens 1300 -> 3 streams (2 full + 1 flushed)
+    assert len(streams) == 3
+    concat = np.concatenate([t for t, _ in streams])
+    assert (concat[:1300] == np.arange(1300)).all()
+    assert (concat[1300:] == -7).all()
+    for tok, cu in streams:
+        assert tok.shape == (512,)
+        assert cu[0] == 0 and cu[-1] == 512
+        assert all(a < b for a, b in zip(cu, cu[1:]))
+    # the split of doc 0 (700 tokens) puts a boundary at 512 in stream 0
+    assert streams[0][1] == [0, 512]
+    assert streams[1][1][1] == 188  # remaining 188 tokens of doc 0
+
+
+def test_pack_corpus_keys_a_stream():
+    """End-to-end: a packed stream's cu_seqlens drives the varlen key."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from magiattention_tpu.api import (
+        calc_attn,
+        dispatch,
+        infer_attn_mask_from_cu_seqlens,
+        magi_attn_varlen_key,
+        undispatch,
+    )
+    from magiattention_tpu.testing import (
+        assert_close,
+        ref_attn_from_ranges,
+    )
+
+    rng = np.random.default_rng(1)
+    docs = [rng.integers(0, 50, int(n)) for n in rng.integers(40, 300, 8)]
+    (tok, cu), *_ = list(pack_corpus(docs, capacity=512))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("cp",))
+    key = magi_attn_varlen_key(
+        cu, 512, mesh, num_heads=(2, 2), head_dim=16, chunk_size=64,
+        out_dtype="float32",
+    )
+    q = jnp.asarray(rng.standard_normal((512, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((512, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((512, 2, 16)), jnp.float32)
+    out = undispatch(
+        calc_attn(dispatch(q, key), dispatch(k, key), dispatch(v, key), key)[0],
+        key,
+    )
+    qr, kr, ts = infer_attn_mask_from_cu_seqlens(cu)
+    ref, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out, ref, atol=3e-5, rtol=3e-5, msg="packed stream")
+
+
+def test_bin_cu_seqlens_skips_empty_docs():
+    """A zero-length doc must not drop the boundaries of later docs."""
+    cu = bin_cu_seqlens([0, 1, 2], [100, 0, 200], 1024)
+    assert cu == [0, 100, 300, 1024]
