@@ -40,6 +40,10 @@ def main() -> None:
     p.add_argument("--head-dim", type=int, default=64)
     p.add_argument("--chunk", type=int, default=128)
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument(
+        "--ckpt", default="", help="checkpoint dir (resume if it has state)"
+    )
+    p.add_argument("--ckpt-every", type=int, default=5)
     args = p.parse_args()
 
     n_dev = args.cp * args.dp * args.tp * args.pp
@@ -129,14 +133,35 @@ def main() -> None:
         batch_rows = args.dp
     opt = optax.adamw(args.lr)
     opt_state = opt.init(params)
+    start_step = 0
+    if args.ckpt:
+        from magiattention_tpu.utils import (
+            latest_step,
+            restore_train_state,
+            save_train_state,
+        )
+
+        if latest_step(args.ckpt) is not None:
+            start_step, st = restore_train_state(
+                args.ckpt,
+                template={"params": params, "opt_state": opt_state},
+            )
+            # back to uncommitted host arrays: orbax restores committed to
+            # one device, which conflicts with the mesh-wide train step —
+            # as host arrays jit places them exactly like fresh init
+            st = jax.tree.map(np.asarray, st)
+            params, opt_state = st["params"], st["opt_state"]
+            print(f"resumed from step {start_step}", flush=True)
     step_fn = model.make_train_step(opt)
 
-    rng = np.random.default_rng(0)
     pos = jnp.broadcast_to(
         jnp.asarray(meta.perm_idx), (batch_rows, args.total)
     )
 
-    for step in range(args.steps):
+    for step in range(start_step, args.steps):
+        # per-step RNG: a resumed run samples the same data an
+        # uninterrupted run would see at this step
+        rng = np.random.default_rng(1000 + step)
         tokens_g = jnp.asarray(
             rng.integers(0, cfg.vocab_size, (batch_rows, args.total)),
             jnp.int32,
@@ -151,6 +176,13 @@ def main() -> None:
             f"step {step}: loss={loss_val:.4f}  ({time.time()-t0:.2f}s)",
             flush=True,
         )
+        if args.ckpt and args.ckpt_every > 0 and (step + 1) % args.ckpt_every == 0:
+            save_train_state(
+                args.ckpt,
+                step + 1,
+                {"params": params, "opt_state": opt_state},
+            )
+            print(f"saved checkpoint at step {step + 1}", flush=True)
 
 
 if __name__ == "__main__":

@@ -126,6 +126,8 @@ def pack_corpus(
     pretraining practice); ``cu_seqlens`` marks every piece boundary so
     split pieces never attend each other beyond their own stream.
     """
+    if capacity <= 0:
+        raise ValueError(f"capacity must be positive, got {capacity}")
     buf = np.full((capacity,), pad_token, dtype=np.int64)
     cu = [0]
     fill = 0

@@ -101,3 +101,8 @@ def test_bin_cu_seqlens_skips_empty_docs():
     """A zero-length doc must not drop the boundaries of later docs."""
     cu = bin_cu_seqlens([0, 1, 2], [100, 0, 200], 1024)
     assert cu == [0, 100, 300, 1024]
+
+
+def test_pack_corpus_rejects_bad_capacity():
+    with pytest.raises(ValueError, match="capacity"):
+        next(pack_corpus([np.arange(5)], capacity=0))
