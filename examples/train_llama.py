@@ -77,6 +77,11 @@ def main() -> None:
         init_pp_params,
     )
     from magiattention_tpu.parallel import dispatch
+    from magiattention_tpu.utils import (
+        latest_step,
+        restore_train_state,
+        save_train_state,
+    )
 
     cfg = LlamaConfig(
         vocab_size=1024,
@@ -135,12 +140,6 @@ def main() -> None:
     opt_state = opt.init(params)
     start_step = 0
     if args.ckpt:
-        from magiattention_tpu.utils import (
-            latest_step,
-            restore_train_state,
-            save_train_state,
-        )
-
         if latest_step(args.ckpt) is not None:
             start_step, st = restore_train_state(
                 args.ckpt,

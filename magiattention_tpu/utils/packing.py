@@ -126,8 +126,18 @@ def pack_corpus(
     pretraining practice); ``cu_seqlens`` marks every piece boundary so
     split pieces never attend each other beyond their own stream.
     """
+    # validate eagerly (at the call site), not on first iteration
     if capacity <= 0:
         raise ValueError(f"capacity must be positive, got {capacity}")
+    return _pack_corpus_gen(docs, capacity, pad_token, flush_incomplete)
+
+
+def _pack_corpus_gen(
+    docs: Iterable[np.ndarray],
+    capacity: int,
+    pad_token: int,
+    flush_incomplete: bool,
+) -> Iterator[tuple[np.ndarray, list[int]]]:
     buf = np.full((capacity,), pad_token, dtype=np.int64)
     cu = [0]
     fill = 0

@@ -66,6 +66,34 @@ SCENARIOS = [
         [(0, 384), (128, 640), (384, 768)],
         [C, I, B],
     ),
+    (
+        # reference share_question_1k_with_q_overlap: two answers share a
+        # question prefix; each answer attends (question FULL + itself
+        # CAUSAL) and never the other answer
+        "share_question_q_overlap",
+        768,
+        [(0, 256), (256, 512), (256, 512), (512, 768), (512, 768)],
+        [(0, 256), (0, 256), (256, 512), (0, 256), (512, 768)],
+        [C, F, C, F, C],
+    ),
+    (
+        # reference full_mask_assembled_from_small_pieces_with_8k: a dense
+        # full mask tiled from 16 small FULL slices — plan must merge the
+        # pieces into the same coverage as one big slice
+        "full_assembled_from_pieces",
+        512,
+        [
+            (q0, q0 + 128)
+            for q0 in range(0, 512, 128)
+            for _k0 in range(0, 512, 128)
+        ],
+        [
+            (k0, k0 + 128)
+            for _q0 in range(0, 512, 128)
+            for k0 in range(0, 512, 128)
+        ],
+        [F] * 16,
+    ),
 ]
 
 

@@ -104,5 +104,6 @@ def test_bin_cu_seqlens_skips_empty_docs():
 
 
 def test_pack_corpus_rejects_bad_capacity():
+    # eager: the error points at the call site, not the first iteration
     with pytest.raises(ValueError, match="capacity"):
-        next(pack_corpus([np.arange(5)], capacity=0))
+        pack_corpus([np.arange(5)], capacity=0)
