@@ -1021,6 +1021,19 @@ def _auto_head_block(pref: int, hq: int, group: int) -> int:
     return best
 
 
+_LONG_SEQ_BLOCK_THRESHOLD = 16384
+# >= 16k tokens: only the wide rungs are candidates — measured on-chip
+# (BENCH_DETAIL.md) the backward pair is grid-bound at (128, 512); any
+# rung denser than (256, 1024) that fails the entry budget implies the
+# smaller rungs fail it too, so they are futile in this regime.
+_LONG_SEQ_CONFIGS = tuple(
+    c for c in _AUTO_BLOCK_CONFIGS if c[0] * c[1] >= 256 * 1024
+)
+# head_block preference keyed by the blocking the kernel will actually
+# run (so caller-fixed block sizes get the hb measured for THAT rung)
+_HB_FOR_BLOCKS = {(bq, bk): hb for bq, bk, hb in _AUTO_BLOCK_CONFIGS}
+
+
 def auto_block_config(
     q_ranges,
     k_ranges,
@@ -1033,14 +1046,31 @@ def auto_block_config(
     """Pick (block_q, block_k, head_block) for a mask: the fastest measured
     config whose entry-table estimate fits the smem scalar-prefetch budget.
 
+    At >= 16k tokens (queries or keys) the (256, 1024, 2) rung is
+    preferred even when the smaller (128, 512, 8) fits: measured on-chip
+    (BENCH_DETAIL.md), the backward pair is grid-bound at the small
+    blocking — bwd full/causal at 16k/32k gains ~50% (43.7 -> 68.0 TF/s
+    at 16k full) while fwd is neutral-to-better; below 16k the small
+    rung's lower latency wins.
+
     Caller-fixed block sizes are honored: the entry estimate and head_block
     choice are computed against the blocking the kernel will actually use.
     """
     group = max(hq // max(hk, 1), 1)
+    extent = max(
+        max((int(r[1]) for r in q_ranges), default=0),
+        max((int(r[1]) for r in k_ranges), default=0),
+    )
+    configs = (
+        _LONG_SEQ_CONFIGS
+        if extent >= _LONG_SEQ_BLOCK_THRESHOLD
+        else _AUTO_BLOCK_CONFIGS
+    )
     last = None
-    for bq, bk, hb in _AUTO_BLOCK_CONFIGS:
+    for bq, bk, hb in configs:
         bq = fixed_block_q if fixed_block_q is not None else bq
         bk = fixed_block_k if fixed_block_k is not None else bk
+        hb = _HB_FOR_BLOCKS.get((bq, bk), hb)
         last = (bq, bk, _auto_head_block(hb, hq, group))
         if _est_entries(q_ranges, k_ranges, bq, bk) <= _MAX_SMEM_ENTRIES:
             return last

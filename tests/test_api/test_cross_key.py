@@ -157,3 +157,35 @@ def test_cross_key_pad_k_not_aliased():
         )
         rt = undispatch_kv(dkv(x, key), key)
         np.testing.assert_array_equal(np.asarray(rt), np.asarray(x))
+
+
+def test_cross_key_jnp_backend():
+    """MAGI_ATTENTION_KERNEL_BACKEND=jnp through the keyed cross path:
+    the dense any-dtype backend must agree with the oracle on a padded
+    tq != tk mask (fp64 on CPU — the sdpa-fp64 analogue)."""
+    tq, tk, cp = 320, 704, 4
+    mesh = _mesh(cp)
+    qr = [(0, 160), (160, 320)]
+    kr = [(0, 352), (176, 704)]
+    ts = [F, C]
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+        key = magi_attn_cross_key(
+            qr, kr, ts, tq, tk, mesh, num_heads=(2, 2), head_dim=32,
+            chunk_size_q=64, chunk_size_k=64, out_dtype="float64",
+        )
+        rng = np.random.default_rng(5)
+        q = jnp.asarray(rng.standard_normal((tq, 2, 32)), jnp.float64)
+        k = jnp.asarray(rng.standard_normal((tk, 2, 32)), jnp.float64)
+        v = jnp.asarray(rng.standard_normal((tk, 2, 32)), jnp.float64)
+        out = undispatch(
+            calc_attn(
+                dispatch(q, key), dispatch_kv(k, key), dispatch_kv(v, key),
+                key,
+            )[0],
+            key,
+        )
+    ref, _, _ = ref_attn_from_ranges(
+        q, k, v, qr, kr, ts, compute_dtype=jnp.float64
+    )
+    assert_close(out, ref, atol=1e-12, rtol=1e-12, msg="xkey jnp fp64")

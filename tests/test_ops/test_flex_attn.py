@@ -263,3 +263,50 @@ def test_large_block_escalation_config():
     ref, ref_lse, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
     assert_close(out, ref, atol=3e-5, rtol=3e-5)
     assert_close(lse, ref_lse, atol=3e-5, rtol=3e-5)
+
+
+def test_auto_block_config_prefers_large_blocks_at_long_seq():
+    """>= 16k tokens: the (256, 1024) rung is preferred when it fits (the
+    measured bwd-throughput winner, BENCH_DETAIL.md); below 16k the
+    low-latency (128, 512) rung stays first; oversized masks still
+    escalate to (512, 2048)."""
+    from magiattention_tpu.ops.flex_attn import auto_block_config
+
+    # short dense causal -> small rung
+    assert auto_block_config([(0, 8192)], [(0, 8192)], 8, 8)[:2] == (128, 512)
+    # long dense causal -> measured bwd winner
+    assert auto_block_config([(0, 32768)], [(0, 32768)], 8, 8)[:2] == (
+        256,
+        1024,
+    )
+    # 128k dense: only the escalation rung fits the smem entry budget
+    assert auto_block_config([(0, 131072)], [(0, 131072)], 8, 8)[:2] == (
+        512,
+        2048,
+    )
+    # fixed blocks are always honored
+    assert auto_block_config(
+        [(0, 32768)], [(0, 32768)], 8, 8, fixed_block_q=128, fixed_block_k=512
+    )[:2] == (128, 512)
+
+
+def test_auto_block_config_fixed_blocks_keep_their_head_block():
+    """Caller-fixed small blocks at long seqlen keep the hb measured for
+    that blocking (8), not the long-seq rung's hb."""
+    from magiattention_tpu.ops.flex_attn import auto_block_config
+
+    assert auto_block_config(
+        [(0, 32768)], [(0, 32768)], 8, 8,
+        fixed_block_q=128, fixed_block_k=512,
+    ) == (128, 512, 8)
+
+
+def test_auto_block_config_long_keys_short_queries():
+    """Cross-attn mask: 4k queries over 128k keys is in the grid-bound
+    regime and must use a wide rung."""
+    from magiattention_tpu.ops.flex_attn import auto_block_config
+
+    assert auto_block_config([(0, 4096)], [(0, 131072)], 8, 8)[:2] == (
+        256,
+        1024,
+    )
