@@ -1030,8 +1030,18 @@ _LONG_SEQ_CONFIGS = tuple(
     c for c in _AUTO_BLOCK_CONFIGS if c[0] * c[1] >= 256 * 1024
 )
 # head_block preference keyed by the blocking the kernel will actually
-# run (so caller-fixed block sizes get the hb measured for THAT rung)
+# run (so caller-fixed block sizes get the hb measured for THAT rung).
+# For mixed pairs (only one of block_q/block_k fixed by the caller) the
+# fallback keys on block_k alone: the K/V double-buffer footprint
+# (block_k x head_block x d) is what the measured hb values are sized
+# against, so the k-width determines the sound head_block.
 _HB_FOR_BLOCKS = {(bq, bk): hb for bq, bk, hb in _AUTO_BLOCK_CONFIGS}
+# min() per bk: several rungs share a block_k; an unmeasured mixed pair
+# must take the most conservative measured head_block for that k-width
+# (vmem-safe regardless of the caller's block_q).
+_HB_FOR_BK: dict[int, int] = {}
+for _bq, _bk, _hb in _AUTO_BLOCK_CONFIGS:
+    _HB_FOR_BK[_bk] = min(_hb, _HB_FOR_BK.get(_bk, _hb))
 
 
 def auto_block_config(
@@ -1070,7 +1080,7 @@ def auto_block_config(
     for bq, bk, hb in configs:
         bq = fixed_block_q if fixed_block_q is not None else bq
         bk = fixed_block_k if fixed_block_k is not None else bk
-        hb = _HB_FOR_BLOCKS.get((bq, bk), hb)
+        hb = _HB_FOR_BLOCKS.get((bq, bk), _HB_FOR_BK.get(bk, hb))
         last = (bq, bk, _auto_head_block(hb, hq, group))
         if _est_entries(q_ranges, k_ranges, bq, bk) <= _MAX_SMEM_ENTRIES:
             return last

@@ -301,6 +301,29 @@ def test_auto_block_config_fixed_blocks_keep_their_head_block():
     ) == (128, 512, 8)
 
 
+def test_auto_block_config_partially_fixed_blocks_key_hb_on_block_k():
+    """When only one block dimension is fixed, the mixed (bq, bk) pair is
+    not a measured rung; head_block falls back to the hb measured for the
+    effective block_k (the K/V double-buffer width the hb values are
+    sized against)."""
+    from magiattention_tpu.ops.flex_attn import auto_block_config
+
+    # fixed small block_k at long seqlen: bq iterates to 256, and
+    # (256, 512) is itself a measured rung -> hb 4
+    assert auto_block_config(
+        [(0, 32768)], [(0, 32768)], 8, 8, fixed_block_k=512
+    ) == (256, 512, 4)
+    # a mixed pair no rung measures (bq=512 fixed, bk=512): hb keys on
+    # block_k alone -> 4, not the iterating wide rung's 2/1
+    assert auto_block_config(
+        [(0, 32768)], [(0, 32768)], 8, 8, fixed_block_q=512, fixed_block_k=512
+    )[2] == 4
+    # fixed small block_q at long seqlen: bk iterates to 1024 -> hb 2
+    assert auto_block_config(
+        [(0, 32768)], [(0, 32768)], 8, 8, fixed_block_q=128
+    ) == (128, 1024, 2)
+
+
 def test_auto_block_config_long_keys_short_queries():
     """Cross-attn mask: 4k queries over 128k keys is in the grid-bound
     regime and must use a wide rung."""
