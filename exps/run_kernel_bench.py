@@ -85,8 +85,23 @@ def main() -> None:
     p.add_argument(
         "--masks", default="", help="comma subset of mask families (all if empty)"
     )
+    p.add_argument(
+        "--out",
+        default="",
+        help="append each completed row as a JSON line to this file (the "
+        "axon tunnel can wedge mid-sweep; incremental persistence means a "
+        "partial run still yields data)",
+    )
     args = p.parse_args()
     modes = set(args.mode.split(","))
+
+    def persist(row):
+        print(row, file=sys.stderr, flush=True)
+        if args.out:
+            import json
+
+            with open(args.out, "a") as f:
+                f.write(json.dumps(row) + "\n")
 
     import jax
     import jax.numpy as jnp
@@ -165,7 +180,7 @@ def main() -> None:
                     else None
                 )
             rows.append(row)
-            print(row, file=sys.stderr, flush=True)
+            persist(row)
 
         # official-kernel reference points (full + causal only)
         try:
@@ -211,7 +226,7 @@ def main() -> None:
                         else None
                     )
                 rows.append(row)
-                print(row, file=sys.stderr, flush=True)
+                persist(row)
         except Exception as e:  # pragma: no cover
             print(f"jax reference kernel failed: {e}", file=sys.stderr)
 

@@ -278,6 +278,14 @@ class DistAttnRuntimeDict:
     def __len__(self) -> int:
         return len(self._d)
 
+    def clear(self, mesh_id: Optional[int] = None) -> None:
+        """Drop all entries, or only those planned over the given mesh."""
+        if mesh_id is None:
+            self._d.clear()
+            return
+        for k in [k for k in self._d if k.mesh_id == mesh_id]:
+            del self._d[k]
+
 
 _runtime_dict = DistAttnRuntimeDict(maxsize=env.runtime_dict_size())
 _most_recent_key: Optional[DistAttnRuntimeKey] = None
@@ -891,9 +899,94 @@ def make_flex_key_for_new_mask_after_dispatch(
     return new_key
 
 
+def make_varlen_key_for_new_mask_after_dispatch(
+    cu_seqlens: Sequence[int],
+    old_key: DistAttnRuntimeKey,
+    *,
+    causal: bool = True,
+) -> DistAttnRuntimeKey:
+    """Varlen-style flavor of :func:`make_flex_key_for_new_mask_after_dispatch`
+    (reference api/magi_attn_interface.py:1167): plan a new packed-batch
+    mask described by ``cu_seqlens`` on the EXISTING dispatch of
+    ``old_key`` (hybrid-attention layer stacks sharing one permutation).
+    ``causal`` defaults to True, matching ``magi_attn_varlen_key`` (the
+    reference defaults both of its varlen entry points to False; here the
+    two stay consistent with each other instead)."""
+    from .functools import infer_attn_mask_from_cu_seqlens
+
+    q_ranges, k_ranges, types = infer_attn_mask_from_cu_seqlens(
+        list(cu_seqlens), causal=causal
+    )
+    return make_flex_key_for_new_mask_after_dispatch(
+        q_ranges, k_ranges, types, old_key
+    )
+
+
+def magi_attn_flex_dispatch(
+    x: jax.Array,
+    q_ranges,
+    k_ranges,
+    attn_type_map,
+    total_seqlen_q: int,
+    total_seqlen_k: int,
+    mesh: jax.sharding.Mesh,
+    **kwargs,
+) -> tuple[jax.Array, DistAttnRuntimeKey]:
+    """Key + dispatch in one call (reference magi_attn_flex_dispatch,
+    api/magi_attn_interface.py:725): plans the runtime for the mask and
+    returns ``(local_x, key)``."""
+    key = magi_attn_flex_key(
+        q_ranges, k_ranges, attn_type_map,
+        total_seqlen_q, total_seqlen_k, mesh, **kwargs,
+    )
+    return dispatch(x, key), key
+
+
+def magi_attn_varlen_dispatch(
+    x: jax.Array,
+    cu_seqlens: Sequence[int],
+    total_seqlen: int,
+    mesh: jax.sharding.Mesh,
+    *,
+    causal: bool = True,
+    **kwargs,
+) -> tuple[jax.Array, DistAttnRuntimeKey]:
+    """Key + dispatch in one call, flash-attn-varlen style (reference
+    magi_attn_varlen_dispatch, api/magi_attn_interface.py:305)."""
+    key = magi_attn_varlen_key(
+        cu_seqlens, total_seqlen, mesh, causal=causal, **kwargs
+    )
+    return dispatch(x, key), key
+
+
+def clear_cache(mesh: "jax.sharding.Mesh | None" = None) -> None:
+    """Drop cached runtime plans (reference clear_cache,
+    api/magi_attn_interface.py:1157). With a ``mesh``, only keys planned
+    over that mesh are dropped; otherwise the whole cache is cleared.
+    Keys stay valid to re-plan — the cache is rebuildable by design."""
+    global _most_recent_key
+    if mesh is None:
+        _runtime_dict.clear()
+        _most_recent_key = None
+        return
+    _runtime_dict.clear(mesh_id=id(mesh))
+    if _most_recent_key is not None and _most_recent_key.mesh_id == id(mesh):
+        _most_recent_key = None
+
+
 def roll(x: jax.Array, key: DistAttnRuntimeKey, shift: int, axis: int = 0):
     """Distributed roll along the global sequence of a dispatched tensor
     (reference api.roll :960 — MTP label shifting)."""
     from ..parallel.dispatch import roll as _roll
 
     return _roll(x, get_runtime_mgr(key).dispatch_meta, shift, axis=axis)
+
+
+def roll_simple(
+    x: jax.Array, key: DistAttnRuntimeKey, shift: int, axis: int = 0
+):
+    """Alias of :func:`roll` (reference roll_simple,
+    api/magi_attn_interface.py:1004 — its only difference is plain vs
+    batched P2P issue order; here both are the same static gather whose
+    communication GSPMD schedules)."""
+    return roll(x, key, shift, axis=axis)

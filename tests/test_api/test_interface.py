@@ -320,3 +320,78 @@ def test_qo_comm_env_flag_routes_api(monkeypatch):
         out_dtype="float32",
     )
     assert key2 != key, "qo flag must be part of the key fingerprint"
+
+
+def test_varlen_dispatch_and_clear_cache():
+    """magi_attn_varlen_dispatch returns (local_x, key) consistent with
+    dispatch(x, key); clear_cache drops plans per-mesh and globally
+    (reference api:305, :1157)."""
+    from magiattention_tpu.api import (
+        clear_cache,
+        magi_attn_varlen_dispatch,
+        roll_simple,
+    )
+    from magiattention_tpu.api.interface import _runtime_dict
+
+    mesh = _mesh(2)
+    total, hq, hk, d = 512, 2, 2, 32
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    xl, key = magi_attn_varlen_dispatch(
+        x, [0, 256, 512], total, mesh,
+        num_heads=(hq, hk), head_dim=d, chunk_size=64, out_dtype="float32",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(xl), np.asarray(dispatch(x, key))
+    )
+
+    # roll_simple aliases roll
+    from magiattention_tpu.api import roll
+
+    np.testing.assert_array_equal(
+        np.asarray(roll_simple(xl, key, 1)), np.asarray(roll(xl, key, 1))
+    )
+
+    assert len(_runtime_dict) > 0
+    other_mesh = _mesh(1)
+    clear_cache(other_mesh)  # different mesh: key survives
+    assert key in _runtime_dict
+    clear_cache(mesh)  # this mesh: dropped
+    assert key not in _runtime_dict
+    clear_cache()
+    assert len(_runtime_dict) == 0
+
+
+def test_make_varlen_key_for_new_mask_after_dispatch():
+    """Hybrid-attn varlen flavor: new cu_seqlens mask on an existing
+    dispatch; the partition is shared and the new mask's output matches
+    the oracle (reference api:1167)."""
+    from magiattention_tpu.api import (
+        make_varlen_key_for_new_mask_after_dispatch,
+    )
+
+    mesh = _mesh(4)
+    total, hq, hk, d = 1024, 2, 2, 32
+    key1 = magi_attn_varlen_key(
+        [0, 512, 1024], total, mesh,
+        num_heads=(hq, hk), head_dim=d, chunk_size=64, out_dtype="float32",
+    )
+    key2 = make_varlen_key_for_new_mask_after_dispatch(
+        [0, 256, 768, 1024], key1, causal=True
+    )
+    assert key2 != key1
+    # shared dispatch: position ids identical
+    np.testing.assert_array_equal(
+        np.asarray(get_position_ids(key1)), np.asarray(get_position_ids(key2))
+    )
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, hk, d)), jnp.float32)
+    qd, kd, vd = dispatch(q, key1), dispatch(k, key1), dispatch(v, key1)
+    out = undispatch(calc_attn(qd, kd, vd, key2)[0], key2)
+    qr, kr, ts = infer_attn_mask_from_cu_seqlens(
+        [0, 256, 768, 1024], causal=True
+    )
+    ref_out, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out, ref_out, atol=2e-5, rtol=2e-5, msg="hybrid varlen")
