@@ -585,14 +585,22 @@ def magi_attn_varlen_key(
     mesh: jax.sharding.Mesh,
     *,
     causal: bool = True,
+    window_size: tuple[int, int] = (-1, -1),
+    global_window_size: int = 0,
     **kwargs,
 ) -> DistAttnRuntimeKey:
     """Varlen (packed-batch) convenience key
-    (reference magi_attn_varlen_key :160)."""
+    (reference magi_attn_varlen_key :160). ``window_size=(left, right)``
+    applies a per-sample bidirectional sliding window (requires
+    ``causal=False``), optionally with ``global_window_size`` leading
+    keys per sample (reference :314-316 window semantics)."""
     from .functools import infer_attn_mask_from_cu_seqlens
 
     q_ranges, k_ranges, types = infer_attn_mask_from_cu_seqlens(
-        list(cu_seqlens), causal=causal
+        list(cu_seqlens),
+        causal=causal,
+        window_size=tuple(window_size),
+        global_window_size=global_window_size,
     )
     return magi_attn_flex_key(
         q_ranges,
@@ -904,6 +912,8 @@ def make_varlen_key_for_new_mask_after_dispatch(
     old_key: DistAttnRuntimeKey,
     *,
     causal: bool = True,
+    window_size: tuple[int, int] = (-1, -1),
+    global_window_size: int = 0,
 ) -> DistAttnRuntimeKey:
     """Varlen-style flavor of :func:`make_flex_key_for_new_mask_after_dispatch`
     (reference api/magi_attn_interface.py:1167): plan a new packed-batch
@@ -911,11 +921,15 @@ def make_varlen_key_for_new_mask_after_dispatch(
     ``old_key`` (hybrid-attention layer stacks sharing one permutation).
     ``causal`` defaults to True, matching ``magi_attn_varlen_key`` (the
     reference defaults both of its varlen entry points to False; here the
-    two stay consistent with each other instead)."""
+    two stay consistent with each other instead). ``window_size`` /
+    ``global_window_size`` follow ``magi_attn_varlen_key``."""
     from .functools import infer_attn_mask_from_cu_seqlens
 
     q_ranges, k_ranges, types = infer_attn_mask_from_cu_seqlens(
-        list(cu_seqlens), causal=causal
+        list(cu_seqlens),
+        causal=causal,
+        window_size=tuple(window_size),
+        global_window_size=global_window_size,
     )
     return make_flex_key_for_new_mask_after_dispatch(
         q_ranges, k_ranges, types, old_key
