@@ -228,6 +228,10 @@ def infer_attn_mask_from_sliding_window(
         "for bidirectional SWA use infer_window_mask_per_range / "
         "infer_attn_mask_from_cu_seqlens(window_size=(l, r))"
     )
+    assert window_size >= 1, (
+        f"window_size must be >= 1, got {window_size} (a 0-wide window "
+        "would collide with the -1 'unbounded' sentinel)"
+    )
     qr, kr, ts = infer_window_mask_per_range(
         (0, total_seqlen),
         (0, total_seqlen),
