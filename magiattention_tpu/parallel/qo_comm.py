@@ -142,6 +142,31 @@ def build_qo_comm_plan(
     )
     sol = solver.solve(rects, cp_size, total_seqlen=total_seqlen)
 
+    import logging
+
+    logger = logging.getLogger("magiattention_tpu")
+    if logger.isEnabledFor(logging.DEBUG):
+        # debug-only bucket plot (reference _make_attn_meta.py:96-101
+        # writes dyn_solver_buckets.png at DEBUG level); the filename is
+        # keyed on the mask so multi-key runs keep every plot, and any
+        # I/O failure must never take planning down
+        try:
+            import hashlib
+
+            from ..utils.vis import plot_dynamic_solution
+
+            tag = hashlib.sha1(sl.tobytes()).hexdigest()[:8]
+            path = plot_dynamic_solution(
+                sol,
+                total_seqlen,
+                total_seqlen,
+                f"./dyn_solver_buckets_cp{cp_size}_{tag}.png",
+            )
+            if path:
+                logger.debug("dynamic-solver bucket plot saved to %s", path)
+        except Exception as e:
+            logger.debug("dynamic-solver bucket plot failed: %r", e)
+
     q_need: list[AttnRanges] = []
     k_need: list[AttnRanges] = []
     rank_slices: list[np.ndarray] = []

@@ -1,4 +1,4 @@
-"""Utility subpackage: instrumentation, cost factors, packing, checkpointing."""
+"""Utility subpackage: instrumentation, cost factors, packing, checkpointing, plan visualization."""
 
 from .checkpoint import latest_step, restore_train_state, save_train_state
 from .cost import (
@@ -7,6 +7,7 @@ from .cost import (
     get_comm_cost_factor,
 )
 from .instrument import add_trace_event, instrument_trace, switch_profile
+from .vis import plot_dynamic_solution, plot_mask
 from .packing import (
     bin_cu_seqlens,
     pack_corpus,
@@ -25,6 +26,8 @@ __all__ = [
     "pack_corpus",
     "pack_documents",
     "packing_efficiency",
+    "plot_dynamic_solution",
+    "plot_mask",
     "restore_train_state",
     "save_train_state",
     "switch_profile",

@@ -1,0 +1,55 @@
+"""Plan-visualization smoke tests: polygons must cover exactly the
+unmasked cells (verified against the dense mask at low resolution)."""
+
+import os
+
+import numpy as np
+
+from magiattention_tpu.common.rectangle import AttnRectangles
+from magiattention_tpu.meta.solver.dynamic_attn_solver import (
+    DynamicAttnSolver,
+)
+from magiattention_tpu.utils import plot_dynamic_solution, plot_mask
+from magiattention_tpu.utils.vis import _mask_polygon
+
+
+def test_mask_polygon_matches_dense_semantics():
+    """Polygon corner math agrees with slice_mask row bounds for all four
+    types (corners are enough — the bounds are linear in q)."""
+    from magiattention_tpu.common.enum import AttnMaskType
+    from magiattention_tpu.common.mask import slice_mask
+
+    qs, qe, ks, ke = 4, 12, 2, 16
+    for mt in AttnMaskType:
+        poly = _mask_polygon(qs, qe, ks, ke, mt)
+        dense = slice_mask(qs, qe, ks, ke, mt, 16, 20)
+        for q in (qs, qe - 1):
+            row = np.where(dense[q])[0]
+            if row.size == 0:
+                continue
+            lo, hi = row[0], row[-1] + 1
+            # interpolate the polygon edges at row q + 0.5ish: the left
+            # edge points are (lo, q) pairs at q=qs and q=qe
+            (l0, _), (l1, _) = poly[0], poly[1]
+            (r1, _), (r0, _) = poly[2], poly[3]
+            frac = (q - qs) / (qe - qs)
+            lo_p = l0 + (l1 - l0) * frac
+            hi_p = r0 + (r1 - r0) * frac
+            assert abs(lo_p - lo) <= 1.0, (mt, q, lo_p, lo)
+            assert abs(hi_p - hi) <= 1.0, (mt, q, hi_p, hi)
+
+
+def test_plot_mask_and_solution(tmp_path):
+    total = 256
+    qr = [(0, 128), (128, 256)]
+    kr = [(0, 128), (64, 256)]
+    ts = [1, 3]
+    p1 = plot_mask(qr, kr, ts, total, total, str(tmp_path / "mask.png"))
+    assert p1 and os.path.getsize(p1) > 1000
+
+    rects = AttnRectangles.from_ranges(qr, kr, ts)
+    sol = DynamicAttnSolver().solve(rects, 4, total_seqlen=total)
+    p2 = plot_dynamic_solution(
+        sol, total, total, str(tmp_path / "buckets.png")
+    )
+    assert p2 and os.path.getsize(p2) > 1000
