@@ -71,18 +71,22 @@ class Run:
 
 
 def runs_from_position_ids(position_ids: np.ndarray) -> list[Run]:
-    """Compress a local->global id map into maximal contiguous runs."""
+    """Compress a local->global id map into maximal contiguous runs.
+
+    Vectorized: run boundaries are exactly the places where the id does
+    not advance by 1 (a Python per-element scan dominated 1M-token plan
+    builds at ~70 ms per call; this is O(n) numpy + O(runs) Python).
+    """
     pos = np.asarray(position_ids, dtype=np.int64).reshape(-1)
-    runs: list[Run] = []
-    i = 0
     n = pos.shape[0]
-    while i < n:
-        j = i + 1
-        while j < n and pos[j] == pos[j - 1] + 1:
-            j += 1
-        runs.append(Run(local_start=i, global_start=int(pos[i]), length=j - i))
-        i = j
-    return runs
+    if n == 0:
+        return []
+    starts = np.concatenate(([0], np.flatnonzero(np.diff(pos) != 1) + 1))
+    ends = np.concatenate((starts[1:], [n]))
+    return [
+        Run(local_start=int(s), global_start=int(pos[s]), length=int(e - s))
+        for s, e in zip(starts, ends)
+    ]
 
 
 def identity_runs(total: int) -> list[Run]:

@@ -141,3 +141,17 @@ def test_kernel_bitwise_deterministic():
         np.testing.assert_array_equal(
             np.asarray(a), np.asarray(b), err_msg=name
         )
+
+
+@pytest.mark.parametrize("d", [72, 96, 256])
+def test_non_lane_aligned_head_dims(d):
+    """Head dims that are not multiples of the 128 TPU lane width (and the
+    wide 256) run correctly — the kernel/Mosaic handles sublane padding
+    (reference rounds head_dim up internally, _flex_flash_attn_jit.py)."""
+    t, h = 128, 2
+    q, k, v = _rand(t, t, h, h, d, seed=d)
+    out = flex_flash_attn_func(
+        q, k, v, [(0, t)], [(0, t)], [1], block_q=64, block_k=64
+    )[0]
+    ref = ref_attn_from_ranges(q, k, v, [(0, t)], [(0, t)], [1])[0]
+    assert_close(out, ref, atol=3e-5, rtol=3e-5, msg=f"d={d}")
