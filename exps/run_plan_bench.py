@@ -1,0 +1,75 @@
+"""Cold-plan latency sweep: host-side planning cost vs scale.
+
+The reference accelerates its solver hot loops with the C++
+magi_attn_ext module because cold planning cost bounds how often masks
+can change (every new mask = one plan). This sweep measures the same
+quantity here: dispatch-meta + bucket + full distributed plan build
+(native entry emission + vectorized run compression), per mask family,
+seqlen, and cp. CPU-only — no TPU needed.
+
+    python exps/run_plan_bench.py [--seqlens 131072,1048576] [--cp 8,32]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seqlens", default="131072,524288,1048576")
+    p.add_argument("--cp", default="8,32")
+    p.add_argument("--doc-len", type=int, default=8192)
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from magiattention_tpu.common.enum import AttnMaskType
+    from magiattention_tpu.common.ranges import AttnRanges
+    from magiattention_tpu.meta.dispatch_meta import (
+        make_dispatch_meta_from_qk_ranges,
+    )
+    from magiattention_tpu.ops.flex_attn import auto_block_config
+    from magiattention_tpu.parallel.dist_attn import build_dist_attn_plan
+
+    def families(total):
+        cuts = list(range(0, total + 1, args.doc_len))
+        docs = list(zip(cuts, cuts[1:]))
+        return {
+            "dense_causal": ([(0, total)], [(0, total)], [1]),
+            "varlen_causal": (docs, docs, [1] * len(docs)),
+        }
+
+    print(f"{'mask':<14} {'seqlen':>8} {'cp':>3} {'meta_s':>7} {'plan_s':>7}")
+    for total in [int(s) for s in args.seqlens.split(",")]:
+        for cp in [int(c) for c in args.cp.split(",")]:
+            chunk = max(total // (8 * cp), 128)
+            for name, (qr, kr, ts) in families(total).items():
+                qa = AttnRanges.from_ranges(qr)
+                ka = AttnRanges.from_ranges(kr)
+                mt = [AttnMaskType(t) for t in ts]
+                bq, bk, _ = auto_block_config(qr, kr, 8, 8)
+                t0 = time.time()
+                mq, mk, bucket = make_dispatch_meta_from_qk_ranges(
+                    qa, ka, mt, total, total, chunk, cp
+                )
+                t1 = time.time()
+                plan = build_dist_attn_plan(
+                    mq, bucket, block_q=bq, block_k=bk
+                )
+                t2 = time.time()
+                print(
+                    f"{name:<14} {total:>8} {cp:>3} {t1 - t0:>7.2f} "
+                    f"{t2 - t1:>7.2f}",
+                    flush=True,
+                )
+                del plan
+
+
+if __name__ == "__main__":
+    main()

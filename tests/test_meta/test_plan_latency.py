@@ -1,0 +1,31 @@
+"""Cold-plan latency regression guard.
+
+Planning is host-side and runs once per unique mask; its cost bounds
+how often masks can change mid-training. The dense-causal 1M-token
+cp=32 plan builds in ~1.3s (vectorized run compression + native entry
+emission); the bound below is ~5x that, loose enough for CI noise but
+tight enough to catch a return of per-element Python scans (8.5s before
+the vectorization, worse without the native module).
+"""
+
+import time
+
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.meta.dispatch_meta import (
+    make_dispatch_meta_from_qk_ranges,
+)
+from magiattention_tpu.parallel.dist_attn import build_dist_attn_plan
+
+
+def test_dense_1m_plan_under_bound():
+    total, cp, chunk = 1 << 20, 32, 4096
+    qr = AttnRanges.from_ranges([(0, total)])
+    t0 = time.time()
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, qr.clone(), [AttnMaskType.CAUSAL], total, total, chunk, cp
+    )
+    plan = build_dist_attn_plan(mq, bucket, block_q=512, block_k=2048)
+    dt = time.time() - t0
+    assert plan.total_area == total * (total + 1) // 2
+    assert dt < 7.0, f"1M-token plan took {dt:.1f}s (regression)"
