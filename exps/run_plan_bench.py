@@ -38,8 +38,8 @@ def main() -> None:
     from magiattention_tpu.parallel.dist_attn import build_dist_attn_plan
 
     def families(total):
-        cuts = list(range(0, total + 1, args.doc_len))
-        docs = list(zip(cuts, cuts[1:]))
+        cuts = list(range(0, total, args.doc_len)) + [total]
+        docs = list(zip(cuts, cuts[1:]))  # tail doc absorbs any remainder
         return {
             "dense_causal": ([(0, total)], [(0, total)], [1]),
             "varlen_causal": (docs, docs, [1] * len(docs)),
@@ -49,6 +49,13 @@ def main() -> None:
     for total in [int(s) for s in args.seqlens.split(",")]:
         for cp in [int(c) for c in args.cp.split(",")]:
             chunk = max(total // (8 * cp), 128)
+            if total % chunk or (total // chunk) % cp:
+                print(
+                    f"skip seqlen={total} cp={cp}: chunk {chunk} does not "
+                    "tile the sequence evenly (pass a padded seqlen)",
+                    file=sys.stderr,
+                )
+                continue
             for name, (qr, kr, ts) in families(total).items():
                 qa = AttnRanges.from_ranges(qr)
                 ka = AttnRanges.from_ranges(kr)

@@ -21,11 +21,11 @@ from magiattention_tpu.parallel.dist_attn import build_dist_attn_plan
 def test_dense_1m_plan_under_bound():
     total, cp, chunk = 1 << 20, 32, 4096
     qr = AttnRanges.from_ranges([(0, total)])
-    t0 = time.time()
+    t0 = time.perf_counter()
     mq, _, bucket = make_dispatch_meta_from_qk_ranges(
         qr, qr.clone(), [AttnMaskType.CAUSAL], total, total, chunk, cp
     )
     plan = build_dist_attn_plan(mq, bucket, block_q=512, block_k=2048)
-    dt = time.time() - t0
+    dt = time.perf_counter() - t0
     assert plan.total_area == total * (total + 1) // 2
     assert dt < 7.0, f"1M-token plan took {dt:.1f}s (regression)"
