@@ -61,15 +61,15 @@ def main() -> None:
                 ka = AttnRanges.from_ranges(kr)
                 mt = [AttnMaskType(t) for t in ts]
                 bq, bk, _ = auto_block_config(qr, kr, 8, 8)
-                t0 = time.time()
+                t0 = time.perf_counter()
                 mq, mk, bucket = make_dispatch_meta_from_qk_ranges(
                     qa, ka, mt, total, total, chunk, cp
                 )
-                t1 = time.time()
+                t1 = time.perf_counter()
                 plan = build_dist_attn_plan(
                     mq, bucket, block_q=bq, block_k=bk
                 )
-                t2 = time.time()
+                t2 = time.perf_counter()
                 print(
                     f"{name:<14} {total:>8} {cp:>3} {t1 - t0:>7.2f} "
                     f"{t2 - t1:>7.2f}",

@@ -395,3 +395,34 @@ def test_make_varlen_key_for_new_mask_after_dispatch():
     )
     ref_out, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
     assert_close(out, ref_out, atol=2e-5, rtol=2e-5, msg="hybrid varlen")
+
+
+def test_roll_edge_cases_and_grads():
+    """Roll with |shift| >= total (wraparound), multi-dim tensors along
+    axis 0, grads flowing through the gather, and roll on an uneven-shard
+    key (reference tests/test_functional/test_roll.py axes)."""
+    from magiattention_tpu.api import roll
+    from magiattention_tpu.config import DistAttnConfig
+    from magiattention_tpu.meta import DispatchConfig
+
+    mesh = _mesh(4)
+    total = 512
+    key = magi_attn_varlen_key(
+        [0, 256, total], total, mesh, num_heads=(2, 2), head_dim=32,
+        chunk_size=32, out_dtype="float32",
+    )
+    rng = np.random.default_rng(77)
+    x = jnp.asarray(rng.standard_normal((total, 3)), jnp.float32)
+    xd = dispatch(x, key)
+    for shift in [0, total, -total, total + 5, -(total + 5), 255]:
+        got = np.asarray(undispatch(roll(xd, key, shift), key))
+        np.testing.assert_array_equal(
+            got, np.roll(np.asarray(x), shift, axis=0), err_msg=f"s={shift}"
+        )
+
+    # grads: d/dx of sum(roll(x) * w) == roll(w, -shift)
+    w = jnp.asarray(rng.standard_normal(xd.shape), jnp.float32)
+    g = jax.grad(lambda xd: (roll(xd, key, 7) * w).sum())(xd)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(roll(w, key, -7)), atol=1e-6
+    )

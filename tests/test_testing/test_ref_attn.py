@@ -1,0 +1,163 @@
+"""Validate the oracle itself (role of reference tests/test_attn/
+test_ref_attn.py): the jnp reference is the ground truth for every other
+test, so it gets checked against a fully independent fp64 numpy
+implementation, its own online variant, analytic identities, and finite
+differences.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from magiattention_tpu.common import make_attn_mask_from_ranges
+from magiattention_tpu.testing import ref_attn_from_ranges
+from magiattention_tpu.testing.ref_attn import ref_attn, ref_attn_online
+
+
+def _numpy_attn(q, k, v, mask, softcap=0.0, sink=None):
+    """Independent fp64 implementation: per-row explicit softmax."""
+    tq, hq, d = q.shape
+    tk, hk, _ = k.shape
+    g = hq // hk
+    out = np.zeros((tq, hq, d))
+    lse = np.full((tq, hq), -np.inf)
+    mx = np.full(hq, -np.inf)
+    scale = 1.0 / np.sqrt(d)
+    for h in range(hq):
+        kh, vh = k[:, h // g], v[:, h // g]
+        for i in range(tq):
+            sel = mask[i]
+            s = (kh[sel] @ q[i, h]) * scale
+            if softcap > 0:
+                s = softcap * np.tanh(s / softcap)
+            if s.size:
+                mx[h] = max(mx[h], s.max())
+            terms = list(s)
+            if sink is not None:
+                terms.append(float(sink[h]))
+            if not terms:
+                continue
+            m = max(terms)
+            Z = sum(np.exp(t - m) for t in terms)
+            lse[i, h] = m + np.log(Z)
+            if s.size:
+                p = np.exp(s - lse[i, h])
+                out[i, h] = p @ vh[sel]
+    return out, lse, mx
+
+
+CASES = [
+    dict(hq=2, hk=2, softcap=0.0, sink=False),
+    dict(hq=4, hk=2, softcap=0.0, sink=False),
+    dict(hq=4, hk=1, softcap=12.0, sink=False),
+    dict(hq=2, hk=2, softcap=0.0, sink=True),
+    dict(hq=4, hk=2, softcap=8.0, sink=True),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=[str(c) for c in CASES])
+def test_oracle_vs_independent_fp64(case):
+    tq = tk = 48
+    d = 16
+    rng = np.random.default_rng(7)
+    q = rng.standard_normal((tq, case["hq"], d))
+    k = rng.standard_normal((tk, case["hk"], d))
+    v = rng.standard_normal((tk, case["hk"], d))
+    sink = rng.standard_normal(case["hq"]) if case["sink"] else None
+    # mixed mask with an uncovered q row region [40, 48)
+    qr = [(0, 16), (16, 40), (8, 24)]
+    kr = [(0, 32), (16, 48), (32, 48)]
+    ts = [1, 2, 0]
+    mask = make_attn_mask_from_ranges(qr, kr, ts, tq, tk)
+
+    out, lse, mx = ref_attn(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mask,
+        softcap=case["softcap"],
+        sink=jnp.asarray(sink) if sink is not None else None,
+        compute_dtype=jnp.float64,
+    )
+    eout, else_, emx = _numpy_attn(
+        q, k, v, mask, softcap=case["softcap"], sink=sink
+    )
+    np.testing.assert_allclose(np.asarray(out), eout, atol=1e-10)
+    np.testing.assert_allclose(np.asarray(lse), else_, atol=1e-10)
+    np.testing.assert_allclose(np.asarray(mx), emx, atol=1e-10)
+
+
+def test_offline_vs_online_oracle():
+    tq = tk = 96
+    hq, hk, d = 4, 2, 32
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((tq, hq, d)), jnp.float64)
+    k = jnp.asarray(rng.standard_normal((tk, hk, d)), jnp.float64)
+    v = jnp.asarray(rng.standard_normal((tk, hk, d)), jnp.float64)
+    mask = make_attn_mask_from_ranges(
+        [(0, 48), (48, 96)], [(0, 96), (24, 72)], [1, 3], tq, tk
+    )
+    o1, l1, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float64)
+    o2, l2 = ref_attn_online(
+        q, k, v, mask, block=17, compute_dtype=jnp.float64
+    )
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-12)
+
+
+def test_sink_rescale_identity():
+    """out_sink == out * exp(lse - lse_sink): adding a sink only rescales
+    each row by the enlarged softmax denominator."""
+    tq = tk = 64
+    hq, hk, d = 2, 2, 16
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((tq, hq, d)), jnp.float64)
+    k = jnp.asarray(rng.standard_normal((tk, hk, d)), jnp.float64)
+    v = jnp.asarray(rng.standard_normal((tk, hk, d)), jnp.float64)
+    sink = jnp.asarray(rng.standard_normal(hq), jnp.float64)
+    qr, kr, ts = [(0, tq)], [(0, tk)], [1]
+    o, l, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts,
+                                   compute_dtype=jnp.float64)
+    os_, ls, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts, sink=sink,
+                                      compute_dtype=jnp.float64)
+    np.testing.assert_allclose(
+        np.asarray(os_),
+        np.asarray(o) * np.exp(np.asarray(l) - np.asarray(ls))[:, :, None],
+        atol=1e-12,
+    )
+
+
+def test_oracle_grads_finite_difference():
+    tq = tk = 24
+    hq, hk, d = 2, 1, 8
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((tq, hq, d)), jnp.float64)
+    k = jnp.asarray(rng.standard_normal((tk, hk, d)), jnp.float64)
+    v = jnp.asarray(rng.standard_normal((tk, hk, d)), jnp.float64)
+    do = jnp.asarray(rng.standard_normal((tq, hq, d)), jnp.float64)
+    qr, kr, ts = [(0, tq)], [(0, tk)], [1]
+
+    def f(q, k, v):
+        return (
+            ref_attn_from_ranges(
+                q, k, v, qr, kr, ts, compute_dtype=jnp.float64
+            )[0]
+            * do
+        ).sum()
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    eps = 1e-6
+    for name, arg, idx, grad in (
+        ("dq", q, 0, g[0]),
+        ("dk", k, 1, g[1]),
+        ("dv", v, 2, g[2]),
+    ):
+        probe = np.zeros(arg.shape)
+        probe[arg.shape[0] // 2, 0, 3] = 1.0
+        args = [q, k, v]
+        args_p = list(args)
+        args_p[idx] = arg + eps * probe
+        args_m = list(args)
+        args_m[idx] = arg - eps * probe
+        fd = (f(*args_p) - f(*args_m)) / (2 * eps)
+        an = float((np.asarray(grad) * probe).sum())
+        assert abs(fd - an) < 1e-6 * max(1.0, abs(an)), (name, fd, an)
