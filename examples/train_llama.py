@@ -41,6 +41,11 @@ def main() -> None:
     p.add_argument("--chunk", type=int, default=128)
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument(
+        "--label-shift", type=int, default=1,
+        help="predict the token this many positions ahead (MTP-style "
+        "shifting via the distributed roll)",
+    )
+    p.add_argument(
         "--ckpt", default="", help="checkpoint dir (resume if it has state)"
     )
     p.add_argument("--ckpt-every", type=int, default=5)
@@ -76,7 +81,7 @@ def main() -> None:
         init_params,
         init_pp_params,
     )
-    from magiattention_tpu.parallel import dispatch
+    from magiattention_tpu.parallel import dispatch, roll
     from magiattention_tpu.utils import (
         latest_step,
         restore_train_state,
@@ -165,9 +170,14 @@ def main() -> None:
             rng.integers(0, cfg.vocab_size, (batch_rows, args.total)),
             jnp.int32,
         )
-        labels_g = jnp.roll(tokens_g, -1, axis=1)
         tokens = jax.vmap(lambda x: dispatch(x, meta))(tokens_g)
-        labels = jax.vmap(lambda x: dispatch(x, meta))(labels_g)
+        # next-token labels via the DISTRIBUTED roll (reference roll_p2p's
+        # MTP use case): shift in dispatch space, O(N/P) memory, instead
+        # of rolling the replicated global array; --label-shift K trains
+        # a K-token-ahead predictor
+        labels = jax.vmap(
+            lambda x: roll(x, meta, -args.label_shift)
+        )(tokens)
         t0 = time.time()
         params, opt_state, loss = step_fn(params, opt_state, tokens, labels, pos)
         loss_val = float(loss)

@@ -399,11 +399,10 @@ def test_make_varlen_key_for_new_mask_after_dispatch():
 
 def test_roll_edge_cases_and_grads():
     """Roll with |shift| >= total (wraparound), multi-dim tensors along
-    axis 0, grads flowing through the gather, and roll on an uneven-shard
-    key (reference tests/test_functional/test_roll.py axes)."""
+    axis 0, and grads flowing through the gather (reference
+    tests/test_functional/test_roll.py axes; uneven-shard roll is covered
+    in tests/test_parallel/test_pipeline.py)."""
     from magiattention_tpu.api import roll
-    from magiattention_tpu.config import DistAttnConfig
-    from magiattention_tpu.meta import DispatchConfig
 
     mesh = _mesh(4)
     total = 512
