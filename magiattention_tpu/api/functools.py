@@ -75,6 +75,66 @@ def infer_varlen_mask_from_batch(
     return infer_attn_mask_from_cu_seqlens(cu.tolist(), causal=causal)
 
 
+def infer_attn_mask_from_segment_ids(
+    segment_ids: Sequence[int] | np.ndarray,
+    causal: bool = True,
+):
+    """Slices for a flat segment-id vector (the convention of jax's
+    flash-attention ``segment_ids``): each maximal run of one id is a
+    sample; ids < 0 mark padding rows that attend nothing (covered by no
+    slice -> out=0, lse=-inf).
+    """
+    seg = np.asarray(segment_ids)
+    assert seg.ndim in (1, 2), f"segment_ids must be [t] or [b, s], {seg.shape}"
+    rows = seg[None, :] if seg.ndim == 1 else seg
+    s = rows.shape[1]
+    ranges = []
+    for i, row in enumerate(rows):
+        if s == 0:
+            continue
+        # runs never merge across batch rows: each row is offset into the
+        # squashed [b*s] coordinate space and processed independently
+        starts = np.concatenate(
+            ([0], np.flatnonzero(np.diff(row) != 0) + 1, [s])
+        )
+        ranges.extend(
+            (i * s + int(a), i * s + int(b))
+            for a, b in zip(starts, starts[1:])
+            if row[a] >= 0
+        )
+    q = AttnRanges.from_ranges(ranges)
+    mt = AttnMaskType.CAUSAL if causal else AttnMaskType.FULL
+    return q, q.clone(), [mt] * len(q)
+
+
+def infer_varlen_mask_from_padded_batch(
+    attention_mask: np.ndarray,
+    causal: bool = True,
+):
+    """Slices for a right-padded [batch, seq] 0/1 attention mask (the HF
+    convention), to be used after :func:`squash_batch_dim`: sample ``i``
+    occupies rows ``[i*s, i*s + valid_i)``; pad rows attend nothing.
+    """
+    am = np.asarray(attention_mask)
+    assert am.ndim == 2, f"attention_mask must be [batch, seq], got {am.shape}"
+    b, s = am.shape
+    lens = am.astype(bool).sum(axis=1)
+    # right-padding check: all valid tokens must be a prefix
+    for i in range(b):
+        if not am[i, : lens[i]].all():
+            raise ValueError(
+                f"attention_mask row {i} is not right-padded (holes are "
+                "not expressible as one varlen sample); build explicit "
+                "ranges instead"
+            )
+    ranges = [
+        (i * s, i * s + int(L)) for i, L in enumerate(lens) if L > 0
+    ]
+    q = AttnRanges.from_ranges(ranges)
+    mt = AttnMaskType.CAUSAL if causal else AttnMaskType.FULL
+    return q, q.clone(), [mt] * len(q)
+
+
 def infer_window_mask_per_range(
     q_range: Sequence[int],
     k_range: Sequence[int],

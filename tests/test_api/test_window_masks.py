@@ -159,3 +159,42 @@ def test_varlen_key_with_window_end_to_end():
     )
     ref_out, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
     assert_close(out, ref_out, atol=3e-5, rtol=3e-5, msg="windowed varlen e2e")
+
+
+def test_segment_ids_and_padded_batch_adapters():
+    """Adapters from jax-style segment_ids and HF-style padded attention
+    masks to slice lists; pads/negative ids attend nothing."""
+    from magiattention_tpu.api import (
+        infer_attn_mask_from_segment_ids,
+        infer_varlen_mask_from_padded_batch,
+    )
+
+    qr, kr, ts = infer_attn_mask_from_segment_ids(
+        [0, 0, 0, 1, 1, -1, -1, 2, 2, 2], causal=True
+    )
+    assert qr.to_naive_ranges() == [(0, 3), (3, 5), (7, 10)]
+    got = make_attn_mask_from_ranges(qr, kr, ts, 10, 10)
+    assert not got[5].any() and not got[6].any()  # pad rows empty
+    assert got[4, 3] and not got[4, 0]  # segment-local causal
+
+    am = np.array([[1, 1, 1, 0], [1, 1, 0, 0]])
+    qr2, kr2, ts2 = infer_varlen_mask_from_padded_batch(am, causal=False)
+    assert qr2.to_naive_ranges() == [(0, 3), (4, 6)]
+    m2 = make_attn_mask_from_ranges(qr2, kr2, ts2, 8, 8)
+    assert m2[0, :3].all() and not m2[3].any() and not m2[:, 3].any()
+
+    with pytest.raises(ValueError):
+        infer_varlen_mask_from_padded_batch(np.array([[1, 0, 1]]))
+
+
+def test_segment_ids_2d_batch_rows_do_not_merge():
+    """[batch, seq] segment ids (the jax flash-attention convention):
+    identical ids in adjacent rows must NOT merge across the row
+    boundary."""
+    from magiattention_tpu.api import infer_attn_mask_from_segment_ids
+
+    seg = np.zeros((3, 4), np.int32)  # every row one sample, all id 0
+    qr, kr, ts = infer_attn_mask_from_segment_ids(seg)
+    assert qr.to_naive_ranges() == [(0, 4), (4, 8), (8, 12)]
+    m = make_attn_mask_from_ranges(qr, kr, ts, 12, 12)
+    assert not m[4, 3]  # no cross-sample attention
