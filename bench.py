@@ -173,6 +173,10 @@ def _measure() -> dict:
     import jax.numpy as jnp
     import numpy as np
 
+    from magiattention_tpu.benchmarking import enable_compile_cache
+
+    enable_compile_cache(os.path.join(_HERE, ".jax_cache"))
+
     from magiattention_tpu.ops import flex_flash_attn_func
 
     tq = 65536

@@ -107,7 +107,15 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from magiattention_tpu.benchmarking import do_bench, perf_report
+    from magiattention_tpu.benchmarking import (
+        do_bench,
+        enable_compile_cache,
+        perf_report,
+    )
+
+    enable_compile_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache")
+    )
     from magiattention_tpu.common.mask import total_area as slices_area
     from magiattention_tpu.common.ranges import AttnRanges
     from magiattention_tpu.ops import flex_flash_attn_func

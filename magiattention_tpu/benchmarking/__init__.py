@@ -5,6 +5,7 @@ from .bench import (
     BenchResult,
     Mark,
     do_bench,
+    enable_compile_cache,
     perf_grid,
     perf_report,
 )
@@ -14,6 +15,7 @@ __all__ = [
     "BenchResult",
     "Mark",
     "do_bench",
+    "enable_compile_cache",
     "perf_grid",
     "perf_report",
 ]

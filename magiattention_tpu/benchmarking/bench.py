@@ -202,3 +202,25 @@ def perf_report(
             "  ".join(f"{r.get(c, '')}".ljust(widths[c]) for c in cols)
         )
     return "\n".join(lines)
+
+
+def enable_compile_cache(default_dir: str | None = None) -> None:
+    """Turn on the persistent XLA compilation cache (MAGI_TPU_COMPILE_CACHE
+    overrides the location). First compiles of the long-seqlen kernels cost
+    20-40s through the tunnel; cached recompiles are ~instant, which
+    matters when a flaky tunnel forces re-runs. Failure (older jax flag
+    names) is reported, not fatal."""
+    import os
+    import sys
+
+    import jax
+
+    cache_dir = os.environ.get(
+        "MAGI_TPU_COMPILE_CACHE",
+        default_dir or os.path.join(os.getcwd(), ".jax_cache"),
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        print(f"compilation cache unavailable: {e!r}", file=sys.stderr)
