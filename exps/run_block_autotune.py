@@ -102,9 +102,12 @@ def main() -> None:
                         block_q=bq, block_k=bk, head_block=hb,
                     )[0]
 
+                row = {"mask": name, "seqlen": total, "bq": bq, "bk": bk,
+                       "hb": hb}
                 try:
                     fwd = jax.jit(attn)
                     r = do_bench(fwd, q, k, v, warmup=1, rep=2, inner=5)
+                    row["ms_fwd"] = round(r.median_ms, 2)
                     fb = jax.jit(
                         jax.grad(
                             lambda q, k, v: (attn(q, k, v) * do)
@@ -114,20 +117,16 @@ def main() -> None:
                         )
                     )
                     rb = do_bench(fb, q, k, v, warmup=1, rep=2, inner=5)
+                    row["ms_fb"] = round(rb.median_ms, 2)
                 except Exception as e:
-                    persist(
-                        {"mask": name, "seqlen": total, "bq": bq, "bk": bk,
-                         "hb": hb, "error": str(e)[:120]}
-                    )
-                    continue
-                row = {
-                    "mask": name, "seqlen": total, "bq": bq, "bk": bk,
-                    "hb": hb, "ms_fwd": round(r.median_ms, 2),
-                    "ms_fb": round(rb.median_ms, 2),
-                }
+                    # keep whatever phase completed (a fwd-only row still
+                    # competes for the ms_fwd winner)
+                    row["error"] = str(e)[:120]
                 persist(row)
                 for key in ("ms_fwd", "ms_fb"):
-                    if key not in best or row[key] < best[key][1]:
+                    if key in row and (
+                        key not in best or row[key] < best[key][1]
+                    ):
                         best[key] = ((bq, bk, hb), row[key])
             for key, (cfg, ms) in sorted(best.items()):
                 print(

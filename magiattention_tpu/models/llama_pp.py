@@ -126,6 +126,10 @@ class MagiLlamaPP:
                     )
                     return h, None
 
+                if cfg.remat:
+                    # per-layer rematerialization inside the stage scan
+                    # (cfg.remat, see llama.forward_local)
+                    body = jax.checkpoint(body)
                 x, _ = jax.lax.scan(body, x, params["layers"])
                 return x
 

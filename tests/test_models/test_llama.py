@@ -190,3 +190,43 @@ def test_build_validation():
             CFG, mesh_tp, TOTAL, qr, kr, ts, chunk_size=CHUNK,
             tp_axis="tp",
         )
+
+
+def test_remat_matches_no_remat():
+    """cfg.remat=True recomputes layers in backward; loss and gradients
+    must match the stored-activation path (same math, different
+    memory/compute schedule) on a (dp, cp) mesh."""
+    import dataclasses
+
+    import optax
+
+    cfg0 = LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=2, n_kv_heads=2,
+        head_dim=32, ffn_hidden=128, dtype="float32",
+    )
+    total, chunk = 256, 32
+    qr, kr, ts = infer_attn_mask_from_cu_seqlens([0, 128, 256])
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "cp"))
+    rng = np.random.default_rng(0)
+    tokens_g = jnp.asarray(rng.integers(0, 128, (2, total)), jnp.int32)
+
+    results = []
+    for remat in (False, True):
+        cfg = dataclasses.replace(cfg0, remat=remat)
+        model, meta = build_magi_llama(
+            cfg, mesh, total, qr, kr, ts, chunk_size=chunk,
+            block_q=32, block_k=32,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.vmap(lambda x: dispatch(x, meta))(tokens_g)
+        labels = jnp.roll(tokens, -1, axis=1)
+        pos = jnp.broadcast_to(jnp.asarray(meta.perm_idx), (2, total))
+        opt = optax.sgd(0.1)
+        step = model.make_train_step(opt)
+        new_params, _, loss = step(params, opt.init(params), tokens, labels, pos)
+        results.append((float(loss), new_params))
+
+    (l0, p0), (l1, p1) = results
+    assert abs(l0 - l1) < 1e-6, (l0, l1)
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
