@@ -65,6 +65,9 @@ class DiTConfig:
     text_len: int = 64
     rope_theta: float = 10000.0
     dtype: str = "float32"
+    # rematerialize each DiT block in backward (jax.checkpoint), same
+    # memory/compute trade as LlamaConfig.remat
+    remat: bool = False
 
     @property
     def jnp_dtype(self):
@@ -188,7 +191,7 @@ def dit_forward_local(
     c = jax.nn.silu(temb.astype(dt) @ params["t_embed_w1"].astype(dt))
     c = c @ params["t_embed_w2"].astype(dt)  # [t_loc, d]
 
-    for layer in params["layers"]:
+    def one_block(x, c, layer):
         mod = jax.nn.silu(c @ layer["ada_w1"].astype(dt))
         mod = mod @ layer["ada_w2"].astype(dt)  # [t_loc, 6d]
         sh1, sc1, g1, sh2, sc2, g2 = jnp.split(mod, 6, axis=-1)
@@ -219,6 +222,12 @@ def dit_forward_local(
             jax.nn.gelu(h2 @ layer["w_up"].astype(dt))
             @ layer["w_down"].astype(dt)
         )
+        return x
+
+    if cfg.remat:
+        one_block = jax.checkpoint(one_block)
+    for layer in params["layers"]:
+        x = one_block(x, c, layer)
 
     fmod = c @ params["final_ada"].astype(dt)
     fsh, fsc = jnp.split(fmod, 2, axis=-1)

@@ -152,3 +152,34 @@ def test_dit_cp_invariance():
 
         outs.append(np.asarray(jax.vmap(lambda a: undispatch(a, mq))(out)))
     np.testing.assert_allclose(outs[0], outs[1], atol=2e-5, rtol=2e-5)
+
+
+def test_dit_remat_matches_no_remat():
+    """DiTConfig(remat=True): one train step's loss and updated params are
+    identical to the stored-activation path."""
+    import dataclasses
+
+    mesh = _mesh(2, 4)
+    results = []
+    for remat in (False, True):
+        cfg = dataclasses.replace(CFG, remat=remat)
+        model, mq = build_magi_dit(
+            cfg, mesh, TOTAL, CHUNK, dispatch_chunk=32, block_q=32,
+            block_k=32,
+        )
+        params = init_dit_params(jax.random.PRNGKey(0), cfg)
+        opt = optax.sgd(0.1)
+        step = model.make_train_step(opt)
+        lat, tc, pos, text, _ = _data(np.random.default_rng(9), mq, 2)
+        noise = jnp.asarray(
+            np.random.default_rng(10).standard_normal(lat.shape), jnp.float32
+        )
+        noised = (1 - tc[..., None]) * lat + tc[..., None] * noise
+        params2, _, loss = step(
+            params, opt.init(params), noised, noise - lat, tc, pos, text
+        )
+        results.append((float(loss), params2))
+    (l0, p0), (l1, p1) = results
+    assert abs(l0 - l1) < 1e-6, (l0, l1)
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
