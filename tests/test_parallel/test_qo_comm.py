@@ -30,6 +30,18 @@ def _params(d):
     )
 
 
+def _window_case(total=512, window=(96, 32)):
+    """Bidirectional sliding window: exercises the BICAUSAL / INVCAUSAL
+    rectangle cuts of the dynamic solver (the band decomposition emits
+    all three band slice types)."""
+    from magiattention_tpu.api import infer_window_mask_per_range
+
+    qr, kr, ts = infer_window_mask_per_range((0, total), (0, total), window)
+    return [
+        (q[0], q[1], k[0], k[1], int(t)) for q, k, t in zip(qr, kr, ts)
+    ]
+
+
 CASES = [
     ("causal", 512, [(0, 512, 0, 512, 1)]),
     (
@@ -37,6 +49,7 @@ CASES = [
         512,
         [(0, 192, 0, 192, 1), (192, 448, 0, 448, 1), (448, 512, 192, 512, 0)],
     ),
+    ("swa_window", 512, _window_case()),
 ]
 
 
