@@ -360,6 +360,9 @@ def magi_attn_flex_key(
     dispatch_config: DispatchConfig | None = None,
     dist_attn_config: "DistAttnConfig | None" = None,
     interpret: bool | None = None,
+    is_same_source: bool = True,
+    is_q_permutable: bool = True,
+    is_k_permutable: bool = True,
 ) -> DistAttnRuntimeKey:
     """Plan (or fetch from cache) a distributed flex-attention runtime
     (reference magi_attn_flex_key, api/magi_attn_interface.py:440).
@@ -367,7 +370,18 @@ def magi_attn_flex_key(
     The mask may have any (q_range, k_range, mask_type) slice list with
     disjoint (q, k) coverage. The sequence is padded so chunks divide evenly
     (reference compute_pad_size/apply_padding, :663-676).
+
+    ``is_same_source`` / ``is_q_permutable`` / ``is_k_permutable`` keep the
+    reference signature: this entry point is the self-attention case
+    (all three True); for cross-attention sources (reference case 2/3,
+    api:505-516) use :func:`magi_attn_cross_key`, which owns the
+    separate q/k dispatch planning here.
     """
+    if not (is_same_source and is_q_permutable and is_k_permutable):
+        raise NotImplementedError(
+            "cross-source masks (is_same_source=False or non-permutable "
+            "roles) are served by magi_attn_cross_key in this framework"
+        )
     assert total_seqlen_q == total_seqlen_k, (
         "self-attention interface requires equal q/k seqlens"
     )
