@@ -425,3 +425,27 @@ def test_roll_edge_cases_and_grads():
     np.testing.assert_allclose(
         np.asarray(g), np.asarray(roll(w, key, -7)), atol=1e-6
     )
+
+
+def test_flex_key_source_flags():
+    """Reference-signature source/permutability flags: defaults pass
+    through; cross-source combinations raise with a pointer to
+    magi_attn_cross_key."""
+    mesh = _mesh(1)
+    kw = dict(num_heads=(2, 2), head_dim=32, chunk_size=64,
+              out_dtype="float32")
+    k = magi_attn_flex_key(
+        [(0, 256)], [(0, 256)], [1], 256, 256, mesh,
+        is_same_source=True, is_q_permutable=True, is_k_permutable=True,
+        **kw,
+    )
+    assert k is not None
+    for bad in (
+        dict(is_same_source=False),
+        dict(is_q_permutable=False),
+        dict(is_k_permutable=False),
+    ):
+        with pytest.raises(NotImplementedError, match="magi_attn_cross_key"):
+            magi_attn_flex_key(
+                [(0, 256)], [(0, 256)], [1], 256, 256, mesh, **kw, **bad
+            )
