@@ -216,7 +216,12 @@ def _needs_mask_flags(
     interior tiles via lax.cond."""
     e = entries.shape[0]
     import os
-    if e == 0 or slices is None or os.environ.get("MAGI_DISABLE_MASK_SKIP"):
+    if (
+        e == 0
+        or slices is None
+        or slices.shape[0] == 0  # rank/stage with no work: all dummies
+        or os.environ.get("MAGI_DISABLE_MASK_SKIP")
+    ):
         return np.ones((e,), dtype=np.int64)
     qb = entries[:, 0]
     kb = entries[:, 1]

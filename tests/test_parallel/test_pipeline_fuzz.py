@@ -24,6 +24,7 @@ from magiattention_tpu.common import make_attn_mask_from_ranges
 from magiattention_tpu.common.ranges import AttnRanges
 from magiattention_tpu.common.sanity import check_slices_non_overlapping
 from magiattention_tpu.config import DistAttnConfig
+from magiattention_tpu.meta import DispatchConfig
 from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
 from magiattention_tpu.testing import assert_close, ref_attn_from_ranges
 
@@ -67,13 +68,44 @@ def _random_mask(rng, total):
     return qr, kr, ts
 
 
+def test_empty_rank_stage_regression():
+    """Seed-116 campaign find: a mask whose tiny slices leave some
+    (rank, stage) with zero slices but a nonempty (all-dummy) entry
+    table crashed the mask-skip flag computation with IndexError
+    (block_meta.py _needs_mask_flags on an empty slice array)."""
+    total, cp, chunk = 512, 2, 64
+    qr = [(0, 480), (480, 512)]
+    kr = [(480, 496), (48, 336)]
+    ts = [1, 2]
+    mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
+    key = magi_attn_flex_key(
+        qr, kr, ts, total, total, mesh,
+        num_heads=(2, 2), head_dim=32, chunk_size=chunk,
+        out_dtype="float32",
+        dist_attn_config=DistAttnConfig(
+            overlap_config=OverlapConfig(degree=None, min_stage_rows=32)
+        ),
+    )
+    rng = np.random.default_rng(116)
+    q = jnp.asarray(rng.standard_normal((total, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, 2, 32)), jnp.float32)
+    out = undispatch(
+        calc_attn(dispatch(q, key), dispatch(k, key), dispatch(v, key), key)[0],
+        key,
+    )
+    ref_out, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out, ref_out, atol=5e-5, rtol=5e-5, msg="empty-stage mask")
+
+
 @pytest.mark.parametrize("seed", range(12))
 def test_pipeline_fuzz(seed):
     rng = np.random.default_rng(1000 + seed)
     total = int(rng.choice([512, 768, 1024]))
-    cp = int(rng.choice([2, 4, 8]))
+    cp = int(rng.choice([2, 3, 4, 8]))
     chunk = int(rng.choice([32, 64]))
-    degree = int(rng.choice([0, 1, 2]))
+    degree = rng.choice([0, 1, 2, None])
+    degree = None if degree is None else int(degree)
     qr, kr, ts = _random_mask(rng, total)
     # skip the degenerate all-masked sample (nothing to check)
     if not make_attn_mask_from_ranges(qr, kr, ts, total, total).any():
@@ -81,12 +113,14 @@ def test_pipeline_fuzz(seed):
 
     mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
     hq, hk, d = 2, 2, 32
+    uneven = (total // chunk) % cp != 0
     key = magi_attn_flex_key(
         qr, kr, ts, total, total, mesh,
         num_heads=(hq, hk), head_dim=d, chunk_size=chunk,
         out_dtype="float32",
         dist_attn_config=DistAttnConfig(
-            overlap_config=OverlapConfig(degree=degree, min_stage_rows=32)
+            dispatch_config=DispatchConfig(uneven_shard=uneven),
+            overlap_config=OverlapConfig(degree=degree, min_stage_rows=32),
         ),
     )
     q = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
