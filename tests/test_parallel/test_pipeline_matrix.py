@@ -365,3 +365,34 @@ def test_world_sizes(cp):
     out, _ = jax.jit(_roundtrip(key))(q, k, v)
     ref_out, _, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
     assert_close(out, ref_out, atol=2e-5, rtol=2e-5, msg=f"cp={cp}")
+
+
+def test_sink_with_windowed_mask_distributed():
+    """Composition: attention sink + bidirectional window decomposition
+    through the staged distributed path (the sink joins every row's
+    denominator exactly once even when the row's band spans stages)."""
+    from magiattention_tpu.api import infer_window_mask_per_range
+
+    total, cp = 1024, 4
+    hq, hk, d = 2, 2, 32
+    qr, kr, ts = infer_window_mask_per_range(
+        (0, total), (0, total), (192, 64), 32
+    )
+    rng = np.random.default_rng(71)
+    sink = jnp.asarray(rng.standard_normal(hq), jnp.float32)
+    mesh = _mesh(cp)
+    key = magi_attn_flex_key(
+        qr, kr, ts, total, total, mesh,
+        num_heads=(hq, hk), head_dim=d, chunk_size=64, out_dtype="float32",
+        sink=sink,
+        dist_attn_config=DistAttnConfig(
+            overlap_config=OverlapConfig(degree=2, min_stage_rows=64)
+        ),
+    )
+    q, k, v = _rand_qkv(rng, total, hq, hk, d)
+    out, lse = jax.jit(_roundtrip(key))(q, k, v)
+    ref_out, ref_lse, _ = ref_attn_from_ranges(
+        q, k, v, qr, kr, ts, sink=sink
+    )
+    assert_close(out, ref_out, atol=3e-5, rtol=3e-5, msg="sink+window out")
+    assert_close(lse, ref_lse, atol=3e-5, rtol=3e-5, msg="sink+window lse")
