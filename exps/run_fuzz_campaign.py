@@ -1,0 +1,297 @@
+"""Randomized correctness campaigns over the distributed runtimes.
+
+The committed fuzz tests (tests/test_parallel/test_pipeline_fuzz.py) run
+a fast seed subset in CI; this harness runs the full campaigns against
+the oracle on the CPU-simulated mesh. Round 3 ran 224 cases across these
+axes and found one planner crash (now pinned as a regression test).
+
+    python exps/run_fuzz_campaign.py --axis main --seeds 100:160
+    python exps/run_fuzz_campaign.py --axis qo --seeds 200:218
+    python exps/run_fuzz_campaign.py --axis hier --seeds 300:312
+    python exps/run_fuzz_campaign.py --axis cross --seeds 400:424
+    python exps/run_fuzz_campaign.py --axis features --seeds 500:580
+    python exps/run_fuzz_campaign.py --axis bf16 --seeds 600:630
+
+Every failure prints the seed + config; exit code = number of failures.
+"""
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, os.path.join(os.path.dirname(_HERE), "tests"))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "--axis",
+        default="main",
+        choices=["main", "qo", "hier", "cross", "features", "bf16"],
+    )
+    p.add_argument("--seeds", default="0:40", help="start:stop range")
+    p.add_argument("--devices", type=int, default=8)
+    args = p.parse_args()
+    lo, hi = (int(x) for x in args.seeds.split(":"))
+
+    if args.axis == "hier" and args.devices < 8:
+        p.error("--axis hier needs --devices >= 8 (a (2, 4) mesh)")
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from magiattention_tpu.api import (
+        calc_attn,
+        dispatch,
+        dispatch_kv,
+        infer_window_mask_per_range,
+        magi_attn_cross_key,
+        magi_attn_flex_key,
+        undispatch,
+    )
+    from magiattention_tpu.common import make_attn_mask_from_ranges
+    from magiattention_tpu.config import DistAttnConfig
+    from magiattention_tpu.meta import DispatchConfig
+    from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+    from magiattention_tpu.testing import (
+        assert_close_to_ref,
+        ref_attn_from_ranges,
+    )
+    from test_parallel.test_pipeline_fuzz import _random_mask
+
+    fails = checked = 0
+
+    def check(tag, out, ref, tol=5e-5):
+        nonlocal fails, checked
+        checked += 1
+        a, b = np.asarray(out), np.asarray(ref)
+        err = float(np.abs(a - b).max())
+        # NaN-aware: a NaN/Inf output must fail, never slip past `> tol`
+        if not np.isfinite(a).all() or not (err <= tol):
+            fails += 1
+            print(f"FAIL {tag} err={err}", flush=True)
+
+    def rand_qkv(rng, tq, tk, hq, hk, d=32, dtype=jnp.float32):
+        return (
+            jnp.asarray(rng.standard_normal((tq, hq, d)), dtype),
+            jnp.asarray(rng.standard_normal((tk, hk, d)), dtype),
+            jnp.asarray(rng.standard_normal((tk, hk, d)), dtype),
+        )
+
+    for seed in range(lo, hi):
+        rng = np.random.default_rng(seed)
+        try:
+            if args.axis == "main":
+                total = int(rng.choice([512, 768, 1024, 1280]))
+                cp = int(rng.choice([2, 3, 4, 8]))
+                chunk = int(rng.choice([32, 64]))
+                degree = rng.choice([0, 1, 2, None])
+                degree = None if degree is None else int(degree)
+                qr, kr, ts = _random_mask(rng, total)
+                if not make_attn_mask_from_ranges(qr, kr, ts, total, total).any():
+                    continue
+                mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
+                key = magi_attn_flex_key(
+                    qr, kr, ts, total, total, mesh,
+                    num_heads=(2, 2), head_dim=32, chunk_size=chunk,
+                    out_dtype="float32",
+                    dist_attn_config=DistAttnConfig(
+                        dispatch_config=DispatchConfig(
+                            uneven_shard=(total // chunk) % cp != 0
+                        ),
+                        overlap_config=OverlapConfig(
+                            degree=degree, min_stage_rows=32
+                        ),
+                    ),
+                )
+                q, k, v = rand_qkv(rng, total, total, 2, 2)
+                out = undispatch(
+                    calc_attn(dispatch(q, key), dispatch(k, key),
+                              dispatch(v, key), key)[0], key)
+                check(f"main seed={seed}", out,
+                      ref_attn_from_ranges(q, k, v, qr, kr, ts)[0])
+
+            elif args.axis == "qo":
+                from magiattention_tpu.meta.solver.dynamic_attn_solver import (
+                    DynamicAttnSolver,
+                    LocalityGreedySolver,
+                    NCQDynamicSolver,
+                )
+                from magiattention_tpu.ops.flex_attn import FlexAttnParams
+                from magiattention_tpu.parallel.qo_comm import (
+                    build_qo_comm_plan,
+                    make_qo_comm_attn_fn,
+                )
+
+                total = int(rng.choice([512, 768]))
+                cp = int(rng.choice([2, 4]))
+                qr, kr, ts = _random_mask(rng, total)
+                if not make_attn_mask_from_ranges(qr, kr, ts, total, total).any():
+                    continue
+                sl = np.asarray(
+                    [(a[0], a[1], b[0], b[1], t)
+                     for a, b, t in zip(qr, kr, ts)], np.int64)
+                solver = [DynamicAttnSolver, NCQDynamicSolver,
+                          LocalityGreedySolver][seed % 3]()
+                plan = build_qo_comm_plan(
+                    sl, total, cp, block_q=64, block_k=64, solver=solver)
+                params = FlexAttnParams(
+                    block_q=64, block_k=64,
+                    scale=float(1.0 / np.sqrt(32)), softcap=0.0,
+                    has_sink=False, out_dtype="float32", interpret=True)
+                fn = make_qo_comm_attn_fn(
+                    plan, Mesh(np.array(jax.devices()[:cp]), ("cp",)), params)
+                q, k, v = rand_qkv(rng, total, total, 2, 2)
+                check(f"qo seed={seed} {type(solver).__name__}",
+                      fn(q, k, v)[0],
+                      ref_attn_from_ranges(q, k, v, qr, kr, ts)[0])
+
+            elif args.axis == "hier":
+                total = 1024
+                qr, kr, ts = _random_mask(rng, total)
+                if not make_attn_mask_from_ranges(qr, kr, ts, total, total).any():
+                    continue
+                mesh = Mesh(
+                    np.array(jax.devices()[:8]).reshape(2, 4), ("dcn", "ici"))
+                key = magi_attn_flex_key(
+                    qr, kr, ts, total, total, mesh,
+                    num_heads=(2, 2), head_dim=32, chunk_size=32,
+                    out_dtype="float32", cp_axis=("dcn", "ici"),
+                    dist_attn_config=DistAttnConfig(
+                        overlap_config=OverlapConfig(
+                            degree=int(rng.choice([0, 2])),
+                            min_stage_rows=32)),
+                )
+                q, k, v = rand_qkv(rng, total, total, 2, 2)
+                out = undispatch(
+                    calc_attn(dispatch(q, key), dispatch(k, key),
+                              dispatch(v, key), key)[0], key)
+                check(f"hier seed={seed}", out,
+                      ref_attn_from_ranges(q, k, v, qr, kr, ts)[0])
+
+            elif args.axis == "cross":
+                tq = int(rng.choice([256, 512]))
+                tk = int(rng.choice([512, 1024]))
+                cp = int(rng.choice([2, 4]))
+                qr, kr, ts = _random_mask(rng, tq)
+                # rescale k ranges onto the memory length
+                kr = [
+                    (min(a * tk // tq, tk - 16), min(b * tk // tq, tk))
+                    for a, b in kr
+                ]
+                kr = [(a, max(b, a + 16)) for a, b in kr]
+                ts = [1 if t == 3 else t for t in ts]
+                if not make_attn_mask_from_ranges(qr, kr, ts, tq, tk).any():
+                    continue
+                mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
+                key = magi_attn_cross_key(
+                    qr, kr, ts, tq, tk, mesh, num_heads=(2, 2), head_dim=32,
+                    chunk_size_q=32, chunk_size_k=64, out_dtype="float32")
+                q, k, v = rand_qkv(rng, tq, tk, 2, 2)
+                out = undispatch(
+                    calc_attn(dispatch(q, key), dispatch_kv(k, key),
+                              dispatch_kv(v, key), key)[0], key)
+                check(f"cross seed={seed}", out,
+                      ref_attn_from_ranges(q, k, v, qr, kr, ts)[0])
+
+            elif args.axis == "features":
+                total = int(rng.choice([512, 768, 1024]))
+                cp = int(rng.choice([2, 3, 4, 8]))
+                chunk = int(rng.choice([32, 64]))
+                degree = rng.choice([0, 1, 2, None])
+                degree = None if degree is None else int(degree)
+                hq, hk = (2, 2) if rng.random() < 0.5 else (4, 2)
+                use_sink = rng.random() < 0.3
+                if rng.random() < 0.3:
+                    qr, kr, ts = infer_window_mask_per_range(
+                        (0, total), (0, total),
+                        (int(rng.integers(32, 256)), int(rng.integers(0, 128))),
+                        int(rng.choice([0, 16])))
+                    ts = [int(t) for t in ts]
+                else:
+                    qr, kr, ts = _random_mask(rng, total)
+                if not make_attn_mask_from_ranges(qr, kr, ts, total, total).any():
+                    continue
+                sink = (jnp.asarray(rng.standard_normal(hq), jnp.float32)
+                        if use_sink else None)
+                mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
+                key = magi_attn_flex_key(
+                    qr, kr, ts, total, total, mesh,
+                    num_heads=(hq, hk), head_dim=32, chunk_size=chunk,
+                    out_dtype="float32", sink=sink,
+                    dist_attn_config=DistAttnConfig(
+                        dispatch_config=DispatchConfig(
+                            uneven_shard=(total // chunk) % cp != 0),
+                        overlap_config=OverlapConfig(
+                            degree=degree, min_stage_rows=32)),
+                )
+                q, k, v = rand_qkv(rng, total, total, hq, hk)
+                out = undispatch(
+                    calc_attn(dispatch(q, key), dispatch(k, key),
+                              dispatch(v, key), key, sink=sink)[0], key)
+                check(f"features seed={seed} h={hq}:{hk} sink={use_sink}",
+                      out,
+                      ref_attn_from_ranges(q, k, v, qr, kr, ts, sink=sink)[0])
+
+            elif args.axis == "bf16":
+                total = int(rng.choice([512, 768]))
+                cp = int(rng.choice([2, 4]))
+                if seed % 2 == 0:
+                    os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] = "jnp"
+                else:
+                    os.environ.pop("MAGI_ATTENTION_KERNEL_BACKEND", None)
+                qr, kr, ts = _random_mask(rng, total)
+                if not make_attn_mask_from_ranges(qr, kr, ts, total, total).any():
+                    continue
+                mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
+                key = magi_attn_flex_key(
+                    qr, kr, ts, total, total, mesh,
+                    num_heads=(2, 2), head_dim=32, chunk_size=32,
+                    out_dtype="bfloat16",
+                    dist_attn_config=DistAttnConfig(
+                        overlap_config=OverlapConfig(
+                            degree=int(rng.choice([0, 2])),
+                            min_stage_rows=32)),
+                )
+                q, k, v = rand_qkv(rng, total, total, 2, 2, dtype=jnp.bfloat16)
+                out = undispatch(
+                    calc_attn(dispatch(q, key), dispatch(k, key),
+                              dispatch(v, key), key)[0], key)
+                ref_hp = ref_attn_from_ranges(
+                    q.astype(jnp.float32), k.astype(jnp.float32),
+                    v.astype(jnp.float32), qr, kr, ts)[0]
+                ref_lp = ref_attn_from_ranges(
+                    q, k, v, qr, kr, ts, compute_dtype=jnp.bfloat16)[0]
+                checked += 1
+                try:
+                    assert_close_to_ref(
+                        out, ref_lp.astype(jnp.float32), ref_hp,
+                        msg=f"bf16 seed={seed}")
+                except AssertionError as e:
+                    fails += 1
+                    print(f"FAIL bf16 seed={seed}: {str(e)[:150]}", flush=True)
+        except Exception as e:
+            fails += 1
+            print(
+                f"ERROR {args.axis} seed={seed}: {type(e).__name__} "
+                f"{str(e)[:150]}",
+                flush=True,
+            )
+    print(f"{args.axis} campaign: {fails} failures / {checked} checked")
+    sys.exit(min(fails, 125))
+
+
+if __name__ == "__main__":
+    main()
