@@ -7,10 +7,11 @@ q-overlap extra slices, random cp/chunk/degree — and every sample must
 match the single-device oracle through dispatch -> calc_attn ->
 undispatch with gradients).
 
-The committed seeds are a fast subset; the same generator ran as 194
-one-off campaign cases in round 3 (main path with uneven shard and auto
-degree; qo-comm across all three dynamic solvers; hierarchical 2-D cp
-mesh; cross-attention with grads; GQA x sink x windowed-mask combos) —
+The committed seeds are a fast subset; the same generator ran as 521
+campaign cases in round 3 via exps/run_fuzz_campaign.py (main path with
+uneven shard and auto degree; qo-comm across all three dynamic solvers;
+hierarchical 2-D cp mesh; cross-attention with grads; GQA x sink x
+windowed-mask combos; bf16 ratio-to-reference incl. the jnp backend) —
 one planner crash found (test_empty_rank_stage_regression), everything
 else matched the oracle.
 """
