@@ -8,6 +8,7 @@ tight enough to catch a return of per-element Python scans (8.5s before
 the vectorization, worse without the native module).
 """
 
+import os
 import time
 
 from magiattention_tpu.common.enum import AttnMaskType
@@ -28,4 +29,9 @@ def test_dense_1m_plan_under_bound():
     plan = build_dist_attn_plan(mq, bucket, block_q=512, block_k=2048)
     dt = time.perf_counter() - t0
     assert plan.total_area == total * (total + 1) // 2
-    assert dt < 7.0, f"1M-token plan took {dt:.1f}s (regression)"
+    # Wall-clock bound: ~5x margin over the measured ~1.3s. Loaded CI
+    # machines can still exceed it, so the bound is an env knob; 0 keeps
+    # the functional check but skips the timing assertion entirely.
+    bound = float(os.environ.get("MAGI_PLAN_LATENCY_BOUND", "7.0"))
+    if bound > 0:
+        assert dt < bound, f"1M-token plan took {dt:.1f}s (bound {bound}s)"
