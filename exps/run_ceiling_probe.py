@@ -1,0 +1,86 @@
+"""Matmul-ceiling probe: measured bf16 MXU throughput on this chip.
+
+Every MFU% quoted in BENCH_DETAIL.md divides a kernel's achieved TFLOPs/s
+by a *measured* matmul ceiling — not the nameplate. This script is the
+committed provenance for that ceiling: a bf16 matmul sweep over square and
+attention-shaped operands, printing TFLOPs/s per shape and the max.
+
+Why measured ≠ nameplate: v5e bf16 nameplate is ~197 TFLOPs/s at max
+clocks; a single shared chip behind the axon tunnel runs at whatever
+clocks/power state the host grants, and the sweep reports what dense
+matmul actually sustains there. Role of the reference's explicit peak
+constants in ``magi_attention/testing/precision.py:40-51`` (it hardcodes
+per-GPU peaks; we measure because the tunnel chip's effective peak is not
+a datasheet number).
+
+Run on a real TPU:  python exps/run_ceiling_probe.py [--dtype bfloat16]
+Appends nothing; paste the table into BENCH_DETAIL.md when refreshing it.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# Square rungs find the chip's dense ceiling; the [T*H, D] x [D, T] shapes
+# mirror what one attention head-batch actually feeds the MXU.
+SHAPES = [
+    (2048, 2048, 2048),
+    (4096, 4096, 4096),
+    (8192, 8192, 8192),
+    (16384, 8192, 8192),
+    (65536, 128, 65536),  # one 64k attention head's QK^T
+    (65536, 65536, 128),  # one 64k attention head's PV
+]
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--json", action="store_true", help="one JSON line only")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from magiattention_tpu.benchmarking import do_bench, enable_compile_cache
+
+    enable_compile_cache(
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache")
+    )
+
+    dev = jax.devices()[0]
+    dtype = jnp.dtype(args.dtype)
+    rng = np.random.default_rng(0)
+    rows = []
+    best = 0.0
+    for m, k, n in SHAPES:
+        a = jnp.asarray(rng.standard_normal((m, k)), dtype)
+        b = jnp.asarray(rng.standard_normal((k, n)), dtype)
+        mm = jax.jit(lambda a, b: a @ b)
+        res = do_bench(mm, a, b)
+        tf = res.tflops(2 * m * k * n)
+        best = max(best, tf)
+        rows.append({"m": m, "k": k, "n": n,
+                     "ms": round(res.median_ms, 3), "tflops": round(tf, 2)})
+        if not args.json:
+            print(f"[{m:>6} x {k:>6} x {n:>6}]  {res.median_ms:8.3f} ms  "
+                  f"{tf:7.2f} TFLOPs/s")
+    payload = {
+        "device": str(dev),
+        "dtype": str(dtype),
+        "ceiling_tflops": round(best, 2),
+        "rows": rows,
+        "recorded_unix": int(time.time()),
+    }
+    print(json.dumps(payload))
+
+
+if __name__ == "__main__":
+    main()

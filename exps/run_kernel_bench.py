@@ -65,7 +65,8 @@ def mask_families(total: int):
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--seqlens", default="4096,8192,16384,32768,65536")
+    # 131072 = the north-star seqlen (BASELINE.md config 3: 128k causal)
+    p.add_argument("--seqlens", default="4096,8192,16384,32768,65536,131072")
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--kv-heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=128)
