@@ -23,7 +23,13 @@ def masked_ce_sums(logits, labels):
     )
 
 
-def sharded_plan_tables(plan, mesh, cp_axis: str):
+def cp_axis_names(cp_axis) -> tuple[str, ...]:
+    """Normalize a cp axis spec — one mesh axis name, or an
+    (inter, intra) pair for hierarchical 2-level cp — to a name tuple."""
+    return tuple(cp_axis) if isinstance(cp_axis, (tuple, list)) else (cp_axis,)
+
+
+def sharded_plan_tables(plan, mesh, cp_axis):
     """The plan's device tables placed P(cp_axis) — or left as host
     constants when the mesh has non-addressable devices (AOT-compilation
     topologies), where placement is impossible and jit embeds them."""
@@ -31,7 +37,7 @@ def sharded_plan_tables(plan, mesh, cp_axis: str):
     if all(
         d.process_index == jax.process_index() for d in mesh.devices.flat
     ):
-        spec = NamedSharding(mesh, P(cp_axis))
+        spec = NamedSharding(mesh, P(cp_axis_names(cp_axis)))
         return tuple(jax.device_put(t, spec) for t in tables)
     return tuple(tables)
 
@@ -45,15 +51,23 @@ def plan_flex_attn(
     attn_type_map,
     *,
     chunk_size: int,
-    cp_axis: str,
+    cp_axis,
     tp_axis: str | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    overlap_config=None,
 ):
     """Shared builder tail for every Llama-family bundle: validate tp
     divisibility, build the dispatch meta + CP plan for one mask, and
-    derive the kernel params. Returns (plan, attn_params, dispatch_meta)."""
+    derive the kernel params. Returns (plan, attn_params, dispatch_meta).
+
+    ``cp_axis`` may be an ``(inter, intra)`` mesh-axis pair: the plan is
+    then built with hierarchical 2-level comm (``cp_mesh_shape``) and the
+    runtime routes casts through the two-hop dedup path (comm/hier.py).
+    ``overlap_config`` forces the overlap degree/algorithm (default:
+    OverlapConfig(), i.e. the degree-0 merged no-overlap path; pass
+    degree=None for the auto-tuned degree)."""
     from .. import env
     from ..common.enum import AttnMaskType
     from ..meta.dispatch_meta import make_dispatch_meta_from_qk_ranges
@@ -66,7 +80,19 @@ def plan_flex_attn(
                 f"tp={tp} must divide n_heads={cfg.n_heads} and "
                 f"n_kv_heads={cfg.n_kv_heads}"
             )
-    cp_size = mesh.shape[cp_axis]
+    names = cp_axis_names(cp_axis)
+    assert len(names) in (1, 2), (
+        f"cp_axis must be one mesh axis or an (inter, intra) pair, got "
+        f"{cp_axis!r}"
+    )
+    cp_size = 1
+    for a in names:
+        cp_size *= mesh.shape[a]
+    cp_mesh_shape = (
+        (mesh.shape[names[0]], mesh.shape[names[1]])
+        if len(names) == 2
+        else None
+    )
     mq, _, bucket = make_dispatch_meta_from_qk_ranges(
         q_ranges,
         k_ranges,
@@ -81,6 +107,8 @@ def plan_flex_attn(
         bucket,
         block_q=block_q or env.block_q(),
         block_k=block_k or env.block_k(),
+        overlap_config=overlap_config,
+        cp_mesh_shape=cp_mesh_shape,
     )
     attn_params = make_attn_params(
         plan, cfg.head_dim, out_dtype=cfg.dtype, interpret=interpret

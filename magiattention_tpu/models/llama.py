@@ -193,7 +193,7 @@ class MagiLlama:
     mesh: Mesh
     plan: DistAttnPlan
     attn_params: FlexAttnParams
-    cp_axis: str = "cp"
+    cp_axis: str | tuple[str, str] = "cp"
     dp_axis: str = "dp"
     tp_axis: str | None = None
 
@@ -326,12 +326,13 @@ def build_magi_llama(
     attn_type_map,
     *,
     chunk_size: int,
-    cp_axis: str = "cp",
+    cp_axis: str | tuple[str, str] = "cp",
     dp_axis: str = "dp",
     tp_axis: str | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    overlap_config=None,
 ) -> tuple[MagiLlama, Any]:
     """Plan the CP attention for one mask and bundle the model.
 
@@ -341,9 +342,15 @@ def build_magi_llama(
     ``tp_axis`` turns on Megatron-style tensor parallelism over that mesh
     axis (head groups + FFN slices; see ``_layer_local``). Requires the
     head counts to divide by the axis size.
+
+    ``cp_axis`` may be an ``(inter, intra)`` mesh-axis pair for
+    hierarchical 2-level cp comm; ``overlap_config`` forces the overlap
+    degree (None = the plan builder's default: degree-0 merged path).
     """
     from ._common import plan_flex_attn
 
+    if isinstance(cp_axis, list):
+        cp_axis = tuple(cp_axis)
     plan, attn_params, mq = plan_flex_attn(
         cfg,
         mesh,
@@ -357,6 +364,7 @@ def build_magi_llama(
         block_q=block_q,
         block_k=block_k,
         interpret=interpret,
+        overlap_config=overlap_config,
     )
     model = MagiLlama(
         cfg=cfg,

@@ -273,3 +273,57 @@ def test_pp_remat_matches_no_remat():
     assert abs(l0 - l1) < 1e-6, (l0, l1)
     for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "cp_axes",
+    [
+        {"cpo": 2, "cpi": 4},  # hierarchical 2-level cp (inter, intra)
+        {"cpo": 4, "cpi": 2},
+    ],
+)
+def test_magi_llama_hier_cp_matches_oracle(oracle, cp_axes):
+    """(dp=1, cp=8) routed hierarchically over an (inter, intra) mesh pair
+    must reproduce the cp=1 oracle exactly — the model-level proof that
+    the two-hop dedup cast (comm/hier.py) composes with the full bundle."""
+    loss_ref, grads_ref = oracle
+    qr, kr, ts = _mask()
+    mesh = _mesh(dp=1, **cp_axes)
+    model, meta = build_magi_llama(
+        CFG, mesh, TOTAL, qr, kr, ts, chunk_size=CHUNK,
+        cp_axis=("cpo", "cpi"), block_q=32, block_k=32,
+    )
+    assert model.plan.hier is not None
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens, labels, pos = _data(meta)
+    tables = model.sharded_tables()
+    loss, grads = jax.value_and_grad(model.loss_fn)(
+        params, tokens, labels, pos, tables
+    )
+    assert abs(float(loss) - loss_ref) < 1e-5 * max(1.0, abs(loss_ref))
+    _tree_close(grads, grads_ref)
+
+
+def test_magi_llama_forced_overlap_degree_matches_oracle(oracle):
+    """cp=8 with a forced multi-stage overlap (degree=2) must match the
+    oracle — the staged lse-merged pipeline is numerics-equivalent to the
+    merged path at model level."""
+    from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+
+    loss_ref, grads_ref = oracle
+    qr, kr, ts = _mask()
+    mesh = _mesh(dp=1, cp=8)
+    model, meta = build_magi_llama(
+        CFG, mesh, TOTAL, qr, kr, ts, chunk_size=CHUNK,
+        block_q=32, block_k=32,
+        overlap_config=OverlapConfig(degree=2, min_stage_rows=8),
+    )
+    assert model.plan.overlap_degree >= 2
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens, labels, pos = _data(meta)
+    tables = model.sharded_tables()
+    loss, grads = jax.value_and_grad(model.loss_fn)(
+        params, tokens, labels, pos, tables
+    )
+    assert abs(float(loss) - loss_ref) < 1e-5 * max(1.0, abs(loss_ref))
+    _tree_close(grads, grads_ref)
