@@ -178,12 +178,12 @@ def main() -> None:
         )
         tokens = jax.vmap(lambda x: dispatch(x, meta))(tokens_g)
         # next-token labels via the DISTRIBUTED roll (reference roll_p2p's
-        # MTP use case): shift in dispatch space, O(N/P) memory, instead
-        # of rolling the replicated global array; --label-shift K trains
-        # a K-token-ahead predictor
-        labels = jax.vmap(
-            lambda x: roll(x, meta, -args.label_shift)
-        )(tokens)
+        # MTP use case): shift in dispatch space over the batched array —
+        # the mesh-aware P2P path keeps it O(N/P) (exps/run_roll_proof.py);
+        # --label-shift K trains a K-token-ahead predictor
+        labels = roll(
+            tokens, meta, -args.label_shift, axis=1, mesh=mesh, cp_axis="cp"
+        )
         t0 = time.time()
         params, opt_state, loss = step_fn(params, opt_state, tokens, labels, pos)
         loss_val = float(loss)

@@ -1004,10 +1004,23 @@ def clear_cache(mesh: "jax.sharding.Mesh | None" = None) -> None:
 
 def roll(x: jax.Array, key: DistAttnRuntimeKey, shift: int, axis: int = 0):
     """Distributed roll along the global sequence of a dispatched tensor
-    (reference api.roll :960 — MTP label shifting)."""
+    (reference api.roll :960 — MTP label shifting).
+
+    Routed through the O(N/P) shard_map point-to-point path (local gather
+    + one padded all-to-all of the rank-crossing rows — the XLA analogue
+    of the reference's ``batch_isend_irecv``, roll.py:448); degenerate
+    exchanges fall back to the static global gather."""
     from ..parallel.dispatch import roll as _roll
 
-    return _roll(x, get_runtime_mgr(key).dispatch_meta, shift, axis=axis)
+    mgr = get_runtime_mgr(key)
+    return _roll(
+        x,
+        mgr.dispatch_meta,
+        shift,
+        axis=axis,
+        mesh=mgr.mesh,
+        cp_axis=key.cp_axis,
+    )
 
 
 def roll_simple(
@@ -1015,6 +1028,5 @@ def roll_simple(
 ):
     """Alias of :func:`roll` (reference roll_simple,
     api/magi_attn_interface.py:1004 — its only difference is plain vs
-    batched P2P issue order; here both are the same static gather whose
-    communication GSPMD schedules)."""
+    batched P2P issue order; here both ride the same P2P exchange)."""
     return roll(x, key, shift, axis=axis)

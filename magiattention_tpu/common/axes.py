@@ -1,0 +1,29 @@
+"""Mesh-axis spec normalization shared across layers.
+
+The context-parallel axis of a mesh is named by one string, or by an
+``(inter, intra)`` pair for hierarchical 2-level comm (comm/hier.py).
+Every layer that accepts a cp-axis spec normalizes it here so the
+flat-vs-hier decision lives in one place.
+"""
+
+from __future__ import annotations
+
+
+def cp_axis_names(cp_axis) -> tuple[str, ...]:
+    """Normalize a cp axis spec to a tuple of mesh axis names.
+
+    One name = flat single-level cp; two names = hierarchical
+    ``(inter, intra)``; anything longer is rejected by callers that build
+    plans (see models/_common.plan_flex_attn).
+    """
+    return (
+        tuple(cp_axis) if isinstance(cp_axis, (tuple, list)) else (cp_axis,)
+    )
+
+
+def cp_axis_size(mesh, cp_axis) -> int:
+    """Total cp world size across the (possibly hierarchical) axis spec."""
+    size = 1
+    for name in cp_axis_names(cp_axis):
+        size *= mesh.shape[name]
+    return size

@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..common.axes import cp_axis_names
+
 
 def masked_ce_sums(logits, labels):
     """(sum of CE over positions with label >= 0, count of them).
@@ -21,12 +23,6 @@ def masked_ce_sums(logits, labels):
         jnp.where(valid, tok_loss, 0.0).sum(),
         valid.sum().astype(jnp.float32),
     )
-
-
-def cp_axis_names(cp_axis) -> tuple[str, ...]:
-    """Normalize a cp axis spec — one mesh axis name, or an
-    (inter, intra) pair for hierarchical 2-level cp — to a name tuple."""
-    return tuple(cp_axis) if isinstance(cp_axis, (tuple, list)) else (cp_axis,)
 
 
 def sharded_plan_tables(plan, mesh, cp_axis):

@@ -51,17 +51,160 @@ def position_ids(meta: DispatchMeta) -> jax.Array:
     return jnp.asarray(perm)
 
 
-def roll(x: jax.Array, meta: DispatchMeta, shift: int, axis: int = 0) -> jax.Array:
-    """Distributed roll along the *global* sequence of a dispatched tensor
-    (reference functional/roll.py roll_p2p — MTP label shifting): in global
-    order, y[i] = x[(i - shift) mod total], computed directly in dispatch
-    space as one static gather (GSPMD inserts the point-to-point comm).
-    Uneven shard: pad slots keep their own (pad) value."""
+def _roll_src_slots(meta: DispatchMeta, shift: int) -> np.ndarray:
+    """Dispatch-space source slot feeding every output slot of a global
+    roll by ``shift``; pad slots source themselves (keep their value)."""
     perm = meta.perm_idx.astype(np.int64)
     unperm = meta.unperm_idx.astype(np.int64)
     total = meta.total_seqlen
     slots = np.arange(perm.shape[0], dtype=np.int64)
     valid = perm < total
     src_global = (np.where(valid, perm, 0) - shift) % total
-    gather = np.where(valid, unperm[src_global], slots).astype(np.int32)
+    return np.where(valid, unperm[src_global], slots)
+
+
+def roll(
+    x: jax.Array,
+    meta: DispatchMeta,
+    shift: int,
+    axis: int = 0,
+    *,
+    mesh=None,
+    cp_axis=None,
+) -> jax.Array:
+    """Distributed roll along the *global* sequence of a dispatched tensor
+    (reference functional/roll.py roll_p2p — MTP label shifting): in global
+    order, y[i] = x[(i - shift) mod total], computed in dispatch space.
+    Uneven shard: pad slots keep their own (pad) value.
+
+    Without ``mesh``, this is one static global gather — correct anywhere,
+    but GSPMD lowers it to a full-sequence all-gather (O(N) memory per
+    device; exps/run_roll_proof.py records the HLO evidence). Pass
+    ``mesh`` + ``cp_axis`` (the mesh axis/axes ``x`` is sharded on along
+    ``axis``) for the O(N/P) path: rows that stay on their rank are a
+    local gather; only rank-crossing rows (~ |shift| per chunk boundary)
+    ride one padded all-to-all — the XLA analogue of the reference's
+    ``batch_isend_irecv`` P2P (roll.py:448).
+    """
+    src_slot = _roll_src_slots(meta, shift)
+    if mesh is not None and cp_axis is not None:
+        out = _roll_p2p(x, meta, src_slot, axis % x.ndim, mesh, cp_axis)
+        if out is not None:
+            return out
+    gather = src_slot.astype(np.int32)
     return jnp.take(x, jnp.asarray(gather), axis=axis)
+
+
+def _roll_p2p(x, meta, src_slot, axis, mesh, cp_axis):
+    """shard_map roll: local gather + padded a2a of rank-crossing rows.
+
+    Returns None when the exchange degenerates (some rank pair moves a
+    near-full shard, so the padded a2a would cost more than the gather's
+    all-gather) — the caller falls back.
+    """
+    from ..common.axes import cp_axis_names, cp_axis_size
+
+    names = cp_axis_names(cp_axis)
+    cp = cp_axis_size(mesh, cp_axis)
+    assert cp == meta.cp_size, (cp, meta.cp_size)
+    shard = meta.shard_seqlen
+    n = cp * shard
+    slots = np.arange(n, dtype=np.int64)
+    src_rank = src_slot // shard
+    dst_rank = slots // shard
+    local = src_rank == dst_rank
+
+    # local part: per-rank gather indices (0 where remote; masked later)
+    local_src = np.where(local, src_slot % shard, 0).astype(np.int32)
+
+    rem = np.flatnonzero(~local)
+    if rem.size == 0:
+        # pure permutation within ranks (e.g. shift=0): no comm at all
+        return _shard_roll_apply(
+            x, axis, mesh, names,
+            local_src.reshape(cp, shard), None, None, None, shard,
+        )
+    s_r = src_rank[rem]
+    d_r = dst_rank[rem]
+    # canonical order shared by sender and receiver: group rows by the
+    # (src, dst) pair, ordered inside a group by destination slot
+    order = np.lexsort((slots[rem], s_r, d_r))
+    rem, s_r, d_r = rem[order], s_r[order], d_r[order]
+    pair = s_r * cp + d_r
+    counts = np.bincount(pair, minlength=cp * cp)
+    S = int(counts.max())
+    if S * cp >= n:  # padded a2a volume would match/exceed the all-gather
+        return None
+    # per-(src, dst) sequence numbers, shared sender/receiver convention:
+    # position of the row within its pair group (groups are contiguous
+    # under a stable sort by pair; rows already ordered by dst slot)
+    pair_order = np.argsort(pair, kind="stable")
+    sorted_pair = pair[pair_order]
+    starts = np.r_[0, np.flatnonzero(np.diff(sorted_pair)) + 1]
+    group_of = np.repeat(
+        np.arange(starts.size), np.diff(np.r_[starts, sorted_pair.size])
+    )
+    pos = np.empty(rem.size, dtype=np.int64)
+    pos[pair_order] = np.arange(sorted_pair.size) - starts[group_of]
+
+    send_idx = np.zeros((cp, cp, S), dtype=np.int32)
+    send_idx[s_r, d_r, pos] = (src_slot[rem] % shard).astype(np.int32)
+    # receive buffer at rank d after a2a: flat index = src*S + pos
+    recv_sel = np.full((cp, shard), cp * S, dtype=np.int32)  # trash slot
+    recv_sel[d_r, rem % shard] = (s_r * S + pos).astype(np.int32)
+    recv_valid = np.zeros((cp, shard), dtype=bool)
+    recv_valid[d_r, rem % shard] = True
+
+    return _shard_roll_apply(
+        x, axis, mesh, names,
+        local_src.reshape(cp, shard), send_idx, recv_sel, recv_valid, shard,
+    )
+
+
+def _shard_roll_apply(
+    x, axis, mesh, names, local_src, send_idx, recv_sel, recv_valid, shard
+):
+    from jax.sharding import PartitionSpec as P
+
+    axis_name = names if len(names) > 1 else names[0]
+    x_spec = P(*([None] * axis), axis_name)
+    tab_spec = P(axis_name)
+
+    def _local(x_l, ls, *tabs):
+        xm = jnp.moveaxis(x_l, axis, 0)  # [shard, ...]
+        loc = jnp.take(xm, ls[0], axis=0)
+        if send_idx is not None:
+            si, rs, rv = tabs
+            si = si[0]  # [cp, S]
+            send_buf = jnp.take(xm, si.reshape(-1), axis=0).reshape(
+                si.shape + xm.shape[1:]
+            )
+            recv = jax.lax.all_to_all(
+                send_buf, axis_name, split_axis=0, concat_axis=0,
+                tiled=False,
+            )
+            flat = recv.reshape((-1,) + xm.shape[1:])
+            remote = jnp.take(
+                flat, jnp.minimum(rs[0], flat.shape[0] - 1), axis=0
+            )
+            mask = rv[0].reshape((shard,) + (1,) * (xm.ndim - 1))
+            loc = jnp.where(mask, remote, loc)
+        return jnp.moveaxis(loc, 0, axis)
+
+    tabs = (jnp.asarray(local_src),)
+    specs = (tab_spec,)
+    if send_idx is not None:
+        tabs += (
+            jnp.asarray(send_idx),
+            jnp.asarray(recv_sel),
+            jnp.asarray(recv_valid),
+        )
+        specs += (tab_spec, tab_spec, tab_spec)
+    fn = jax.shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(x_spec,) + specs,
+        out_specs=x_spec,
+        check_vma=False,
+    )
+    return fn(x, *tabs)
