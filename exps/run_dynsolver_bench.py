@@ -1,0 +1,166 @@
+"""Dynamic-solver quality harness: KD vs NCQ vs LocalityGreedy vs
+GridLocality (GRG-grade) vs AutoDynamicSolver.
+
+The reference backs its dynamic mode with a 3.7k-LoC algorithm family
+(snf.py 717 / fast_snf.py 1052 / grg.py 580 / ncq.py + the
+BinaryGreedyParallel default). This repo covers those roles with four
+solvers plus an auto-selector (meta/solver/dynamic_attn_solver.py); this
+harness is the quality evidence behind that replacement — per
+(workload, cp, solver):
+
+- balance ratio: max rank area / mean rank area (1.0 = perfect)
+- q/kv comm rows: rows each rank needs outside its own contiguous shard
+  (what the qo-comm runtime actually casts, build_qo_comm_plan's
+  q_need/k_need minus the local part), as a fraction of total tokens
+- plan time: wall time of solve()
+
+Workloads mirror the reference's pipeline scenarios
+(tests/test_pipeline.py: full_attn, varlen_block_causal,
+bi_causal_with_q_overlap). Pure host-side: runs anywhere, no devices.
+
+Run:  python exps/run_dynsolver_bench.py [--total 65536 --json]
+The committed results table lives in docs/dynamic_solver.md; the
+regression thresholds derived from it are tests/test_meta/
+test_dynsolver_quality.py.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from magiattention_tpu.common.rectangle import AttnRectangles  # noqa: E402
+from magiattention_tpu.meta.solver.dynamic_attn_solver import (  # noqa: E402
+    AutoDynamicSolver,
+    DynamicAttnSolver,
+    GridLocalitySolver,
+    LocalityGreedySolver,
+    NCQDynamicSolver,
+    modeled_step_cost,
+    rank_comm_rows,
+)
+
+
+def dense_causal(total):
+    return [(0, total, 0, total, 1)]
+
+
+def varlen_block_causal(total, n_docs=12, block=None):
+    """Docs of pseudo-random length; causal in doc-sized blocks (each
+    block attends all earlier blocks of its doc fully + itself causal —
+    expressed as one causal slice per doc for the plane model)."""
+    rng = np.random.default_rng(7)
+    cuts = np.sort(rng.choice(np.arange(1, total), n_docs - 1, replace=False))
+    bounds = [0, *[int(c) for c in cuts], total]
+    return [
+        (a, b, a, b, 1) for a, b in zip(bounds, bounds[1:])
+    ]
+
+
+def shared_question_q_overlap(total, n_answers=8):
+    """Reference bi_causal_with_q_overlap shape: a shared question prefix
+    (first quarter) that EVERY answer segment attends fully, plus each
+    answer causal over itself — answer q rows appear in two slices."""
+    q_len = total // 4
+    rest = total - q_len
+    seg = rest // n_answers
+    slices = [(0, q_len, 0, q_len, 1)]  # the question itself, causal
+    for i in range(n_answers):
+        a = q_len + i * seg
+        b = q_len + (i + 1) * seg if i < n_answers - 1 else total
+        slices.append((a, b, 0, q_len, 0))  # full attention to question
+        slices.append((a, b, a, b, 1))  # causal over itself
+    return slices
+
+
+WORKLOADS = {
+    "dense_causal": dense_causal,
+    "varlen_block_causal": varlen_block_causal,
+    "shared_question": shared_question_q_overlap,
+}
+
+SOLVERS = {
+    "kd": DynamicAttnSolver,
+    "ncq": NCQDynamicSolver,
+    "locality_greedy": LocalityGreedySolver,
+    "grid": GridLocalitySolver,
+    "auto": AutoDynamicSolver,
+}
+
+
+def comm_rows(sol, total, cp):
+    """(q_remote_rows, kv_remote_rows) summed over ranks — the rows the
+    qo-comm runtime casts (ownership = contiguous shard)."""
+    rows = rank_comm_rows(sol, total, cp)
+    return sum(q for q, _ in rows), sum(kv for _, kv in rows)
+
+
+def run(total, cps):
+    rows = []
+    for wname, wfn in WORKLOADS.items():
+        slices = wfn(total)
+        rects = AttnRectangles.from_ranges(
+            [(s[0], s[1]) for s in slices],
+            [(s[2], s[3]) for s in slices],
+            [s[4] for s in slices],
+        )
+        for cp in cps:
+            for sname, scls in SOLVERS.items():
+                solver = scls()
+                t0 = time.perf_counter()
+                sol = solver.solve(rects, cp, total_seqlen=total)
+                dt = time.perf_counter() - t0
+                assert sum(sol.areas) == rects.area, (
+                    wname, sname, sum(sol.areas), rects.area,
+                )
+                q_rem, kv_rem = comm_rows(sol, total, cp)
+                rows.append({
+                    "workload": wname,
+                    "cp": cp,
+                    "solver": sname,
+                    "balance": round(sol.balance_ratio, 4),
+                    "q_comm_frac": round(q_rem / total, 4),
+                    "kv_comm_frac": round(kv_rem / total, 4),
+                    # overlap-aware slowest-rank model, as a multiple of
+                    # the perfectly-balanced zero-comm ideal (area/cp)
+                    "step_cost": round(
+                        modeled_step_cost(sol, total, cp)
+                        / (rects.area / cp),
+                        4,
+                    ),
+                    "plan_ms": round(dt * 1e3, 2),
+                })
+    return rows
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--total", type=int, default=65536)
+    p.add_argument("--cps", default="8,16")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args()
+    rows = run(args.total, [int(c) for c in args.cps.split(",")])
+    if args.json:
+        print(json.dumps(rows))
+        return
+    hdr = f"{'workload':<22}{'cp':>4}{'solver':>18}{'balance':>9}" \
+          f"{'q_comm':>8}{'kv_comm':>9}{'step':>7}{'plan_ms':>9}"
+    print(f"total={args.total}")
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows:
+        print(
+            f"{r['workload']:<22}{r['cp']:>4}{r['solver']:>18}"
+            f"{r['balance']:>9.3f}{r['q_comm_frac']:>8.3f}"
+            f"{r['kv_comm_frac']:>9.3f}{r['step_cost']:>7.3f}"
+            f"{r['plan_ms']:>9.2f}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -16,16 +16,26 @@ reference family's *roles*, not its implementations):
 - :class:`NCQDynamicSolver` — zero-Q/O-comm (role of reference ncq.py):
   cut only along the host q-shard boundaries so every rank computes
   exactly its own q rows; only KV moves.
-- :class:`LocalityGreedySolver` — balance/locality tradeoff (role of the
-  snf/fast_snf/grg family): cut work units at host boundaries, then
-  greedily assign largest-first to the rank minimizing
-  load + penalty x non-local Q/KV rows.
+- :class:`LocalityGreedySolver` — balance/locality tradeoff: cut work
+  units at host boundaries, then greedily assign largest-first to the
+  rank minimizing load + penalty x non-local Q/KV rows. Superseded by
+  GridLocalitySolver (kept for comparison; its per-unit extent counting
+  over-counts KV rows that merged casts dedup).
+- :class:`GridLocalitySolver` — GRG-grade (role of reference
+  grg.py/snf.py/fast_snf.py): cut at host q AND k boundaries into grid
+  cells, then dedup-aware greedy with random restarts — comm cost is
+  computed on the MERGED per-rank row sets (what group-cast actually
+  sends), so overlapping cell extents on one rank are counted once.
+  Quality evidence vs KD/NCQ: exps/run_dynsolver_bench.py +
+  docs/dynamic_solver.md.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import random
 
+from ...common.ranges import AttnRanges
 from ...common.rectangle import AttnRectangles
 
 
@@ -227,3 +237,239 @@ class LocalityGreedySolver:
                 rr.append(rect)
             parts.append(rr)
         return DynamicAttnSolution(rank_rects=tuple(parts))
+
+
+class GridLocalitySolver:
+    """GRG-grade grid partition (role of reference grg/snf/fast_snf).
+
+    The plane is cut at every host q-shard AND k-shard boundary into grid
+    cells; cells are assigned to ranks greedily (largest-first) under
+
+        load[rank] + c2a * (2 * added_remote_q + added_remote_kv)
+
+    where ``added_remote_*`` are the NEW rows rank would have to receive:
+    rows already in the rank's merged need-set (from earlier cells) or
+    inside its own contiguous shard are free — matching what the qo-comm
+    runtime's merged group-casts actually transfer. Q movement is
+    weighted 2x (cast out + O lse-reduce back, the reference's
+    cast/reduce split, grg.py:_eval_greedy_algorithm).
+
+    ``restarts`` greedy passes run with jittered orderings (the "random"
+    in greedy-random-grid); the pass with the best global cost wins.
+    Deterministic for a fixed seed.
+    """
+
+    def __init__(
+        self,
+        comm_rows_to_area: float | None = None,
+        restarts: int = 4,
+        seed: int = 0,
+    ):
+        self.c2a = comm_rows_to_area
+        self.restarts = max(1, restarts)
+        self.seed = seed
+
+    def solve(
+        self,
+        rects: AttnRectangles,
+        cp_size: int,
+        total_seqlen: int | None = None,
+    ) -> DynamicAttnSolution:
+        total = _infer_total(rects, total_seqlen)
+        shard = -(-total // cp_size)
+        area_total = rects.area
+        if area_total == 0 or cp_size == 1:
+            parts = [rects] + [AttnRectangles() for _ in range(cp_size - 1)]
+            return DynamicAttnSolution(rank_rects=tuple(parts))
+        # a received row is worth this much area: the h=8/d=128 bf16
+        # hardware ratio (ICI time per row / MXU time per (q,k) pair),
+        # ~1024 — see modeled_step_cost (measured sweep in
+        # docs/dynamic_solver.md: workload-scaled defaults over-penalize
+        # movement and collapse to NCQ)
+        c2a = self.c2a if self.c2a is not None else 1024.0
+
+        # grid cells: cut at host boundaries on both axes; anything beyond
+        # total_seqlen has no owning shard — fail fast rather than drop it
+        # (the solution's areas must sum exactly to the input area)
+        cells: list[tuple[int, int, AttnRectangles]] = []
+        rest = rects
+        for i in range(cp_size):
+            band, rest = rest.cut_q(min((i + 1) * shard, total))
+            for j in range(cp_size):
+                cell, band = band.cut_k(min((j + 1) * shard, total))
+                if cell.area > 0:
+                    cells.append((i, j, cell))
+            if band.area > 0:
+                raise ValueError(
+                    f"mask extends past total_seqlen={total} on k "
+                    f"(leftover area {band.area})"
+                )
+        if rest.area > 0:
+            raise ValueError(
+                f"mask extends past total_seqlen={total} on q "
+                f"(leftover area {rest.area})"
+            )
+        units = []
+        for i, j, cell in cells:
+            q_ext, k_ext = AttnRanges(), AttnRanges()
+            for r in cell:
+                q_ext.append(r.q_range.clone())
+                k_ext.append(r.k_range.clone())
+            units.append(
+                (cell.area, i, j, cell, q_ext.merge(), k_ext.merge())
+            )
+        units.sort(key=lambda u: -u[0])
+
+        rng = random.Random(self.seed)
+        best = None
+        for trial in range(self.restarts):
+            order = list(units)
+            if trial:  # jitter: swap nearby entries in the sorted order
+                for idx in range(len(order) - 1):
+                    if rng.random() < 0.5:
+                        order[idx], order[idx + 1] = (
+                            order[idx + 1], order[idx],
+                        )
+            sol = self._greedy(order, cp_size, shard, total, c2a)
+            if best is None or sol[0] < best[0]:
+                best = sol
+        buckets = best[1]
+        parts = []
+        for b in buckets:
+            rr = AttnRectangles()
+            for cell in b:
+                rr.extend(cell)
+            parts.append(rr)
+        return DynamicAttnSolution(rank_rects=tuple(parts))
+
+    @staticmethod
+    def _added_remote(ext, need, own) -> int:
+        """Rows of ``ext`` not already in ``need`` and not in ``own``."""
+        added = ext.union_size_with(need) - need.union_size()
+        ext_own = ext.find_overlap_ranges(own)
+        need_own = need.find_overlap_ranges(own)
+        added_local = (
+            ext_own.union_size_with(need_own) - need_own.union_size()
+        )
+        return added - added_local
+
+    def _greedy(self, order, cp, shard, total, c2a):
+        loads = [0.0] * cp
+        q_need = [AttnRanges() for _ in range(cp)]
+        k_need = [AttnRanges() for _ in range(cp)]
+        own = [
+            AttnRanges.from_ranges(
+                [(r * shard, min((r + 1) * shard, total))]
+            )
+            for r in range(cp)
+        ]
+        buckets: list[list[AttnRectangles]] = [[] for _ in range(cp)]
+        q_rem = [0] * cp
+        kv_rem = [0] * cp
+        for area, i, j, cell, q_ext, k_ext in order:
+            # candidate ranks: q home, k home, and the least-loaded rank
+            # (enough in practice; evaluating all cp ranks barely helps
+            # and costs cp x the range ops)
+            cands = {i, j, min(range(cp), key=loads.__getitem__)}
+            best_r, best_cost, best_dq, best_dk = None, None, 0, 0
+            for r in cands:
+                dq = self._added_remote(q_ext, q_need[r], own[r])
+                dk = self._added_remote(k_ext, k_need[r], own[r])
+                cost = loads[r] + area + c2a * (2 * dq + dk)
+                if best_cost is None or cost < best_cost - 1e-9:
+                    best_r, best_cost, best_dq, best_dk = r, cost, dq, dk
+            loads[best_r] += area
+            q_need[best_r].extend(q_ext)
+            q_need[best_r] = q_need[best_r].merge()
+            k_need[best_r].extend(k_ext)
+            k_need[best_r] = k_need[best_r].merge()
+            buckets[best_r].append(cell)
+            q_rem[best_r] += best_dq
+            kv_rem[best_r] += best_dk
+        # score restarts by the same overlap-aware slowest-rank model the
+        # solution is judged on (modeled_step_cost): per rank, comm hides
+        # under compute when smaller
+        global_cost = max(
+            max(loads[r], c2a * (2 * q_rem[r] + kv_rem[r]))
+            for r in range(cp)
+        )
+        return (global_cost, buckets)
+
+
+def rank_comm_rows(
+    sol: DynamicAttnSolution, total_seqlen: int, cp_size: int
+) -> list[tuple[int, int]]:
+    """Per-rank (q_remote, kv_remote) rows under contiguous ownership —
+    the rows the qo-comm runtime's merged group-casts transfer."""
+    shard = -(-total_seqlen // cp_size)
+    out = []
+    for r, rr in enumerate(sol.rank_rects):
+        own = AttnRanges.from_ranges(
+            [(r * shard, min((r + 1) * shard, total_seqlen))]
+        )
+        qs, ks = AttnRanges(), AttnRanges()
+        for rect in rr:
+            qs.append(rect.q_range.clone())
+            ks.append(rect.k_range.clone())
+        qs, ks = qs.merge(), ks.merge()
+        out.append(
+            (
+                qs.total_seqlen - qs.intersect_size_with(own),
+                ks.total_seqlen - ks.intersect_size_with(own),
+            )
+        )
+    return out
+
+
+def modeled_step_cost(
+    sol: DynamicAttnSolution,
+    total_seqlen: int,
+    cp_size: int,
+    comm_rows_to_area: float = 1024.0,
+) -> float:
+    """Overlap-aware step-time model: per rank the comm (cast Q 2x for
+    the O return + cast KV) hides under compute when smaller, so rank
+    time = max(area, c2a * rows); step time = slowest rank. The default
+    c2a ~ 1024 area-units/row is the h=8/d=128 bf16 hardware ratio
+    (bytes-per-row / ICI bw) / (flops-per-pair / MXU flops)."""
+    rows = rank_comm_rows(sol, total_seqlen, cp_size)
+    areas = sol.areas
+    return max(
+        max(float(a), comm_rows_to_area * (2.0 * q + kv))
+        for a, (q, kv) in zip(areas, rows)
+    )
+
+
+class AutoDynamicSolver:
+    """Pick the best partition by the modeled step cost.
+
+    Runs every candidate solver (all are host-side, ms-scale) and keeps
+    the solution minimizing :func:`modeled_step_cost` — the role of the
+    reference's manually-selected algorithm family, made automatic: KD
+    wins dense masks (free-position cuts), NCQ wins q-overlap-heavy
+    masks (zero Q/O movement), the grid solver the varlen middle ground
+    (measured: exps/run_dynsolver_bench.py, docs/dynamic_solver.md).
+    """
+
+    def __init__(self, comm_rows_to_area: float = 1024.0, candidates=None):
+        self.c2a = comm_rows_to_area
+        self.candidates = candidates or (
+            DynamicAttnSolver(),
+            NCQDynamicSolver(),
+            GridLocalitySolver(comm_rows_to_area=comm_rows_to_area),
+        )
+
+    def solve(
+        self,
+        rects: AttnRectangles,
+        cp_size: int,
+        total_seqlen: int | None = None,
+    ) -> DynamicAttnSolution:
+        total = _infer_total(rects, total_seqlen)
+        best, best_cost = None, None
+        for solver in self.candidates:
+            sol = solver.solve(rects, cp_size, total_seqlen=total)
+            cost = modeled_step_cost(sol, total, cp_size, self.c2a)
+            if best_cost is None or cost < best_cost:
+                best, best_cost = sol, cost
+        return best

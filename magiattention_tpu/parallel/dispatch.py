@@ -167,8 +167,14 @@ def _shard_roll_apply(
     from jax.sharding import PartitionSpec as P
 
     axis_name = names if len(names) > 1 else names[0]
-    x_spec = P(*([None] * axis), axis_name)
-    tab_spec = P(axis_name)
+    # partial-manual shard_map (axis_names=cp only) requires full-rank
+    # specs with explicit None for auto dims
+    x_spec = P(
+        *([None] * axis), axis_name, *([None] * (x.ndim - axis - 1))
+    )
+
+    def tab_spec(t):
+        return P(axis_name, *([None] * (t.ndim - 1)))
 
     def _local(x_l, ls, *tabs):
         xm = jnp.moveaxis(x_l, axis, 0)  # [shard, ...]
@@ -192,19 +198,23 @@ def _shard_roll_apply(
         return jnp.moveaxis(loc, 0, axis)
 
     tabs = (jnp.asarray(local_src),)
-    specs = (tab_spec,)
     if send_idx is not None:
         tabs += (
             jnp.asarray(send_idx),
             jnp.asarray(recv_sel),
             jnp.asarray(recv_valid),
         )
-        specs += (tab_spec, tab_spec, tab_spec)
+    specs = tuple(tab_spec(t) for t in tabs)
     fn = jax.shard_map(
         _local,
         mesh=mesh,
         in_specs=(x_spec,) + specs,
         out_specs=x_spec,
-        check_vma=False,
+        # only the cp axis/axes are manual: shardings of other dims over
+        # the remaining mesh axes (e.g. a tp-sharded hidden dim) pass
+        # through GSPMD untouched instead of being forced replicated.
+        # check_vma must stay True — disabling it rewrites out_specs to
+        # full specs, which partial-manual mode rejects
+        axis_names=set(names),
     )
     return fn(x, *tabs)

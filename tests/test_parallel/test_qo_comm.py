@@ -55,7 +55,9 @@ CASES = [
 
 def _solver_for(kind):
     from magiattention_tpu.meta.solver.dynamic_attn_solver import (
+        AutoDynamicSolver,
         DynamicAttnSolver,
+        GridLocalitySolver,
         LocalityGreedySolver,
         NCQDynamicSolver,
     )
@@ -64,10 +66,14 @@ def _solver_for(kind):
         "kd": DynamicAttnSolver,
         "ncq": NCQDynamicSolver,
         "locality": LocalityGreedySolver,
+        "grid": GridLocalitySolver,
+        "auto": AutoDynamicSolver,
     }[kind]()
 
 
-@pytest.mark.parametrize("solver_kind", ["kd", "ncq", "locality"])
+@pytest.mark.parametrize(
+    "solver_kind", ["kd", "ncq", "locality", "grid", "auto"]
+)
 @pytest.mark.parametrize("cp", [2, 4])
 @pytest.mark.parametrize("name,total,slices", CASES, ids=[c[0] for c in CASES])
 def test_qo_comm_pipeline(name, total, slices, cp, solver_kind):
@@ -77,7 +83,12 @@ def test_qo_comm_pipeline(name, total, slices, cp, solver_kind):
     plan = build_qo_comm_plan(
         sl, total, cp, block_q=64, block_k=64, solver=_solver_for(solver_kind)
     )
-    if solver_kind != "ncq":  # the zero-comm partition trades balance away
+    if solver_kind in ("kd", "locality"):
+        # balance-seeking solvers must balance; ncq trades it away by
+        # design, and grid/auto minimize the modeled step cost, which at
+        # this toy scale (shard=128 rows vs c2a=1024 area/row) correctly
+        # says movement never pays — they collapse to ncq placement
+        # (scale behavior measured in docs/dynamic_solver.md)
         assert max(plan.rank_areas) <= 1.5 * (sum(plan.rank_areas) / cp)
     params = _params(d)
     fn = make_qo_comm_attn_fn(plan, mesh, params)

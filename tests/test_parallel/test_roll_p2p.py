@@ -131,3 +131,22 @@ def test_api_roll_routes_p2p():
         .as_text()
     )
     assert " all-gather" not in txt
+
+
+def test_p2p_preserves_other_axis_sharding():
+    """Partial-manual shard_map: a hidden dim sharded over another mesh
+    axis (tp) must pass through the roll untouched — not be forced
+    replicated (memory blow-up) or stripped (silent reshard)."""
+    total = 1024
+    qr = AttnRanges.from_ranges([(0, total)])
+    meta, _, _ = make_dispatch_meta_from_qk_ranges(
+        qr, qr.clone(), [AttnMaskType.CAUSAL], total, total, CHUNK, 4
+    )
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("cp", "tp"))
+    sh = NamedSharding(mesh, P("cp", "tp"))
+    x = jax.device_put(
+        jnp.arange(total * 8, dtype=jnp.float32).reshape(total, 8), sh
+    )
+    y = roll(x, meta, -1, mesh=mesh, cp_axis="cp")
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(roll(x, meta, -1)))
+    assert y.sharding.spec == P("cp", "tp"), y.sharding
