@@ -46,43 +46,9 @@ from magiattention_tpu.meta.solver.dynamic_attn_solver import (  # noqa: E402
 )
 
 
-def dense_causal(total):
-    return [(0, total, 0, total, 1)]
-
-
-def varlen_block_causal(total, n_docs=12, block=None):
-    """Docs of pseudo-random length; causal in doc-sized blocks (each
-    block attends all earlier blocks of its doc fully + itself causal —
-    expressed as one causal slice per doc for the plane model)."""
-    rng = np.random.default_rng(7)
-    cuts = np.sort(rng.choice(np.arange(1, total), n_docs - 1, replace=False))
-    bounds = [0, *[int(c) for c in cuts], total]
-    return [
-        (a, b, a, b, 1) for a, b in zip(bounds, bounds[1:])
-    ]
-
-
-def shared_question_q_overlap(total, n_answers=8):
-    """Reference bi_causal_with_q_overlap shape: a shared question prefix
-    (first quarter) that EVERY answer segment attends fully, plus each
-    answer causal over itself — answer q rows appear in two slices."""
-    q_len = total // 4
-    rest = total - q_len
-    seg = rest // n_answers
-    slices = [(0, q_len, 0, q_len, 1)]  # the question itself, causal
-    for i in range(n_answers):
-        a = q_len + i * seg
-        b = q_len + (i + 1) * seg if i < n_answers - 1 else total
-        slices.append((a, b, 0, q_len, 0))  # full attention to question
-        slices.append((a, b, a, b, 1))  # causal over itself
-    return slices
-
-
-WORKLOADS = {
-    "dense_causal": dense_causal,
-    "varlen_block_causal": varlen_block_causal,
-    "shared_question": shared_question_q_overlap,
-}
+from magiattention_tpu.testing.workloads import (  # noqa: E402
+    DYNSOLVER_WORKLOADS as WORKLOADS,
+)
 
 SOLVERS = {
     "kd": DynamicAttnSolver,

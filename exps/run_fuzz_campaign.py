@@ -124,12 +124,23 @@ def main() -> None:
                       ref_attn_from_ranges(q, k, v, qr, kr, ts)[0])
 
             elif args.axis == "qo":
+                from magiattention_tpu.common.enum import AttnMaskType
+                from magiattention_tpu.common.ranges import AttnRanges
+                from magiattention_tpu.meta.dispatch_meta import (
+                    make_dispatch_meta_from_qk_ranges,
+                )
                 from magiattention_tpu.meta.solver.dynamic_attn_solver import (
+                    AutoDynamicSolver,
                     DynamicAttnSolver,
+                    GridLocalitySolver,
                     LocalityGreedySolver,
                     NCQDynamicSolver,
                 )
                 from magiattention_tpu.ops.flex_attn import FlexAttnParams
+                from magiattention_tpu.parallel.dispatch import (
+                    dispatch as meta_dispatch,
+                    undispatch as meta_undispatch,
+                )
                 from magiattention_tpu.parallel.qo_comm import (
                     build_qo_comm_plan,
                     make_qo_comm_attn_fn,
@@ -144,9 +155,21 @@ def main() -> None:
                     [(a[0], a[1], b[0], b[1], t)
                      for a, b, t in zip(qr, kr, ts)], np.int64)
                 solver = [DynamicAttnSolver, NCQDynamicSolver,
-                          LocalityGreedySolver][seed % 3]()
+                          LocalityGreedySolver, GridLocalitySolver,
+                          AutoDynamicSolver][seed % 5]()
+                # odd seeds: ownership = MinHeap-balanced dispatch layout
+                # (the qo x balanced-dispatch composition); even: contiguous
+                meta = None
+                if seed % 2:
+                    meta, _, _ = make_dispatch_meta_from_qk_ranges(
+                        AttnRanges.from_ranges(qr),
+                        AttnRanges.from_ranges(kr),
+                        [AttnMaskType(t) for t in ts],
+                        total, total, 32, cp,
+                    )
                 plan = build_qo_comm_plan(
-                    sl, total, cp, block_q=64, block_k=64, solver=solver)
+                    sl, total, cp, block_q=64, block_k=64, solver=solver,
+                    dispatch_meta=meta)
                 params = FlexAttnParams(
                     block_q=64, block_k=64,
                     scale=float(1.0 / np.sqrt(32)), softcap=0.0,
@@ -154,9 +177,15 @@ def main() -> None:
                 fn = make_qo_comm_attn_fn(
                     plan, Mesh(np.array(jax.devices()[:cp]), ("cp",)), params)
                 q, k, v = rand_qkv(rng, total, total, 2, 2)
-                check(f"qo seed={seed} {type(solver).__name__}",
-                      fn(q, k, v)[0],
-                      ref_attn_from_ranges(q, k, v, qr, kr, ts)[0])
+                if meta is not None:
+                    out = meta_undispatch(
+                        fn(meta_dispatch(q, meta), meta_dispatch(k, meta),
+                           meta_dispatch(v, meta))[0], meta)
+                else:
+                    out = fn(q, k, v)[0]
+                check(f"qo seed={seed} {type(solver).__name__}"
+                      f"{' dispatched' if meta is not None else ''}",
+                      out, ref_attn_from_ranges(q, k, v, qr, kr, ts)[0])
 
             elif args.axis == "hier":
                 total = 1024

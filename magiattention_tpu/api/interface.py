@@ -459,15 +459,6 @@ def magi_attn_flex_key(
         cp_axis=cp_axis,
         uneven_shard=dispatch_config.uneven_shard,
     )
-    if env.is_qo_comm_enable():
-        # qo-comm needs the contiguous shard its plane partition is built
-        # over: force Sequential dispatch (reference qo-comm path keeps the
-        # dispatch meta; our dynamic solver plans in global coordinates)
-        from ..meta.solver.dispatch_solver import SequentialDispatchAlg
-
-        dispatch_config = dataclasses.replace(
-            dispatch_config, alg=SequentialDispatchAlg()
-        )
     sink_fp = (
         hash(np.asarray(jax.device_get(sink), np.float32).tobytes())
         if sink is not None
@@ -514,7 +505,10 @@ def magi_attn_flex_key(
     if env.is_qo_comm_enable():
         # qo-comm mode (reference _make_attn_meta.py:40: DynamicAttnSolver
         # iff MAGI_ATTENTION_QO_COMM): dynamic plane partition moving Q/O
-        # as well as KV, over the contiguous shard forced above.
+        # as well as KV. Token ownership is the dispatch meta built above
+        # with the configured (default MinHeap-balanced) algorithm — the
+        # plane partition composes with area-balanced sharding, casts
+        # routed over the permuted ownership.
         from ..parallel.qo_comm import (
             build_qo_comm_plan,
             make_qo_comm_attn_fn,
@@ -533,6 +527,7 @@ def magi_attn_flex_key(
             cp_size,
             block_q=env.block_q(),
             block_k=env.block_k(),
+            dispatch_meta=mq,
         )
         params = make_attn_params(
             qo_plan,

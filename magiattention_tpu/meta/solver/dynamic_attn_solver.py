@@ -357,12 +357,7 @@ class GridLocalitySolver:
         loads = [0.0] * cp
         q_need = [AttnRanges() for _ in range(cp)]
         k_need = [AttnRanges() for _ in range(cp)]
-        own = [
-            AttnRanges.from_ranges(
-                [(r * shard, min((r + 1) * shard, total))]
-            )
-            for r in range(cp)
-        ]
+        own = [_own_shard_ranges(r, shard, total) for r in range(cp)]
         buckets: list[list[AttnRectangles]] = [[] for _ in range(cp)]
         q_rem = [0] * cp
         kv_rem = [0] * cp
@@ -396,6 +391,17 @@ class GridLocalitySolver:
         return (global_cost, buckets)
 
 
+def _own_shard_ranges(rank: int, shard: int, total: int) -> AttnRanges:
+    """Contiguous ownership of one rank, clamped to the sequence — ranks
+    entirely past ``total`` (cp_size not dividing total_seqlen) own
+    nothing rather than an invalid reversed range."""
+    lo = min(rank * shard, total)
+    hi = min((rank + 1) * shard, total)
+    if lo >= hi:
+        return AttnRanges()
+    return AttnRanges.from_ranges([(lo, hi)])
+
+
 def rank_comm_rows(
     sol: DynamicAttnSolution, total_seqlen: int, cp_size: int
 ) -> list[tuple[int, int]]:
@@ -404,9 +410,7 @@ def rank_comm_rows(
     shard = -(-total_seqlen // cp_size)
     out = []
     for r, rr in enumerate(sol.rank_rects):
-        own = AttnRanges.from_ranges(
-            [(r * shard, min((r + 1) * shard, total_seqlen))]
-        )
+        own = _own_shard_ranges(r, shard, total_seqlen)
         qs, ks = AttnRanges(), AttnRanges()
         for rect in rr:
             qs.append(rect.q_range.clone())

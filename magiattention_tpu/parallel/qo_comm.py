@@ -13,9 +13,10 @@ segment merge (comm/group_collective.group_reduce_lse), the Q/KV casts
 transpose into the dQ/dKV returns automatically, and the kernel vjp's
 first-class lse cotangent makes the partial-merge backward exact.
 
-Token ownership is the contiguous (sequential) shard — qo-comm layers on
-top of an existing natural sharding rather than the chunk-permuted
-dispatch.
+Token ownership is the contiguous (sequential) shard by default, or —
+when a ``dispatch_meta`` is passed to :func:`build_qo_comm_plan` — the
+chunk-permuted load-balanced dispatch layout, composing qo-comm with
+area-balanced sharding (reference _make_attn_meta.py:40-130).
 """
 
 from __future__ import annotations
@@ -35,7 +36,10 @@ from ..comm.group_collective import (
     group_cast,
     group_reduce_lse,
 )
-from ..meta.solver.dynamic_attn_solver import DynamicAttnSolver
+from ..meta.solver.dynamic_attn_solver import (
+    AutoDynamicSolver,
+    DynamicAttnSolver,
+)
 from ..ops.block_meta import Run, build_block_meta_general, runs_from_position_ids
 from ..ops.flex_attn import FlexAttnParams
 from .dist_attn import StageTables, _call_kernel, _headmajor_to_seq, _hm, _round_up
@@ -69,28 +73,40 @@ class QoCommPlan:
 
 
 def _ranges_to_send_map(
-    need: list[AttnRanges], shard: int, cp: int
+    need: list[AttnRanges],
+    shard: int,
+    cp: int,
+    unperm: np.ndarray | None = None,
 ) -> tuple[list[list[np.ndarray]], list[list[tuple[int, np.ndarray]]]]:
-    """send_map[s][d] = s-local rows of need[d] owned by s (contiguous
-    ownership); recv_segments[d] = (src, global ids) in recv order."""
+    """send_map[s][d] = s-local rows of need[d] owned by s;
+    recv_segments[d] = (src, global ids) in recv order.
+
+    Ownership: global row g lives at dispatch slot ``unperm[g]`` =
+    rank * shard + local. ``unperm=None`` is the contiguous identity
+    (sequential shard) fast path; a chunk-permuted dispatch layout
+    (balanced MinHeap etc.) routes through its own unperm_idx — the
+    composition the reference gets from building the dynamic attn meta
+    over the dispatch meta (_make_attn_meta.py:40-130)."""
     send_map = [
         [np.empty(0, np.int64) for _ in range(cp)] for _ in range(cp)
     ]
     recv_segments: list[list[tuple[int, np.ndarray]]] = [[] for _ in range(cp)]
     for d in range(cp):
-        for s in range(cp):
-            own = AttnRanges.from_ranges([(s * shard, (s + 1) * shard)])
-            inter = need[d].find_overlap_ranges(own)
-            if inter.is_empty():
-                continue
-            rows = np.concatenate(
-                [
-                    np.arange(r.start - s * shard, r.end - s * shard, dtype=np.int64)
-                    for r in inter
-                ]
-            )
-            send_map[s][d] = rows
-            recv_segments[d].append((s, rows + s * shard))
+        if need[d].is_empty():
+            continue
+        ids = np.concatenate(
+            [np.arange(r.start, r.end, dtype=np.int64) for r in need[d]]
+        )
+        slots = ids if unperm is None else unperm[ids]
+        s_rank = slots // shard
+        local = slots % shard
+        # canonical (src, global id) order shared by sender and receiver
+        order = np.lexsort((ids, s_rank))
+        s_sorted = s_rank[order]
+        for s in np.unique(s_sorted):
+            m = s_sorted == s
+            send_map[int(s)][d] = local[order][m]
+            recv_segments[d].append((int(s), ids[order][m]))
     return send_map, recv_segments
 
 
@@ -120,7 +136,18 @@ def build_qo_comm_plan(
     block_q: int = 128,
     block_k: int = 128,
     solver: DynamicAttnSolver | None = None,
+    dispatch_meta=None,
 ) -> QoCommPlan:
+    """Plan the dynamic (attention-plane) partition + its comm routing.
+
+    ``dispatch_meta``: when given, token ownership is that (chunk-
+    permuted, load-balanced) dispatch layout instead of the contiguous
+    sequential shard — qo-comm then composes with area-balanced
+    dispatching exactly as the reference does by selecting the dynamic
+    solver over the dispatch meta (_make_attn_meta.py:40-130). The plane
+    partition itself stays in global coordinates either way; only the
+    cast/reduce routing follows the permuted ownership.
+    """
     assert total_seqlen % cp_size == 0, (
         f"total_seqlen {total_seqlen} must be divisible by cp_size {cp_size}"
     )
@@ -133,7 +160,23 @@ def build_qo_comm_plan(
         "would silently never be cast)"
     )
     shard = total_seqlen // cp_size
-    solver = solver or DynamicAttnSolver()
+    unperm = None
+    if dispatch_meta is not None:
+        assert dispatch_meta.cp_size == cp_size, (
+            dispatch_meta.cp_size, cp_size,
+        )
+        assert not dispatch_meta.is_uneven, (
+            "qo-comm x uneven shard is unsupported (check_flag_comb)"
+        )
+        assert dispatch_meta.shard_seqlen == shard, (
+            f"dispatch meta shard {dispatch_meta.shard_seqlen} != "
+            f"{shard} (pad the sequence to the dispatch layout first)"
+        )
+        unperm = dispatch_meta.unperm_idx.astype(np.int64)
+    # default: best-of-family by the modeled step cost (the measured
+    # recommendation, docs/dynamic_solver.md) — pass an explicit solver
+    # to pin one algorithm
+    solver = solver or AutoDynamicSolver()
 
     rects = AttnRectangles.from_ranges(
         [(int(s[0]), int(s[1])) for s in slices],
@@ -190,8 +233,8 @@ def build_qo_comm_plan(
         k_need.append(ks.merge())
         rank_slices.append(np.asarray(rows, dtype=np.int64).reshape(-1, 5))
 
-    send_q, recv_q = _ranges_to_send_map(q_need, shard, cp_size)
-    send_kv, recv_kv = _ranges_to_send_map(k_need, shard, cp_size)
+    send_q, recv_q = _ranges_to_send_map(q_need, shard, cp_size, unperm)
+    send_kv, recv_kv = _ranges_to_send_map(k_need, shard, cp_size, unperm)
     comm_q = GroupCollectiveMeta.build(send_q, [shard] * cp_size)
     comm_kv = GroupCollectiveMeta.build(send_kv, [shard] * cp_size)
 

@@ -18,36 +18,15 @@ from magiattention_tpu.meta import (
     rank_comm_rows,
 )
 
+from magiattention_tpu.testing.workloads import (
+    DYNSOLVER_WORKLOADS,
+    varlen_block_causal,
+)
+
 TOTAL = 16384
 
-
-def dense_causal():
-    return [(0, TOTAL, 0, TOTAL, 1)]
-
-
-def varlen_block_causal(n_docs=12):
-    rng = np.random.default_rng(7)
-    cuts = np.sort(rng.choice(np.arange(1, TOTAL), n_docs - 1, replace=False))
-    bounds = [0, *[int(c) for c in cuts], TOTAL]
-    return [(a, b, a, b, 1) for a, b in zip(bounds, bounds[1:])]
-
-
-def shared_question(n_answers=8):
-    q_len = TOTAL // 4
-    seg = (TOTAL - q_len) // n_answers
-    slices = [(0, q_len, 0, q_len, 1)]
-    for i in range(n_answers):
-        a = q_len + i * seg
-        b = q_len + (i + 1) * seg if i < n_answers - 1 else TOTAL
-        slices.append((a, b, 0, q_len, 0))
-        slices.append((a, b, a, b, 1))
-    return slices
-
-
 WORKLOADS = {
-    "dense_causal": dense_causal,
-    "varlen_block_causal": varlen_block_causal,
-    "shared_question": shared_question,
+    name: (lambda fn=fn: fn(TOTAL)) for name, fn in DYNSOLVER_WORKLOADS.items()
 }
 
 
@@ -84,10 +63,7 @@ def test_grid_beats_kd_on_varlen_step_cost(cp):
     documented 64k scale — at small totals the comm term dominates the
     model and the grid correctly collapses toward ncq placement."""
     total = 65536
-    rng = np.random.default_rng(7)
-    cuts = np.sort(rng.choice(np.arange(1, total), 11, replace=False))
-    bounds = [0, *[int(c) for c in cuts], total]
-    rects = _rects([(a, b, a, b, 1) for a, b in zip(bounds, bounds[1:])])
+    rects = _rects(varlen_block_causal(total))
     kd = DynamicAttnSolver().solve(rects, cp, total_seqlen=total)
     grid = GridLocalitySolver().solve(rects, cp, total_seqlen=total)
     c_kd = modeled_step_cost(kd, total, cp)
@@ -114,13 +90,13 @@ def test_auto_is_best_of_family(wname, cp):
 
 
 def test_ncq_zero_q_comm():
-    rects = _rects(shared_question())
+    rects = _rects(WORKLOADS["shared_question"]())
     sol = NCQDynamicSolver().solve(rects, 8, total_seqlen=TOTAL)
     assert all(q == 0 for q, _ in rank_comm_rows(sol, TOTAL, 8))
 
 
 def test_grid_deterministic():
-    rects = _rects(varlen_block_causal())
+    rects = _rects(varlen_block_causal(TOTAL))
     a = GridLocalitySolver(seed=3).solve(rects, 8, total_seqlen=TOTAL)
     b = GridLocalitySolver(seed=3).solve(rects, 8, total_seqlen=TOTAL)
     assert a.areas == b.areas

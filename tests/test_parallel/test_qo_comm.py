@@ -161,3 +161,59 @@ def test_qo_comm_sink(cp):
         lambda s: (ref_attn_from_ranges(q, k, v, qr, kr, ts, sink=s)[0] * do).sum()
     )(sink)
     assert_close(gs, gr, atol=1e-4, rtol=1e-4, msg="qo dsink")
+
+
+@pytest.mark.parametrize("solver_kind", ["kd", "grid", "auto"])
+@pytest.mark.parametrize("name,total,slices", CASES, ids=[c[0] for c in CASES])
+def test_qo_comm_composes_with_balanced_dispatch(name, total, slices, solver_kind):
+    """qo-comm over a MinHeap-dispatched (chunk-permuted) ownership: the
+    plane partition stays global, casts/reduces route over the permuted
+    layout (reference composes exactly this way, _make_attn_meta.py:40).
+    Forward AND q-gradient must match the oracle."""
+    from magiattention_tpu.common.enum import AttnMaskType
+    from magiattention_tpu.meta.dispatch_meta import (
+        make_dispatch_meta_from_qk_ranges,
+    )
+    from magiattention_tpu.common.ranges import AttnRanges
+    from magiattention_tpu.parallel.dispatch import dispatch, undispatch
+
+    cp, chunk, hq, d = 4, 32, 2, 64
+    mesh = _mesh(cp)
+    sl = np.asarray(slices, np.int64)
+    qr = [(int(s[0]), int(s[1])) for s in sl]
+    kr = [(int(s[2]), int(s[3])) for s in sl]
+    ts = [int(s[4]) for s in sl]
+    meta, _, _ = make_dispatch_meta_from_qk_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr),
+        [AttnMaskType(t) for t in ts], total, total, chunk, cp,
+    )
+    # the point of the test: ownership is genuinely permuted
+    assert meta.partitions != tuple(
+        tuple(range(r * len(meta.partitions[0]),
+                    (r + 1) * len(meta.partitions[0])))
+        for r in range(cp)
+    ) or name == "full", meta.partitions
+    plan = build_qo_comm_plan(
+        sl, total, cp, block_q=64, block_k=64,
+        solver=_solver_for(solver_kind), dispatch_meta=meta,
+    )
+    params = _params(d)
+    fn = make_qo_comm_attn_fn(plan, mesh, params)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, hq, d)), jnp.float32)
+    qd, kd, vd = (dispatch(x, meta) for x in (q, k, v))
+    out = undispatch(fn(qd, kd, vd)[0], meta)
+    ref = ref_attn_from_ranges(q, k, v, qr, kr, ts)[0]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+    g = jax.grad(lambda qd: (fn(qd, kd, vd)[0] ** 2).sum())(qd)
+    gref = jax.grad(
+        lambda q: (ref_attn_from_ranges(q, k, v, qr, kr, ts)[0] ** 2).sum()
+    )(q)
+    np.testing.assert_allclose(
+        np.asarray(undispatch(g, meta)), np.asarray(gref),
+        atol=2e-4, rtol=2e-4,
+    )
