@@ -20,3 +20,33 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--run-slow",
+        action="store_true",
+        default=False,
+        help="run slow-marked full-size scenarios (reference --skip-slow"
+        " inverted: the CPU-sim suite skips them by default)",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: full-size (10k-15k token) oracle scenarios"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest as _pytest
+
+    run_slow = os.environ.get("MAGI_RUN_SLOW", "").lower() in (
+        "1", "true", "yes",
+    )
+    if config.getoption("--run-slow") or run_slow:
+        return
+    skip = _pytest.mark.skip(reason="slow; use --run-slow or MAGI_RUN_SLOW=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

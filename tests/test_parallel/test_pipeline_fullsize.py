@@ -1,0 +1,205 @@
+"""Full-size named pipeline scenarios at the reference's token counts.
+
+Ports of reference ``tests/test_pipeline.py:403-857``: the same masks at
+the same sequence lengths (10k-15k executed, 144k plan-only), through the
+full dispatch -> dist-attn -> undispatch pipeline on the cp=8 CPU mesh,
+oracle-checked. The executed scenarios are ``slow``-marked (skipped by
+default; ``--run-slow`` / ``MAGI_RUN_SLOW=1`` runs them — the inversion
+of the reference's ``--skip-slow``) and use the jnp kernel backend
+(``MAGI_ATTENTION_KERNEL_BACKEND=jnp``): the plan/comm machinery at real
+scale is what these exercise — kernel numerics are covered everywhere
+else — and interpret-mode Pallas at 15k tokens on one CPU core is
+prohibitive.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from magiattention_tpu.common import AttnMaskType, AttnRanges
+from magiattention_tpu.meta import (
+    DispatchConfig,
+    make_dispatch_meta_from_qk_ranges,
+)
+from magiattention_tpu.parallel import (
+    build_dist_attn_plan,
+    dispatch,
+    make_attn_params,
+    make_dist_attn_fn,
+    undispatch,
+)
+from magiattention_tpu.testing import assert_close, ref_attn_from_ranges
+
+F = int(AttnMaskType.FULL)
+C = int(AttnMaskType.CAUSAL)
+I = int(AttnMaskType.INVCAUSAL)
+B = int(AttnMaskType.BICAUSAL)
+
+_BC15_BOUNDS = [0, 2048, 4096, 6144, 8192, 10240, 12288, 15360]
+
+# (name, total, q_ranges, k_ranges, types, chunk, uneven) — reference
+# tests/test_pipeline.py:403-857, same masks, same token counts
+SCENARIOS = [
+    (
+        "full_attn_14k",
+        14336,
+        [(0, 14336)], [(0, 14336)], [F], 512, False,
+    ),
+    (
+        "varlen_full_attn_12k",
+        12288,
+        [(i * 2048, (i + 1) * 2048) for i in range(6)],
+        [(i * 2048, (i + 1) * 2048) for i in range(6)],
+        [F] * 6, 512, False,
+    ),
+    (
+        "varlen_block_causal_15k",
+        15360,
+        list(zip(_BC15_BOUNDS, _BC15_BOUNDS[1:])),
+        [(0, 2048), (0, 4096), (0, 6144), (0, 8192),
+         (8192, 10240), (8192, 12288), (12288, 15360)],
+        [F] * 7, 512, False,
+    ),
+    (
+        "varlen_block_causal_12k_with_q_overlap",
+        12288,
+        [(0, 8192), (2048, 8192), (4096, 8192), (6144, 8192),
+         (8192, 12288), (10240, 12288)],
+        [(0, 2048), (2048, 4096), (4096, 6144), (6144, 8192),
+         (8192, 10240), (10240, 12288)],
+        [F] * 6, 512, False,
+    ),
+    (
+        "bi_causal_12k_with_q_overlap",
+        12288,
+        [(0, 2048), (2048, 4096), (4096, 6144), (6144, 8192),
+         (8192, 10240), (10240, 12288), (1000, 4000), (10000, 12000)],
+        [(0, 3072), (0, 4096), (0, 6144), (6144, 12288),
+         (8192, 12288), (9216, 12288), (8000, 12000), (0, 5000)],
+        [B] * 8, 512, False,
+    ),
+    (
+        "uneven_full_attn_10k",
+        10000,
+        [(0, 10000)], [(0, 10000)], [F], 512, True,
+    ),
+    (
+        "uneven_varlen_11k",
+        11021,
+        [(0, 2000), (2000, 4000), (4000, 6000), (6000, 8000),
+         (8000, 9500), (9500, 11021)],
+        [(0, 2000), (0, 4000), (0, 6000), (0, 8000),
+         (8000, 9500), (8000, 11021)],
+        [F, C, I, B, I, C], 1111, True,
+    ),
+]
+
+CP = 8
+
+
+def _plan_for(total, qr, kr, ts, chunk, uneven):
+    q_ranges = AttnRanges.from_ranges(qr)
+    k_ranges = AttnRanges.from_ranges(kr)
+    cfg = DispatchConfig(uneven_shard=uneven)
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        q_ranges, k_ranges, [AttnMaskType(t) for t in ts],
+        total, total, chunk_size=chunk, cp_size=CP, dispatch_config=cfg,
+    )
+    plan = build_dist_attn_plan(mq, bucket, block_q=128, block_k=128)
+    return mq, plan
+
+
+def _padded(total, chunk, uneven):
+    mult = chunk if uneven else chunk * CP
+    return -(-total // mult) * mult
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "name,total,qr,kr,ts,chunk,uneven",
+    SCENARIOS,
+    ids=[s[0] for s in SCENARIOS],
+)
+def test_fullsize_pipeline_fwd_bwd(
+    name, total, qr, kr, ts, chunk, uneven, monkeypatch
+):
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    padded = _padded(total, chunk, uneven)
+    mq, plan = _plan_for(padded, qr, kr, ts, chunk, uneven)
+    hq, hk, d = 2, 2, 64
+    params = make_attn_params(plan, d, out_dtype="float32")
+    mesh = Mesh(np.array(jax.devices()[:CP]), ("cp",))
+    attn_fn = make_dist_attn_fn(plan, mesh, params)
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((padded, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((padded, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((padded, hk, d)), jnp.float32)
+    do = jnp.asarray(rng.standard_normal((padded, hq, d)), jnp.float32)
+
+    def full_fwd(q, k, v):
+        out_d, lse_d = attn_fn(
+            dispatch(q, mq), dispatch(k, mq), dispatch(v, mq)
+        )
+        return undispatch(out_d, mq), undispatch(lse_d, mq)
+
+    out, lse = jax.jit(full_fwd)(q, k, v)
+    ref_out, ref_lse, _ = ref_attn_from_ranges(q, k, v, qr, kr, ts)
+    assert_close(out, ref_out, atol=3e-5, rtol=3e-5, msg=f"{name} out")
+    finite = ~np.isneginf(np.asarray(ref_lse))
+    assert_close(
+        np.asarray(lse)[finite], np.asarray(ref_lse)[finite],
+        atol=3e-5, rtol=3e-5, msg=f"{name} lse",
+    )
+
+    g = jax.jit(
+        jax.grad(
+            lambda q, k, v: (full_fwd(q, k, v)[0] * do).sum(),
+            argnums=(0, 1, 2),
+        )
+    )(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: (
+            ref_attn_from_ranges(q, k, v, qr, kr, ts)[0] * do
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, nm in zip(g, gr, ["dq", "dk", "dv"]):
+        assert_close(a, b, atol=2e-4, rtol=2e-4, msg=f"{name} {nm}")
+
+
+# 144k plan-only checks (reference PROFILE_ONLY cases): the plan must
+# build at the real scale with exact area accounting and finite comm
+# tables — host-side, fast, always on.
+_BC144_BOUNDS = [0, 20480, 40960, 61440, 81920, 102400, 122880, 147456]
+
+
+@pytest.mark.parametrize(
+    "name,qr,kr,ts",
+    [
+        (
+            "full_attn_144k",
+            [(0, 147456)], [(0, 147456)], [F],
+        ),
+        (
+            "varlen_block_causal_144k",
+            list(zip(_BC144_BOUNDS, _BC144_BOUNDS[1:])),
+            [(0, 20480), (0, 40960), (0, 61440), (0, 81920),
+             (81920, 102400), (81920, 122880), (122880, 147456)],
+            [F] * 7,
+        ),
+    ],
+    ids=["full_attn_144k", "varlen_block_causal_144k"],
+)
+def test_fullsize_144k_plan_only(name, qr, kr, ts):
+    total, chunk = 147456, 2048
+    mq, plan = _plan_for(total, qr, kr, ts, chunk, uneven=False)
+    # exact area accounting at scale (all slices are FULL rectangles)
+    expected = sum(
+        (b - a) * (d_ - c) for (a, b), (c, d_) in zip(qr, kr)
+    )
+    assert plan.total_area == expected
+    assert plan.shard_q_pad >= total // CP
+    assert len(plan.describe()) > 0, name

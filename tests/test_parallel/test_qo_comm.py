@@ -192,7 +192,7 @@ def test_qo_comm_composes_with_balanced_dispatch(name, total, slices, solver_kin
         tuple(range(r * len(meta.partitions[0]),
                     (r + 1) * len(meta.partitions[0])))
         for r in range(cp)
-    ) or name == "full", meta.partitions
+    ), meta.partitions
     plan = build_qo_comm_plan(
         sl, total, cp, block_q=64, block_k=64,
         solver=_solver_for(solver_kind), dispatch_meta=meta,
