@@ -1,0 +1,37 @@
+# Developer entry points (role of reference makefile:36-46).
+#
+# Everything runs on the 8-device virtual CPU mesh (tests/conftest.py
+# forces the platform); no TPU needed for any target here.
+
+PY ?= python
+
+.PHONY: install test test-fast test-slow lint typecheck bench-plan
+
+install:
+	$(PY) -m pip install -e . --no-build-isolation
+
+# fast subset: host-side planning/solver/common layers (seconds-minutes)
+test-fast:
+	$(PY) -m pytest tests/test_common tests/test_meta tests/test_api/test_window_masks.py -q
+
+test:
+	$(PY) -m pytest tests -q
+
+# full-size (10k-15k token) oracle scenarios, skipped by default
+test-slow:
+	$(PY) -m pytest tests -q --run-slow
+
+lint:
+	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
+		$(PY) -m ruff check magiattention_tpu tests exps examples; \
+	else \
+		echo "ruff not installed; syntax-checking via compileall"; \
+		$(PY) -m compileall -q magiattention_tpu tests exps examples bench.py __graft_entry__.py; \
+	fi
+
+typecheck:
+	$(PY) -m mypy
+
+# host-side planning latency sweep (no devices needed)
+bench-plan:
+	$(PY) exps/run_plan_bench.py
