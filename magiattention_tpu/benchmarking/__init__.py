@@ -4,8 +4,10 @@ from .bench import (
     Benchmark,
     BenchResult,
     Mark,
+    MemoryRecorder,
     do_bench,
     enable_compile_cache,
+    mesh_barrier,
     perf_grid,
     perf_report,
 )
@@ -14,8 +16,10 @@ __all__ = [
     "Benchmark",
     "BenchResult",
     "Mark",
+    "MemoryRecorder",
     "do_bench",
     "enable_compile_cache",
+    "mesh_barrier",
     "perf_grid",
     "perf_report",
 ]

@@ -10,6 +10,7 @@ in this repo's round-1 bring-up), plus jax device memory stats.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import statistics
 import time
 from typing import Any, Callable, Sequence
@@ -24,6 +25,115 @@ def _sync(result) -> None:
         _ = float(jnp.sum(leaves[0].ravel()[0]))
 
 
+def mesh_barrier(mesh) -> None:
+    """Rendezvous every device of a mesh and block the host on the result
+    (role of the reference's ``maybe_dist_sync``: cuda.synchronize +
+    dist.barrier before each sweep, bench.py:328). One psum over all mesh
+    axes forces every device to reach this point; the scalar readback
+    forces the host to wait — through remote tunnels block_until_ready
+    alone does not fully synchronize."""
+    fn, zero = _barrier_cache(mesh)
+    _ = float(fn(zero))
+
+
+@functools.lru_cache(maxsize=8)
+def _barrier_cache(mesh):
+    """Jitted barrier + placed scalar per mesh — a fresh closure each call
+    would retrace/compile every rep (expensive through a remote tunnel)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    names = tuple(mesh.axis_names)
+
+    def _b(x):
+        return jax.shard_map(
+            lambda v: jax.lax.psum(v, names),
+            mesh=mesh,
+            in_specs=P(),
+            out_specs=P(),
+            check_vma=False,
+        )(x)
+
+    zero = jax.device_put(jnp.zeros(()), NamedSharding(mesh, P()))
+    return jax.jit(_b), zero
+
+
+class MemoryRecorder:
+    """Sample device memory while a region runs (role of the reference's
+    NVML ``MemRecorder``, bench.py:45-77). A background thread polls
+    ``memory_stats()`` of the given devices at ``interval_s``; on exit
+    ``peak_bytes`` holds the max bytes_in_use seen per device (plus the
+    allocator's own lifetime peak where the backend reports one).
+
+    Backends without memory_stats (CPU) record nothing and stay usable —
+    ``peak_bytes`` is then an empty dict.
+
+    Usage::
+
+        with MemoryRecorder() as rec:
+            run_step()
+        print(rec.peak_bytes)     # {device: bytes}
+    """
+
+    def __init__(self, devices=None, interval_s: float = 0.01):
+        self.devices = list(devices) if devices else jax.local_devices()
+        self.interval_s = interval_s
+        self.peak_bytes: dict[Any, int] = {}
+        self.samples: list[dict[Any, int]] = []
+        self._stop = None
+        self._thread = None
+
+    def _poll_once(self) -> dict[Any, int]:
+        out = {}
+        for d in self.devices:
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if stats and "bytes_in_use" in stats:
+                out[d] = int(stats["bytes_in_use"])
+        return out
+
+    def __enter__(self):
+        import threading
+
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.is_set():
+                sample = self._poll_once()
+                if sample:
+                    self.samples.append(sample)
+                    for d, b in sample.items():
+                        if b > self.peak_bytes.get(d, 0):
+                            self.peak_bytes[d] = b
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def record(self) -> None:
+        """Take one sample now (for callers that poll at known-quiet
+        points instead of running the background thread)."""
+        sample = self._poll_once()
+        if sample:
+            self.samples.append(sample)
+            for d, b in sample.items():
+                if b > self.peak_bytes.get(d, 0):
+                    self.peak_bytes[d] = b
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self.record()  # one final sample at region end
+        # NOTE: peaks are POLLED values; an allocation spike shorter than
+        # interval_s between two ticks can be missed. The allocator's own
+        # peak_bytes_in_use is deliberately NOT folded in — it is a
+        # process-lifetime high-water mark that would contaminate this
+        # region with earlier history.
+        return False
+
+
 @dataclasses.dataclass(frozen=True)
 class BenchResult:
     mean_ms: float
@@ -31,7 +141,8 @@ class BenchResult:
     min_ms: float
     max_ms: float
     reps: int
-    peak_bytes: int | None = None
+    peak_bytes: int | None = None  # max over devices
+    peak_bytes_per_device: tuple[int, ...] = ()
 
     def tflops(self, flops: float) -> float:
         return flops / (self.median_ms * 1e-3) / 1e12
@@ -44,35 +155,44 @@ def do_bench(
     rep: int = 10,
     inner: int = 5,
     record_memory: bool = False,
+    mesh=None,
     **kwargs,
 ) -> BenchResult:
     """Time fn(*args) with warmup; each rep runs ``inner`` calls between
-    syncs so fixed sync latency amortizes (reference do_bench :79)."""
+    syncs so fixed sync latency amortizes (reference do_bench :79).
+
+    ``mesh``: rendezvous every device of the mesh before each timed rep
+    (:func:`mesh_barrier` — the reference's maybe_dist_sync role), so
+    multi-device sweeps never time one device's leftover queue.
+    ``record_memory``: samples memory BETWEEN reps (after each sync, via
+    :class:`MemoryRecorder.record` — no concurrent polling thread, so the
+    memory_stats RPCs never perturb the timed regions; use a standalone
+    MemoryRecorder context for continuous in-flight sampling)."""
     r = fn(*args, **kwargs)  # at least one call before timing (compile)
     for _ in range(max(warmup - 1, 0)):
         r = fn(*args, **kwargs)
     _sync(r)
+    rec = MemoryRecorder() if record_memory else None
     times = []
     for _ in range(rep):
+        if mesh is not None:
+            mesh_barrier(mesh)
         t0 = time.perf_counter()
         for _ in range(inner):
             r = fn(*args, **kwargs)
         _sync(r)
         times.append((time.perf_counter() - t0) / inner * 1e3)
-    peak = None
-    if record_memory:
-        try:
-            stats = jax.local_devices()[0].memory_stats()
-            peak = int(stats.get("peak_bytes_in_use", 0)) if stats else None
-        except Exception:
-            peak = None
+        if rec is not None:
+            rec.record()  # outside the timed window
+    peaks = tuple(sorted(rec.peak_bytes.values())) if rec else ()
     return BenchResult(
         mean_ms=statistics.fmean(times),
         median_ms=statistics.median(times),
         min_ms=min(times),
         max_ms=max(times),
         reps=rep,
-        peak_bytes=peak,
+        peak_bytes=max(peaks) if peaks else None,
+        peak_bytes_per_device=peaks,
     )
 
 

@@ -62,3 +62,46 @@ def test_perf_grid_dict_results():
 
     rows = bench_fn.run(print_data=False)
     assert rows == [{"n": 1, "x_ms": 1.5, "x_tflops": 2.0}]
+
+
+def test_mesh_barrier_and_synced_bench():
+    """mesh_barrier rendezvouses the 8-device mesh; do_bench(mesh=...)
+    still produces sane timings through the barrier."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from magiattention_tpu.benchmarking import do_bench, mesh_barrier
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("a", "b"))
+    mesh_barrier(mesh)  # must not deadlock or crash
+
+    sh = NamedSharding(mesh, P("a"))
+    x = jax.device_put(jnp.ones((16, 8)), sh)
+    f = jax.jit(lambda x: x * 2.0)
+    res = do_bench(f, x, warmup=1, rep=2, inner=2, mesh=mesh)
+    assert res.median_ms > 0
+    assert res.reps == 2
+
+
+def test_memory_recorder_graceful_on_cpu():
+    """CPU backend may not expose memory_stats; the recorder must stay
+    usable and report whatever the backend gives (possibly nothing)."""
+    import jax.numpy as jnp
+
+    from magiattention_tpu.benchmarking import MemoryRecorder, do_bench
+
+    with MemoryRecorder(interval_s=0.001) as rec:
+        _ = jnp.ones((256, 256)) @ jnp.ones((256, 256))
+    assert isinstance(rec.peak_bytes, dict)  # may be empty on CPU
+
+    res = do_bench(
+        lambda: jnp.ones((64, 64)) @ jnp.ones((64, 64)),
+        warmup=1, rep=2, inner=1, record_memory=True,
+    )
+    if res.peak_bytes is None:
+        assert res.peak_bytes_per_device == ()
+    else:
+        assert res.peak_bytes_per_device
+        assert res.peak_bytes == max(res.peak_bytes_per_device)
