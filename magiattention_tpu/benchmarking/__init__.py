@@ -7,6 +7,7 @@ from .bench import (
     MemoryRecorder,
     do_bench,
     enable_compile_cache,
+    image_grid,
     mesh_barrier,
     perf_grid,
     perf_report,
@@ -19,6 +20,7 @@ __all__ = [
     "MemoryRecorder",
     "do_bench",
     "enable_compile_cache",
+    "image_grid",
     "mesh_barrier",
     "perf_grid",
     "perf_report",

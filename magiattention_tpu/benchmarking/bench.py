@@ -61,8 +61,8 @@ class MemoryRecorder:
     """Sample device memory while a region runs (role of the reference's
     NVML ``MemRecorder``, bench.py:45-77). A background thread polls
     ``memory_stats()`` of the given devices at ``interval_s``; on exit
-    ``peak_bytes`` holds the max bytes_in_use seen per device (plus the
-    allocator's own lifetime peak where the backend reports one).
+    ``peak_bytes`` holds the max bytes_in_use seen per device within the
+    region (polled — see the note in ``__exit__``).
 
     Backends without memory_stats (CPU) record nothing and stay usable —
     ``peak_bytes`` is then an empty dict.
@@ -344,3 +344,54 @@ def enable_compile_cache(default_dir: str | None = None) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception as e:
         print(f"compilation cache unavailable: {e!r}", file=sys.stderr)
+
+
+def image_grid(
+    paths: Sequence[str],
+    out_path: str,
+    cols: int | None = None,
+) -> str | None:
+    """Tile saved benchmark plot PNGs into one grid image (role of
+    reference ``benchmarking/image_grid.py``: its make_grid collage of
+    sweep plots). ``cols=None`` picks the near-square factorization.
+    Returns ``out_path``, or None when matplotlib/PIL are unavailable or
+    no inputs exist (report tooling must never take a bench run down)."""
+    import math
+    import os
+
+    paths = [p for p in paths if os.path.exists(p)]
+    if not paths:
+        return None
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.image as mpimg
+        import matplotlib.pyplot as plt
+    except Exception:
+        return None
+    n = len(paths)
+    if cols is None:
+        cols = max(1, int(math.ceil(math.sqrt(n))))
+    nrows = -(-n // cols)
+    try:
+        fig, axes = plt.subplots(
+            nrows, cols, figsize=(5.5 * cols, 4.0 * nrows), squeeze=False
+        )
+        for i, ax in enumerate(axes.flat):
+            ax.axis("off")
+            if i < n:
+                ax.imshow(mpimg.imread(paths[i]))
+                ax.set_title(os.path.basename(paths[i]), fontsize=8)
+        fig.tight_layout()
+        fig.savefig(out_path, dpi=120)
+        plt.close(fig)
+    except Exception:
+        # truncated PNG, unwritable out_path, ... — report tooling must
+        # never take a bench run down
+        try:
+            plt.close("all")
+        except Exception:
+            pass
+        return None
+    return out_path

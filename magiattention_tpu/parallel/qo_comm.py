@@ -94,14 +94,37 @@ def _ranges_to_send_map(
     for d in range(cp):
         if need[d].is_empty():
             continue
+        if unperm is None:
+            # contiguous ownership: pure interval arithmetic, no
+            # row-id materialization or sort (1M-token plans care)
+            for s in range(cp):
+                own = AttnRanges.from_ranges([(s * shard, (s + 1) * shard)])
+                inter = need[d].find_overlap_ranges(own)
+                if inter.is_empty():
+                    continue
+                rows = np.concatenate(
+                    [
+                        np.arange(
+                            r.start - s * shard,
+                            r.end - s * shard,
+                            dtype=np.int64,
+                        )
+                        for r in inter
+                    ]
+                )
+                send_map[s][d] = rows
+                recv_segments[d].append((s, rows + s * shard))
+            continue
         ids = np.concatenate(
             [np.arange(r.start, r.end, dtype=np.int64) for r in need[d]]
         )
-        slots = ids if unperm is None else unperm[ids]
+        slots = unperm[ids]
         s_rank = slots // shard
         local = slots % shard
-        # canonical (src, global id) order shared by sender and receiver
-        order = np.lexsort((ids, s_rank))
+        # canonical (src, global id) order shared by sender and receiver:
+        # ids are ascending (merged ranges), so a stable sort by src rank
+        # keeps them ascending within each src group
+        order = np.argsort(s_rank, kind="stable")
         s_sorted = s_rank[order]
         for s in np.unique(s_sorted):
             m = s_sorted == s

@@ -105,3 +105,28 @@ def test_memory_recorder_graceful_on_cpu():
     else:
         assert res.peak_bytes_per_device
         assert res.peak_bytes == max(res.peak_bytes_per_device)
+
+
+def test_image_grid(tmp_path):
+    """Tile per-sweep plot PNGs into one report image; missing inputs are
+    skipped, empty input returns None."""
+    import pytest
+
+    matplotlib = pytest.importorskip("matplotlib")
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from magiattention_tpu.benchmarking import image_grid
+
+    paths = []
+    for i in range(3):
+        f, ax = plt.subplots(figsize=(2, 1.5))
+        ax.plot([0, 1], [i, 1])
+        p = str(tmp_path / f"plot{i}.png")
+        f.savefig(p)
+        plt.close(f)
+        paths.append(p)
+    out = image_grid(paths + [str(tmp_path / "missing.png")],
+                     str(tmp_path / "grid.png"))
+    assert out is not None and (tmp_path / "grid.png").exists()
+    assert image_grid([], str(tmp_path / "empty.png")) is None

@@ -35,3 +35,22 @@ def test_dense_1m_plan_under_bound():
     bound = float(os.environ.get("MAGI_PLAN_LATENCY_BOUND", "7.0"))
     if bound > 0:
         assert dt < bound, f"1M-token plan took {dt:.1f}s (bound {bound}s)"
+
+
+def test_qo_plan_1m_under_bound():
+    """qo-comm planning at MTP scale (1M tokens, cp=32): the dynamic
+    plane partition + send-map build must stay seconds-scale (contiguous
+    ownership uses interval arithmetic, no row materialization)."""
+    import numpy as np
+
+    from magiattention_tpu.parallel.qo_comm import build_qo_comm_plan
+
+    total, cp = 1 << 20, 32
+    sl = np.asarray([(0, total, 0, total, 1)], np.int64)
+    t0 = time.perf_counter()
+    plan = build_qo_comm_plan(sl, total, cp, block_q=512, block_k=2048)
+    dt = time.perf_counter() - t0
+    assert sum(plan.rank_areas) == total * (total + 1) // 2
+    bound = float(os.environ.get("MAGI_PLAN_LATENCY_BOUND", "7.0"))
+    if bound > 0:
+        assert dt < bound, f"1M-token qo plan took {dt:.1f}s (bound {bound}s)"
