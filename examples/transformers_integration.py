@@ -18,13 +18,20 @@ tensor — the ``get_most_recent_key`` convention of the reference
 (magi_attention_func.py:35: the key created most recently for the process
 group is fetched inside the attention call, so the model never sees it).
 
+The bridge is DIFFERENTIABLE: when any input requires grad, the forward
+runs under ``jax.vjp`` inside a ``torch.autograd.Function``, so HF
+training through this backend gets exact dq/dk/dv (parameter-gradient
+parity vs eager attention is tested); ``examples/hf_trainer.py`` builds
+a ``transformers.Trainer`` subclass on top.
+
 Scope note, stated honestly: HF's torch models execute on the torch
-device; each attention call crosses host<->device once in each direction.
-That is the right shape for parity demos and CPU validation (this file's
-``main()``), not for TPU production — there, use the jax-native model
-family (``magiattention_tpu/models``) or an HF Flax model. The reference
-has the same split: its transformers example is the integration story,
-Megatron the performance story (SURVEY.md §2.9 examples)."""
+device; each attention call crosses host<->device once in each direction
+(twice when training). That is the right shape for parity demos and CPU
+validation (this file's ``main()``), not for TPU production — there, use
+the jax-native model family (``magiattention_tpu/models``) or an HF Flax
+model. The reference has the same split: its transformers example is the
+integration story, Megatron the performance story (SURVEY.md §2.9
+examples)."""
 
 from __future__ import annotations
 
@@ -49,6 +56,7 @@ def magi_attention_forward(
     """HF attention-interface conformant forward (same contract as
     transformers.integrations.sdpa_attention.sdpa_attention_forward:
     returns (attn_output [b, s, hq, d], attn_weights=None))."""
+    import jax
     import jax.numpy as jnp
     import torch
 
@@ -74,17 +82,61 @@ def magi_attention_forward(
         f"attention got {s}: create the key for this sequence length first"
     )
 
-    def to_jax(t):  # [1, h, s, d] torch -> [s, h, d] jax
+    import numpy as np
+
+    def _pipeline(qj, kj, vj):
+        qd, kd, vd = dispatch(qj, k), dispatch(kj, k), dispatch(vj, k)
+        out_d, _ = calc_attn(qd, kd, vd, k)
+        return undispatch(out_d, k)  # [s, hq, d]
+
+    def to_jax(t):  # [1, h, s, d] torch -> [s, h, d] jax fp32
         return jnp.asarray(
-            t[0].permute(1, 0, 2).detach().cpu().numpy()
+            t[0].permute(1, 0, 2).detach().cpu().to(torch.float32).numpy()
         )
 
-    qj, kj, vj = to_jax(query), to_jax(key), to_jax(value)
-    qd, kd, vd = dispatch(qj, k), dispatch(kj, k), dispatch(vj, k)
-    out_d, _ = calc_attn(qd, kd, vd, k)
-    out = undispatch(out_d, k)  # [s, hq, d]
-    t = torch.from_numpy(__import__("numpy").asarray(out).copy())
-    return t.to(query.dtype).unsqueeze(0), None
+    def to_torch(a, like):
+        return (
+            torch.from_numpy(np.asarray(a).copy())
+            .to(like.dtype)
+            .to(like.device)
+        )
+
+    class _Bridge(torch.autograd.Function):
+        """torch<->jax autograd interop: forward runs the jax pipeline
+        under jax.vjp; backward feeds the torch cotangent through the
+        stored vjp — so HF training through this backend gets EXACT
+        dq/dk/dv (the reference's MagiAttention autograd role; without
+        this the bridge would silently train with detached attention)."""
+
+        @staticmethod
+        def forward(ctx, q_t, k_t, v_t):
+            out, vjp = jax.vjp(
+                _pipeline, to_jax(q_t), to_jax(k_t), to_jax(v_t)
+            )
+            ctx._vjp = vjp
+            return to_torch(out, q_t)  # [s, hq, d]
+
+        @staticmethod
+        def backward(ctx, dout):
+            # ctx._vjp stays on ctx (freed with the graph), so
+            # retain_graph=True / repeated backward keeps working
+            dq, dk, dv = ctx._vjp(
+                jnp.asarray(
+                    dout.detach().cpu().to(torch.float32).numpy()
+                )
+            )
+
+            def back(a, like):  # [s, h, d] jax -> [1, h, s, d] torch
+                return to_torch(a, like).permute(1, 0, 2).unsqueeze(0)
+
+            return back(dq, dout), back(dk, dout), back(dv, dout)
+
+    if query.requires_grad or key.requires_grad or value.requires_grad:
+        out = _Bridge.apply(query, key, value)
+    else:  # inference fast path: no vjp residuals kept
+        out = to_torch(_pipeline(to_jax(query), to_jax(key), to_jax(value)),
+                       query)
+    return out.unsqueeze(0), None
 
 
 def register() -> None:
@@ -108,21 +160,38 @@ def prepare(
     *,
     cu_seqlens=None,
     chunk_size: int | None = None,
+    causal: bool = True,
 ):
     """Create (and make most-recent) the runtime key the registered
     forward will fetch — causal over the full stream by default, or
-    per-document causal when ``cu_seqlens`` is given (the reference
-    example's per-step varlen key, examples/torch_native/main.py:242)."""
+    per-document when ``cu_seqlens`` is given (the reference example's
+    per-step varlen key, examples/torch_native/main.py:242)."""
     from magiattention_tpu.api import magi_attn_flex_key
 
     if cu_seqlens is not None:
         from magiattention_tpu.api import infer_attn_mask_from_cu_seqlens
 
-        qr, kr, ts = infer_attn_mask_from_cu_seqlens(cu_seqlens)
+        qr, kr, ts = infer_attn_mask_from_cu_seqlens(
+            cu_seqlens, causal=causal
+        )
         qr, kr = qr.to_naive_ranges(), kr.to_naive_ranges()
         ts = [int(t) for t in ts]
     else:
-        qr, kr, ts = [(0, total)], [(0, total)], [1]
+        qr, kr, ts = [(0, total)], [(0, total)], [1 if causal else 0]
+    return prepare_slices(
+        qr, kr, ts, total, mesh, num_heads, head_dim,
+        chunk_size=chunk_size,
+    )
+
+
+def prepare_slices(
+    qr, kr, ts, total, mesh, num_heads, head_dim, *, chunk_size=None
+):
+    """Slice-level prepare: key an arbitrary (q_range, k_range, type)
+    list (e.g. from the padded-attention-mask adapter,
+    infer_varlen_mask_from_padded_batch) for the registered backend."""
+    from magiattention_tpu.api import magi_attn_flex_key
+
     return magi_attn_flex_key(
         qr, kr, ts, total, total, mesh,
         num_heads=num_heads, head_dim=head_dim,

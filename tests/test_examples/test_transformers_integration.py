@@ -78,3 +78,143 @@ def test_backend_rejects_batched_input():
     with pytest.raises(AssertionError, match="squash"):
         with torch.no_grad():
             model(ids)
+
+
+def test_registered_backend_gradients_match_eager():
+    """The torch<->jax autograd bridge: parameter gradients of a full HF
+    model trained through the magi backend must match eager attention —
+    the proof the bridge does not silently detach attention."""
+    import jax
+    from jax.sharding import Mesh
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import examples.transformers_integration as mi
+
+    mi.register()
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=256,
+    )
+    total = 128
+    mesh = Mesh(np.array(jax.devices()[:2]), ("cp",))
+    mi.prepare(total, mesh, (2, 2), cfg.hidden_size // 2, chunk_size=16)
+    ids = torch.randint(0, cfg.vocab_size, (1, total),
+                        generator=torch.Generator().manual_seed(1))
+
+    def grads(impl):
+        torch.manual_seed(0)
+        model = LlamaForCausalLM(cfg)
+        model.set_attn_implementation(impl)
+        loss = model(ids, labels=ids).loss
+        loss.backward()
+        return float(loss), {
+            n: p.grad.clone() for n, p in model.named_parameters()
+            if p.grad is not None
+        }
+
+    l_ref, g_ref = grads("eager")
+    l_magi, g_magi = grads("magi_attention_tpu")
+    assert abs(l_ref - l_magi) < 1e-4, (l_ref, l_magi)
+    assert g_magi.keys() == g_ref.keys()
+    for n in g_ref:
+        diff = (g_magi[n] - g_ref[n]).abs().max().item()
+        scale = g_ref[n].abs().max().item()
+        assert diff <= 1e-4 + 1e-2 * scale, (n, diff, scale)
+    # the embedding gradient flows THROUGH attention (q/k/v projections)
+    # — nonzero proves the bridge backward is live
+    assert g_magi["model.embed_tokens.weight"].abs().max().item() > 0
+
+
+def test_magi_trainer_two_steps(tmp_path):
+    """MagiTrainer end to end: per-batch key creation + training through
+    the differentiable bridge (reference examples/transformers/
+    magi_trainer.py role)."""
+    import jax
+    from jax.sharding import Mesh
+    from transformers import LlamaConfig, LlamaForCausalLM, TrainingArguments
+
+    from examples.hf_trainer import MagiTrainer
+
+    total, vocab = 128, 128
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=total,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(cfg)
+
+    class Packed(torch.utils.data.Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            g = torch.Generator().manual_seed(i)
+            ids = torch.randint(0, vocab, (total,), generator=g)
+            return {"input_ids": ids, "labels": ids.clone()}
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("cp",))
+    trainer = MagiTrainer(
+        model=model,
+        args=TrainingArguments(
+            output_dir=str(tmp_path), max_steps=2,
+            per_device_train_batch_size=1, report_to=[], use_cpu=True,
+        ),
+        train_dataset=Packed(),
+        mesh=mesh, num_heads=(2, 2), head_dim=cfg.hidden_size // 2,
+        chunk_size=16,
+    )
+    out = trainer.train()
+    assert np.isfinite(out.training_loss)
+
+
+def test_magi_trainer_padded_batch_excludes_pads(tmp_path):
+    """A right-padded batch routes through the padded-mask adapter: the
+    key's q coverage stops at the valid length (pad rows attend nothing
+    instead of being treated as real tokens)."""
+    import jax
+    from jax.sharding import Mesh
+    from transformers import LlamaConfig, LlamaForCausalLM, TrainingArguments
+
+    from examples.hf_trainer import MagiTrainer
+    from magiattention_tpu.api import get_most_recent_key
+
+    total, valid, vocab = 128, 96, 128
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=total,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(cfg)
+
+    class Padded(torch.utils.data.Dataset):
+        def __len__(self):
+            return 2
+
+        def __getitem__(self, i):
+            ids = torch.randint(
+                0, vocab, (total,), generator=torch.Generator().manual_seed(i)
+            )
+            am = torch.zeros(total, dtype=torch.long)
+            am[:valid] = 1
+            labels = ids.clone()
+            labels[valid:] = -100
+            return {"input_ids": ids, "attention_mask": am, "labels": labels}
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("cp",))
+    trainer = MagiTrainer(
+        model=model,
+        args=TrainingArguments(
+            output_dir=str(tmp_path), max_steps=1,
+            per_device_train_batch_size=1, report_to=[], use_cpu=True,
+        ),
+        train_dataset=Padded(),
+        mesh=mesh, num_heads=(2, 2), head_dim=cfg.hidden_size // 2,
+        chunk_size=16,
+    )
+    out = trainer.train()
+    assert np.isfinite(out.training_loss)
+    key = get_most_recent_key()
+    assert max(e for _, e in key.q_ranges) == valid, key.q_ranges
