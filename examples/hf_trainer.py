@@ -60,22 +60,66 @@ def get_magi_trainer_cls():
             causal: bool = True,
             **kwargs,
         ):
-            assert mesh is not None and num_heads and head_dim, (
-                "MagiTrainer requires mesh=, num_heads=(hq, hkv), "
-                "head_dim= (the key parameters the model cannot provide)"
-            )
+            assert mesh is not None, "MagiTrainer requires mesh="
             mi.register()
             self._mesh = mesh
-            self._num_heads = tuple(num_heads)
-            self._head_dim = int(head_dim)
             self._chunk_size = chunk_size
             self._causal = causal
             super().__init__(*args, **kwargs)
+            # head geometry from the model config (overridable; a typo'd
+            # override would plan the key with wrong head counts, so
+            # cross-check when both are available)
+            cfg = getattr(self.model, "config", None)
+            cfg_heads = (
+                (
+                    int(cfg.num_attention_heads),
+                    int(
+                        getattr(
+                            cfg, "num_key_value_heads",
+                            cfg.num_attention_heads,
+                        )
+                    ),
+                )
+                if cfg is not None and hasattr(cfg, "num_attention_heads")
+                else None
+            )
+            cfg_head_dim = (
+                int(
+                    getattr(
+                        cfg, "head_dim",
+                        cfg.hidden_size // cfg.num_attention_heads,
+                    )
+                )
+                if cfg is not None and hasattr(cfg, "num_attention_heads")
+                else None
+            )
+            self._num_heads = tuple(num_heads) if num_heads else cfg_heads
+            self._head_dim = (
+                int(head_dim) if head_dim is not None else cfg_head_dim
+            )
+            assert self._num_heads and self._head_dim, (
+                "could not derive num_heads/head_dim from the model "
+                "config; pass num_heads=(hq, hkv), head_dim= explicitly"
+            )
+            if num_heads and cfg_heads and tuple(num_heads) != cfg_heads:
+                raise ValueError(
+                    f"num_heads={tuple(num_heads)} contradicts the model "
+                    f"config {cfg_heads}"
+                )
+            if (
+                head_dim is not None
+                and cfg_head_dim is not None
+                and int(head_dim) != cfg_head_dim
+            ):
+                raise ValueError(
+                    f"head_dim={head_dim} contradicts the model config "
+                    f"{cfg_head_dim}"
+                )
             self.model.set_attn_implementation("magi_attention_tpu")
 
         def _magi_prepare_key(self, inputs, total: int) -> None:
             cu = None
-            if "cu_seqlens" in inputs:
+            if inputs.get("cu_seqlens") is not None:
                 raw = inputs["cu_seqlens"]
                 raw = (
                     raw.reshape(-1).tolist()
@@ -115,15 +159,52 @@ def get_magi_trainer_cls():
         def _prepare_inputs(self, inputs):
             inputs = super()._prepare_inputs(inputs)
             ids = inputs.get("input_ids")
-            if ids is not None:
-                assert ids.shape[0] == 1, (
-                    "MagiTrainer feeds packed single-row batches "
-                    "([1, total]); pack samples instead of batching "
-                    "(reference magi_trainer squashes the batch dim the "
-                    "same way)"
-                )
+            if ids is None:
+                return inputs
+            if ids.shape[0] > 1:
+                inputs = self._squash_batch(inputs)
+            else:
                 self._magi_prepare_key(inputs, int(ids.shape[1]))
             return inputs
+
+        def _squash_batch(self, inputs):
+            """[b, s] -> [1, b*s] packed stream (reference magi_trainer's
+            squash_batch_dim role — e.g. the default eval batch of 8):
+            the key is built from the per-sample structure (padded-mask
+            adapter when pads exist, else uniform cu_seqlens) so
+            attention stays sample-local, and explicit position_ids
+            restart RoPE at every sample."""
+            from magiattention_tpu.api import (
+                infer_varlen_mask_from_padded_batch,
+            )
+
+            am2d = inputs.get("attention_mask")
+            b, s = inputs["input_ids"].shape
+            if am2d is not None and not bool(am2d.bool().all()):
+                qr, kr, ts = infer_varlen_mask_from_padded_batch(
+                    am2d.detach().cpu().numpy(), causal=self._causal
+                )
+                mi.prepare_slices(
+                    qr.to_naive_ranges(), kr.to_naive_ranges(),
+                    [int(t) for t in ts], b * s, self._mesh,
+                    self._num_heads, self._head_dim,
+                    chunk_size=self._chunk_size,
+                )
+            else:
+                mi.prepare(
+                    b * s, self._mesh, self._num_heads, self._head_dim,
+                    cu_seqlens=list(range(0, b * s + 1, s)),
+                    chunk_size=self._chunk_size, causal=self._causal,
+                )
+            out = dict(inputs)
+            for name in ("input_ids", "labels", "attention_mask"):
+                if out.get(name) is not None:
+                    out[name] = out[name].reshape(1, b * s)
+            out["position_ids"] = (
+                torch.arange(s).repeat(b).reshape(1, b * s)
+                .to(inputs["input_ids"].device)
+            )
+            return out
 
     return MagiTrainer
 

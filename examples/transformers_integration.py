@@ -41,6 +41,59 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _REGISTERED = False
+_BRIDGE_CLS = None
+
+
+def _bridge_cls():
+    """Module-level torch<->jax autograd Function (built once — a fresh
+    class per attention call would run per-layer-per-step on the hot
+    path). ``apply(q, k, v, pipeline, to_jax, to_torch)``: the non-tensor
+    helpers ride as constants (grad None)."""
+    global _BRIDGE_CLS
+    if _BRIDGE_CLS is not None:
+        return _BRIDGE_CLS
+    import jax
+    import jax.numpy as jnp
+    import torch
+
+    class _Bridge(torch.autograd.Function):
+        """Forward runs the jax pipeline under jax.vjp; backward feeds
+        the torch cotangent through the stored vjp — so HF training
+        through this backend gets EXACT dq/dk/dv (the reference's
+        MagiAttention autograd role; without this the bridge would
+        silently train with detached attention)."""
+
+        @staticmethod
+        def forward(ctx, q_t, k_t, v_t, pipeline, to_jax, to_torch):
+            out, vjp = jax.vjp(
+                pipeline, to_jax(q_t), to_jax(k_t), to_jax(v_t)
+            )
+            ctx._vjp = vjp
+            ctx._to_torch = to_torch
+            return to_torch(out, q_t)  # [s, hq, d]
+
+        @staticmethod
+        @torch.autograd.function.once_differentiable
+        def backward(ctx, dout):
+            # once_differentiable: the grads are numpy-built (no torch
+            # graph), so second-order autodiff through attention would be
+            # silently zero — fail loudly instead. ctx._vjp stays on ctx
+            # (freed with the graph), so retain_graph / repeated
+            # first-order backward keeps working.
+            dq, dk, dv = ctx._vjp(
+                jnp.asarray(
+                    dout.detach().cpu().to(torch.float32).numpy()
+                )
+            )
+            to_torch = ctx._to_torch
+
+            def back(a):  # [s, h, d] jax -> [1, h, s, d] torch
+                return to_torch(a, dout).permute(1, 0, 2).unsqueeze(0)
+
+            return back(dq), back(dk), back(dv), None, None, None
+
+    _BRIDGE_CLS = _Bridge
+    return _Bridge
 
 
 def magi_attention_forward(
@@ -56,7 +109,6 @@ def magi_attention_forward(
     """HF attention-interface conformant forward (same contract as
     transformers.integrations.sdpa_attention.sdpa_attention_forward:
     returns (attn_output [b, s, hq, d], attn_weights=None))."""
-    import jax
     import jax.numpy as jnp
     import torch
 
@@ -101,38 +153,10 @@ def magi_attention_forward(
             .to(like.device)
         )
 
-    class _Bridge(torch.autograd.Function):
-        """torch<->jax autograd interop: forward runs the jax pipeline
-        under jax.vjp; backward feeds the torch cotangent through the
-        stored vjp — so HF training through this backend gets EXACT
-        dq/dk/dv (the reference's MagiAttention autograd role; without
-        this the bridge would silently train with detached attention)."""
-
-        @staticmethod
-        def forward(ctx, q_t, k_t, v_t):
-            out, vjp = jax.vjp(
-                _pipeline, to_jax(q_t), to_jax(k_t), to_jax(v_t)
-            )
-            ctx._vjp = vjp
-            return to_torch(out, q_t)  # [s, hq, d]
-
-        @staticmethod
-        def backward(ctx, dout):
-            # ctx._vjp stays on ctx (freed with the graph), so
-            # retain_graph=True / repeated backward keeps working
-            dq, dk, dv = ctx._vjp(
-                jnp.asarray(
-                    dout.detach().cpu().to(torch.float32).numpy()
-                )
-            )
-
-            def back(a, like):  # [s, h, d] jax -> [1, h, s, d] torch
-                return to_torch(a, like).permute(1, 0, 2).unsqueeze(0)
-
-            return back(dq, dout), back(dk, dout), back(dv, dout)
-
     if query.requires_grad or key.requires_grad or value.requires_grad:
-        out = _Bridge.apply(query, key, value)
+        out = _bridge_cls().apply(
+            query, key, value, _pipeline, to_jax, to_torch
+        )
     else:  # inference fast path: no vjp residuals kept
         out = to_torch(_pipeline(to_jax(query), to_jax(key), to_jax(value)),
                        query)

@@ -218,3 +218,50 @@ def test_magi_trainer_padded_batch_excludes_pads(tmp_path):
     assert np.isfinite(out.training_loss)
     key = get_most_recent_key()
     assert max(e for _, e in key.q_ranges) == valid, key.q_ranges
+
+
+def test_magi_trainer_eval_batch_squashes(tmp_path):
+    """Mid-training evaluation with the default eval batch size (8 > 1)
+    squashes [b, s] -> [1, b*s] with per-sample key + RoPE restarts
+    instead of crashing (reference squash_batch_dim role)."""
+    import jax
+    from jax.sharding import Mesh
+    from transformers import LlamaConfig, LlamaForCausalLM, TrainingArguments
+
+    from examples.hf_trainer import MagiTrainer
+
+    total, vocab = 64, 64
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=total * 4,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(cfg)
+
+    class Packed(torch.utils.data.Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            g = torch.Generator().manual_seed(i)
+            ids = torch.randint(0, vocab, (total,), generator=g)
+            return {"input_ids": ids, "labels": ids.clone()}
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("cp",))
+    trainer = MagiTrainer(
+        model=model,
+        args=TrainingArguments(
+            output_dir=str(tmp_path), max_steps=1,
+            per_device_train_batch_size=1,
+            per_device_eval_batch_size=4,  # > 1: must squash, not crash
+            report_to=[], use_cpu=True,
+        ),
+        train_dataset=Packed(),
+        eval_dataset=Packed(),
+        mesh=mesh,  # num_heads/head_dim derived from the model config
+        chunk_size=16,
+    )
+    trainer.train()
+    metrics = trainer.evaluate()
+    assert np.isfinite(metrics["eval_loss"])

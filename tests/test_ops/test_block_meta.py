@@ -1,0 +1,24 @@
+"""Entry-table budget guards for the headline bench shapes."""
+
+
+def test_128k_causal_auto_config_fits_budget():
+    """The north-star 128k-causal bench row (BASELINE.md config 3): the
+    auto-selected fwd AND bwd entry tables must fit the SMEM
+    scalar-prefetch budget, so the on-chip sweep cannot fail on table
+    size when the chip window opens."""
+    from magiattention_tpu.ops.block_meta import build_block_meta
+    from magiattention_tpu.ops.flex_attn import (
+        _MAX_SMEM_ENTRIES,
+        auto_block_config,
+    )
+
+    total = 131072
+    qr, kr, ts = [(0, total)], [(0, total)], [1]
+    bq, bk, _hb = auto_block_config(qr, kr, 8, 8)
+    meta = build_block_meta(qr, kr, ts, total, total, block_q=bq, block_k=bk)
+    assert meta.num_fwd_entries <= _MAX_SMEM_ENTRIES, (
+        meta.num_fwd_entries, bq, bk,
+    )
+    assert meta.num_bwd_entries <= _MAX_SMEM_ENTRIES, (
+        meta.num_bwd_entries, bq, bk,
+    )
