@@ -44,6 +44,30 @@ from .functools import compute_pad_size, pad_at_dim
 
 logger = logging.getLogger("magiattention_tpu")
 
+# reference api/magi_attn_interface.py:157 — mask types may be given as
+# one scalar (broadcast to every slice) or a sequence of AttnMaskType
+# members / ints / case-insensitive names ("causal", "bi_causal", ...)
+GeneralAttnMaskType = str | AttnMaskType | Sequence[str | AttnMaskType]
+
+
+def _one_mask_type(t) -> int:
+    if isinstance(t, str):
+        name = t.strip().upper().replace("-", "_")
+        # reference spells INVCAUSAL/BICAUSAL with underscores
+        name = {"INV_CAUSAL": "INVCAUSAL", "BI_CAUSAL": "BICAUSAL"}.get(
+            name, name
+        )
+        return int(AttnMaskType[name])
+    return int(t)
+
+
+def _coerce_mask_types(attn_type_map, n_slices: int) -> tuple:
+    """Accept every GeneralAttnMaskType spelling; a scalar broadcasts to
+    all slices (reference wrap_to_list, magi_attn_interface.py:604)."""
+    if isinstance(attn_type_map, (str, int, AttnMaskType)):
+        return (int(_one_mask_type(attn_type_map)),) * n_slices
+    return tuple(_one_mask_type(t) for t in attn_type_map)
+
 
 def check_flag_comb(
     *,
@@ -252,18 +276,33 @@ class DistAttnRuntimeMgr:
 
 
 class DistAttnRuntimeDict:
-    """LRU key -> mgr cache (reference DistAttnRuntimeDict :410-449)."""
+    """LRU key -> mgr cache (reference DistAttnRuntimeDict :410-449 +
+    the manager interface of DistAttnRuntimeDictManager,
+    api/magi_attn_interface.py:64-134: get(key, default), item access,
+    keys; ``max_size_per_group`` accepted as the reference's constructor
+    spelling)."""
 
-    def __init__(self, maxsize: int):
+    def __init__(
+        self, maxsize: int | None = None, *, max_size_per_group: int | None = None
+    ):
+        if maxsize is None:
+            maxsize = (
+                max_size_per_group
+                if max_size_per_group is not None
+                else env.runtime_dict_size()
+            )
         self.maxsize = maxsize
         self._d: OrderedDict[DistAttnRuntimeKey, DistAttnRuntimeMgr] = (
             OrderedDict()
         )
 
-    def get(self, key: DistAttnRuntimeKey) -> Optional[DistAttnRuntimeMgr]:
+    def get(
+        self, key: DistAttnRuntimeKey, default=None
+    ) -> Optional[DistAttnRuntimeMgr]:
         mgr = self._d.get(key)
-        if mgr is not None:
-            self._d.move_to_end(key)
+        if mgr is None:
+            return default
+        self._d.move_to_end(key)
         return mgr
 
     def put(self, key: DistAttnRuntimeKey, mgr: DistAttnRuntimeMgr) -> None:
@@ -271,6 +310,18 @@ class DistAttnRuntimeDict:
         self._d.move_to_end(key)
         while len(self._d) > self.maxsize:
             self._d.popitem(last=False)
+
+    def __getitem__(self, key: DistAttnRuntimeKey) -> DistAttnRuntimeMgr:
+        mgr = self.get(key)
+        if mgr is None:
+            raise KeyError(key)
+        return mgr
+
+    def __setitem__(self, key, mgr) -> None:
+        self.put(key, mgr)
+
+    def keys(self):
+        return self._d.keys()
 
     def __contains__(self, key) -> bool:
         return key in self._d
@@ -288,6 +339,12 @@ class DistAttnRuntimeDict:
 
 
 _runtime_dict = DistAttnRuntimeDict(maxsize=env.runtime_dict_size())
+
+# reference api surface: the manager class + its live singleton
+# (api/magi_attn_interface.py:64 DistAttnRuntimeDictManager +
+# dist_attn_runtime_dict_mgr)
+DistAttnRuntimeDictManager = DistAttnRuntimeDict
+dist_attn_runtime_dict_mgr = _runtime_dict
 _most_recent_key: Optional[DistAttnRuntimeKey] = None
 
 
@@ -410,7 +467,7 @@ def magi_attn_flex_key(
         q_ranges = AttnRanges.from_ranges(q_ranges)
     if not isinstance(k_ranges, AttnRanges):
         k_ranges = AttnRanges.from_ranges(k_ranges)
-    types = tuple(int(t) for t in attn_type_map)
+    types = _coerce_mask_types(attn_type_map, len(q_ranges))
     if env.is_auto_range_merge_enable():
         # canonicalize the slice list before keying/planning (reference
         # AUTO_RANGE_MERGE path, flex_flash_attn.py:79-178)
@@ -671,7 +728,7 @@ def magi_attn_cross_key(
         q_ranges = AttnRanges.from_ranges(q_ranges)
     if not isinstance(k_ranges, AttnRanges):
         k_ranges = AttnRanges.from_ranges(k_ranges)
-    types = tuple(int(t) for t in attn_type_map)
+    types = _coerce_mask_types(attn_type_map, len(q_ranges))
     if env.is_auto_range_merge_enable():
         # canonicalize before keying, same as magi_attn_flex_key
         from ..ops.range_merge import merge_ranges
@@ -852,7 +909,7 @@ def make_flex_key_for_new_mask_after_dispatch(
         q_ranges = AttnRanges.from_ranges(q_ranges)
     if not isinstance(k_ranges, AttnRanges):
         k_ranges = AttnRanges.from_ranges(k_ranges)
-    types = tuple(int(t) for t in attn_type_map)
+    types = _coerce_mask_types(attn_type_map, len(q_ranges))
     if env.is_sanity_check_enabled():
         from ..common.sanity import check_slices_non_overlapping
 

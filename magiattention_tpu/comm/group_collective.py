@@ -256,3 +256,21 @@ def group_reduce_lse(
     out_new = out_remote + l_local[..., None] * out_acc.astype(jnp.float32)
     denom = jnp.where(l_tot > 0, l_tot, 1.0)[..., None]
     return (out_new / denom).astype(out_acc.dtype), lse_new
+
+
+@dataclasses.dataclass(frozen=True)
+class GrpCollConfig:
+    """API-parity shim of the reference's NVSHMEM group-collective tuning
+    config (comm/primitive/grpcoll/_config.py:44: SM counts and
+    NVLink/RDMA chunk+buffer sizing for its hand-written device kernels).
+    On TPU the group collectives are XLA ``all_to_all``s whose buffers
+    the compiler sizes and schedules, so every field is accepted for
+    drop-in imports and none has any effect."""
+
+    num_sms: int = 24
+    nvl_chunk_size: int = 8
+    nvl_buffer_size: int = 256
+    rdma_chunk_size: int = 16
+    rdma_buffer_size: int = 128
+    num_nvl_bytes: int = int(2e9)
+    num_rdma_bytes: int = 0

@@ -117,3 +117,23 @@ class OverlapSolver:
             stage_of_l[i] = s
             loads[s] += cost[i]
         return OverlapSolution(stage_of=tuple(stage_of_l), num_stages=degree)
+
+
+class UniformOverlapAlg:
+    """Reference-compat spelling (overlap_solver.py:41): calling it yields
+    the enum member our :class:`OverlapConfig` takes —
+    ``OverlapConfig(alg=UniformOverlapAlg())`` is drop-in. The reference
+    dataclass's fields (random_costs/random_seed etc.) are accepted and
+    ignored: its randomized cost probing has no role in the
+    deterministic timeline model here."""
+
+    def __new__(cls, *args, **kwargs):
+        return OverlapAlgType.UNIFORM
+
+
+class GreedyOverlapAlg:
+    """Reference-compat spelling (overlap_solver.py:58); see
+    :class:`UniformOverlapAlg`."""
+
+    def __new__(cls, *args, **kwargs):
+        return OverlapAlgType.GREEDY

@@ -449,3 +449,57 @@ def test_flex_key_source_flags():
             magi_attn_flex_key(
                 [(0, 256)], [(0, 256)], [1], 256, 256, mesh, **kw, **bad
             )
+
+
+def test_reference_api_surface_importable():
+    """Every name the reference exports from `magi_attention.api` is
+    importable from `magiattention_tpu.api` (drop-in import parity;
+    GrpCollConfig is an accepted-no-effect shim, documented as such)."""
+    from magiattention_tpu import api as ours
+
+    ref_all = [
+        "AttnForwardMeta", "AttnMaskType", "AttnOverlapMode", "AttnRanges",
+        "BSDispatchAlg", "DPDispatchAlg", "DispatchAlg", "DispatchConfig",
+        "DistAttnConfig", "DistAttnRuntimeDictManager", "DistAttnRuntimeKey",
+        "GeneralAttnMaskType", "GreedyOverlapAlg", "GrpCollConfig",
+        "LBDispatchAlg", "MinHeapDispatchAlg", "OverlapAlg", "OverlapConfig",
+        "SequentialDispatchAlg", "SortedSequentialSelectAlg",
+        "ToppHeapDispatchAlg", "UniformOverlapAlg", "calc_attn",
+        "clear_cache", "compute_pad_size", "dispatch",
+        "dist_attn_runtime_dict_mgr", "flex_flash_attn_func",
+        "get_most_recent_key", "get_position_ids",
+        "infer_attn_mask_from_cu_seqlens",
+        "infer_attn_mask_from_sliding_window", "infer_varlen_mask_from_batch",
+        "magi_attn_flex_dispatch", "magi_attn_flex_key",
+        "magi_attn_varlen_dispatch", "magi_attn_varlen_key",
+        "make_flex_key_for_new_mask_after_dispatch",
+        "make_varlen_key_for_new_mask_after_dispatch", "roll", "roll_simple",
+        "squash_batch_dim", "undispatch",
+    ]
+    missing = [n for n in ref_all if not hasattr(ours, n)]
+    assert not missing, missing
+    # reference-style OverlapConfig construction is drop-in
+    from magiattention_tpu.api import (
+        GreedyOverlapAlg,
+        OverlapConfig,
+        UniformOverlapAlg,
+    )
+
+    assert OverlapConfig(degree=2, alg=UniformOverlapAlg()).alg.name == "UNIFORM"
+    assert OverlapConfig(degree=2, alg=GreedyOverlapAlg()).alg.name == "GREEDY"
+
+
+def test_string_mask_types_accepted():
+    """Reference GeneralAttnMaskType spellings: strings (any case, with
+    or without underscores) plan identically to enum/int types."""
+    total, cp = 256, 2
+    mesh = _mesh(cp)
+    k1 = magi_attn_flex_key(
+        [(0, 128), (128, 256)], [(0, 128), (64, 256)], ["causal", "BI_CAUSAL"],
+        total, total, mesh, num_heads=(2, 2), head_dim=16, chunk_size=32,
+    )
+    k2 = magi_attn_flex_key(
+        [(0, 128), (128, 256)], [(0, 128), (64, 256)], [1, 3],
+        total, total, mesh, num_heads=(2, 2), head_dim=16, chunk_size=32,
+    )
+    assert k1 == k2  # same fingerprint -> same cached runtime
