@@ -16,7 +16,7 @@ Layering (mirrors reference SURVEY.md layer map, re-designed TPU-first):
 - ``testing/``  : reference oracles + precision harness
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 import logging as _logging
 import os as _os
@@ -43,4 +43,40 @@ if _level_name:
 from . import common  # noqa: F401,E402
 from .env import recommended_compiler_options  # noqa: F401,E402
 
-__all__ = ["common", "recommended_compiler_options", "__version__"]
+
+def __getattr__(name):
+    # lazy subpackage access (reference magi_attention/__init__.py exports
+    # its subpackages; loading ops/models eagerly would import jax at
+    # package-import time, which some host-only consumers avoid)
+    import importlib
+
+    if name in (
+        "api", "benchmarking", "comm", "config", "env", "meta", "models",
+        "ops", "parallel", "testing", "utils",
+    ):
+        return importlib.import_module(f".{name}", __name__)
+    if name in ("init_dist_attn_runtime_key", "init_dist_attn_runtime_mgr"):
+        from .api import interface
+
+        return getattr(interface, name)
+    raise AttributeError(name)
+
+
+__all__ = [
+    "api",
+    "benchmarking",
+    "comm",
+    "common",
+    "config",
+    "env",
+    "init_dist_attn_runtime_key",
+    "init_dist_attn_runtime_mgr",
+    "meta",
+    "models",
+    "ops",
+    "parallel",
+    "recommended_compiler_options",
+    "testing",
+    "utils",
+    "__version__",
+]

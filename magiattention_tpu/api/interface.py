@@ -401,7 +401,7 @@ def get_most_recent_key() -> DistAttnRuntimeKey:
 def magi_attn_flex_key(
     q_ranges: AttnRanges | Sequence[Sequence[int]],
     k_ranges: AttnRanges | Sequence[Sequence[int]],
-    attn_type_map: Sequence[AttnMaskType | int],
+    attn_type_map: GeneralAttnMaskType,
     total_seqlen_q: int,
     total_seqlen_k: int,
     mesh: jax.sharding.Mesh,
@@ -682,7 +682,7 @@ def magi_attn_varlen_key(
 def magi_attn_cross_key(
     q_ranges: AttnRanges | Sequence[Sequence[int]],
     k_ranges: AttnRanges | Sequence[Sequence[int]],
-    attn_type_map: Sequence[AttnMaskType | int],
+    attn_type_map: GeneralAttnMaskType,
     total_seqlen_q: int,
     total_seqlen_k: int,
     mesh: jax.sharding.Mesh,
@@ -878,7 +878,7 @@ def get_xattn_args(key: DistAttnRuntimeKey) -> XAttnArgs:
 def make_flex_key_for_new_mask_after_dispatch(
     q_ranges: AttnRanges | Sequence[Sequence[int]],
     k_ranges: AttnRanges | Sequence[Sequence[int]],
-    attn_type_map: Sequence[AttnMaskType | int],
+    attn_type_map: GeneralAttnMaskType,
     old_key: DistAttnRuntimeKey,
 ) -> DistAttnRuntimeKey:
     """Plan a NEW mask on the EXISTING dispatch of ``old_key``
@@ -1082,3 +1082,66 @@ def roll_simple(
     api/magi_attn_interface.py:1004 — its only difference is plain vs
     batched P2P issue order; here both ride the same P2P exchange)."""
     return roll(x, key, shift, axis=axis)
+
+
+def init_dist_attn_runtime_key(
+    q_ranges,
+    k_ranges,
+    attn_mask_type,
+    total_seqlen_q: int,
+    total_seqlen_k: int,
+    num_heads_q: int,
+    num_heads_kv: int,
+    head_dim: int,
+    chunk_size: int,
+    mesh: jax.sharding.Mesh,
+    *,
+    cp_axis="cp",
+    dist_attn_config=None,
+    **kwargs,
+) -> DistAttnRuntimeKey:
+    """Low-level key constructor (reference
+    dist_attn_runtime_mgr.py:484 ``init_dist_attn_runtime_key``): build
+    + plan a runtime key without the convenience-entry sugar. The
+    reference's ``cp_group``/``cp_mesh`` pair collapses to the jax mesh
+    (+ cp_axis); reference-only kwargs (``pad_size`` — padding is
+    auto-resolved here — and the torch-distributed handles) are accepted
+    and ignored."""
+    for ref_only in ("pad_size", "cp_group", "cp_mesh"):
+        kwargs.pop(ref_only, None)
+    return magi_attn_flex_key(
+        q_ranges, k_ranges, attn_mask_type,
+        total_seqlen_q, total_seqlen_k, mesh,
+        num_heads=(num_heads_q, num_heads_kv), head_dim=head_dim,
+        chunk_size=chunk_size, cp_axis=cp_axis,
+        dist_attn_config=dist_attn_config, **kwargs,
+    )
+
+
+def init_dist_attn_runtime_mgr(
+    q_ranges,
+    k_ranges,
+    attn_mask_type,
+    total_seqlen_q: int,
+    total_seqlen_k: int,
+    num_heads_q: int,
+    num_heads_kv: int,
+    head_dim: int,
+    chunk_size: int,
+    mesh: jax.sharding.Mesh,
+    *,
+    cp_axis="cp",
+    dist_attn_config=None,
+    **kwargs,
+) -> DistAttnRuntimeMgr:
+    """Low-level manager constructor (reference
+    dist_attn_runtime_mgr.py:545 ``init_dist_attn_runtime_mgr``):
+    the planned manager for the key, directly."""
+    return get_runtime_mgr(
+        init_dist_attn_runtime_key(
+            q_ranges, k_ranges, attn_mask_type,
+            total_seqlen_q, total_seqlen_k,
+            num_heads_q, num_heads_kv, head_dim, chunk_size, mesh,
+            cp_axis=cp_axis, dist_attn_config=dist_attn_config, **kwargs,
+        )
+    )

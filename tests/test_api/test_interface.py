@@ -503,3 +503,25 @@ def test_string_mask_types_accepted():
         total, total, mesh, num_heads=(2, 2), head_dim=16, chunk_size=32,
     )
     assert k1 == k2  # same fingerprint -> same cached runtime
+
+
+def test_toplevel_package_surface():
+    """Reference top-level exports (magi_attention/__init__.py __all__):
+    subpackages + the low-level runtime-init constructors resolve from
+    the package root; version matches the distribution."""
+    import magiattention_tpu as m
+
+    for name in ("api", "comm", "config", "env", "meta", "models", "ops",
+                 "parallel", "init_dist_attn_runtime_key",
+                 "init_dist_attn_runtime_mgr"):
+        assert getattr(m, name) is not None, name
+    mesh = _mesh(2)
+    mgr = m.init_dist_attn_runtime_mgr(
+        [(0, 256)], [(0, 256)], "causal", 256, 256, 2, 2, 16, 32, mesh,
+    )
+    assert mgr.plan.total_area == 256 * 257 // 2
+    key = m.init_dist_attn_runtime_key(
+        [(0, 256)], [(0, 256)], "causal", 256, 256, 2, 2, 16, 32, mesh,
+        pad_size=0,  # reference signature field, accepted & auto-resolved
+    )
+    assert mgr is m.api.get_runtime_mgr(key)
