@@ -40,6 +40,25 @@ def correct_attn_out_lse(
     rows covered by neither stay (0, -inf). fp32 internally.
     """
     lse = safe_lse_merge(lse1, lse2)
+    return correct_attn_out(out1, lse1, out2, lse2, lse), lse
+
+
+def correct_attn_lse(lse1: jax.Array, lse2: jax.Array) -> jax.Array:
+    """Merged lse of two partials (reference correct_attn_lse :286 —
+    the reference's explicit spelling of :func:`safe_lse_merge`)."""
+    return safe_lse_merge(lse1, lse2)
+
+
+def correct_attn_out(
+    out1: jax.Array,
+    lse1: jax.Array,
+    out2: jax.Array,
+    lse2: jax.Array,
+    lse: jax.Array,
+) -> jax.Array:
+    """Merge two partial outs given the already-merged ``lse``
+    (reference correct_attn_out :322): exp(lse_i - lse)-weighted sum,
+    fp32 internally; rows covered by neither stay 0."""
     lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
     w1 = jnp.where(jnp.isneginf(lse1), 0.0, jnp.exp(lse1 - lse_safe))
     w2 = jnp.where(jnp.isneginf(lse2), 0.0, jnp.exp(lse2 - lse_safe))
@@ -47,4 +66,54 @@ def correct_attn_out_lse(
         w1[..., None] * out1.astype(jnp.float32)
         + w2[..., None] * out2.astype(jnp.float32)
     )
-    return out.astype(out1.dtype), lse
+    return out.astype(out1.dtype)
+
+
+def _sink_lse(sink: jax.Array, sink_layout: str, tq: int) -> jax.Array:
+    """Per-(row, head) log-denominator contribution of the sink logits.
+
+    Layouts (reference functional/utils.py:561-677): ``sh`` =
+    [seqlen_sink, hq] shared by every q row; ``ssh`` = [tq, seqlen_sink,
+    hq] per-row sinks. ``shd`` (value-carrying sinks) has no TPU
+    implementation — sinks here contribute to the softmax denominator
+    only, which is the reference's attention-sink semantics for the
+    paths this framework ships."""
+    s = sink.astype(jnp.float32)
+    if sink_layout == "sh":
+        assert s.ndim == 2, f"sh sink must be [S, hq], got {s.shape}"
+        return jax.nn.logsumexp(s, axis=0)[None, :]  # [1, hq]
+    if sink_layout == "ssh":
+        assert s.ndim == 3 and s.shape[0] == tq, (
+            f"ssh sink must be [tq, S, hq], got {s.shape} (tq={tq})"
+        )
+        return jax.nn.logsumexp(s, axis=1)  # [tq, hq]
+    raise NotImplementedError(
+        f"sink_layout={sink_layout!r}: only 'sh' and 'ssh' exist here "
+        "('shd' value-carrying sinks are a reference-FA4 concept)"
+    )
+
+
+def correct_attn_lse_with_sink(
+    lse: jax.Array, sink: jax.Array, sink_layout: str = "sh"
+) -> jax.Array:
+    """lse' = logaddexp(lse, sink-lse) (reference :561)."""
+    return safe_lse_merge(lse, jnp.broadcast_to(
+        _sink_lse(sink, sink_layout, lse.shape[0]), lse.shape
+    ))
+
+
+def correct_attn_out_with_sink(
+    out: jax.Array, lse: jax.Array, sink: jax.Array, sink_layout: str = "sh"
+) -> jax.Array:
+    """out' = out * exp(lse - lse') (reference :593): the sink joins the
+    softmax denominator exactly once; uncovered rows (lse=-inf) stay 0."""
+    return correct_attn_out_lse_with_sink(out, lse, sink, sink_layout)[0]
+
+
+def correct_attn_out_lse_with_sink(
+    out: jax.Array, lse: jax.Array, sink: jax.Array, sink_layout: str = "sh"
+) -> tuple[jax.Array, jax.Array]:
+    """(out', lse') with the sink folded in once (reference :634)."""
+    lse_tot = correct_attn_lse_with_sink(lse, sink, sink_layout)
+    w = jnp.where(jnp.isneginf(lse), 0.0, jnp.exp(lse - lse_tot))
+    return (out.astype(jnp.float32) * w[..., None]).astype(out.dtype), lse_tot

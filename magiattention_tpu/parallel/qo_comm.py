@@ -41,6 +41,7 @@ from ..meta.solver.dynamic_attn_solver import (
     DynamicAttnSolver,
 )
 from ..ops.block_meta import Run, build_block_meta_general, runs_from_position_ids
+from ..ops.correction import correct_attn_out_lse_with_sink
 from ..ops.flex_attn import FlexAttnParams
 from .dist_attn import StageTables, _call_kernel, _headmajor_to_seq, _hm, _round_up
 
@@ -355,14 +356,11 @@ def qo_comm_attn_local(
         axis_name=axis_name,
     )
     if sink is not None:
-        s = sink.astype(jnp.float32)[None, :]  # [1, hq]
         # rows with lse=-inf (uncovered) end at lse'=sink, out stays 0 —
         # the Pallas epilogue's uncovered-row-with-sink behavior
-        lse_tot = jnp.logaddexp(lse, s)
-        out = out * jnp.where(
-            jnp.isneginf(lse), 0.0, jnp.exp(lse - lse_tot)
-        )[..., None]
-        lse = lse_tot
+        out, lse = correct_attn_out_lse_with_sink(
+            out, lse, sink.astype(jnp.float32)[None, :], "sh"
+        )
     return out.astype(params.out_jnp_dtype), lse
 
 

@@ -1,0 +1,100 @@
+"""Out/LSE correction family vs first-principles softmax (reference
+functional/utils.py correct_attn_* + the _with_sink variants)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.ops import (
+    correct_attn_lse,
+    correct_attn_lse_with_sink,
+    correct_attn_out,
+    correct_attn_out_lse,
+    correct_attn_out_lse_with_sink,
+    correct_attn_out_with_sink,
+)
+
+
+def _partials(tq=16, h=2, d=8, tk=24, split=10, seed=0):
+    """One attention computed whole and as two disjoint-KV partials."""
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((tq, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((tk, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((tk, h, d)), jnp.float32)
+
+    def attend(k_, v_):
+        s = jnp.einsum("qhd,khd->qhk", q, k_)  # scale-free: math identity
+        lse = jax.nn.logsumexp(s, axis=-1)
+        out = jnp.einsum("qhk,khd->qhd", jax.nn.softmax(s, axis=-1), v_)
+        return out, lse
+
+    full = attend(k, v)
+    p1 = attend(k[:split], v[:split])
+    p2 = attend(k[split:], v[split:])
+    return full, p1, p2
+
+
+def test_out_lse_merge_matches_whole():
+    (out_f, lse_f), (o1, l1), (o2, l2) = _partials()
+    out, lse = correct_attn_out_lse(o1, l1, o2, l2)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_f), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_f), rtol=1e-5,
+                               atol=1e-6)
+    # the split spellings agree with the fused one
+    lse2 = correct_attn_lse(l1, l2)
+    out2 = correct_attn_out(o1, l1, o2, l2, lse2)
+    np.testing.assert_allclose(np.asarray(lse2), np.asarray(lse), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out), rtol=1e-6)
+
+
+def test_uncovered_rows_stay_neutral():
+    (_, _), (o1, l1), _ = _partials()
+    neg = jnp.full_like(l1, -jnp.inf)
+    zero = jnp.zeros_like(o1)
+    out, lse = correct_attn_out_lse(o1, l1, zero, neg)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(l1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(o1), rtol=1e-6)
+    out0, lse0 = correct_attn_out_lse(zero, neg, zero, neg)
+    assert np.all(np.isneginf(np.asarray(lse0)))
+    np.testing.assert_array_equal(np.asarray(out0), np.asarray(zero))
+
+
+@pytest.mark.parametrize("layout,s_shape", [("sh", (3,)), ("ssh", (16, 3))])
+def test_sink_fold_matches_direct_softmax(layout, s_shape):
+    """Folding sink logits post-hoc == computing softmax with the sink
+    columns in the denominator from the start."""
+    (out_f, lse_f), _, _ = _partials()
+    h = lse_f.shape[1]
+    rng = np.random.default_rng(1)
+    sink = jnp.asarray(rng.standard_normal(s_shape + (h,)), jnp.float32)
+
+    out_s, lse_s = correct_attn_out_lse_with_sink(out_f, lse_f, sink, layout)
+    # direct: denominator gains sum(exp(sink)) per (row, head)
+    s_lse = (
+        jax.nn.logsumexp(sink, axis=0)[None, :]
+        if layout == "sh"
+        else jax.nn.logsumexp(sink, axis=1)
+    )
+    lse_direct = jnp.logaddexp(lse_f, jnp.broadcast_to(s_lse, lse_f.shape))
+    out_direct = out_f * jnp.exp(lse_f - lse_direct)[..., None]
+    np.testing.assert_allclose(np.asarray(lse_s), np.asarray(lse_direct),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_direct),
+                               rtol=1e-6)
+    # split spellings agree
+    np.testing.assert_allclose(
+        np.asarray(correct_attn_lse_with_sink(lse_f, sink, layout)),
+        np.asarray(lse_s), rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(correct_attn_out_with_sink(out_f, lse_f, sink, layout)),
+        np.asarray(out_s), rtol=1e-6,
+    )
+
+
+def test_shd_layout_rejected():
+    with pytest.raises(NotImplementedError, match="shd"):
+        correct_attn_lse_with_sink(
+            jnp.zeros((4, 2)), jnp.zeros((1, 2, 8)), "shd"
+        )
