@@ -4,7 +4,7 @@ The committed fuzz tests (tests/test_parallel/test_pipeline_fuzz.py) run
 a fast seed subset in CI; this harness runs the full campaigns against
 the oracle on the CPU-simulated mesh. Round 3 ran 224 cases across these
 axes and found one planner crash (now pinned as a regression test);
-round 4 added 300 more (seeds 300:350 x 6 axes, incl. the new
+round 4 added 450 more (seeds 300:375 x 6 axes, incl. the new
 dispatched-ownership qo mode and grid/auto solvers) — 0 failures.
 
     python exps/run_fuzz_campaign.py --axis main --seeds 100:160
