@@ -1,4 +1,17 @@
-"""Distributed execution runtime: CP attention plan + hot path + dispatch."""
+"""Distributed execution runtime: CP attention plan + hot path + dispatch.
+
+This package is the analogue of reference ``magi_attention/functional/``;
+its ``*_func`` export spellings are aliased below for porters
+(``dist_attn_func`` maps to the SPMD hot path ``dist_attn_local`` — the
+reference's autograd Function role is plain jax autodiff here, so there
+is no separate Function object; ``ffa_fa4_func`` has no analogue,
+Blackwell-only).
+
+Only the SPELLINGS are ported, not the call signatures: there is no
+torch process-group argument anywhere, and the meta comes before the
+shift in :func:`roll` (``roll(x, meta, shift)`` vs the reference's
+``roll(x, shift, ...)``) — check each docstring when porting a call
+site."""
 
 from .dispatch import dispatch, position_ids, roll, undispatch
 from .dist_attn import (
@@ -15,6 +28,13 @@ from .qo_comm import (
     qo_comm_attn_local,
 )
 
+# reference functional/__init__.py export spellings
+dispatch_func = dispatch
+undispatch_func = undispatch
+roll_func = roll
+roll_simple_func = roll
+dist_attn_func = dist_attn_local
+
 __all__ = [
     "DistAttnPlan",
     "QoCommPlan",
@@ -23,10 +43,15 @@ __all__ = [
     "qo_comm_attn_local",
     "build_dist_attn_plan",
     "dispatch",
+    "dispatch_func",
+    "dist_attn_func",
     "dist_attn_local",
     "make_attn_params",
     "make_dist_attn_fn",
     "position_ids",
     "roll",
+    "roll_func",
+    "roll_simple_func",
     "undispatch",
+    "undispatch_func",
 ]

@@ -525,3 +525,22 @@ def test_toplevel_package_surface():
         pad_size=0,  # reference signature field, accepted & auto-resolved
     )
     assert mgr is m.api.get_runtime_mgr(key)
+
+
+def test_functional_layer_alias_spellings():
+    """Reference functional/__init__.py export spellings resolve in the
+    parallel package (the functional-layer analogue); correction math
+    spellings resolve in ops (see ops/correction.py)."""
+    from magiattention_tpu import ops, parallel
+
+    assert parallel.dispatch_func is parallel.dispatch
+    assert parallel.undispatch_func is parallel.undispatch
+    assert parallel.roll_func is parallel.roll
+    assert parallel.roll_simple_func is parallel.roll
+    assert parallel.dist_attn_func is parallel.dist_attn_local
+    for name in (
+        "correct_attn_lse", "correct_attn_out", "correct_attn_out_lse",
+        "correct_attn_lse_with_sink", "correct_attn_out_with_sink",
+        "correct_attn_out_lse_with_sink", "flex_flash_attn_func",
+    ):
+        assert hasattr(ops, name), name
