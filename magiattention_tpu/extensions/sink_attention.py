@@ -2,17 +2,29 @@
 
 Role of reference ``extensions/magi_attn_extensions/fa{2,3,4}_interface_
 with_sink.py``: drop-in replacements for plain flash-attention calls that
-add a learned per-head sink logit to the softmax denominator (GPT-OSS /
-StreamingLLM-style), so frameworks can adopt sinks without touching their
-attention plumbing. The TPU analogue wraps this repo's flex kernel — sink
-is first-class in-kernel here, so the wrapper is a thin layout adapter
-rather than a rescale post-pass."""
+add learned attention sinks (GPT-OSS / StreamingLLM-style), so frameworks
+can adopt sinks without touching their attention plumbing.
+
+All three reference sink layouts are accepted (reference common/enum.py:24
+``AttnSinkLayout = Literal["sh", "shd", "ssh"]``):
+
+- ``sh``  — [seqlen_sink, hq] (or legacy [hq]) logits shared by all rows;
+- ``ssh`` — [b, sq, seqlen_sink, hq] per-row logits;
+- ``shd`` — [seqlen_sink, hq, d] zero-logit value-carrying sinks (this
+  framework's semantics; the reference declares the layout but leaves it
+  ``// TODO`` everywhere — see ops/correction.py:_sink_lse).
+
+The per-head scalar ``sh`` case rides the in-kernel sink fast path of the
+flex kernel; the general layouts run the kernel sink-free and fold the
+sink in with the (autodiff-transparent) correction post-pass — the same
+rescale-post-pass design the reference interfaces use.
+"""
 
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 
+from ..ops.correction import correct_attn_out_lse_with_sink
 from ..ops.flex_attn import flex_flash_attn_func
 
 
@@ -20,8 +32,9 @@ def flash_attention_with_sink(
     q: jax.Array,  # [batch, seqlen, hq, d] (flash-attention layout)
     k: jax.Array,  # [batch, seqlen, hk, d]
     v: jax.Array,
-    sink: jax.Array,  # [hq] learned sink logits
+    sink: jax.Array,
     *,
+    sink_layout: str = "sh",
     causal: bool = False,
     window: int | None = None,  # sliding-window size (causal SWA)
     softcap: float = 0.0,
@@ -29,16 +42,18 @@ def flash_attention_with_sink(
     return_lse: bool = False,
     interpret: bool | None = None,
 ):
-    """Batched standard attention with an attention sink.
+    """Batched standard attention with attention sinks.
 
     Matches the reference sink-interface contract: same signature shape as
-    a flash-attention call plus ``sink``; a zero-filled sink reproduces
-    plain attention exactly. ``window`` adds causal sliding-window masking
+    a flash-attention call plus ``sink``/``sink_layout``; a zero-filled
+    ``sh`` sink of one token reproduces plain attention up to the extra
+    denominator term, and an empty-value ``shd`` sink is exactly
+    softmax-off-by-one. ``window`` adds causal sliding-window masking
     (reference SWA benchmark config, cp_benchmark.md:21-29).
     """
     assert q.ndim == 4, f"expected [b, s, h, d], got {q.shape}"
     b, t, hq, d = q.shape
-    assert sink.shape == (hq,), f"sink must be [hq]={hq}, got {sink.shape}"
+    _check_sink_layout(sink, sink_layout, b, t, hq, d)
 
     if window is not None:
         from ..api.functools import infer_attn_mask_from_sliding_window
@@ -48,6 +63,14 @@ def flash_attention_with_sink(
         ts = [int(x) for x in ts]
     else:
         qr, kr, ts = [(0, t)], [(0, t)], [1 if causal else 0]
+
+    # Fast path: per-head scalar logits go through the kernel's native sink.
+    kernel_sink = None
+    if sink_layout == "sh":
+        if sink.ndim == 1:
+            kernel_sink = sink
+        elif sink.shape[0] == 1:
+            kernel_sink = sink[0]
 
     def one(qb, kb, vb):
         out, lse = flex_flash_attn_func(
@@ -59,12 +82,39 @@ def flash_attention_with_sink(
             ts,
             scale=scale,
             softcap=softcap,
-            sink=sink,
+            sink=kernel_sink,
             interpret=interpret,
         )[:2]
         return out, lse
 
     out, lse = jax.vmap(one)(q, k, v)
+
+    if kernel_sink is None:
+        sink_axis = 0 if (sink_layout == "ssh" and sink.ndim == 4) else None
+        out, lse = jax.vmap(
+            lambda o, l, s: correct_attn_out_lse_with_sink(o, l, s, sink_layout),
+            in_axes=(0, 0, sink_axis),
+        )(out, lse, sink)
+
     if return_lse:
         return out, lse
     return out
+
+
+def _check_sink_layout(
+    sink: jax.Array, sink_layout: str, b: int, t: int, hq: int, d: int
+) -> None:
+    """Shape validation mirroring reference _check_sink_layout
+    (fa3_interface_with_sink.py:407-419)."""
+    if sink_layout == "sh":
+        ok = sink.shape == (hq,) or (sink.ndim == 2 and sink.shape[1] == hq)
+    elif sink_layout == "ssh":
+        ok = (sink.ndim == 4 and sink.shape[0] == b and sink.shape[1] == t
+              and sink.shape[3] == hq) or (
+            sink.ndim == 3 and sink.shape[0] == t and sink.shape[2] == hq)
+    elif sink_layout == "shd":
+        ok = sink.ndim == 3 and sink.shape[1] == hq and sink.shape[2] == d
+    else:
+        raise ValueError(f"Invalid sink_layout {sink_layout!r}")
+    assert ok, f"{sink_layout!r} sink shape {sink.shape} invalid for " \
+               f"(b={b}, t={t}, hq={hq}, d={d})"

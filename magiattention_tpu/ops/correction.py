@@ -70,14 +70,20 @@ def correct_attn_out(
 
 
 def _sink_lse(sink: jax.Array, sink_layout: str, tq: int) -> jax.Array:
-    """Per-(row, head) log-denominator contribution of the sink logits.
+    """Per-(row, head) log-denominator contribution of the sink tokens.
 
     Layouts (reference functional/utils.py:561-677): ``sh`` =
-    [seqlen_sink, hq] shared by every q row; ``ssh`` = [tq, seqlen_sink,
-    hq] per-row sinks. ``shd`` (value-carrying sinks) has no TPU
-    implementation — sinks here contribute to the softmax denominator
-    only, which is the reference's attention-sink semantics for the
-    paths this framework ships."""
+    [seqlen_sink, hq] logits shared by every q row; ``ssh`` = [tq,
+    seqlen_sink, hq] per-row logits; ``shd`` = [seqlen_sink, hq,
+    head_dim] zero-logit *value-carrying* sinks.
+
+    ``shd`` semantics are this framework's own definition: the reference
+    declares the layout everywhere but implements it nowhere
+    (functional/utils.py:275 raises, csrc/flexible_flash_attention/
+    sink_layout.cuh:27 is ``// TODO: support SHD``, testing/ref_attn.py:472
+    raises). We define it as the softmax-off-by-one generalisation: each
+    sink token has attention logit 0 and a learned value vector, so its
+    log-denominator contribution is log(seqlen_sink), independent of q."""
     s = sink.astype(jnp.float32)
     if sink_layout == "sh":
         assert s.ndim == 2, f"sh sink must be [S, hq], got {s.shape}"
@@ -87,9 +93,11 @@ def _sink_lse(sink: jax.Array, sink_layout: str, tq: int) -> jax.Array:
             f"ssh sink must be [tq, S, hq], got {s.shape} (tq={tq})"
         )
         return jax.nn.logsumexp(s, axis=1)  # [tq, hq]
-    raise NotImplementedError(
-        f"sink_layout={sink_layout!r}: only 'sh' and 'ssh' exist here "
-        "('shd' value-carrying sinks are a reference-FA4 concept)"
+    if sink_layout == "shd":
+        assert s.ndim == 3, f"shd sink must be [S, hq, d], got {s.shape}"
+        return jnp.full((1, s.shape[1]), jnp.log(float(s.shape[0])))
+    raise ValueError(
+        f"sink_layout={sink_layout!r}: expected 'sh', 'ssh' or 'shd'"
     )
 
 
@@ -106,14 +114,29 @@ def correct_attn_out_with_sink(
     out: jax.Array, lse: jax.Array, sink: jax.Array, sink_layout: str = "sh"
 ) -> jax.Array:
     """out' = out * exp(lse - lse') (reference :593): the sink joins the
-    softmax denominator exactly once; uncovered rows (lse=-inf) stay 0."""
+    softmax denominator exactly once; uncovered rows (lse=-inf) stay 0.
+    For ``shd`` the sink values also join the numerator (see
+    :func:`_sink_lse` for the layout's semantics)."""
     return correct_attn_out_lse_with_sink(out, lse, sink, sink_layout)[0]
 
 
 def correct_attn_out_lse_with_sink(
     out: jax.Array, lse: jax.Array, sink: jax.Array, sink_layout: str = "sh"
 ) -> tuple[jax.Array, jax.Array]:
-    """(out', lse') with the sink folded in once (reference :634)."""
+    """(out', lse') with the sink folded in once (reference :634).
+
+    ``sh``/``ssh`` sinks are pure logits: they rescale ``out`` by
+    exp(lse - lse'). ``shd`` sinks carry values: each of the S sink
+    tokens attends with logit 0 and value sink[s, h, :], so
+    out' = exp(lse - lse') * out + exp(-lse') * sum_s sink[s]."""
     lse_tot = correct_attn_lse_with_sink(lse, sink, sink_layout)
     w = jnp.where(jnp.isneginf(lse), 0.0, jnp.exp(lse - lse_tot))
-    return (out.astype(jnp.float32) * w[..., None]).astype(out.dtype), lse_tot
+    out32 = out.astype(jnp.float32) * w[..., None]
+    if sink_layout == "shd":
+        # each sink token's softmax weight is exp(0 - lse'); its value
+        # contribution is that weight times sink[s, h, :], summed over s.
+        # lse' = -inf only when S = 0 AND the row is uncovered: keep 0.
+        w_sink = jnp.where(jnp.isneginf(lse_tot), 0.0, jnp.exp(-lse_tot))
+        sink_sum = sink.astype(jnp.float32).sum(axis=0)  # [hq, d]
+        out32 = out32 + w_sink[..., None] * sink_sum[None]
+    return out32.astype(out.dtype), lse_tot

@@ -68,6 +68,84 @@ def test_sink_wrapper_sliding_window():
     assert_close(out[0], ref, atol=3e-5, rtol=3e-5)
 
 
+def test_sink_wrapper_sh_multi_token():
+    """sh layout with S > 1 sink tokens rides the correction post-pass."""
+    b, t, hq, hk, d = 1, 128, 2, 2, 32
+    q, k, v = _qkv(b, t, hq, hk, d)
+    rng = np.random.default_rng(5)
+    sink = jnp.asarray(rng.standard_normal((3, hq)), jnp.float32)
+    out, lse = flash_attention_with_sink(
+        q, k, v, sink, sink_layout="sh", causal=True, return_lse=True
+    )
+    ref, ref_lse, _ = ref_attn_from_ranges(q[0], k[0], v[0],
+                                           [(0, t)], [(0, t)], [1])
+    s_lse = jax.nn.logsumexp(sink, axis=0)[None, :]
+    lse_exp = jnp.logaddexp(ref_lse, jnp.broadcast_to(s_lse, ref_lse.shape))
+    assert_close(lse[0], lse_exp, atol=3e-5, rtol=3e-5)
+    assert_close(out[0], ref * jnp.exp(ref_lse - lse_exp)[..., None],
+                 atol=3e-5, rtol=3e-5)
+
+
+def test_sink_wrapper_ssh_per_row():
+    """ssh layout: per-row sink logits, batched [b, sq, S, hq]."""
+    b, t, hq, hk, d = 2, 128, 2, 2, 32
+    q, k, v = _qkv(b, t, hq, hk, d)
+    rng = np.random.default_rng(6)
+    sink = jnp.asarray(rng.standard_normal((b, t, 2, hq)), jnp.float32)
+    out, lse = flash_attention_with_sink(
+        q, k, v, sink, sink_layout="ssh", causal=True, return_lse=True
+    )
+    for i in range(b):
+        ref, ref_lse, _ = ref_attn_from_ranges(q[i], k[i], v[i],
+                                               [(0, t)], [(0, t)], [1])
+        s_lse = jax.nn.logsumexp(sink[i], axis=1)  # [t, hq]
+        lse_exp = jnp.logaddexp(ref_lse, s_lse)
+        assert_close(lse[i], lse_exp, atol=3e-5, rtol=3e-5)
+        assert_close(out[i], ref * jnp.exp(ref_lse - lse_exp)[..., None],
+                     atol=3e-5, rtol=3e-5, msg=f"batch {i}")
+
+
+def test_sink_wrapper_shd_appended_token_oracle():
+    """shd (value-carrying) == dense attention over KV extended with S
+    zero-key tokens carrying the sink values, with the mask letting every
+    row see them.  Zero keys give logit q.0*scale = 0 — exactly the
+    zero-logit semantics of ops/correction.py:_sink_lse."""
+    b, t, hq, hk, d = 1, 128, 2, 2, 32
+    q, k, v = _qkv(b, t, hq, hk, d)
+    S = 2
+    rng = np.random.default_rng(7)
+    sink = jnp.asarray(rng.standard_normal((S, hq, d)), jnp.float32)
+
+    out, lse = flash_attention_with_sink(
+        q, k, v, sink, sink_layout="shd", causal=True, return_lse=True
+    )
+
+    # oracle: hq == hk here, so sink values can ride the KV head axis
+    k_ext = jnp.concatenate([k[0], jnp.zeros((S, hk, d), jnp.float32)])
+    v_ext = jnp.concatenate([v[0], sink], axis=0)
+    mask = np.zeros((t, t + S), dtype=bool)
+    mask[:, :t] = np.tril(np.ones((t, t), dtype=bool))
+    mask[:, t:] = True
+    from magiattention_tpu.testing import ref_attn
+
+    ref, ref_lse, _ = ref_attn(q[0], k_ext, v_ext, mask)
+    assert_close(lse[0], ref_lse, atol=3e-5, rtol=3e-5)
+    assert_close(out[0], ref, atol=3e-5, rtol=3e-5)
+
+
+def test_sink_wrapper_bad_layout_shape_rejected():
+    b, t, hq, hk, d = 1, 64, 2, 2, 32
+    q, k, v = _qkv(b, t, hq, hk, d)
+    with pytest.raises(AssertionError):
+        flash_attention_with_sink(
+            q, k, v, jnp.zeros((3, hq + 1)), sink_layout="sh"
+        )
+    with pytest.raises(ValueError, match="sink_layout"):
+        flash_attention_with_sink(
+            q, k, v, jnp.zeros((hq,)), sink_layout="hsd"
+        )
+
+
 def test_dsa_full_topk_equals_dense():
     t, hq, hk, d = 256, 2, 2, 32
     rng = np.random.default_rng(1)

@@ -93,8 +93,103 @@ def test_sink_fold_matches_direct_softmax(layout, s_shape):
     )
 
 
-def test_shd_layout_rejected():
-    with pytest.raises(NotImplementedError, match="shd"):
+def test_shd_fold_matches_appended_token_softmax():
+    """shd (zero-logit value-carrying sinks, ops/correction.py:_sink_lse)
+    == dense attention with S extra KV tokens whose logits are 0 and
+    whose values are sink[s, h, :]."""
+    (out_f, lse_f), _, _ = _partials()
+    tq, h = lse_f.shape
+    d = out_f.shape[-1]
+    S = 3
+    rng = np.random.default_rng(2)
+    sink = jnp.asarray(rng.standard_normal((S, h, d)), jnp.float32)
+
+    out_s, lse_s = correct_attn_out_lse_with_sink(out_f, lse_f, sink, "shd")
+
+    # oracle: probs = softmax([scores, 0 x S]); out = p_kv @ V + p_sink @ sink
+    lse_direct = jnp.logaddexp(lse_f, jnp.log(float(S)))
+    w_kv = jnp.exp(lse_f - lse_direct)  # total prob mass on real KV
+    p_one_sink = jnp.exp(-lse_direct)  # each sink token's prob
+    out_direct = (
+        out_f * w_kv[..., None]
+        + p_one_sink[..., None] * sink.sum(axis=0)[None]
+    )
+    np.testing.assert_allclose(np.asarray(lse_s), np.asarray(lse_direct),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_direct),
+                               rtol=1e-6, atol=1e-7)
+    # split spellings agree
+    np.testing.assert_allclose(
+        np.asarray(correct_attn_lse_with_sink(lse_f, sink, "shd")),
+        np.asarray(lse_s), rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(correct_attn_out_with_sink(out_f, lse_f, sink, "shd")),
+        np.asarray(out_s), rtol=1e-6,
+    )
+
+
+def test_shd_zero_values_is_softmax_off_by_S():
+    """All-zero shd values only enlarge the denominator (softmax1-style)."""
+    (out_f, lse_f), _, _ = _partials()
+    h, d = lse_f.shape[1], out_f.shape[-1]
+    sink = jnp.zeros((1, h, d), jnp.float32)
+    out_s, lse_s = correct_attn_out_lse_with_sink(out_f, lse_f, sink, "shd")
+    np.testing.assert_allclose(
+        np.asarray(lse_s), np.asarray(jnp.logaddexp(lse_f, 0.0)), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_s),
+        np.asarray(out_f * jnp.exp(lse_f - lse_s)[..., None]),
+        rtol=1e-6,
+    )
+
+
+def test_shd_uncovered_row_averages_sinks():
+    """A row with lse=-inf attends only to the sinks -> mean sink value."""
+    h, d, S = 2, 8, 4
+    rng = np.random.default_rng(3)
+    sink = jnp.asarray(rng.standard_normal((S, h, d)), jnp.float32)
+    out = jnp.zeros((5, h, d), jnp.float32)
+    lse = jnp.full((5, h), -jnp.inf, jnp.float32)
+    out_s, lse_s = correct_attn_out_lse_with_sink(out, lse, sink, "shd")
+    np.testing.assert_allclose(
+        np.asarray(lse_s), np.full((5, h), np.log(S), np.float32), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_s),
+        np.broadcast_to(np.asarray(sink.mean(axis=0)), (5, h, d)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_shd_grads_flow_to_sink_values():
+    """The correction post-pass is autodiff-transparent: d(loss)/d(sink)
+    matches the appended-token oracle's gradient."""
+    (out_f, lse_f), _, _ = _partials(tq=8, h=2, d=4)
+    S, h, d = 2, 2, 4
+    rng = np.random.default_rng(4)
+    sink0 = jnp.asarray(rng.standard_normal((S, h, d)), jnp.float32)
+
+    def loss_impl(s):
+        return correct_attn_out_lse_with_sink(out_f, lse_f, s, "shd")[0].sum()
+
+    def loss_oracle(s):
+        lse_tot = jnp.logaddexp(lse_f, jnp.log(float(S)))
+        o = out_f * jnp.exp(lse_f - lse_tot)[..., None] + jnp.exp(-lse_tot)[
+            ..., None
+        ] * s.sum(axis=0)[None]
+        return o.sum()
+
+    g_impl = jax.grad(loss_impl)(sink0)
+    g_oracle = jax.grad(loss_oracle)(sink0)
+    np.testing.assert_allclose(np.asarray(g_impl), np.asarray(g_oracle),
+                               rtol=1e-5, atol=1e-6)
+    assert float(jnp.abs(g_impl).sum()) > 0
+
+
+def test_unknown_layout_rejected():
+    with pytest.raises(ValueError, match="sink_layout"):
         correct_attn_lse_with_sink(
-            jnp.zeros((4, 2)), jnp.zeros((1, 2, 8)), "shd"
+            jnp.zeros((4, 2)), jnp.zeros((1, 2, 8)), "hsd"
         )
