@@ -47,8 +47,8 @@ def flash_attention_with_sink(
     Matches the reference sink-interface contract: same signature shape as
     a flash-attention call plus ``sink``/``sink_layout``; a zero-filled
     ``sh`` sink of one token reproduces plain attention up to the extra
-    denominator term, and an empty-value ``shd`` sink is exactly
-    softmax-off-by-one. ``window`` adds causal sliding-window masking
+    denominator term, and a zero-valued single-token ``shd`` sink is
+    exactly softmax-off-by-one. ``window`` adds causal sliding-window masking
     (reference SWA benchmark config, cp_benchmark.md:21-29).
     """
     assert q.ndim == 4, f"expected [b, s, h, d], got {q.shape}"
