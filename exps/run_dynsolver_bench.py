@@ -1,11 +1,11 @@
 """Dynamic-solver quality harness: KD vs NCQ vs LocalityGreedy vs
-GridLocality (GRG-grade) vs AutoDynamicSolver.
+GridLocality (GRG-grade) vs SNF (flow-based) vs AutoDynamicSolver.
 
 The reference backs its dynamic mode with a 3.7k-LoC algorithm family
 (snf.py 717 / fast_snf.py 1052 / grg.py 580 / ncq.py + the
-BinaryGreedyParallel default). This repo covers those roles with four
-solvers plus an auto-selector (meta/solver/dynamic_attn_solver.py); this
-harness is the quality evidence behind that replacement — per
+BinaryGreedyParallel default). This repo covers those roles with five
+solvers plus an auto-selector (meta/solver/{dynamic_attn,snf}_solver.py);
+this harness is the quality evidence behind that replacement — per
 (workload, cp, solver):
 
 - balance ratio: max rank area / mean rank area (1.0 = perfect)
@@ -44,7 +44,9 @@ from magiattention_tpu.meta.solver.dynamic_attn_solver import (  # noqa: E402
     modeled_step_cost,
     rank_comm_rows,
 )
-
+from magiattention_tpu.meta.solver.snf_solver import (  # noqa: E402
+    SNFDynamicSolver,
+)
 
 from magiattention_tpu.testing.workloads import (  # noqa: E402
     DYNSOLVER_WORKLOADS as WORKLOADS,
@@ -55,6 +57,7 @@ SOLVERS = {
     "ncq": NCQDynamicSolver,
     "locality_greedy": LocalityGreedySolver,
     "grid": GridLocalitySolver,
+    "snf": SNFDynamicSolver,
     "auto": AutoDynamicSolver,
 }
 

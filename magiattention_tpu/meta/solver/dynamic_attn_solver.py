@@ -21,13 +21,16 @@ reference family's *roles*, not its implementations):
   rank minimizing load + penalty x non-local Q/KV rows. Superseded by
   GridLocalitySolver (kept for comparison; its per-unit extent counting
   over-counts KV rows that merged casts dedup).
-- :class:`GridLocalitySolver` — GRG-grade (role of reference
-  grg.py/snf.py/fast_snf.py): cut at host q AND k boundaries into grid
-  cells, then dedup-aware greedy with random restarts — comm cost is
-  computed on the MERGED per-rank row sets (what group-cast actually
-  sends), so overlapping cell extents on one rank are counted once.
-  Quality evidence vs KD/NCQ: exps/run_dynsolver_bench.py +
-  docs/dynamic_solver.md.
+- :class:`GridLocalitySolver` — GRG-grade (role of reference grg.py):
+  cut at host q AND k boundaries into grid cells, then dedup-aware
+  greedy with random restarts — comm cost is computed on the MERGED
+  per-rank row sets (what group-cast actually sends), so overlapping
+  cell extents on one rank are counted once. Quality evidence vs
+  KD/NCQ: exps/run_dynsolver_bench.py + docs/dynamic_solver.md.
+
+The flow-based SNF solver (role of reference snf.py/fast_snf.py) lives
+in :mod:`.snf_solver`; :func:`dynamic_solver_for` maps every
+``DynamicAttnAlgType`` member to its implementation.
 """
 
 from __future__ import annotations
@@ -131,6 +134,42 @@ def _infer_total(rects: AttnRectangles, total_seqlen: int | None) -> int:
     if total_seqlen is not None:
         return total_seqlen
     return max((r.q_range.end for r in rects), default=0)
+
+
+def grid_cells(
+    rects: AttnRectangles, cp_size: int, shard: int, total: int
+) -> list[tuple[int, int, int, AttnRectangles, AttnRanges, AttnRanges]]:
+    """Cut the plane at every host q- AND k-shard boundary.
+
+    Returns ``(area, q_home, k_home, cell, q_extent, k_extent)`` per
+    non-empty cell, extents merged. Shared by the grid-greedy and SNF
+    solvers. Raises if the mask extends past ``total`` on either axis
+    (a solution's areas must sum exactly to the input area)."""
+    cells: list[tuple[int, int, int, AttnRectangles, AttnRanges, AttnRanges]] = []
+    rest = rects
+    for i in range(cp_size):
+        band, rest = rest.cut_q(min((i + 1) * shard, total))
+        for j in range(cp_size):
+            cell, band = band.cut_k(min((j + 1) * shard, total))
+            if cell.area > 0:
+                q_ext, k_ext = AttnRanges(), AttnRanges()
+                for r in cell:
+                    q_ext.append(r.q_range.clone())
+                    k_ext.append(r.k_range.clone())
+                cells.append(
+                    (cell.area, i, j, cell, q_ext.merge(), k_ext.merge())
+                )
+        if band.area > 0:
+            raise ValueError(
+                f"mask extends past total_seqlen={total} on k "
+                f"(leftover area {band.area})"
+            )
+    if rest.area > 0:
+        raise ValueError(
+            f"mask extends past total_seqlen={total} on q "
+            f"(leftover area {rest.area})"
+        )
+    return cells
 
 
 class NCQDynamicSolver:
@@ -288,36 +327,7 @@ class GridLocalitySolver:
         # movement and collapse to NCQ)
         c2a = self.c2a if self.c2a is not None else 1024.0
 
-        # grid cells: cut at host boundaries on both axes; anything beyond
-        # total_seqlen has no owning shard — fail fast rather than drop it
-        # (the solution's areas must sum exactly to the input area)
-        cells: list[tuple[int, int, AttnRectangles]] = []
-        rest = rects
-        for i in range(cp_size):
-            band, rest = rest.cut_q(min((i + 1) * shard, total))
-            for j in range(cp_size):
-                cell, band = band.cut_k(min((j + 1) * shard, total))
-                if cell.area > 0:
-                    cells.append((i, j, cell))
-            if band.area > 0:
-                raise ValueError(
-                    f"mask extends past total_seqlen={total} on k "
-                    f"(leftover area {band.area})"
-                )
-        if rest.area > 0:
-            raise ValueError(
-                f"mask extends past total_seqlen={total} on q "
-                f"(leftover area {rest.area})"
-            )
-        units = []
-        for i, j, cell in cells:
-            q_ext, k_ext = AttnRanges(), AttnRanges()
-            for r in cell:
-                q_ext.append(r.q_range.clone())
-                k_ext.append(r.k_range.clone())
-            units.append(
-                (cell.area, i, j, cell, q_ext.merge(), k_ext.merge())
-            )
+        units = grid_cells(rects, cp_size, shard, total)
         units.sort(key=lambda u: -u[0])
 
         rng = random.Random(self.seed)
@@ -391,6 +401,29 @@ class GridLocalitySolver:
         return (global_cost, buckets)
 
 
+def dynamic_solver_for(alg, **kwargs):
+    """Factory: a working solver for every ``DynamicAttnAlgType`` member.
+
+    BINARY_GREEDY / BINARY_GREEDY_PARALLEL are one algorithm here (the
+    parallelism in the reference name is a CPU-thread detail,
+    binary_greedy_parallel.py); SIMPLEX_NETWORK_FLOW and
+    FAST_SIMPLEX_NETWORK_FLOW are served by the single flow-based
+    implementation (see snf_solver.py header for why the reference's
+    ILP backend split is not reproduced)."""
+    from ...common.enum import DynamicAttnAlgType as T
+    from .snf_solver import SNFDynamicSolver
+
+    table = {
+        T.BINARY_GREEDY_PARALLEL: DynamicAttnSolver,
+        T.BINARY_GREEDY: DynamicAttnSolver,
+        T.FAST_SIMPLEX_NETWORK_FLOW: SNFDynamicSolver,
+        T.SIMPLEX_NETWORK_FLOW: SNFDynamicSolver,
+        T.GREEDY_RANDOM_GRID: GridLocalitySolver,
+        T.NON_COMMUNICATION_QO: NCQDynamicSolver,
+    }
+    return table[alg](**kwargs)
+
+
 def _own_shard_ranges(rank: int, shard: int, total: int) -> AttnRanges:
     """Contiguous ownership of one rank, clamped to the sequence — ranks
     entirely past ``total`` (cp_size not dividing total_seqlen) own
@@ -456,11 +489,14 @@ class AutoDynamicSolver:
     """
 
     def __init__(self, comm_rows_to_area: float = 1024.0, candidates=None):
+        from .snf_solver import SNFDynamicSolver
+
         self.c2a = comm_rows_to_area
         self.candidates = candidates or (
             DynamicAttnSolver(),
             NCQDynamicSolver(),
             GridLocalitySolver(comm_rows_to_area=comm_rows_to_area),
+            SNFDynamicSolver(),
         )
 
     def solve(

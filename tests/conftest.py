@@ -21,6 +21,18 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Persistent compilation cache: the suite's cost is dominated by XLA
+# compiles of many distinct jit programs (tiny shapes, big graphs), so a
+# warm cache cuts wall time several-fold. Safe across processes (content
+# keyed); MAGI_TEST_JAX_CACHE=0 disables.
+_cache = os.environ.get("MAGI_TEST_JAX_CACHE", "")
+if _cache != "0":
+    from magiattention_tpu.benchmarking import enable_compile_cache
+
+    enable_compile_cache(
+        _cache or os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+    )
+
 
 def pytest_addoption(parser):
     parser.addoption(

@@ -54,12 +54,13 @@ CASES = [
 
 
 def _solver_for(kind):
-    from magiattention_tpu.meta.solver.dynamic_attn_solver import (
+    from magiattention_tpu.meta import (
         AutoDynamicSolver,
         DynamicAttnSolver,
         GridLocalitySolver,
         LocalityGreedySolver,
         NCQDynamicSolver,
+        SNFDynamicSolver,
     )
 
     return {
@@ -68,11 +69,12 @@ def _solver_for(kind):
         "locality": LocalityGreedySolver,
         "grid": GridLocalitySolver,
         "auto": AutoDynamicSolver,
+        "snf": SNFDynamicSolver,
     }[kind]()
 
 
 @pytest.mark.parametrize(
-    "solver_kind", ["kd", "ncq", "locality", "grid", "auto"]
+    "solver_kind", ["kd", "ncq", "locality", "grid", "auto", "snf"]
 )
 @pytest.mark.parametrize("cp", [2, 4])
 @pytest.mark.parametrize("name,total,slices", CASES, ids=[c[0] for c in CASES])
