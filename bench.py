@@ -317,7 +317,7 @@ def _measure_extras(dt_fwd_64k: float) -> dict:
         q, k, v = qkv(t)
         f = jax.jit(lambda q, k, v: flex_flash_attn_func(q, k, v, qr, kr, ts)[0])
         dt = _timeit(f, q, k, v, n=n)
-        return 4 * area * hq * d / dt / 1e12, (q, k, v, f, dt)
+        return 4 * area * hq * d / dt / 1e12
 
     # 1. 64k causal pure-bwd: (fwd+bwd) - fwd at 2.5x fwd FLOPs
     #    (the exps/run_kernel_bench.py convention, cp_benchmark.md:45);
@@ -359,7 +359,7 @@ def _measure_extras(dt_fwd_64k: float) -> dict:
 
     mask = make_attn_mask_from_ranges(qr, kr, ts, t, t)
     area = int(np.asarray(mask).sum())
-    tf_varlen, _ = fwd_tf(t, qr, kr, ts, area, n=10)
+    tf_varlen = fwd_tf(t, qr, kr, ts, area, n=10)
     extras["flex_attn_fwd_tflops_16k_varlen_block_causal_bf16"] = round(
         tf_varlen, 3
     )
@@ -369,7 +369,7 @@ def _measure_extras(dt_fwd_64k: float) -> dict:
     t = 131072
     qr, kr, ts = [(0, t)], [(0, t)], [1]
     area = t * (t + 1) // 2
-    tf_128k, _ = fwd_tf(t, qr, kr, ts, area, n=3)
+    tf_128k = fwd_tf(t, qr, kr, ts, area, n=3)
     extras["flex_attn_fwd_tflops_128k_causal_bf16"] = round(tf_128k, 3)
     print(f"extras: 128k causal fwd {tf_128k:.1f} TF/s", file=sys.stderr)
     return extras

@@ -30,6 +30,7 @@ TPU hosts where the planner is pure Python either way).
 from __future__ import annotations
 
 import dataclasses
+from collections import deque
 
 from ...common.rectangle import AttnRectangles
 from .dynamic_attn_solver import (
@@ -77,10 +78,10 @@ class _MinCostFlow:
             dist = [float("inf")] * n
             dist[s] = 0.0
             inq = [False] * n
-            queue = [s]
+            queue = deque([s])
             inq[s] = True
             while queue:
-                u = queue.pop(0)
+                u = queue.popleft()
                 inq[u] = False
                 e = self.head[u]
                 while e != -1:
@@ -197,7 +198,8 @@ class SNFDynamicSolver:
         (i -> r), cells (i, j) whose KV side is already at r (j == r, or
         the previous round's assignment put them on r) become computable
         at r; symmetrically for KV links. Unassigned area contributes
-        1/cp of itself (it could end up anywhere)."""
+        1/(2*cp) of itself (it could end up anywhere, and completing it
+        needs the other side's link half the time)."""
         row_area: dict[int, float] = {}
         col_area: dict[int, float] = {}
         by_q: dict[tuple[int, int], float] = {}

@@ -47,12 +47,7 @@ def test_mcmf_max_flow_small():
 def test_mcmf_prefers_cheap_path():
     """Two parallel 2-cap paths, costs 0 and 1; pushing 3 units must use
     the cheap path fully: min cost = 0*2 + 1*1 = 1."""
-    net = _MinCostFlow(4)
     s, a, b, t = range(4)
-    net.add_edge(s, a, 2.0, 0.0)
-    net.add_edge(s, b, 2.0, 0.0)
-    net.add_edge(a, t, 3.0, 0.0)
-    net.add_edge(b, t, 3.0, 1.0)
     # cap the total at 3 via a super-source
     net2 = _MinCostFlow(5)
     s2 = 4
