@@ -28,15 +28,56 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
+_DOC_DIST_CSV = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "data",
+    "doc_length_distribution.csv",
+)
+
+
+def _load_doc_length_histogram() -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(lo, hi, prob) bins of the reference's real document-length
+    distribution (data imported verbatim from
+    exps/dist_attn/benchmark/datasets/default/doc_length_distribution.csv
+    — the corpus histogram its dist benchmark samples from)."""
+    lo, hi, cnt = [], [], []
+    with open(_DOC_DIST_CSV) as f:
+        next(f)  # header
+        for line in f:
+            rng_part, rest = line.strip().split('",')
+            a, b = rng_part.strip('"[]').split(",")
+            lo.append(int(a))
+            hi.append(int(b.strip().rstrip("]")))
+            cnt.append(int(rest.split(",")[0]))
+    cnt_arr = np.asarray(cnt, np.float64)
+    return (
+        np.asarray(lo, np.int64),
+        np.asarray(hi, np.int64),
+        cnt_arr / cnt_arr.sum(),
+    )
+
+
 def sample_doc_cuts(
-    total: int, rng: np.random.Generator, mean_len: float = 4096.0
+    total: int,
+    rng: np.random.Generator,
+    mean_len: float | None = None,
 ) -> list[int]:
-    """Document cut points from a heavy-tailed length distribution, each
-    sample capped at total/4 (the reference's benchmark convention,
-    cp_benchmark.md:63-76)."""
+    """Document cut points drawn from the reference's REAL doc-length
+    histogram (uniform within the chosen bin), each sample capped at
+    total/4 (cp_benchmark.md:63-76). Passing ``mean_len`` falls back to
+    the old synthetic lognormal (kept for sensitivity checks)."""
     cuts = [0]
+    if mean_len is not None:
+        while cuts[-1] < total:
+            ln = int(
+                np.clip(rng.lognormal(np.log(mean_len), 1.0), 128, total // 4)
+            )
+            cuts.append(min(cuts[-1] + ln, total))
+        return cuts
+    lo, hi, p = _load_doc_length_histogram()
     while cuts[-1] < total:
-        ln = int(np.clip(rng.lognormal(np.log(mean_len), 1.0), 128, total // 4))
+        b = rng.choice(len(p), p=p)
+        ln = int(np.clip(rng.integers(lo[b], hi[b] + 1), 1, total // 4))
         cuts.append(min(cuts[-1] + ln, total))
     return cuts
 
@@ -85,7 +126,14 @@ def main() -> None:
     p.add_argument("--head-dim", type=int, default=128)
     p.add_argument("--chunk", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--mean-doc", type=float, default=4096.0)
+    p.add_argument(
+        "--mean-doc",
+        type=float,
+        default=None,
+        help="opt into the synthetic lognormal doc sampler with this mean; "
+        "default draws from the reference's real doc-length histogram "
+        "(exps/data/doc_length_distribution.csv)",
+    )
     p.add_argument(
         "--causal",
         action=argparse.BooleanOptionalAction,

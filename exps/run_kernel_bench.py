@@ -87,6 +87,13 @@ def main() -> None:
         "--masks", default="", help="comma subset of mask families (all if empty)"
     )
     p.add_argument(
+        "--sparse",
+        action="store_true",
+        help="also bench the sparse kernels (block-sparse keeping every "
+        "4th/8th causal block per row — ~1/4 and ~1/8 of the causal area "
+        "— plus NSA-style top-k index attention), FLOPs over kept blocks",
+    )
+    p.add_argument(
         "--out",
         default="",
         help="append each completed row as a JSON line to this file (the "
@@ -188,6 +195,67 @@ def main() -> None:
                     if bwd_ms > 0.05 * r.median_ms
                     else None
                 )
+            rows.append(row)
+            persist(row)
+
+        # sparse-kernel rows (reference exps/attn block-sparse/index
+        # variants, SURVEY §2.9): block-sparse at two densities + NSA-style
+        # top-k index attention. FLOPs are counted over the KEPT blocks.
+        if args.sparse:
+            from magiattention_tpu.ops import (
+                block_sparse_attn_func,
+                index_attn_func,
+            )
+
+            bq = bk = 128
+            nq, nk = total // bq, total // bk
+            sparse_cases = []
+            for keepth_name, keep in (("d25", 4), ("d12", 8)):
+                bm = np.zeros((nq, nk), dtype=bool)
+                for i in range(nq):
+                    bm[i, i :: -keep] = True  # diagonal + every keep-th back
+                    bm[i, i] = True
+                sparse_cases.append((keepth_name, bm))
+            for sp_name, bm in sparse_cases:
+                kept_blocks = int(bm.sum())
+                area = kept_blocks * bq * bk
+                flops = 4 * area * args.heads * args.head_dim
+                f = jax.jit(
+                    lambda q, k, v, bm=bm: block_sparse_attn_func(
+                        q, k, v, bm, block_q=bq, block_k=bk
+                    )[0]
+                )
+                r = do_bench(f, q, k, v, warmup=2, rep=3, inner=10)
+                row = {
+                    "mask": sp_name,
+                    "seqlen": total,
+                    "area_frac": round(area / (total * total), 3),
+                    "ms_fwd": round(r.median_ms, 2),
+                    "tf_fwd": round(r.tflops(flops), 2),
+                }
+                rows.append(row)
+                persist(row)
+            # NSA-style top-k: 8 causal blocks per q block (incl. diagonal)
+            topk = min(8, nk)
+            sel = np.full((nq, topk), -1, dtype=np.int64)
+            for i in range(nq):
+                cand = list(range(max(0, i - topk + 1), i + 1))
+                sel[i, : len(cand)] = cand
+            area = int((sel >= 0).sum()) * bq * bk
+            flops = 4 * area * args.heads * args.head_dim
+            f = jax.jit(
+                lambda q, k, v: index_attn_func(
+                    q, k, v, sel, causal=False, block_q=bq, block_k=bk
+                )[0]
+            )
+            r = do_bench(f, q, k, v, warmup=2, rep=3, inner=10)
+            row = {
+                "mask": f"index_top{topk}",
+                "seqlen": total,
+                "area_frac": round(area / (total * total), 3),
+                "ms_fwd": round(r.median_ms, 2),
+                "tf_fwd": round(r.tflops(flops), 2),
+            }
             rows.append(row)
             persist(row)
 

@@ -87,10 +87,10 @@ def check_flag_comb(
     hier_axis = isinstance(cp_axis, (tuple, list))
     backend = env.kernel_backend()
 
-    if backend not in ("pallas", "jnp"):
+    if backend not in ("pallas", "jnp", "jnp_online"):
         raise ValueError(
             f"MAGI_ATTENTION_KERNEL_BACKEND={backend!r} is not one of "
-            "('pallas', 'jnp')"
+            "('pallas', 'jnp', 'jnp_online')"
         )
     if hier_flag and not hier_axis:
         raise ValueError(

@@ -55,7 +55,8 @@ def runtime_dict_size() -> int:
 
 
 def kernel_backend() -> str:
-    """'pallas' (TPU production) or 'jnp' (any-platform reference path)."""
+    """'pallas' (TPU production), 'jnp' (any-platform dense reference
+    path), or 'jnp_online' (block-wise online-softmax reference path)."""
     return _env_str("MAGI_ATTENTION_KERNEL_BACKEND", "pallas").lower()
 
 

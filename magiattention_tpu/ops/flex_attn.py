@@ -863,6 +863,14 @@ def _fwd_jnp(q, k, v, sink2d, ftab, params: FlexAttnParams):
     p = jnp.where(mask[None], jnp.exp(s - m_safe[..., None]), 0.0)
     l = p.sum(axis=-1)
     acc = jnp.einsum("hqk,hkd->hqd", p, vf.astype(acc_t))
+    return _jnp_epilogue(m, m_safe, l, acc, sink2d, params, hq, tqp)
+
+
+def _jnp_epilogue(m, m_safe, l, acc, sink2d, params, hq, tqp):
+    """Shared dense/online jnp epilogue: sink fold, uncovered rows
+    (out=0 / lse=-inf, lse=sink when has_sink), lane broadcast."""
+    acc_t = m.dtype
+    neg = jnp.asarray(NEG_INF, acc_t)
     if params.has_sink:
         sinkc = sink2d[:, :1].astype(acc_t)  # [hq, 1]
         m_tot = jnp.maximum(m, sinkc)
@@ -890,6 +898,88 @@ def _fwd_jnp(q, k, v, sink2d, ftab, params: FlexAttnParams):
     return out.astype(params.out_jnp_dtype), lse_lanes, rowmax_lanes
 
 
+def _fwd_jnp_online(q, k, v, sink2d, ftab, params: FlexAttnParams):
+    """Online-softmax jnp backend (MAGI_ATTENTION_KERNEL_BACKEND=
+    jnp_online): block-wise lax.scan over k with running (m, l, acc),
+    O(hq * tq * block_k) live scores instead of the dense path's
+    O(hq * tq * tk) float score tensor; GQA K/V stay at hk heads.
+
+    Role of reference ``functional/sdpa_online.py`` (1-326): the
+    lower-memory any-platform runtime alternative for long-seqlen
+    precision debugging — numerically the online recurrence the Pallas
+    kernel itself implements, in plain differentiable jnp.
+
+    Memory honesty: the block mask is still materialized densely
+    ([tqp, tkp] bool — 64x smaller than the dense backend's fp32 scores
+    at hq=8, but O(tq*tk) nonetheless), and reverse-mode through the
+    scan saves the (m, l, acc) carry per step; use the Pallas kernel
+    (or this backend fwd-only) where those bounds matter."""
+    hq, tqp, d = q.shape
+    hk, tkp = k.shape[0], k.shape[1]
+    group = hq // hk
+    bk = params.block_k
+    mask = _dense_mask_from_tables(ftab, tqp, tkp, params.block_q, bk)
+
+    acc_t = jnp.promote_types(q.dtype, jnp.float32)
+    qf = q.astype(acc_t).reshape(hk, group, tqp, d)
+    kf = k.astype(acc_t)
+    vf = v.astype(acc_t)
+    neg = jnp.asarray(NEG_INF, acc_t)
+    scale = jnp.asarray(params.scale, acc_t)
+
+    @jax.checkpoint
+    def step(carry, idx):
+        m, l, acc = carry
+        c0 = idx * bk
+        kb = jax.lax.dynamic_slice_in_dim(kf, c0, bk, axis=1)  # [hk, bk, d]
+        vb = jax.lax.dynamic_slice_in_dim(vf, c0, bk, axis=1)
+        mb = jax.lax.dynamic_slice_in_dim(mask, c0, bk, axis=1)  # [tqp, bk]
+        z = (
+            jnp.einsum("hgqd,hkd->hgqk", qf, kb) * scale
+        ).reshape(hq, tqp, bk)
+        if params.softcap > 0.0:
+            cap = jnp.asarray(params.softcap, acc_t)
+            z = cap * jnp.tanh(z / cap)
+        s = jnp.where(mb[None], z, neg)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        m_new_safe = jax.lax.stop_gradient(
+            jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        )
+        # rescale of the running sums; rows still uncovered contribute 0.
+        # CRITICAL: the rescale is built from stop-gradiented maxima only —
+        # then the telescoped weight of every score is exactly
+        # exp(s - m_final_safe) with s as the sole live input, identical
+        # to the dense path's gradient. A live max here would inject a
+        # spurious gradient path per step (measured: dq ~(steps+1)x off).
+        m_prev_safe = jax.lax.stop_gradient(
+            jnp.where(jnp.isneginf(m), 0.0, m)
+        )
+        resc = jnp.where(
+            jnp.isneginf(m), 0.0, jnp.exp(m_prev_safe - m_new_safe)
+        ).astype(acc_t)
+        p = jnp.where(mb[None], jnp.exp(s - m_new_safe[..., None]), 0.0)
+        l_new = l * resc + p.sum(axis=-1)
+        pv = jnp.einsum(
+            "hgqk,hkd->hgqd", p.reshape(hk, group, tqp, bk), vb
+        ).reshape(hq, tqp, d)
+        acc_new = acc * resc[..., None] + pv
+        return (m_new, l_new, acc_new), None
+
+    init = (
+        jnp.full((hq, tqp), neg, acc_t),
+        jnp.zeros((hq, tqp), acc_t),
+        jnp.zeros((hq, tqp, d), acc_t),
+    )
+    (m, l, acc), _ = jax.lax.scan(
+        step, init, jnp.arange(tkp // bk, dtype=jnp.int32)
+    )
+    m_safe = jax.lax.stop_gradient(jnp.where(jnp.isneginf(m), 0.0, m))
+    # l/acc left the last step rebased to its m_new_safe, and the last
+    # step's m_new IS the global max — so they are already relative to
+    # m_safe here, exactly what the epilogue expects
+    return _jnp_epilogue(m, m_safe, l, acc, sink2d, params, hq, tqp)
+
+
 def flex_attn_headmajor(
     q: jax.Array,  # [hq, tq_pad, d] (block-multiple padded)
     k: jax.Array,  # [hk, tk_pad, d]
@@ -905,9 +995,10 @@ def flex_attn_headmajor(
     Table arrays may be traced (per-rank, sharded) values.
 
     ``MAGI_ATTENTION_KERNEL_BACKEND=jnp`` swaps the Pallas kernels for the
-    dense jnp reference path (:func:`_fwd_jnp`) — same tables, same
-    semantics, plain-autodiff backward (reference SDPA backend switch,
-    functional/dist_attn.py:1215).
+    dense jnp reference path (:func:`_fwd_jnp`), ``jnp_online`` for the
+    block-wise online-softmax one (:func:`_fwd_jnp_online`) — same
+    tables, same semantics, plain-autodiff backward (reference SDPA
+    backend switch, functional/dist_attn.py:1215 + sdpa_online.py).
     """
     from .. import env
 
@@ -918,6 +1009,8 @@ def flex_attn_headmajor(
         sink2d = jnp.zeros((hq, 1), jnp.float32)
     if env.kernel_backend() == "jnp":
         return _fwd_jnp(q, k, v, sink2d, tuple(ftab), params)
+    if env.kernel_backend() == "jnp_online":
+        return _fwd_jnp_online(q, k, v, sink2d, tuple(ftab), params)
     return _flex_attn_core(q, k, v, sink2d, tuple(ftab), tuple(btab), params)
 
 

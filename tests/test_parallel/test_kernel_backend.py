@@ -78,15 +78,18 @@ def _pipeline(total, qr, kr, ts, cp, dtype, out_dtype):
     return q, k, v, out, lse, g
 
 
+@pytest.mark.parametrize("backend", ["jnp", "jnp_online"])
 @pytest.mark.parametrize(
     "name,total,qr,kr,ts", SCENARIOS, ids=[s[0] for s in SCENARIOS]
 )
 @pytest.mark.parametrize("cp", [1, 4])
-def test_jnp_backend_matches_pallas(name, total, qr, kr, ts, cp, monkeypatch):
+def test_jnp_backend_matches_pallas(
+    name, total, qr, kr, ts, cp, backend, monkeypatch
+):
     q, k, v, out_p, lse_p, g_p = _pipeline(
         total, qr, kr, ts, cp, jnp.float32, "float32"
     )
-    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", backend)
     _, _, _, out_j, lse_j, g_j = _pipeline(
         total, qr, kr, ts, cp, jnp.float32, "float32"
     )
@@ -102,11 +105,14 @@ def test_jnp_backend_matches_pallas(name, total, qr, kr, ts, cp, monkeypatch):
         assert_close(gj, gp, atol=5e-5, rtol=5e-5, msg=f"{name} d{nm}")
 
 
-def test_jnp_backend_fp64_pipeline(monkeypatch):
+@pytest.mark.parametrize("backend", ["jnp", "jnp_online"])
+def test_jnp_backend_fp64_pipeline(backend, monkeypatch):
     """fp64 end-to-end through the distributed path (reference
-    sdpa_varlen_* fp64 scenarios): the jnp backend carries float64 where
-    the Pallas kernel cannot, giving a high-precision distributed oracle."""
-    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    sdpa_varlen_* fp64 scenarios; sdpa_online.py for the online variant):
+    the jnp backends carry float64 where the Pallas kernel cannot, giving
+    a high-precision distributed oracle — the online one at O(tq*block_k)
+    live scores, for long-seqlen precision debugging."""
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", backend)
     total, cp = 512, 4
     qr, kr, ts = [(0, 512)], [(0, 512)], [1]
     q, k, v, out, lse, _ = _pipeline(
@@ -121,3 +127,59 @@ def test_jnp_backend_fp64_pipeline(monkeypatch):
     assert_close(
         np.asarray(lse)[fin], np.asarray(ref_lse)[fin], atol=1e-12, rtol=1e-12
     )
+
+
+def test_online_backend_uncovered_rows_and_sink(monkeypatch):
+    """Direct headmajor check of the online backend's edge semantics:
+    uncovered q rows give out=0 / lse=-inf without a sink and lse=sink
+    with one — identical to the dense jnp and Pallas epilogues."""
+    from magiattention_tpu.ops.block_meta import Run, build_block_meta_general
+    from magiattention_tpu.ops.flex_attn import (
+        FlexAttnParams,
+        bwd_tables,
+        flex_attn_headmajor,
+        fwd_tables,
+    )
+
+    total, hq, d, blk = 256, 2, 32, 64
+    # rows [128, 192) covered by nothing
+    slices = np.asarray(
+        [(0, 128, 0, 128, 1), (192, 256, 0, 256, 0)], np.int64
+    )
+    runs = [Run(local_start=0, global_start=0, length=total)]
+    meta = build_block_meta_general(
+        slices, runs, runs, total, total, block_q=blk, block_k=blk
+    )
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((hq, total, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((hq, total, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((hq, total, d)), jnp.float32)
+
+    for has_sink, sink in ((False, None), (True, jnp.asarray([0.3, -0.2]))):
+        params = FlexAttnParams(
+            block_q=blk, block_k=blk, scale=1.0 / np.sqrt(d), softcap=0.0,
+            has_sink=has_sink, out_dtype="float32", interpret=True,
+        )
+        results = {}
+        for backend in ("jnp", "jnp_online"):
+            monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", backend)
+            out, lse_lanes, rowmax = flex_attn_headmajor(
+                q, k, v, fwd_tables(meta), bwd_tables(meta), params,
+                sink=sink,
+            )
+            results[backend] = (out, lse_lanes)
+        out_d, lse_d = results["jnp"]
+        out_o, lse_o = results["jnp_online"]
+        assert_close(out_o, out_d, atol=2e-6, rtol=2e-6)
+        assert_close(lse_o, lse_d, atol=2e-6, rtol=2e-6)
+        dead = np.asarray(out_o)[:, 128:192]
+        np.testing.assert_array_equal(dead, 0.0)
+        lse_dead = np.asarray(lse_o)[:, 128:192, 0]
+        if has_sink:
+            np.testing.assert_allclose(
+                lse_dead,
+                np.broadcast_to(np.asarray(sink)[:, None], lse_dead.shape),
+                rtol=1e-6,
+            )
+        else:
+            assert np.all(np.isneginf(lse_dead))
