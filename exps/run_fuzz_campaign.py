@@ -13,6 +13,7 @@ dispatched-ownership qo mode and grid/auto solvers) — 0 failures.
     python exps/run_fuzz_campaign.py --axis cross --seeds 400:424
     python exps/run_fuzz_campaign.py --axis features --seeds 500:580
     python exps/run_fuzz_campaign.py --axis bf16 --seeds 600:630
+    python exps/run_fuzz_campaign.py --axis backend --seeds 700:760
 
 Every failure prints the seed + config; exit code = number of failures.
 """
@@ -31,7 +32,7 @@ def main() -> None:
     p.add_argument(
         "--axis",
         default="main",
-        choices=["main", "qo", "hier", "cross", "features", "bf16"],
+        choices=["main", "qo", "hier", "cross", "features", "bf16", "backend"],
     )
     p.add_argument("--seeds", default="0:40", help="start:stop range")
     p.add_argument("--devices", type=int, default=8)
@@ -138,6 +139,9 @@ def main() -> None:
                     LocalityGreedySolver,
                     NCQDynamicSolver,
                 )
+                from magiattention_tpu.meta.solver.snf_solver import (
+                    SNFDynamicSolver,
+                )
                 from magiattention_tpu.ops.flex_attn import FlexAttnParams
                 from magiattention_tpu.parallel.dispatch import (
                     dispatch as meta_dispatch,
@@ -156,9 +160,12 @@ def main() -> None:
                 sl = np.asarray(
                     [(a[0], a[1], b[0], b[1], t)
                      for a, b, t in zip(qr, kr, ts)], np.int64)
+                # (seed // 2) % 6: keeps the solver choice independent of
+                # the seed % 2 ownership-layout switch below (a plain
+                # seed % 6 would parity-lock each solver to one layout)
                 solver = [DynamicAttnSolver, NCQDynamicSolver,
                           LocalityGreedySolver, GridLocalitySolver,
-                          AutoDynamicSolver][seed % 5]()
+                          AutoDynamicSolver, SNFDynamicSolver][(seed // 2) % 6]()
                 # odd seeds: ownership = MinHeap-balanced dispatch layout
                 # (the qo x balanced-dispatch composition); even: contiguous
                 meta = None
@@ -275,6 +282,36 @@ def main() -> None:
                 check(f"features seed={seed} h={hq}:{hk} sink={use_sink}",
                       out,
                       ref_attn_from_ranges(q, k, v, qr, kr, ts, sink=sink)[0])
+
+            elif args.axis == "backend":
+                # jnp / jnp_online reference backends through the full api
+                # path vs the fp32 oracle (round-5 jnp_online coverage)
+                backend = ["jnp", "jnp_online"][seed % 2]
+                os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] = backend
+                try:
+                    total = int(rng.choice([512, 768, 1024]))
+                    cp = int(rng.choice([2, 4]))
+                    chunk = int(rng.choice([32, 64]))
+                    hq, hk = (2, 2) if rng.random() < 0.5 else (4, 2)
+                    qr, kr, ts = _random_mask(rng, total)
+                    if not make_attn_mask_from_ranges(
+                        qr, kr, ts, total, total
+                    ).any():
+                        continue
+                    mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
+                    key = magi_attn_flex_key(
+                        qr, kr, ts, total, total, mesh,
+                        num_heads=(hq, hk), head_dim=32, chunk_size=chunk,
+                        out_dtype="float32",
+                    )
+                    q, k, v = rand_qkv(rng, total, total, hq, hk)
+                    out = undispatch(
+                        calc_attn(dispatch(q, key), dispatch(k, key),
+                                  dispatch(v, key), key)[0], key)
+                    check(f"backend seed={seed} {backend}", out,
+                          ref_attn_from_ranges(q, k, v, qr, kr, ts)[0])
+                finally:
+                    os.environ.pop("MAGI_ATTENTION_KERNEL_BACKEND", None)
 
             elif args.axis == "bf16":
                 total = int(rng.choice([512, 768]))
