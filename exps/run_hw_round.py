@@ -1,0 +1,112 @@
+"""Hardware-round orchestrator: capture everything a tunnel window allows.
+
+The axon tunnel to the single real chip wedges for hours at a time
+(rounds 2-4 each lost their on-chip slot). When it IS up, this script
+runs the full round-5 measurement agenda in priority order, one
+subprocess at a time (two concurrent clients wedge the tunnel), each
+with its own timeout, persisting results incrementally so a mid-agenda
+wedge still yields everything completed so far:
+
+  1. probe        — fast backend-init check; abort early if wedged
+  2. bench        — python bench.py --real (headline + extras, writes
+                    BENCH_CACHE.json with fresh provenance)
+  3. ceiling      — exps/run_ceiling_probe.py --json (the measured-MFU
+                    denominator; VERDICT r4 item 1)
+  4. kernel sweep — exps/run_kernel_bench.py --sparse --out ... (the
+                    BENCH_DETAIL.md source table, now incl. sparse rows)
+  5. dist bench   — exps/run_dist_bench.py (real doc-length dist)
+
+Usage:  python exps/run_hw_round.py [--skip probe,...] [--only bench]
+Everything lands in exps/hw_round_results/ (gitignored-free; commit it).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+_OUT = os.path.join(_HERE, "hw_round_results")
+
+
+def _run(name: str, cmd: list[str], timeout_s: int, log: dict) -> bool:
+    print(f"== {name}: {' '.join(cmd)} (timeout {timeout_s}s)", flush=True)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd,
+            cwd=_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        ok = proc.returncode == 0
+        log[name] = {
+            "rc": proc.returncode,
+            "seconds": round(time.time() - t0, 1),
+            "stdout_tail": proc.stdout[-4000:],
+            "stderr_tail": proc.stderr[-4000:],
+        }
+        with open(os.path.join(_OUT, f"{name}.log"), "w") as f:
+            f.write(proc.stdout)
+            f.write("\n--- stderr ---\n")
+            f.write(proc.stderr)
+        print(f"== {name}: rc={proc.returncode} in {time.time()-t0:.0f}s",
+              flush=True)
+        return ok
+    except subprocess.TimeoutExpired:
+        log[name] = {"rc": "timeout", "seconds": round(time.time() - t0, 1)}
+        print(f"== {name}: TIMEOUT after {timeout_s}s (tunnel wedged?)",
+              flush=True)
+        return False
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--skip", default="", help="comma list of step names")
+    p.add_argument("--only", default="", help="run just these steps")
+    args = p.parse_args()
+    os.makedirs(_OUT, exist_ok=True)
+    skip = set(args.skip.split(",")) if args.skip else set()
+    only = set(args.only.split(",")) if args.only else None
+
+    py = sys.executable
+    sweep_out = os.path.join(_OUT, "kernel_sweep.jsonl")
+    autotune_out = os.path.join(_OUT, "block_autotune.jsonl")
+    steps = [
+        ("probe", [py, "-c", "import jax; print(jax.devices())"], 120),
+        ("bench", [py, "bench.py", "--real"], 2400),
+        ("ceiling", [py, "exps/run_ceiling_probe.py", "--json"], 900),
+        (
+            "kernel_sweep",
+            [py, "exps/run_kernel_bench.py", "--sparse", "--out", sweep_out],
+            3600,
+        ),
+        (
+            "autotune",
+            [py, "exps/run_block_autotune.py", "--out", autotune_out],
+            2400,
+        ),
+        ("dist_bench", [py, "exps/run_dist_bench.py"], 1800),
+    ]
+
+    log: dict = {"started_unix": int(time.time())}
+    for name, cmd, timeout_s in steps:
+        if name in skip or (only is not None and name not in only):
+            continue
+        ok = _run(name, cmd, timeout_s, log)
+        if name == "probe" and not ok:
+            print("tunnel down; aborting agenda", flush=True)
+            break
+        log["finished_unix"] = int(time.time())
+        with open(os.path.join(_OUT, "agenda.json"), "w") as f:
+            json.dump(log, f, indent=1)
+    print(json.dumps({k: v for k, v in log.items() if isinstance(v, dict)
+                      and "rc" in v}, default=str))
+
+
+if __name__ == "__main__":
+    main()
