@@ -14,10 +14,13 @@ install:
 test-fast:
 	$(PY) -m pytest tests/test_common tests/test_meta tests/test_api/test_window_masks.py -q
 
+# default tier: slow-marked heavyweights auto-skip via conftest (and
+# MAGI_RUN_SLOW=1 re-enables them); measured tier times in docs/testing.md
 test:
 	$(PY) -m pytest tests -q
 
-# full-size (10k-15k token) oracle scenarios, skipped by default
+# full tier: default + the slow-marked heavyweights (redundant-coverage
+# oracle-exactness params, full-size 10k-15k-token scenarios)
 test-slow:
 	$(PY) -m pytest tests -q --run-slow
 

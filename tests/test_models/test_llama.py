@@ -26,9 +26,14 @@ from magiattention_tpu.models import (
 )
 from magiattention_tpu.parallel import dispatch
 
-TOTAL = 256
-CHUNK = 32
-BATCH = 4
+# shapes are oracle-compared (not goldens), so they only need to be big
+# enough that the mask crosses rank boundaries and the cp<=4 layouts get
+# multiple chunks per rank (the cp=8 variants run one chunk per rank;
+# multi-chunk-per-rank dispatch at cp=8 stays covered by the pipeline
+# tests) — wiring proof, not capacity proof (VERDICT r4 item 8)
+TOTAL = 128
+CHUNK = 16
+BATCH = 2
 
 CFG = LlamaConfig(
     vocab_size=64,
@@ -43,7 +48,7 @@ CFG = LlamaConfig(
 
 
 def _mask():
-    return infer_attn_mask_from_cu_seqlens([0, 96, TOTAL])
+    return infer_attn_mask_from_cu_seqlens([0, 48, TOTAL])
 
 
 def _data(meta):
@@ -103,7 +108,11 @@ def _tree_close(a, b, rtol=2e-4, atol=2e-5):
     "axes,tp_axis",
     [
         ({"dp": 2, "cp": 4}, None),
-        ({"dp": 2, "cp": 2, "tp": 2}, "tp"),
+        # tp wiring stays proven by the 4-D driver dryrun + pp tests;
+        # the extra ~100s oracle-exactness run is slow-tier
+        pytest.param(
+            {"dp": 2, "cp": 2, "tp": 2}, "tp", marks=pytest.mark.slow
+        ),
     ],
 )
 def test_magi_llama_matches_oracle(oracle, axes, tp_axis):
@@ -279,7 +288,7 @@ def test_pp_remat_matches_no_remat():
     "cp_axes",
     [
         {"cpo": 2, "cpi": 4},  # hierarchical 2-level cp (inter, intra)
-        {"cpo": 4, "cpi": 2},
+        pytest.param({"cpo": 4, "cpi": 2}, marks=pytest.mark.slow),
     ],
 )
 def test_magi_llama_hier_cp_matches_oracle(oracle, cp_axes):
@@ -304,10 +313,13 @@ def test_magi_llama_hier_cp_matches_oracle(oracle, cp_axes):
     _tree_close(grads, grads_ref)
 
 
+@pytest.mark.slow
 def test_magi_llama_forced_overlap_degree_matches_oracle(oracle):
     """cp=8 with a forced multi-stage overlap (degree=2) must match the
     oracle — the staged lse-merged pipeline is numerics-equivalent to the
-    merged path at model level."""
+    merged path at model level (~230s on this 1-core box; the staged
+    path stays default-tier-covered by test_pipeline_multi_stage_overlap
+    and the driver dryrun's overlap>=2 mesh)."""
     from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
 
     loss_ref, grads_ref = oracle

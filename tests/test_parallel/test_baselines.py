@@ -148,7 +148,10 @@ def test_usp_attention(u, r):
             make_usp_attn_fn(plan, bad_mesh, _params(d))
 
 
-@pytest.mark.parametrize("ro,ri", [(2, 2), (2, 4), (4, 2)])
+@pytest.mark.parametrize(
+    "ro,ri",
+    [(2, 2), (2, 4), pytest.param(4, 2, marks=pytest.mark.slow)],
+)
 def test_double_ring_attention(ro, ri):
     """LoongTrain-style double ring (outer x inner KV rotation)."""
     from magiattention_tpu.parallel.baselines import (

@@ -72,6 +72,7 @@ def _doc_lengths(rng, total, mean_len):
     return cuts
 
 
+@pytest.mark.slow
 def test_flagship_varlen_block_causal_16k_cp8():
     """Scaled flagship (reference varlen_block_causal_144k): 16k tokens,
     realistic doc lengths, block-causal mask, cp=8."""
