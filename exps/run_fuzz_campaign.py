@@ -6,6 +6,9 @@ the oracle on the CPU-simulated mesh. Round 3 ran 224 cases across these
 axes and found one planner crash (now pinned as a regression test);
 round 4 added 450 more (seeds 300:375 x 6 axes, incl. the new
 dispatched-ownership qo mode and grid/auto solvers) — 0 failures.
+Round 5: backend axis (jnp/jnp_online, seeds 700:730) 0/30 and the
+6-solver qo rotation incl. SNF x both ownership layouts (seeds 800:824)
+0/24.
 
     python exps/run_fuzz_campaign.py --axis main --seeds 100:160
     python exps/run_fuzz_campaign.py --axis qo --seeds 200:218
