@@ -210,7 +210,10 @@ def main() -> None:
             bq = bk = 128
             nq, nk = total // bq, total // bk
             sparse_cases = []
-            for keepth_name, keep in (("d25", 4), ("d12", 8)):
+            for keepth_name, keep in (
+                ("block_sparse_keep4th", 4),
+                ("block_sparse_keep8th", 8),
+            ):
                 bm = np.zeros((nq, nk), dtype=bool)
                 for i in range(nq):
                     bm[i, i :: -keep] = True  # diagonal + every keep-th back
