@@ -33,7 +33,11 @@ lint:
 	fi
 
 typecheck:
-	$(PY) -m mypy
+	@if $(PY) -m mypy --version >/dev/null 2>&1; then \
+		$(PY) -m mypy; \
+	else \
+		echo "mypy not installed; skipping (pip install -e .[dev])"; \
+	fi
 
 # host-side planning latency sweep (no devices needed)
 bench-plan:
