@@ -1,0 +1,50 @@
+"""Render a kernel-sweep JSONL into the BENCH_DETAIL.md table body.
+
+``exps/run_kernel_bench.py --out sweep.jsonl`` persists one JSON row per
+(mask, seqlen) case; this script formats those rows into the aligned
+plain-text table BENCH_DETAIL.md embeds, so refreshing the committed
+perf table after a chip window is mechanical:
+
+    python exps/render_bench_detail.py exps/hw_round_results/kernel_sweep.jsonl
+
+Rows are grouped by seqlen in input order (the sweep already emits the
+reference family order); missing fields print as ``-`` (e.g. fwd-only
+runs, or ``tf_bwd=None`` when timing noise made pure-bwd unmeasurable).
+"""
+
+import json
+import sys
+
+COLS = ["mask", "seqlen", "area_frac", "ms_fwd", "tf_fwd", "ms_fb", "tf_bwd"]
+
+
+def render(rows: list[dict]) -> str:
+    rows = [r for r in rows if "mask" in r]
+    widths = {c: len(c) for c in COLS}
+    cells = []
+    for r in rows:
+        line = {}
+        for c in COLS:
+            v = r.get(c)
+            line[c] = "-" if v is None else str(v)
+            widths[c] = max(widths[c], len(line[c]))
+        cells.append(line)
+    out = ["  ".join(c.ljust(widths[c]) for c in COLS).rstrip()]
+    out.append("  ".join("-" * widths[c] for c in COLS))
+    for line in cells:
+        out.append(
+            "  ".join(line[c].ljust(widths[c]) for c in COLS).rstrip()
+        )
+    return "\n".join(out)
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        sys.exit(__doc__)
+    with open(sys.argv[1]) as f:
+        rows = [json.loads(ln) for ln in f if ln.strip()]
+    print(render(rows))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,16 +26,25 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-# Square rungs find the chip's dense ceiling; the [T*H, D] x [D, T] shapes
-# mirror what one attention head-batch actually feeds the MXU.
+# Square rungs find the chip's dense ceiling. The skinny shapes mirror
+# attention's MXU diet without materializing a 64k x 64k score matrix
+# (the original (65536, 128, 65536) probe OOM'd the 16 GB chip: its
+# bf16 output alone is 8.6 GB, plus do_bench's live result copies —
+# attention never materializes that, so the probe must not either):
+# contraction-128 for QK^T, output-128 for PV, both capped so every
+# operand/output stays ~1 GB.
 SHAPES = [
     (2048, 2048, 2048),
     (4096, 4096, 4096),
     (8192, 8192, 8192),
     (16384, 8192, 8192),
-    (65536, 128, 65536),  # one 64k attention head's QK^T
-    (65536, 65536, 128),  # one 64k attention head's PV
+    (65536, 128, 8192),  # QK^T-shaped: d=128 contraction
+    (65536, 8192, 128),  # PV-shaped: d=128 output width
 ]
+
+# One grid step of the 64k kernel at the (256, 1024) rung, batched over
+# tiles: what the fwd kernel's two dots actually look like to the MXU.
+TILE_BATCH = 512  # 512 tiles x (256x128 @ 128x1024) = 34 GFLOP/call
 
 
 def main() -> None:
@@ -60,26 +69,60 @@ def main() -> None:
     rng = np.random.default_rng(0)
     rows = []
     best = 0.0
-    for m, k, n in SHAPES:
+
+    def probe(label, flops, make):
+        """``make`` allocates operands AND runs: allocation-time OOM on a
+        fragmented/16 GB chip must land in the same per-rung guard as
+        execution-time OOM, or one bad rung loses the whole window's rows."""
+        nonlocal best
+        try:
+            res = make()
+        except Exception as e:  # one OOM'd rung must not kill the probe
+            msg = f"{type(e).__name__}: {str(e)[:200]}"
+            rows.append({"shape": label, "error": msg})
+            if not args.json:
+                print(f"[{label}]  FAILED: {msg}")
+            return
+        tf = res.tflops(flops)
+        best = max(best, tf)
+        rows.append({"shape": label, "ms": round(res.median_ms, 3),
+                     "tflops": round(tf, 2)})
+        if not args.json:
+            print(f"[{label}]  {res.median_ms:8.3f} ms  {tf:7.2f} TFLOPs/s")
+
+    def mm_rung(m, k, n):
         a = jnp.asarray(rng.standard_normal((m, k)), dtype)
         b = jnp.asarray(rng.standard_normal((k, n)), dtype)
-        mm = jax.jit(lambda a, b: a @ b)
-        res = do_bench(mm, a, b)
-        tf = res.tflops(2 * m * k * n)
-        best = max(best, tf)
-        rows.append({"m": m, "k": k, "n": n,
-                     "ms": round(res.median_ms, 3), "tflops": round(tf, 2)})
-        if not args.json:
-            print(f"[{m:>6} x {k:>6} x {n:>6}]  {res.median_ms:8.3f} ms  "
-                  f"{tf:7.2f} TFLOPs/s")
+        return do_bench(jax.jit(lambda a, b: a @ b), a, b)
+
+    for m, k, n in SHAPES:
+        probe(f"{m}x{k}x{n}", 2 * m * k * n, lambda m=m, k=k, n=n: mm_rung(m, k, n))
+
+    # batched kernel-tile shape (see TILE_BATCH note above)
+    bq, d, bk = 256, 128, 1024
+
+    def tile_rung():
+        a = jnp.asarray(rng.standard_normal((TILE_BATCH, bq, d)), dtype)
+        b = jnp.asarray(rng.standard_normal((TILE_BATCH, d, bk)), dtype)
+        return do_bench(jax.jit(jnp.matmul), a, b)
+
+    probe(
+        f"tile_{TILE_BATCH}x({bq}x{d}@{d}x{bk})",
+        2 * TILE_BATCH * bq * d * bk,
+        tile_rung,
+    )
     payload = {
         "device": str(dev),
         "dtype": str(dtype),
-        "ceiling_tflops": round(best, 2),
+        # null, never 0.0: a fully-wedged window must not hand the next
+        # BENCH_DETAIL refresh a zero MFU denominator with rc=0
+        "ceiling_tflops": round(best, 2) if best > 0 else None,
         "rows": rows,
         "recorded_unix": int(time.time()),
     }
     print(json.dumps(payload))
+    if best == 0.0:
+        sys.exit(1)  # no rung succeeded: surface failure to the agenda log
 
 
 if __name__ == "__main__":
