@@ -22,16 +22,19 @@ from magiattention_tpu.parallel.dist_attn import build_dist_attn_plan
 def test_dense_1m_plan_under_bound():
     total, cp, chunk = 1 << 20, 32, 4096
     qr = AttnRanges.from_ranges([(0, total)])
-    t0 = time.perf_counter()
+    # process_time, not wall-clock: planning is host-side CPU work, and a
+    # loaded CI box (e.g. a concurrent on-chip bench on this 1-core host)
+    # inflates wall time by core-contention the guard shouldn't flag.
+    t0 = time.process_time()
     mq, _, bucket = make_dispatch_meta_from_qk_ranges(
         qr, qr.clone(), [AttnMaskType.CAUSAL], total, total, chunk, cp
     )
     plan = build_dist_attn_plan(mq, bucket, block_q=512, block_k=2048)
-    dt = time.perf_counter() - t0
+    dt = time.process_time() - t0
     assert plan.total_area == total * (total + 1) // 2
-    # Wall-clock bound: ~5x margin over the measured ~1.3s. Loaded CI
-    # machines can still exceed it, so the bound is an env knob; 0 keeps
-    # the functional check but skips the timing assertion entirely.
+    # CPU-time bound: ~5x margin over the measured ~1.3s; an env knob for
+    # slower boxes; 0 keeps the functional check but skips the timing
+    # assertion entirely.
     bound = float(os.environ.get("MAGI_PLAN_LATENCY_BOUND", "7.0"))
     if bound > 0:
         assert dt < bound, f"1M-token plan took {dt:.1f}s (bound {bound}s)"
@@ -47,9 +50,9 @@ def test_qo_plan_1m_under_bound():
 
     total, cp = 1 << 20, 32
     sl = np.asarray([(0, total, 0, total, 1)], np.int64)
-    t0 = time.perf_counter()
+    t0 = time.process_time()  # CPU time: see wall-clock note above
     plan = build_qo_comm_plan(sl, total, cp, block_q=512, block_k=2048)
-    dt = time.perf_counter() - t0
+    dt = time.process_time() - t0
     assert sum(plan.rank_areas) == total * (total + 1) // 2
     bound = float(os.environ.get("MAGI_PLAN_LATENCY_BOUND", "7.0"))
     if bound > 0:
