@@ -228,6 +228,29 @@ def _parity_check() -> bool:
     return ok
 
 
+def _stock_flash_tf(q, k, v, area, hq, d, n, block_sizes=None):
+    """Time jax's official flash_attention on [t,h,d] inputs, causal,
+    returning TFLOPs/s under the shared mask-area FLOPs convention.
+    Single definition so the headline ratio and the tuned-baseline
+    control can never drift apart in layout or FLOPs accounting."""
+    import jax
+
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention,
+    )
+
+    qb = q.transpose(1, 0, 2)[None]  # [1, h, t, d]
+    kb = k.transpose(1, 0, 2)[None]
+    vb = v.transpose(1, 0, 2)[None]
+    f = jax.jit(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_sizes=block_sizes
+        )
+    )
+    dt = _timeit(f, qb, kb, vb, n=n)
+    return 4 * area * hq * d / dt / 1e12
+
+
 def _measure() -> dict:
     import jax
     import jax.numpy as jnp
@@ -261,20 +284,9 @@ def _measure() -> dict:
 
     # baseline: jax official TPU flash attention, causal, same shape
     try:
-        from jax.experimental.pallas.ops.tpu.flash_attention import (
-            flash_attention,
-        )
-
-        qb = q.transpose(1, 0, 2)[None]  # [1, h, t, d]
-        kb = k.transpose(1, 0, 2)[None]
-        vb = v.transpose(1, 0, 2)[None]
-        ref = jax.jit(
-            lambda q, k, v: flash_attention(q, k, v, causal=True)
-        )
-        dt_ref = _timeit(ref, qb, kb, vb, n=5)
-        ref_tflops = flops / dt_ref / 1e12
+        ref_tflops = _stock_flash_tf(q, k, v, area, hq, d, n=5)
         print(
-            f"jax flash: {dt_ref*1e3:.2f} ms  {ref_tflops:.2f} TFLOPs/s",
+            f"jax flash: {ref_tflops:.2f} TFLOPs/s (default blocks)",
             file=sys.stderr,
         )
         vs = tflops / ref_tflops
@@ -372,6 +384,51 @@ def _measure_extras(dt_fwd_64k: float) -> dict:
     tf_128k = fwd_tf(t, qr, kr, ts, area, n=3)
     extras["flex_attn_fwd_tflops_128k_causal_bf16"] = round(tf_128k, 3)
     print(f"extras: 128k causal fwd {tf_128k:.1f} TF/s", file=sys.stderr)
+
+    # 4. TUNED stock-kernel control (VERDICT r4 weakness 3): the headline
+    #    vs_baseline times jax's flash_attention at its DEFAULT block
+    #    sizes, which under-uses the chip at 64k. Sweep a few tuned
+    #    BlockSizes and record the best, so the committed ratio has an
+    #    honest tuned-baseline control next to it. Reuses section 1's
+    #    still-live 64k q/k/v (no second 64k allocation), and any failure
+    #    here must not discard sections 1-3 (whole section guarded).
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            BlockSizes,
+        )
+
+        t = 65536
+        area = t * (t + 1) // 2
+        best = 0.0
+        best_cfg = None
+        for bq, bk in ((256, 512), (512, 1024), (1024, 1024)):
+            try:
+                bs = BlockSizes(
+                    block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+                    block_q_major_dkv=bq, block_k_major_dkv=bk,
+                    block_q_dkv=bq, block_k_dkv=bk,
+                    block_q_dq=bq, block_k_dq=bk, block_k_major_dq=bk,
+                )
+                tf = _stock_flash_tf(q, k, v, area, hq, d, n=3,
+                                     block_sizes=bs)
+                print(
+                    f"extras: stock flash tuned ({bq},{bk}): {tf:.1f} TF/s",
+                    file=sys.stderr,
+                )
+                if tf > best:
+                    best, best_cfg = tf, (bq, bk)
+            except Exception as e:
+                print(
+                    f"extras: stock flash ({bq},{bk}) failed: {e!r}",
+                    file=sys.stderr,
+                )
+        if best > 0:
+            extras["jax_flash_fwd_tflops_64k_causal_bf16_best_tuned"] = round(
+                best, 3
+            )
+            extras["jax_flash_best_tuned_blocks"] = list(best_cfg)
+    except Exception as e:  # never lose sections 1-3 to the control
+        print(f"extras: tuned-baseline control failed: {e!r}", file=sys.stderr)
     return extras
 
 
