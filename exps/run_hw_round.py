@@ -14,7 +14,8 @@ wedge still yields everything completed so far:
                     denominator; VERDICT r4 item 1)
   4. kernel sweep — exps/run_kernel_bench.py --sparse --out ... (the
                     BENCH_DETAIL.md source table, now incl. sparse rows)
-  5. dist bench   — exps/run_dist_bench.py (real doc-length dist)
+  5. dist bench   — exps/run_dist_bench.py --wallclock (real doc-length
+                    dist; the wallclock kernel tier needs the chip)
 
 Usage:  python exps/run_hw_round.py [--skip probe,...] [--only bench]
 Everything lands in exps/hw_round_results/ (gitignored-free; commit it).
@@ -84,7 +85,10 @@ def _cycle(skip, only, log) -> bool:
             [py, "exps/run_block_autotune.py", "--out", autotune_out],
             2400,
         ),
-        ("dist_bench", [py, "exps/run_dist_bench.py"], 1800),
+        # --wallclock is the tier that needs the chip (cp=1 kernel
+        # wall-clock on the doc-distribution mask); the plan tier that
+        # runs first is host-side and works anywhere
+        ("dist_bench", [py, "exps/run_dist_bench.py", "--wallclock"], 1800),
     ]
 
     selected = [
