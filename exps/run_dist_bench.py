@@ -143,10 +143,19 @@ def main() -> None:
     p.add_argument(
         "--mask",
         default="doc",
-        choices=["doc", "video"],
+        choices=["doc", "video", "swa_doc"],
         help="doc = varlen doc-length-distribution mask (reference "
         "exps/dist_attn benchmark shape); video = Magi-1 chunked AR "
-        "video mask (chunk_causal_mask, models/dit.py)",
+        "video mask (chunk_causal_mask, models/dit.py); swa_doc = "
+        "per-document causal sliding window over the same doc "
+        "distribution (BASELINE config-4 shape: SWA + doc mask)",
+    )
+    p.add_argument(
+        "--window",
+        type=int,
+        default=1024,
+        help="sliding-window width for --mask swa_doc (reference common "
+        "config: SWA window 1024, cp_benchmark.md:21-29)",
     )
     p.add_argument(
         "--video-chunk",
@@ -184,6 +193,20 @@ def main() -> None:
         vc = args.video_chunk if args.video_chunk is not None else args.total // 8
         assert vc > 0, f"--video-chunk must be positive, got {vc}"
         qr, kr, ts = chunk_causal_mask(args.total, vc)
+    elif args.mask == "swa_doc":
+        from magiattention_tpu.api import infer_attn_mask_from_cu_seqlens
+
+        assert args.window >= 1, (
+            f"--window must be >= 1, got {args.window} (0 would collide "
+            "with the -1 'unbounded' sentinel in the window convention)"
+        )
+        cuts = sample_doc_cuts(args.total, rng, args.mean_doc)
+        aq, ak, at = infer_attn_mask_from_cu_seqlens(
+            cuts, causal=False, window_size=(args.window - 1, 0)
+        )
+        qr = [tuple(r) for r in aq.to_naive_ranges()]
+        kr = [tuple(r) for r in ak.to_naive_ranges()]
+        ts = [int(t) for t in at]
     else:
         cuts = sample_doc_cuts(args.total, rng, args.mean_doc)
         qr, kr, ts = doc_mask(cuts, causal=args.causal)
@@ -246,8 +269,13 @@ def main() -> None:
 
     def contig_max_area(n_splits: int) -> int:
         """Max per-split mask area when q rows are cut into n contiguous
-        equal token groups (the ring-family layout; causal row-clips keep
-        the bottom-right anchor)."""
+        equal token groups (the ring-family layout). Row-clipping a slice
+        must move the k bound(s) its mask edge is anchored to: the causal
+        edge rides the bottom-right corner (ke shrinks with the clipped
+        tail rows), the inv-causal edge the top-left (ks grows with the
+        clipped head rows); BICAUSAL moves both. Leaving an anchor in
+        place overcounts the clipped band (3x on SWA slices — caught
+        against the dense-mask ground truth)."""
         if n_splits <= 1:
             return area
         span = total // n_splits
@@ -259,10 +287,9 @@ def main() -> None:
                 s0, s1 = max(qs, lo), min(qe, hi)
                 if s0 >= s1:
                     continue
-                if mt == 1:
-                    a += slice_area(s0, s1, ks, ke - (qe - s1), 1)
-                else:
-                    a += slice_area(s0, s1, ks, ke, mt)
+                ks2 = ks + (s0 - qs) if int(mt) in (2, 3) else ks
+                ke2 = ke - (qe - s1) if int(mt) in (1, 3) else ke
+                a += slice_area(s0, s1, ks2, ke2, mt)
             worst = max(worst, a)
         return worst
 
