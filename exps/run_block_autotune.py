@@ -24,6 +24,10 @@ CANDIDATES = [
     (256, 1024),
     (512, 1024),
     (512, 2048),
+    # square/wide-q rungs: the round-5 tuned stock-flash control peaked at
+    # (1024, 1024), which the table had never tried
+    (1024, 1024),
+    (1024, 2048),
 ]
 HEAD_BLOCKS = [1, 2, 4, 8]
 

@@ -98,6 +98,66 @@ def main() -> None:
     for m, k, n in SHAPES:
         probe(f"{m}x{k}x{n}", 2 * m * k * n, lambda m=m, k=k, n=n: mm_rung(m, k, n))
 
+    # --- chained rungs: the round-5 raw sweep exposed a ~12-15 ms fixed
+    # per-dispatch floor on the axon tunnel (a 2048^3 matmul "measured"
+    # 14.5 ms). Chaining ITERS serial matmuls inside ONE jitted fori_loop
+    # divides that floor away; these rungs are the real MFU denominator.
+    CHAIN_ITERS = 16
+
+    from magiattention_tpu.benchmarking import chained_ms
+
+    def chained_square(n):
+        """(y, b) -> (y @ b, b), square: one dispatch, CHAIN_ITERS serial
+        matmuls (b rides the carry, not a closure — HLO-literal limit)."""
+        def make():
+            b = jnp.asarray(rng.standard_normal((n, n)), dtype)
+            y0 = jnp.asarray(rng.standard_normal((n, n)), dtype)
+            return chained_ms(
+                lambda c: ((c[0] @ c[1]).astype(dtype), c[1]),
+                (y0, b),
+                iters=CHAIN_ITERS,
+            )
+        return make
+
+    def chained_attn_pair(t, d, w):
+        """y (t,d) -> y @ B (t,w: the QK^T diet) -> @ C (t,d: the PV diet);
+        both matmuls per step, exactly attention's alternating MXU shapes."""
+        def make():
+            B = jnp.asarray(rng.standard_normal((d, w)), dtype)
+            C = jnp.asarray(rng.standard_normal((w, d)), dtype)
+            y0 = jnp.asarray(rng.standard_normal((t, d)), dtype)
+            return chained_ms(
+                lambda c: (((c[0] @ c[1]) @ c[2]).astype(dtype), c[1], c[2]),
+                (y0, B, C),
+                iters=CHAIN_ITERS,
+            )
+        return make
+
+    def probe_chained(label, flops, make):
+        nonlocal best
+        try:
+            ms = make()
+        except Exception as e:
+            rows.append({"shape": label, "error":
+                         f"{type(e).__name__}: {str(e)[:200]}"})
+            if not args.json:
+                print(f"[{label}]  FAILED: {type(e).__name__}")
+            return
+        tf = flops / (ms * 1e-3) / 1e12
+        best = max(best, tf)
+        rows.append({"shape": label, "ms": round(ms, 3),
+                     "tflops": round(tf, 2), "chained": True})
+        if not args.json:
+            print(f"[{label}]  {ms:8.3f} ms  {tf:7.2f} TFLOPs/s  (chained)")
+
+    for n in (4096, 8192):
+        probe_chained(f"chained_{n}x{n}x{n}", 2 * n**3, chained_square(n))
+    probe_chained(
+        "chained_qkpv_65536x128<->8192",
+        2 * 2 * 65536 * 128 * 8192,
+        chained_attn_pair(65536, 128, 8192),
+    )
+
     # batched kernel-tile shape (see TILE_BATCH note above)
     bq, d, bk = 256, 128, 1024
 

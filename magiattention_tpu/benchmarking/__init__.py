@@ -5,6 +5,7 @@ from .bench import (
     BenchResult,
     Mark,
     MemoryRecorder,
+    chained_ms,
     do_bench,
     enable_compile_cache,
     image_grid,
@@ -18,6 +19,7 @@ __all__ = [
     "BenchResult",
     "Mark",
     "MemoryRecorder",
+    "chained_ms",
     "do_bench",
     "enable_compile_cache",
     "image_grid",

@@ -148,6 +148,41 @@ class BenchResult:
         return flops / (self.median_ms * 1e-3) / 1e12
 
 
+def chained_ms(step, carry, iters: int = 8, batches: int = 3) -> float:
+    """Median per-application wall-clock ms of ``step`` chained ``iters``
+    times inside ONE jitted ``lax.fori_loop`` dispatch.
+
+    ``step`` maps a pytree carry to a same-structure, same-dtype carry
+    (e.g. ``(q, k, v) -> (out, k, v)`` for a forward,
+    ``(q, k, v) -> (dq, dk, dv)`` for a gradient — returning EVERY grad
+    through the carry keeps every backward kernel live against DCE).
+    Serial data dependence through the carry defeats CSE, and the single
+    dispatch amortizes the tunnel's fixed per-dispatch latency floor
+    (~12-15 ms measured in the round-5 ceiling probe: a 2048^3 matmul
+    "takes" 14.5 ms per raw call) down to ~floor/iters per application —
+    :func:`do_bench`'s ``inner`` calls do NOT pipeline through the
+    tunnel, so this is the only honest timing for sub-50 ms kernels
+    there. Keep loop-invariant operands (k/v) inside the carry rather
+    than closed over: closure constants embed in the HLO and the remote
+    compiler rejects bodies past ~200 MB (HTTP 413).
+    """
+    import jax
+
+    f = jax.jit(
+        lambda c: jax.lax.fori_loop(0, iters, lambda i, cc: step(cc), c)
+    )
+    r = f(carry)
+    _sync(r)  # compile + settle
+    times = []
+    for _ in range(batches):
+        t0 = time.perf_counter()
+        r = f(carry)
+        _sync(r)
+        times.append((time.perf_counter() - t0) / iters * 1e3)
+    times.sort()
+    return times[len(times) // 2]
+
+
 def do_bench(
     fn: Callable,
     *args,

@@ -37,7 +37,7 @@ import numpy as np
 # Fields per slice in the flattened bounds table (global coords).
 SLICE_FIELDS = 5  # qs, qe, ks, ke, mask_type
 # Fields per entry in the flattened runs table (local windows + offsets).
-RUN_FIELDS = 7  # ql0, ql1, kl0, kl1, qoff, koff, needs_mask
+RUN_FIELDS = 7  # ql0, ql1, kl0, kl1, qoff, koff, needs_mask (diagnostic)
 
 
 def _cdiv(a: int, b: int) -> int:
@@ -134,6 +134,30 @@ class FlexAttnBlockMeta:
     def num_bwd_entries(self) -> int:
         return int(self.bwd_k_block.shape[0])
 
+    @property
+    def fwd_steps(self) -> int:
+        """Max fwd entries on any q block: the kernel's inner grid extent."""
+        return max_row_count(self.fwd_q_block, self.num_q_blocks)
+
+    @property
+    def bwd_steps(self) -> int:
+        """Max bwd entries on any k block."""
+        return max_row_count(self.bwd_k_block, self.num_k_blocks)
+
+
+def max_row_count(major: np.ndarray, num_major: int) -> int:
+    """Max entries sharing one major block (>= 1: dummies cover all majors).
+
+    This is the static inner-grid extent S of the row-major kernels: the
+    grid is (heads, num_major, S) and each major's entries occupy its
+    first row_count steps, the rest clamped dead. Host-side only — the
+    launchers recompute row starts/counts on-device from the (possibly
+    traced, per-rank stacked) major array with searchsorted.
+    """
+    if num_major <= 0 or major.size == 0:
+        return 1
+    return int(np.bincount(np.asarray(major), minlength=num_major).max())
+
 
 def _slice_k_span(
     gq_lo: int, gq_hi: int, ks: int, ke: int, qs: int, qe: int, mask_type: int
@@ -210,10 +234,17 @@ def _needs_mask_flags(
     block_q: int,
     block_k: int,
 ) -> np.ndarray:
-    """1 where the tile needs in-kernel masking, 0 where it is provably
-    fully unmasked (window covers the whole tile AND the slice constraints
-    hold at the worst corners) — lets the kernel skip all VPU mask work on
-    interior tiles via lax.cond."""
+    """1 where the tile's mask constraints actually bind, 0 where it is
+    provably fully unmasked (window covers the whole tile AND the slice
+    constraints hold at the worst corners).
+
+    DIAGNOSTIC ONLY since the round-5 kernel rewrite: the kernels apply
+    the branch-free row-interval mask unconditionally (a per-entry
+    lax.cond skip measured 37% SLOWER on dense-causal 64k — see
+    flex_attn._entry_interval_mask), so this flag no longer gates any
+    kernel work. It remains in the table (RUN_FIELDS slot 6) for plan
+    diagnostics — interior-tile fraction is a useful mask statistic —
+    and for table-ABI stability with the C++ planner parity tests."""
     e = entries.shape[0]
     import os
     if (
@@ -252,6 +283,62 @@ def _needs_mask_flags(
     return (~full).astype(np.int64)
 
 
+def _distribute_pad_majors(
+    major: np.ndarray, extra: int, num_major: int
+) -> np.ndarray:
+    """Major-block values for ``extra`` inert pad entries, chosen to keep
+    per-major row counts level (always the currently-shortest row).
+
+    Appending all pads to one major — the old behavior — inflates that
+    row's count and with it the kernels' static inner-grid extent
+    S = max row count, turning cross-rank entry padding into dead grid
+    steps multiplied across EVERY row of every rank.
+    """
+    import heapq
+
+    counts = np.bincount(
+        np.asarray(major, dtype=np.int64), minlength=max(num_major, 1)
+    )
+    heap = [(int(c), i) for i, c in enumerate(counts)]
+    heapq.heapify(heap)
+    out = np.empty(extra, np.int32)
+    for n in range(extra):
+        c, i = heapq.heappop(heap)
+        out[n] = i
+        heapq.heappush(heap, (c + 1, i))
+    return out
+
+
+def _append_pads_leveled(
+    major: np.ndarray,
+    minor: np.ndarray,
+    sid: np.ndarray,
+    runs: np.ndarray,
+    extra: int,
+    num_major: int,
+    sentinel: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Append ``extra`` inert (sentinel-slice, all-masked) pad entries with
+    leveled major assignment, then stable-resort by major so each major's
+    entries stay contiguous (the row-major kernels require it)."""
+    pad_major = _distribute_pad_majors(major, extra, num_major)
+    pad_runs = np.zeros((extra, RUN_FIELDS), np.int32)
+    pad_runs[:, 6] = 1  # diagnostic flag: sentinel-slice pads are all-masked
+    major = np.concatenate([major, pad_major])
+    minor = np.concatenate([minor, np.zeros(extra, np.int32)])
+    sid = np.concatenate([sid, np.full(extra, sentinel, np.int32)])
+    runs2 = np.concatenate(
+        [runs.reshape(-1, RUN_FIELDS), pad_runs], axis=0
+    )
+    order = np.argsort(major, kind="stable")
+    return (
+        np.ascontiguousarray(major[order]),
+        np.ascontiguousarray(minor[order]),
+        np.ascontiguousarray(sid[order]),
+        np.ascontiguousarray(runs2[order].reshape(-1)),
+    )
+
+
 def _build_table(
     entries: np.ndarray,  # [E, 9] entry tuples (major-first ordering applied)
     num_major_blocks: int,
@@ -285,10 +372,12 @@ def _build_table(
     e = entries.shape[0]
     target = max(_round_up(e, max(pad_to, 1)), 1)
     if target > e:
-        row = list(dummy)
-        row[major_col] = int(entries[-1, major_col])
-        pad = np.tile(np.asarray([row], dtype=np.int64), (target - e, 1))
+        pad = np.tile(np.asarray([dummy], dtype=np.int64), (target - e, 1))
+        pad[:, major_col] = _distribute_pad_majors(
+            entries[:, major_col], target - e, num_major_blocks
+        )
         entries = np.concatenate([entries, pad], axis=0)
+        entries = entries[np.argsort(entries[:, major_col], kind="stable")]
     flags = _needs_mask_flags(entries, slices_for_flags, block_q_f, block_k_f)
     major = entries[:, major_col].astype(np.int32)
     minor = entries[:, minor_col].astype(np.int32)
@@ -356,7 +445,7 @@ def build_block_meta_general(
         slices_for_flags=slices, block_q_f=block_q, block_k_f=block_k,
     )
 
-    def _pad_table(table, target):
+    def _pad_table(table, target, num_major):
         major, minor, sid, runs = table
         e = major.shape[0]
         if target is None or target <= e:
@@ -364,18 +453,12 @@ def build_block_meta_general(
                 f"table length {e} exceeds requested pad {target}"
             )
             return table
-        extra = target - e
-        major = np.concatenate([major, np.full(extra, major[-1], np.int32)])
-        minor = np.concatenate([minor, np.zeros(extra, np.int32)])
-        pad_sid = np.full(extra, S, np.int32)
-        sid = np.concatenate([sid, pad_sid])
-        pad_runs = np.zeros((extra, RUN_FIELDS), np.int32)
-        pad_runs[:, 6] = 1  # pads must mask: sentinel slice = all-masked
-        runs = np.concatenate([runs, pad_runs.reshape(-1)])
-        return major, minor, sid, runs
+        return _append_pads_leveled(
+            major, minor, sid, runs, target - e, num_major, S
+        )
 
-    fwd = _pad_table(fwd, pad_entries_to)
-    bwd = _pad_table(bwd, pad_bwd_entries_to)
+    fwd = _pad_table(fwd, pad_entries_to, nq)
+    bwd = _pad_table(bwd, pad_bwd_entries_to, nk)
 
     n_slices_store = S if num_slices_padded is None else num_slices_padded
     assert n_slices_store >= S
@@ -454,19 +537,13 @@ def pad_block_meta(
     S = meta.num_slices
     assert num_slices_padded >= S
 
-    def pad_tab(major, minor, sid, runs, target, sentinel):
+    def pad_tab(major, minor, sid, runs, target, sentinel, num_major):
         e = major.shape[0]
         assert target >= e, f"table length {e} exceeds pad target {target}"
         if target == e:
             return major, minor, sid, runs
-        extra = target - e
-        pad_runs = np.zeros((extra, RUN_FIELDS), np.int32)
-        pad_runs[:, 6] = 1  # pads must mask: sentinel slice = all-masked
-        return (
-            np.concatenate([major, np.full(extra, major[-1], np.int32)]),
-            np.concatenate([minor, np.zeros(extra, np.int32)]),
-            np.concatenate([sid, np.full(extra, sentinel, np.int32)]),
-            np.concatenate([runs, pad_runs.reshape(-1)]),
+        return _append_pads_leveled(
+            major, minor, sid, runs, target - e, num_major, sentinel
         )
 
     fq, fk, fs, fr = pad_tab(
@@ -476,6 +553,7 @@ def pad_block_meta(
         meta.fwd_runs,
         pad_entries_to,
         S,
+        meta.num_q_blocks,
     )
     bk, bq, bs, br = pad_tab(
         meta.bwd_k_block,
@@ -484,6 +562,7 @@ def pad_block_meta(
         meta.bwd_runs,
         pad_bwd_entries_to,
         S,
+        meta.num_k_blocks,
     )
     bounds = np.zeros(((num_slices_padded + 1) * SLICE_FIELDS,), np.int32)
     bounds[: meta.slice_bounds.shape[0]] = meta.slice_bounds

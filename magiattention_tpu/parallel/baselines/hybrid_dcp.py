@@ -30,6 +30,7 @@ from ..dist_attn import (
     _headmajor_to_seq,
     _hm,
     _round_up,
+    ensure_kernel_steps,
 )
 
 
@@ -141,6 +142,7 @@ def hybrid_dcp_attn_local(
     assert not params.has_sink, (
         "attention sink is not supported by the hybrid-dcp baseline"
     )
+    params = ensure_kernel_steps(params, (plan.tables,))
     kg = jax.lax.all_gather(k, axis_name, tiled=True)  # [total, hk, d]
     vg = jax.lax.all_gather(v, axis_name, tiled=True)
     qh = _hm(q, plan.shard_q_pad)

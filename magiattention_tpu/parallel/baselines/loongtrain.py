@@ -28,6 +28,7 @@ from ..dist_attn import (
     _headmajor_to_seq,
     _hm,
     _round_up,
+    ensure_kernel_steps,
 )
 
 
@@ -121,6 +122,7 @@ def double_ring_attn_local(
     assert not params.has_sink, (
         "attention sink is not supported by the double-ring baseline"
     )
+    params = ensure_kernel_steps(params, plan.steps)
     fp32 = dataclasses.replace(params, out_dtype="float32")
     qh = _hm(q, plan.shard_q_pad)
     kv = jnp.stack([k, v], axis=0)

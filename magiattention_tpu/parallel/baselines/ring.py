@@ -25,7 +25,14 @@ import numpy as np
 from ...ops.block_meta import build_block_meta_general, Run
 from ...ops.correction import correct_attn_out_lse
 from ...ops.flex_attn import FlexAttnParams
-from ..dist_attn import StageTables, _call_kernel, _headmajor_to_seq, _hm, _round_up
+from ..dist_attn import (
+    StageTables,
+    _call_kernel,
+    _headmajor_to_seq,
+    _hm,
+    _round_up,
+    ensure_kernel_steps,
+)
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -102,6 +109,7 @@ def ring_attn_local(
     assert not params.has_sink, (
         "attention sink is not supported by the ring baseline"
     )
+    params = ensure_kernel_steps(params, plan.steps)
     cp = plan.cp_size
     fp32_params = dataclasses.replace(params, out_dtype="float32")
     qh = _hm(q, plan.shard_q_pad)

@@ -101,7 +101,14 @@ def ulysses_attn_local(
     qh = _hm(qg, tqp)
     kh = _hm(kg, tkp)
     vh = _hm(vg, tkp)
-    fp32_params = dataclasses.replace(params, out_dtype="float32")
+    fp32_params = dataclasses.replace(
+        params,
+        out_dtype="float32",
+        # tables become tracers under the surrounding jit; the row-major
+        # kernels need the static grid extents from the host-side meta
+        fwd_steps=params.fwd_steps or meta.fwd_steps,
+        bwd_steps=params.bwd_steps or meta.bwd_steps,
+    )
     out_h, lse_lanes, _ = flex_attn_headmajor(
         qh, kh, vh, fwd_tables(meta), bwd_tables(meta), fp32_params
     )

@@ -93,6 +93,18 @@ class StageTables:
             self.bounds,
         )
 
+    def kernel_steps(self) -> tuple[int, int]:
+        """(fwd, bwd) static inner-grid extents across ranks: the max
+        entries sharing one q block (fwd/dq) resp. k block (dkv). The
+        kernels run row-major grids (see FlexAttnParams.fwd_steps) and the
+        tables are traced per-rank slices at runtime, so these must be
+        computed host-side and carried in the params."""
+        from ..ops.block_meta import max_row_count
+
+        fs = max(max_row_count(row, 1) for row in self.fwd_qblk)
+        bs = max(max_row_count(row, 1) for row in self.bwd_kblk)
+        return fs, bs
+
     @staticmethod
     def from_rank_metas(metas: list[FlexAttnBlockMeta], kv_pad: int):
         e = max(m.num_fwd_entries for m in metas)
@@ -676,15 +688,28 @@ def make_attn_params(
         scale = 1.0 / math.sqrt(head_dim)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return FlexAttnParams(
-        head_block=int(head_block),
-        block_q=plan.block_q,
-        block_k=plan.block_k,
-        scale=float(scale),
-        softcap=float(softcap),
-        has_sink=has_sink,
-        out_dtype=str(jnp.dtype(out_dtype)),
-        interpret=bool(interpret),
+    # plan-wide static inner-grid extents: max over every table set the
+    # plan can hand the kernels (merged / host / per-stage / qo-comm) —
+    # the per-rank tables are traced at runtime, so the row-major grids
+    # need these in the hashable params (FlexAttnParams.fwd_steps)
+    tabs = (
+        getattr(plan, "merged_tables", None),
+        getattr(plan, "host_tables", None),
+        getattr(plan, "tables", None),
+        *(sp.tables for sp in getattr(plan, "stages", ()) or ()),
+    )
+    return ensure_kernel_steps(
+        FlexAttnParams(
+            head_block=int(head_block),
+            block_q=plan.block_q,
+            block_k=plan.block_k,
+            scale=float(scale),
+            softcap=float(softcap),
+            has_sink=has_sink,
+            out_dtype=str(jnp.dtype(out_dtype)),
+            interpret=bool(interpret),
+        ),
+        tabs,
     )
 
 
@@ -702,6 +727,32 @@ def _headmajor_to_seq(out_h, lse_lanes, n):
     out = jnp.transpose(out_h, (1, 0, 2))[:n]
     lse = jnp.transpose(lse_lanes[:, :, 0], (1, 0))[:n]
     return out, lse
+
+
+def ensure_kernel_steps(params: FlexAttnParams, tables) -> FlexAttnParams:
+    """Raise ``FlexAttnParams.fwd_steps``/``bwd_steps`` to cover the given
+    host-side :class:`StageTables`. At runtime the per-rank tables are
+    traced shard_map operands, so the row-major kernel grids need these
+    static extents in the params; callers that built params directly
+    (tests, baselines) get them derived here from the plan they already
+    hold. Always maxes against the tables — never trusts pre-set values
+    alone — so params built for one plan cannot silently under-cover a
+    different plan's tables (too-small steps would drop entries with no
+    error under tracing)."""
+    fs = bs = 0
+    for t in tables:
+        if t is None:
+            continue
+        a, b = t.kernel_steps()
+        fs = max(fs, a)
+        bs = max(bs, b)
+    if params.fwd_steps >= fs and params.bwd_steps >= bs:
+        return params
+    return dataclasses.replace(
+        params,
+        fwd_steps=max(params.fwd_steps, fs),
+        bwd_steps=max(params.bwd_steps, bs),
+    )
 
 
 def _call_kernel(qh, k_buf, v_buf, tab_arrays, kv_pad, params, sink):
@@ -731,6 +782,11 @@ def dist_attn_local(
     """
     from .. import env
 
+    params = ensure_kernel_steps(
+        params,
+        (plan.merged_tables, plan.host_tables,
+         *(sp.tables for sp in plan.stages)),
+    )
     qh = _hm(q, plan.shard_q_pad)
     kv = jnp.stack([k, v], axis=1)  # one all_to_all payload for K and V
     if env.is_backward_high_precision_reduce():

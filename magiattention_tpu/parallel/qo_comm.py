@@ -326,6 +326,9 @@ def qo_comm_attn_local(
         f"({plan.block_q},{plan.block_k}) — entry tables would be misread; "
         "derive params with make_attn_params(plan, head_dim)"
     )
+    from .dist_attn import ensure_kernel_steps
+
+    params = ensure_kernel_steps(params, (plan.tables,))
     kt = tables
     ktab = kt[:9]
     q_send, q_sel, q_valid, q_seg = kt[9:13]

@@ -266,21 +266,21 @@ def test_large_block_escalation_config():
 
 
 def test_auto_block_config_prefers_large_blocks_at_long_seq():
-    """>= 16k tokens: the (256, 1024) rung is preferred when it fits (the
-    measured bwd-throughput winner, BENCH_DETAIL.md); below 16k the
-    low-latency (128, 512) rung stays first; oversized masks still
-    escalate to (512, 2048)."""
+    """>= 16k tokens: the (1024, 1024) square rung is preferred (round-5
+    chained on-chip winner for fwd AND fwd+bwd at 64k causal on the
+    row-major grid); below 16k the low-latency (128, 512) rung stays
+    first; oversized masks still escalate to (512, 2048)."""
     from magiattention_tpu.ops.flex_attn import auto_block_config
 
     # short dense causal -> small rung
     assert auto_block_config([(0, 8192)], [(0, 8192)], 8, 8)[:2] == (128, 512)
-    # long dense causal -> measured bwd winner
+    # long dense causal -> measured winner
     assert auto_block_config([(0, 32768)], [(0, 32768)], 8, 8)[:2] == (
-        256,
+        1024,
         1024,
     )
-    # 128k dense: only the escalation rung fits the smem entry budget
-    assert auto_block_config([(0, 131072)], [(0, 131072)], 8, 8)[:2] == (
+    # 256k dense: only the k-wide escalation rung fits the entry budget
+    assert auto_block_config([(0, 262144)], [(0, 262144)], 8, 8)[:2] == (
         512,
         2048,
     )
@@ -308,20 +308,22 @@ def test_auto_block_config_partially_fixed_blocks_key_hb_on_block_k():
     sized against)."""
     from magiattention_tpu.ops.flex_attn import auto_block_config
 
-    # fixed small block_k at long seqlen: bq iterates to 256, and
-    # (256, 512) is itself a measured rung -> hb 4
+    # fixed small block_k at long seqlen: bq iterates to 1024 (square
+    # rung first); (1024, 512) is unmeasured, so hb keys on block_k -> 4
     assert auto_block_config(
         [(0, 32768)], [(0, 32768)], 8, 8, fixed_block_k=512
-    ) == (256, 512, 4)
+    ) == (1024, 512, 4)
     # a mixed pair no rung measures (bq=512 fixed, bk=512): hb keys on
     # block_k alone -> 4, not the iterating wide rung's 2/1
     assert auto_block_config(
         [(0, 32768)], [(0, 32768)], 8, 8, fixed_block_q=512, fixed_block_k=512
     )[2] == 4
-    # fixed small block_q at long seqlen: bk iterates to 1024 -> hb 2
+    # fixed small block_q at long seqlen: bk iterates to 1024; the
+    # (128, 1024) pair is unmeasured, so hb keys on block_k -> the most
+    # conservative measured hb for bk=1024 (min of 2 and 1 = 1)
     assert auto_block_config(
         [(0, 32768)], [(0, 32768)], 8, 8, fixed_block_q=128
-    ) == (128, 1024, 2)
+    ) == (128, 1024, 1)
 
 
 def test_auto_block_config_long_keys_short_queries():
@@ -330,6 +332,6 @@ def test_auto_block_config_long_keys_short_queries():
     from magiattention_tpu.ops.flex_attn import auto_block_config
 
     assert auto_block_config([(0, 4096)], [(0, 131072)], 8, 8)[:2] == (
-        256,
+        1024,
         1024,
     )
