@@ -248,7 +248,11 @@ def main() -> None:
                 index_attn_func,
             )
 
-            bq = bk = 128
+            # 128-token sparse blocks up to 32k, 256 at 64k, 512 at 128k+:
+            # the keep-4th pattern at 128 granularity emits ~33k entries at
+            # 64k, past the kernels' ~1 MB scalar-prefetch SMEM budget
+            # (flex_attn._check_smem_budget rejects it loudly)
+            bq = bk = 128 if total <= 32768 else (256 if total <= 65536 else 512)
             nq, nk = total // bq, total // bk
             sparse_cases = []
             for keepth_name, keep in (

@@ -436,6 +436,64 @@ def build_block_meta_general(
             else np.empty((0, 9), dtype=np.int64)
         )
 
+    # exact area: intersect each slice with the runs (a slice may reference
+    # global rows/cols this rank does not hold)
+    from ..csrc import slice_area_runs_native
+
+    area_native = slice_area_runs_native(slices, q_runs_arr, k_runs_arr)
+    if area_native is not None:
+        area = area_native
+    else:
+        area = 0
+        for sid in range(S):
+            qs, qe, ks, ke, mt = (int(x) for x in slices[sid])
+            for qr in q_runs:
+                a, b = max(qs, qr.global_start), min(qe, qr.global_end)
+                if a >= b:
+                    continue
+                k_lo, k_hi = _slice_k_span(a, b, ks, ke, qs, qe, mt)
+                for kr in k_runs:
+                    c, d = max(k_lo, kr.global_start), min(k_hi, kr.global_end)
+                    if c >= d:
+                        continue
+                    area += _sub_area(a, b, c, d, qs, qe, ks, ke, mt)
+
+    return assemble_block_meta(
+        entries,
+        slices,
+        total_q,
+        total_k,
+        block_q,
+        block_k,
+        int(area),
+        entry_pad=entry_pad,
+        pad_entries_to=pad_entries_to,
+        pad_bwd_entries_to=pad_bwd_entries_to,
+        num_slices_padded=num_slices_padded,
+    )
+
+
+def assemble_block_meta(
+    entries: np.ndarray,  # [E, 9] (qblk, kblk, sid, ql0, ql1, kl0, kl1, qoff, koff)
+    slices: np.ndarray,  # [S, SLICE_FIELDS]
+    total_q: int,
+    total_k: int,
+    block_q: int,
+    block_k: int,
+    total_area: int,
+    *,
+    entry_pad: int = 8,
+    pad_entries_to: int | None = None,
+    pad_bwd_entries_to: int | None = None,
+    num_slices_padded: int | None = None,
+) -> FlexAttnBlockMeta:
+    """Entries + slices -> FlexAttnBlockMeta: sort both orientations, add
+    dummies/pads, assemble bounds. Shared by the general slice-emission
+    builder and planners that emit entries directly (block-sparse), so
+    table-ABI details live in exactly one place."""
+    S = slices.shape[0]
+    nq = max(_cdiv(total_q, block_q), 1)
+    nk = max(_cdiv(total_k, block_k), 1)
     fwd = _build_table(
         entries.copy(), nq, S, entry_pad, major_col=0,
         slices_for_flags=slices, block_q_f=block_q, block_k_f=block_k,
@@ -466,28 +524,6 @@ def build_block_meta_general(
     bounds[:S] = slices
     # rows S..n_slices_store stay all-zero (sentinels: empty range = all-masked)
 
-    # exact area: intersect each slice with the runs (a slice may reference
-    # global rows/cols this rank does not hold)
-    from ..csrc import slice_area_runs_native
-
-    area_native = slice_area_runs_native(slices, q_runs_arr, k_runs_arr)
-    if area_native is not None:
-        area = area_native
-    else:
-        area = 0
-        for sid in range(S):
-            qs, qe, ks, ke, mt = (int(x) for x in slices[sid])
-            for qr in q_runs:
-                a, b = max(qs, qr.global_start), min(qe, qr.global_end)
-                if a >= b:
-                    continue
-                k_lo, k_hi = _slice_k_span(a, b, ks, ke, qs, qe, mt)
-                for kr in k_runs:
-                    c, d = max(k_lo, kr.global_start), min(k_hi, kr.global_end)
-                    if c >= d:
-                        continue
-                    area += _sub_area(a, b, c, d, qs, qe, ks, ke, mt)
-
     return FlexAttnBlockMeta(
         total_q=total_q,
         total_k=total_k,
@@ -496,7 +532,7 @@ def build_block_meta_general(
         num_q_blocks=nq,
         num_k_blocks=nk,
         num_slices=n_slices_store,
-        total_area=int(area),
+        total_area=int(total_area),
         fwd_q_block=fwd[0],
         fwd_k_block=fwd[1],
         fwd_slice_id=fwd[2],

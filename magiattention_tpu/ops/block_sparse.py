@@ -4,10 +4,15 @@ Role of reference block-sparse / sparse-load modes (flex_flash_attn.py
 sparse options :1110-1123, utils/sparse_utils.py, tests/
 test_block_sparse_attn.py): attention where a boolean block mask
 [num_q_blocks, num_k_blocks] says which tiles compute. The entry-table
-kernel is natively block-sparse — each True block becomes one entry (a FULL
-slice covering exactly that tile), so this is a thin planning adapter with
-zero kernel changes. Optionally a causal constraint is applied on top
-(diagonal blocks get the causal mask type).
+kernel is natively block-sparse — each True block becomes one kernel
+ENTRY whose run window bounds exactly that tile, against at most TWO
+global slices (FULL for interior tiles; a CAUSAL slice aligned to the
+global token diagonal for diagonal-crossing tiles). Entries are emitted
+directly — the earlier one-*slice*-per-tile construction put the whole
+kept-block list into the kernel's SMEM bounds table (~33k slices x 20 B
+at 64k keep-4th: past the ~1 MB SMEM budget, crashing compilation);
+per-entry windows cost nothing extra because every entry carries them
+anyway.
 """
 
 from __future__ import annotations
@@ -16,7 +21,11 @@ import functools
 
 import numpy as np
 
-from .block_meta import FlexAttnBlockMeta, Run, build_block_meta_general
+from .block_meta import (
+    FlexAttnBlockMeta,
+    _sub_area,
+    assemble_block_meta,
+)
 
 
 def build_block_meta_from_block_mask(
@@ -28,9 +37,10 @@ def build_block_meta_from_block_mask(
     block_k: int = 128,
     causal: bool = False,
 ) -> FlexAttnBlockMeta:
-    """One slice per True tile; with ``causal``, tiles strictly above the
-    token diagonal are dropped and diagonal-crossing tiles become CAUSAL
-    (bottom-right aligned to the global diagonal — standard block-causal
+    """One kernel entry per True tile; with ``causal``, tiles strictly
+    above the token diagonal are dropped and diagonal-crossing tiles
+    reference the global CAUSAL slice (bottom-right aligned: keep
+    (q, k) iff k <= q + (total_k - total_q) — standard block-causal
     semantics for square masks)."""
     bm = np.asarray(block_mask, dtype=bool)
     nq = -(-total_q // block_q)
@@ -39,50 +49,51 @@ def build_block_meta_from_block_mask(
         f"block_mask shape {bm.shape} != blocks ({nq}, {nk}) for "
         f"({total_q}, {total_k}) at ({block_q}, {block_k})"
     )
-    slices = []
-    for i in range(nq):
-        q0, q1 = i * block_q, min((i + 1) * block_q, total_q)
-        for j in range(nk):
-            if not bm[i, j]:
-                continue
-            k0, k1 = j * block_k, min((j + 1) * block_k, total_k)
-            if causal:
-                # token-level causal on the global diagonal:
-                # keep (q, k) iff k <= q + (total_k - total_q)
-                off = total_k - total_q
-                if k0 > q1 - 1 + off:
-                    continue  # fully above the diagonal
-                if k1 - 1 <= q0 + off:
-                    slices.append((q0, q1, k0, k1, 0))  # fully below: FULL
-                elif k1 >= q1 + off:
-                    # diagonal exits through the bottom edge: one CAUSAL
-                    # slice whose bottom-right corner (q1-1, q1-1+off) sits
-                    # on the diagonal, so k <= q + (ke - qe) == q + off
-                    slices.append((q0, q1, k0, q1 + off, 1))
-                else:
-                    # diagonal exits through the right edge (k1 < q1 + off,
-                    # e.g. block_k < block_q or a ragged last k tile): rows
-                    # q >= k1 - off already see the full tile width; rows
-                    # above them form a CAUSAL slice whose bottom-right
-                    # corner (k1-off-1, k1-1) sits on the diagonal
-                    qsplit = k1 - off
-                    slices.append((q0, qsplit, k0, k1, 1))
-                    slices.append((qsplit, q1, k0, k1, 0))
-                continue
-            slices.append((q0, q1, k0, k1, 0))
-    sl = (
-        np.asarray(slices, dtype=np.int64)
-        if slices
-        else np.empty((0, 5), dtype=np.int64)
+    off = total_k - total_q
+    # at most two slices, both spanning the whole problem
+    slices = np.asarray(
+        [
+            (0, total_q, 0, total_k, 0),  # sid 0: FULL
+            (0, total_q, 0, total_k, 1),  # sid 1: CAUSAL on the diagonal
+        ],
+        dtype=np.int64,
     )
-    return build_block_meta_general(
-        sl,
-        [Run(0, 0, total_q)],
-        [Run(0, 0, total_k)],
-        total_q,
-        total_k,
-        block_q=block_q,
-        block_k=block_k,
+    iq, jk = np.nonzero(bm)
+    q0 = iq * block_q
+    q1 = np.minimum(q0 + block_q, total_q)
+    k0 = jk * block_k
+    k1 = np.minimum(k0 + block_k, total_k)
+    if causal:
+        keep = k0 <= (q1 - 1 + off)  # drop tiles fully above the diagonal
+        iq, jk, q0, q1, k0, k1 = (
+            a[keep] for a in (iq, jk, q0, q1, k0, k1)
+        )
+        crossing = (k1 - 1) > (q0 + off)  # diagonal passes through tile
+        sid = np.where(crossing, 1, 0)
+    else:
+        sid = np.zeros(iq.shape[0], dtype=np.int64)
+    entries = np.stack(
+        [iq, jk, sid, q0, q1, k0, k1,
+         np.zeros_like(iq), np.zeros_like(iq)],
+        axis=1,
+    ).astype(np.int64)
+
+    # exact kept area (the bench FLOPs convention counts kept pairs):
+    # interior tiles contribute rows*cols vectorized; only the ~nq
+    # diagonal-crossing tiles need the per-row causal count (_sub_area)
+    rows = q1 - q0
+    cols = k1 - k0
+    area = int((rows * cols)[sid == 0].sum()) if len(sid) else 0
+    if causal:
+        for a, b, c, d in zip(
+            q0[sid == 1], q1[sid == 1], k0[sid == 1], k1[sid == 1]
+        ):
+            area += _sub_area(
+                int(a), int(b), int(c), int(d), 0, total_q, 0, total_k, 1
+            )
+
+    return assemble_block_meta(
+        entries, slices, total_q, total_k, block_q, block_k, area
     )
 
 

@@ -1140,6 +1140,7 @@ def flex_attn_headmajor(
         return _fwd_jnp(q, k, v, sink2d, tuple(ftab), params)
     if env.kernel_backend() == "jnp_online":
         return _fwd_jnp_online(q, k, v, sink2d, tuple(ftab), params)
+    _check_smem_budget(ftab, btab, q.shape[1], k.shape[1], params)
     return _flex_attn_core(q, k, v, sink2d, tuple(ftab), tuple(btab), params)
 
 
@@ -1201,6 +1202,41 @@ def flex_attn_with_meta(
         max_logits = jnp.max(rowmax_lanes[:, :, 0], axis=1)
         return out, lse, max_logits
     return out, lse
+
+
+# Per-kernel SMEM budget for the scalar-prefetch tables. The v5e scalar
+# core has ~1 MB of SMEM; past it the backend's compiler crashes with an
+# opaque internal error (observed: HTTP 500 from the remote compile
+# helper at ~33k entries x 40 B), so fail loudly host-side first. Sized
+# so plans at _MAX_SMEM_ENTRIES (the auto-config escalation bound,
+# 24000 x 40 B = 960 KB) stay inside it.
+_SMEM_BUDGET_BYTES = 1_048_576
+
+
+def _check_smem_budget(ftab, btab, tqp: int, tkp: int, params) -> None:
+    """Reject plans whose scalar-prefetch tables exceed the chip's SMEM.
+
+    Runs on every compiled launch (table SHAPES are static even when the
+    contents are traced per-rank slices, so the distributed path is
+    covered too); interpret mode has no SMEM and skips the check.
+    """
+    if params.interpret:
+        return
+    per_entry = 4 * (3 + RUN_FIELDS)  # major+minor+sid + run fields, int32
+    fixed = int(ftab[4].shape[0]) * 4 + 4 * 2 * (
+        tqp // params.block_q + tkp // params.block_k
+    )
+    worst = max(int(ftab[0].shape[0]), int(btab[0].shape[0]))
+    est = worst * per_entry + fixed
+    if est > _SMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"flex-attn plan needs ~{est // 1024} KiB of scalar-prefetch "
+            f"SMEM ({worst} entries x {per_entry} B + {fixed} B bounds/row "
+            f"tables), past the ~{_SMEM_BUDGET_BYTES // 1024} KiB budget — "
+            "the backend compiler crashes opaquely beyond it. Use larger "
+            "block_q/block_k (fewer, bigger tiles), a coarser sparse block "
+            "granularity, or merge adjacent mask slices."
+        )
 
 
 _AUTO_BLOCK_CONFIGS: tuple[tuple[int, int, int], ...] = (
