@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -42,3 +42,9 @@ typecheck:
 # host-side planning latency sweep (no devices needed)
 bench-plan:
 	$(PY) exps/run_plan_bench.py
+
+# telemetry drift guard: build a tiny CPU-backend plan with telemetry on
+# and assert the snapshot carries every metric docs/observability.md
+# documents (exps/run_telemetry_check.py exits non-zero on drift)
+telemetry-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_telemetry_check.py

@@ -31,6 +31,7 @@ import time
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _CACHE = os.path.join(_HERE, "BENCH_CACHE.json")
+_TELEMETRY_OUT = os.path.join(_HERE, "BENCH_TELEMETRY.json")
 _KEYS = ("metric", "value", "unit", "vs_baseline")
 
 sys.path.insert(0, _HERE)
@@ -114,9 +115,126 @@ def _run_real_and_cache() -> None:
     print(json.dumps(payload))
 
 
+def _telemetry_block() -> None:
+    """Per-run observability block (ISSUE 1): build the representative
+    distributed plan HOST-SIDE with telemetry on, print the summary to
+    stderr, and archive the full snapshot next to the BENCH_*.json
+    artifacts (same schema style: one committed JSON file).
+
+    Planning is pure numpy — no devices, no tunnel — so this works (and
+    records real comm-bytes / imbalance / overlap numbers for the bench
+    shape) even on rounds where the TPU tunnel is wedged. Never fatal:
+    the driver's one-JSON-line stdout contract is sacred.
+    """
+    try:
+        from magiattention_tpu import env, telemetry
+        from magiattention_tpu.common.enum import AttnMaskType
+        from magiattention_tpu.common.ranges import AttnRanges
+        from magiattention_tpu.meta.dispatch_meta import (
+            make_dispatch_meta_from_qk_ranges,
+        )
+        from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+        from magiattention_tpu.parallel.dist_attn import build_dist_attn_plan
+        from magiattention_tpu.utils.cost import (
+            get_calc_cost_factor,
+            get_comm_cost_factor,
+        )
+
+        telemetry.set_enabled(True)
+        telemetry.reset()
+        # the dist_bench reference shape: 64k causal over cp=4, auto degree
+        total, cp, hq, hkv, d = 65536, 4, 8, 8, 128
+        chunk = total // (env.min_chunks_per_rank() * cp)
+        qr = AttnRanges.from_ranges([(0, total)])
+        kr = AttnRanges.from_ranges([(0, total)])
+        mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+            qr, kr, [AttnMaskType.CAUSAL], total, total,
+            chunk_size=chunk, cp_size=cp,
+        )
+        gen = env.tpu_generation()
+        oc = OverlapConfig(
+            degree=None,
+            calc_cost_factor=get_calc_cost_factor(hq, d, gen),
+            comm_cost_factor=get_comm_cost_factor(hkv, d, gen),
+        )
+        plan = build_dist_attn_plan(mq, bucket, overlap_config=oc)
+        telemetry.record_runtime_costs(
+            plan, num_heads_q=hq, num_heads_kv=hkv, head_dim=d,
+            bytes_per_elt=2, generation=gen,
+        )
+        snap = telemetry.snapshot()
+        payload = {
+            "provenance": (
+                "host-side plan telemetry for the bench shape (64k causal "
+                "bf16, cp=4, auto overlap degree); see docs/observability.md"
+            ),
+            "recorded_unix": int(time.time()),
+            "snapshot": snap,
+        }
+        tmp = _TELEMETRY_OUT + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, _TELEMETRY_OUT)
+        print(telemetry.telemetry_summary(snap), file=sys.stderr)
+        print(f"telemetry snapshot -> {_TELEMETRY_OUT}", file=sys.stderr)
+    except Exception as e:  # observability must never take the bench down
+        print(f"telemetry block failed: {e!r}", file=sys.stderr)
+    finally:
+        try:
+            from magiattention_tpu import telemetry
+
+            telemetry.set_enabled(None)
+        except Exception:
+            pass
+
+
+def _start_telemetry_subprocess():
+    """Launch :func:`_telemetry_block` in a CPU-pinned subprocess,
+    CONCURRENT with the measurement (host planning vs TPU kernels — no
+    contention), so it adds no serial wall-clock to the bench.
+
+    The block only needs host-side planning, but it imports jax — and in
+    the driver's parent process the axon TPU plugin could wedge backend
+    init. A subprocess with JAX_PLATFORMS=cpu keeps the parent (and the
+    stdout one-JSON-line contract) safe. Returns the Popen handle or
+    None; observability must never take the bench down.
+    """
+    try:
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--telemetry"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=_HERE,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+    except Exception as e:
+        print(f"telemetry subprocess failed to launch: {e!r}", file=sys.stderr)
+        return None
+
+
+def _finish_telemetry_subprocess(proc) -> None:
+    """Join the telemetry child (its output goes to stderr)."""
+    if proc is None:
+        return
+    try:
+        out, _ = proc.communicate(
+            timeout=int(os.environ.get("MAGI_TPU_TELEMETRY_TIMEOUT", "300"))
+        )
+        if out:
+            sys.stderr.write(out)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        print("telemetry subprocess timed out; killed", file=sys.stderr)
+    except Exception as e:
+        print(f"telemetry subprocess failed: {e!r}", file=sys.stderr)
+
+
 def main() -> None:
     """Driver entry: subprocess with timeout; cached fallback."""
     timeout_s = int(os.environ.get("MAGI_TPU_BENCH_TIMEOUT", "1500"))
+    telemetry_proc = _start_telemetry_subprocess()
     line = None
     degraded_line = None
     try:
@@ -169,6 +287,7 @@ def main() -> None:
             "falling back to cache",
             file=sys.stderr,
         )
+    _finish_telemetry_subprocess(telemetry_proc)
     if line is None:
         try:
             with open(_CACHE) as f:
@@ -435,5 +554,7 @@ def _measure_extras(dt_fwd_64k: float) -> dict:
 if __name__ == "__main__":
     if "--real" in sys.argv[1:]:
         _run_real_and_cache()
+    elif "--telemetry" in sys.argv[1:]:
+        _telemetry_block()
     else:
         main()

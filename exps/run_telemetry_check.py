@@ -1,0 +1,110 @@
+"""Telemetry drift guard (``make telemetry-check``).
+
+Builds a tiny CPU-backend distributed plan with telemetry enabled and
+asserts the snapshot contains every metric name the documentation
+promises (``telemetry.REQUIRED_PLAN_METRICS`` — the same catalog
+``docs/observability.md`` documents). If a refactor renames or drops a
+metric without updating the catalog/docs, this exits non-zero.
+
+Also sanity-checks the two structured exporters (metrics JSON + Chrome
+trace events JSON) and the disabled-mode no-op contract, so the guard
+covers the full acceptance surface of ISSUE 1 without needing devices.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from magiattention_tpu import telemetry  # noqa: E402
+from magiattention_tpu.common.enum import AttnMaskType  # noqa: E402
+from magiattention_tpu.common.ranges import AttnRanges  # noqa: E402
+from magiattention_tpu.meta.dispatch_meta import (  # noqa: E402
+    make_dispatch_meta_from_qk_ranges,
+)
+from magiattention_tpu.parallel.dist_attn import (  # noqa: E402
+    build_dist_attn_plan,
+)
+
+
+def has_series(snapshot: dict, name: str) -> bool:
+    """A metric is present if any section holds the bare name or a
+    labeled ``name{...}`` series."""
+    for section in snapshot.values():
+        for key in section:
+            if key == name or key.startswith(name + "{"):
+                return True
+    return False
+
+
+def main() -> int:
+    # 1. disabled mode records nothing
+    telemetry.set_enabled(False)
+    telemetry.reset()
+    total, cp, chunk = 2048, 4, 256
+    qr = AttnRanges.from_ranges([(0, total)])
+    kr = AttnRanges.from_ranges([(0, total)])
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, [AttnMaskType.CAUSAL], total, total,
+        chunk_size=chunk, cp_size=cp,
+    )
+    build_dist_attn_plan(mq, bucket)
+    snap = telemetry.snapshot()
+    if any(snap.values()):
+        print(f"FAIL: disabled-mode telemetry recorded data: {snap}")
+        return 1
+
+    # 2. enabled mode populates the documented catalog
+    telemetry.set_enabled(True)
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, [AttnMaskType.CAUSAL], total, total,
+        chunk_size=chunk, cp_size=cp,
+    )
+    with telemetry.span("telemetry-check"):
+        plan = build_dist_attn_plan(mq, bucket)
+    telemetry.record_runtime_costs(
+        plan, num_heads_q=8, num_heads_kv=8, head_dim=128,
+        bytes_per_elt=2, generation="v5e",
+    )
+    snap = telemetry.snapshot()
+    missing = [
+        m for m in telemetry.REQUIRED_PLAN_METRICS
+        if not has_series(snap, m)
+    ]
+    if missing:
+        print(
+            "FAIL: documented metrics missing from a real plan snapshot "
+            f"(catalog drift): {missing}"
+        )
+        return 1
+
+    # 3. exporters round-trip through JSON
+    with tempfile.TemporaryDirectory() as d:
+        mpath = telemetry.dump_metrics(os.path.join(d, "metrics.json"))
+        epath = telemetry.dump_events(os.path.join(d, "events.json"))
+        with open(mpath) as f:
+            if json.load(f) != snap:
+                print("FAIL: dump_metrics does not round-trip the snapshot")
+                return 1
+        with open(epath) as f:
+            trace = json.load(f)
+        if "traceEvents" not in trace or not trace["traceEvents"]:
+            print(f"FAIL: dump_events wrote no trace events: {trace}")
+            return 1
+
+    telemetry.set_enabled(None)
+    print(
+        f"telemetry-check OK: {len(telemetry.REQUIRED_PLAN_METRICS)} "
+        "documented metrics present, exporters round-trip, disabled mode "
+        "is a no-op"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,27 +18,14 @@ Layering (mirrors reference SURVEY.md layer map, re-designed TPU-first):
 
 __version__ = "0.4.0"
 
-import logging as _logging
-import os as _os
+# reference magi_attention/__init__.py:61-83 — an explicitly-set
+# MAGI_ATTENTION_LOG_LEVEL (env.log_level()) sets the package logger's
+# level and attaches a formatted stderr handler (unknown values degrade
+# to WARNING instead of crashing the import); unset leaves the logger
+# untouched so embedders' own logging config stays in control
+from .telemetry.logger import configure_logging as _configure_logging
 
-# reference magi_attention/__init__.py:61-83 — attach a formatted handler
-# when MAGI_ATTENTION_LOG_LEVEL is set; unknown values degrade to WARNING
-# (reference env/general.py:66-67) instead of crashing the import
-_level_name = _os.environ.get("MAGI_ATTENTION_LOG_LEVEL")
-logger = _logging.getLogger("magiattention_tpu")
-if _level_name:
-    _level = getattr(_logging, _level_name.strip().upper(), None)
-    if not isinstance(_level, int):
-        _level = _logging.WARNING
-    _h = _logging.StreamHandler()
-    _h.setFormatter(
-        _logging.Formatter(
-            "[%(asctime)s][%(name)s][%(levelname)s] %(message)s"
-        )
-    )
-    logger.addHandler(_h)
-    logger.setLevel(_level)
-    logger.propagate = False
+logger = _configure_logging()
 
 from . import common  # noqa: F401,E402
 from .env import recommended_compiler_options  # noqa: F401,E402
@@ -52,7 +39,7 @@ def __getattr__(name):
 
     if name in (
         "api", "benchmarking", "comm", "config", "env", "meta", "models",
-        "ops", "parallel", "testing", "utils",
+        "ops", "parallel", "telemetry", "testing", "utils",
     ):
         return importlib.import_module(f".{name}", __name__)
     if name in ("init_dist_attn_runtime_key", "init_dist_attn_runtime_mgr"):
@@ -76,6 +63,7 @@ __all__ = [
     "ops",
     "parallel",
     "recommended_compiler_options",
+    "telemetry",
     "testing",
     "utils",
     "__version__",

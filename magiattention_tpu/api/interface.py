@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import env
+from .. import env, telemetry
 from ..common.enum import AttnMaskType
 from ..common.ranges import AttnRanges
 from ..meta.dispatch_meta import DispatchMeta, make_dispatch_meta_from_qk_ranges
@@ -545,8 +545,10 @@ def magi_attn_flex_key(
         flags=env.flags_fingerprint(),
     )
     if key in _runtime_dict:
+        telemetry.record_cache_access(hit=True)
         _most_recent_key = key
         return key
+    telemetry.record_cache_access(hit=False)
 
     # cold path: full planning
     mq, _, bucket = make_dispatch_meta_from_qk_ranges(
@@ -614,6 +616,14 @@ def magi_attn_flex_key(
         block_k=env.block_k(),
         overlap_config=dist_attn_config.overlap_config,
         cp_mesh_shape=cp_mesh_shape,
+    )
+    telemetry.record_runtime_costs(
+        plan,
+        num_heads_q=hq,
+        num_heads_kv=hkv,
+        head_dim=head_dim,
+        bytes_per_elt=jnp.dtype(out_dtype).itemsize,
+        generation=env.tpu_generation(),
     )
     if logger.isEnabledFor(logging.INFO):
         logger.info(
@@ -785,8 +795,10 @@ def magi_attn_cross_key(
         flags=env.flags_fingerprint(),
     )
     if key in _runtime_dict:
+        telemetry.record_cache_access(hit=True)
         _most_recent_key = key
         return key
+    telemetry.record_cache_access(hit=False)
 
     from ..meta.dispatch_meta import make_cross_attn_dispatch_meta
 
@@ -808,6 +820,14 @@ def magi_attn_cross_key(
         block_q=env.block_q(),
         block_k=env.block_k(),
         overlap_config=overlap_config,
+    )
+    telemetry.record_runtime_costs(
+        plan,
+        num_heads_q=hq,
+        num_heads_kv=hkv,
+        head_dim=head_dim,
+        bytes_per_elt=jnp.dtype(out_dtype).itemsize,
+        generation=env.tpu_generation(),
     )
     from ..ops.flex_attn import _auto_head_block
 
@@ -921,8 +941,10 @@ def make_flex_key_for_new_mask_after_dispatch(
         attn_type_map=types,
     )
     if new_key in _runtime_dict:
+        telemetry.record_cache_access(hit=True)
         _most_recent_key = new_key
         return new_key
+    telemetry.record_cache_access(hit=False)
 
     from ..meta.dispatch_meta import make_global_bucket_from_qk_ranges
 
@@ -943,6 +965,14 @@ def make_flex_key_for_new_mask_after_dispatch(
         block_k=env.block_k(),
         overlap_config=overlap,
         cp_mesh_shape=old_mgr.plan.hier,
+    )
+    telemetry.record_runtime_costs(
+        plan,
+        num_heads_q=new_key.num_heads_q,
+        num_heads_kv=new_key.num_heads_kv,
+        head_dim=new_key.head_dim,
+        bytes_per_elt=jnp.dtype(new_key.out_dtype).itemsize,
+        generation=env.tpu_generation(),
     )
     from ..ops.flex_attn import _auto_head_block
 
@@ -1037,6 +1067,17 @@ def magi_attn_varlen_dispatch(
         cu_seqlens, total_seqlen, mesh, causal=causal, **kwargs
     )
     return dispatch(x, key), key
+
+
+def get_telemetry_snapshot() -> dict:
+    """Plain-dict snapshot of the runtime telemetry registry (ISSUE 1):
+    plan/comm/solver introspection recorded while
+    ``MAGI_ATTENTION_TELEMETRY`` (or ``telemetry.set_enabled(True)``) was
+    on — per-rank comm rows/bytes, chunk imbalance, overlap degree,
+    kernel step counts, modeled FLOP/comm cost, cache hit rates. Always
+    JSON-serializable; empty sections while telemetry is disabled. See
+    ``docs/observability.md`` for the metric catalog."""
+    return telemetry.snapshot()
 
 
 def clear_cache(mesh: "jax.sharding.Mesh | None" = None) -> None:

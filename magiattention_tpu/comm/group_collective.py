@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
+
 NEG_INF = float("-inf")
 
 
@@ -101,7 +103,7 @@ class GroupCollectiveMeta:
                 recv_sel[d, pos : pos + n] = s * S + np.arange(n)
                 recv_valid[d, pos : pos + n] = True
                 pos += n
-        return GroupCollectiveMeta(
+        meta = GroupCollectiveMeta(
             cp_size=cp,
             max_send=S,
             max_recv=R,
@@ -112,6 +114,8 @@ class GroupCollectiveMeta:
             recv_valid=recv_valid,
             seg_ids=seg_ids,
         )
+        telemetry.record_group_collective_build(meta)
+        return meta
 
     # device-array views (leading cp axis -> shard over the cp mesh axis)
     def device_args(self):

@@ -29,7 +29,31 @@ def _env_str(name: str, default: str) -> str:
 
 
 def log_level() -> str:
+    """Logging level for the ``magiattention_tpu`` logger tree; consumed
+    by :func:`magiattention_tpu.telemetry.logger.configure_logging` at
+    package import."""
     return _env_str("MAGI_ATTENTION_LOG_LEVEL", "WARNING")
+
+
+def is_telemetry_enabled() -> bool:
+    """Turn on the runtime telemetry layer (``telemetry/``): plan/comm/
+    solver introspection metrics + host-side span events. Off by default;
+    the disabled path is a no-op predicate per hook. Pure observability —
+    never influences planning, so NOT part of :func:`flags_fingerprint`."""
+    return _env_bool("MAGI_ATTENTION_TELEMETRY")
+
+
+def telemetry_ring_size() -> int:
+    """Capacity of the host-side span-event ring buffer (most recent N
+    spans are kept; see telemetry/events.py)."""
+    return _env_int("MAGI_ATTENTION_TELEMETRY_RING_SIZE", 4096)
+
+
+def trace_dir() -> str:
+    """Default XLA profiler trace directory used by
+    ``utils/instrument.py::switch_profile`` when profile mode is on and no
+    explicit ``trace_dir`` is passed."""
+    return _env_str("MAGI_ATTENTION_TRACE_DIR", "./magi_attention_trace")
 
 
 def is_sanity_check_enabled() -> bool:
@@ -145,9 +169,12 @@ def is_cpp_backend_enabled() -> bool:
 
 
 def is_profile_mode() -> bool:
-    """Informational (reference MAGI_ATTENTION_PROFILE_MODE): the profiler
-    helpers in utils/instrument.py are invoked programmatically; named
-    scopes are always annotated."""
+    """Default-on switch for the profiler helpers (reference
+    MAGI_ATTENTION_PROFILE_MODE): ``switch_profile()`` with no explicit
+    ``trace_dir`` starts an XLA trace into :func:`trace_dir`, and
+    ``instrument_trace`` / ``add_trace_event`` annotate named scopes
+    (they are zero-cost passthroughs when this and telemetry are both
+    off)."""
     return _env_bool("MAGI_ATTENTION_PROFILE_MODE", False)
 
 

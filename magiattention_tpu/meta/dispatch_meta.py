@@ -8,11 +8,13 @@ permutation (position ids / perm indices) that dispatch/undispatch apply.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Sequence
 
 import numpy as np
 
+from .. import telemetry
 from ..common.enum import AttnMaskType, DispatchAlgType
 from ..common.range import AttnRange
 from ..common.ranges import AttnRanges
@@ -186,18 +188,27 @@ def _solve_q_partitions(
         affinities = [
             IOUAffinity.from_ranges(c.k_ranges.merge()) for c in bucket.q_chunks
         ]
+    t0 = time.perf_counter()
     solution = DispatchSolver(dispatch_config.alg).solve(
         DispatchData(
             jobs=DispatchJob.from_job_list(workloads, affinities),
             num_buckets=cp_size,
         )
     )
+    solve_s = time.perf_counter() - t0
     assert solution.bucket_partitions, (
         f"{dispatch_config.alg.type} does not return partitions; "
         "choose a partition-returning algorithm for dispatch"
     )
     partitions = [sorted(p) for p in solution.bucket_partitions]
     assert sorted(x for p in partitions for x in p) == list(range(num_chunks))
+    if telemetry.enabled():  # keep the O(num_chunks) sums off the disabled path
+        telemetry.record_dispatch_solution(
+            dispatch_config.alg.type.value,
+            solution.minimax_workload,
+            [sum(workloads[i] for i in p) for p in partitions],
+            solve_s,
+        )
     return partitions
 
 
@@ -261,6 +272,7 @@ def make_cross_attn_dispatch_meta(
             for r in range(cp_size)
         ),
     )
+    telemetry.record_dispatch_meta(meta_q)
     return meta_q, meta_k, bucket
 
 
@@ -305,5 +317,6 @@ def make_dispatch_meta_from_qk_ranges(
         cp_size=cp_size,
         partitions=tuple(tuple(p) for p in partitions),
     )
+    telemetry.record_dispatch_meta(meta)
     # self-attn: K/V follow the same partition
     return meta, meta, bucket

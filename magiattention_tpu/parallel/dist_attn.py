@@ -33,11 +33,13 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
 from ..common.ranges import AttnRanges
 from ..comm.group_collective import GroupCollectiveMeta, group_cast
 from ..comm.hier import HierGroupCollectiveMeta, group_cast_hier
@@ -391,6 +393,7 @@ def _choose_overlap_degree(
             )
         if t < best_t * (1.0 - 1e-9):
             best_d, best_t = d, t
+    telemetry.record_overlap_choice(best_d, best_t)
     return best_d
 
 
@@ -413,7 +416,38 @@ def build_dist_attn_plan(
     ``cp_mesh_shape``: (n_inter, n_intra) for hierarchical 2-level comm over
     a 2-D cp mesh (rank = inter * n_intra + intra; reference
     _group_collective_hier.py): casts dedup rows across the inter hop.
+
+    With telemetry enabled the build is timed (span + latency histogram)
+    and the finished plan's comm/overlap/kernel-grid facts are recorded
+    (``telemetry.record_plan``) — all host-side, nothing traced.
     """
+    t0 = time.perf_counter()
+    with telemetry.span(
+        "build_dist_attn_plan", cp=dispatch_meta.cp_size
+    ):
+        plan = _build_dist_attn_plan(
+            dispatch_meta,
+            bucket,
+            kv_dispatch_meta=kv_dispatch_meta,
+            block_q=block_q,
+            block_k=block_k,
+            overlap_config=overlap_config,
+            cp_mesh_shape=cp_mesh_shape,
+        )
+    telemetry.record_plan(plan, build_seconds=time.perf_counter() - t0)
+    return plan
+
+
+def _build_dist_attn_plan(
+    dispatch_meta: DispatchMeta,
+    bucket: AttnBucket,
+    *,
+    kv_dispatch_meta: DispatchMeta | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    overlap_config: OverlapConfig | None = None,
+    cp_mesh_shape: tuple[int, int] | None = None,
+) -> DistAttnPlan:
     cp = dispatch_meta.cp_size
     shard_len = dispatch_meta.shard_seqlen
     kv_meta = kv_dispatch_meta or dispatch_meta

@@ -208,6 +208,11 @@ def build_qo_comm_plan(
         [int(s[4]) for s in slices],
     )
     sol = solver.solve(rects, cp_size, total_seqlen=total_seqlen)
+    from .. import telemetry
+
+    telemetry.record_dynamic_solution(
+        type(solver).__name__, sol.balance_ratio
+    )
 
     import logging
 

@@ -1,0 +1,131 @@
+"""Runtime telemetry: metrics registry, span events, structured export.
+
+The observability spine of MagiAttention-TPU (ISSUE 1). The runtime
+computes everything the paper's value proposition rests on — per-rank
+comm volume, chunk balance, overlap degree, kernel step counts — during
+planning; this package records those facts instead of discarding them.
+
+Layout:
+
+- :mod:`.registry`   — process-global counters/gauges/histograms +
+  ``snapshot()``/``dump``
+- :mod:`.events`     — host-side span ring buffer + Chrome-trace export
+- :mod:`.collectors` — the ``record_*`` hooks each planning layer calls
+  (and the metric-name catalog)
+- :mod:`.logger`     — ``MAGI_ATTENTION_LOG_LEVEL`` -> logging config
+
+Gating: everything is OFF by default. ``MAGI_ATTENTION_TELEMETRY=1`` (or
+``set_enabled(True)`` programmatically, e.g. from tests and benches) turns
+recording on; while off, every hook is a single predicate call — no dict
+churn, no clock reads, and nothing whatsoever inside jitted regions
+(recording is host-side plan/bench-time only by construction).
+
+Typical use::
+
+    from magiattention_tpu import telemetry
+    telemetry.set_enabled(True)
+    ... build plans / run benches ...
+    snap = telemetry.snapshot()
+    telemetry.dump_metrics("metrics.json")
+    telemetry.dump_events("trace.json")   # chrome://tracing format
+"""
+
+from __future__ import annotations
+
+from .collectors import (  # noqa: F401
+    REQUIRED_PLAN_METRICS,
+    record_cache_access,
+    record_dispatch_meta,
+    record_dispatch_solution,
+    record_dynamic_solution,
+    record_group_collective_build,
+    record_overlap_choice,
+    record_plan,
+    record_runtime_costs,
+    telemetry_summary,
+)
+from .events import (  # noqa: F401
+    EventBuffer,
+    get_event_buffer,
+    record_event,
+    span,
+)
+from .logger import configure_logging, get_logger  # noqa: F401
+from .registry import (  # noqa: F401
+    MetricsRegistry,
+    get_registry,
+    series_key,
+)
+
+# tri-state programmatic override: None -> defer to the env flag
+_enabled_override: bool | None = None
+
+
+def enabled() -> bool:
+    """Is telemetry recording on? Programmatic override first, then the
+    ``MAGI_ATTENTION_TELEMETRY`` env flag. This is THE gate every hook
+    checks; keep it a couple of dict lookups."""
+    if _enabled_override is not None:
+        return _enabled_override
+    from .. import env
+
+    return env.is_telemetry_enabled()
+
+
+def set_enabled(value: bool | None) -> None:
+    """Force telemetry on/off (``True``/``False``) or restore env-flag
+    control (``None``). Benches and tests use this; long-running jobs
+    usually just set the env var."""
+    global _enabled_override
+    _enabled_override = value
+
+
+def snapshot() -> dict:
+    """Plain-dict snapshot of the global registry (always available, even
+    when disabled — it is then simply empty)."""
+    return get_registry().snapshot()
+
+
+def reset() -> None:
+    """Clear the global registry AND the span ring buffer."""
+    get_registry().reset()
+    get_event_buffer().clear()
+
+
+def dump_metrics(path: str) -> str:
+    """Write the registry snapshot as JSON; returns ``path``."""
+    return get_registry().dump(path)
+
+
+def dump_events(path: str) -> str:
+    """Write buffered spans as Chrome trace-event JSON; returns ``path``."""
+    return get_event_buffer().dump(path)
+
+
+__all__ = [
+    "EventBuffer",
+    "MetricsRegistry",
+    "REQUIRED_PLAN_METRICS",
+    "configure_logging",
+    "dump_events",
+    "dump_metrics",
+    "enabled",
+    "get_event_buffer",
+    "get_logger",
+    "get_registry",
+    "record_cache_access",
+    "record_dispatch_meta",
+    "record_dispatch_solution",
+    "record_dynamic_solution",
+    "record_event",
+    "record_group_collective_build",
+    "record_overlap_choice",
+    "record_plan",
+    "record_runtime_costs",
+    "reset",
+    "series_key",
+    "set_enabled",
+    "snapshot",
+    "span",
+    "telemetry_summary",
+]

@@ -1,0 +1,314 @@
+"""Plan-time metric collectors: the runtime's introspection surface.
+
+Each ``record_*`` function is called from ONE spot in the planning stack
+(dispatch meta builder, group-collective routing, overlap auto-tuner, plan
+builder, keyed interface) and translates what that layer just computed —
+and previously discarded — into registry series. Every function no-ops
+immediately while telemetry is disabled, so the planning hot path pays a
+single predicate call.
+
+The metric catalog (names, labels, units) is defined here as constants and
+documented in ``docs/observability.md``; ``make telemetry-check`` asserts
+the two stay in sync by building a real plan and checking the snapshot for
+:data:`REQUIRED_PLAN_METRICS`.
+"""
+
+from __future__ import annotations
+
+from .registry import get_registry
+
+# ---------------------------------------------------------------------------
+# metric catalog (see docs/observability.md for the prose version)
+# ---------------------------------------------------------------------------
+
+# counters
+M_PLAN_BUILDS = "magi_plan_builds_total"  # build_dist_attn_plan completions
+M_DISPATCH_BUILDS = "magi_dispatch_meta_builds_total"
+M_GRPCOLL_BUILDS = "magi_group_collective_builds_total"
+M_CACHE_HITS = "magi_runtime_cache_hits_total"
+M_CACHE_MISSES = "magi_runtime_cache_misses_total"
+
+# gauges — dispatch layer
+M_DISPATCH_NUM_CHUNKS = "magi_dispatch_num_chunks"
+M_DISPATCH_CHUNKS_RANK = "magi_dispatch_chunks_per_rank"  # {rank=}
+M_DISPATCH_TOKEN_IMBALANCE = "magi_dispatch_token_imbalance_ratio"
+M_DISPATCH_UNEVEN = "magi_dispatch_uneven"  # 0/1
+M_SOLVER_MINIMAX = "magi_dispatch_solver_minimax_workload"
+M_SOLVER_BALANCE = "magi_dispatch_solver_balance_ratio"  # max/mean bucket
+M_DYN_SOLVER_BALANCE = "magi_dynamic_solver_balance_ratio"  # qo-comm plane
+
+# gauges — comm layer (rows are payload rows; bytes are resolved by the
+# interface layer, which knows heads/head_dim/dtype)
+M_COMM_SEND_ROWS = "magi_comm_send_rows"  # {rank=}
+M_COMM_RECV_ROWS = "magi_comm_recv_rows"  # {rank=}
+M_COMM_PADDED_ROWS = "magi_comm_padded_payload_rows"
+M_COMM_BYTES_RANK = "magi_comm_bytes_per_rank"  # {rank=}, bytes
+
+# gauges — plan layer
+M_PLAN_OVERLAP_DEGREE = "magi_plan_overlap_degree"
+M_PLAN_NUM_STAGES = "magi_plan_num_stages"
+M_PLAN_TOTAL_AREA = "magi_plan_total_area"
+M_PLAN_MAX_RANK_AREA = "magi_plan_max_rank_area"
+M_PLAN_AREA_IMBALANCE = "magi_plan_area_imbalance_ratio"
+M_PLAN_KERNEL_STEPS_FWD = "magi_plan_kernel_steps_fwd"
+M_PLAN_KERNEL_STEPS_BWD = "magi_plan_kernel_steps_bwd"
+M_OVERLAP_AUTO_DEGREE = "magi_overlap_auto_degree"
+M_OVERLAP_MAKESPAN = "magi_overlap_modeled_makespan_s"
+
+# gauges — cost model (interface layer; utils/cost.py factors)
+M_MODELED_FLOPS = "magi_plan_modeled_flops"
+M_MODELED_CALC_S = "magi_plan_modeled_calc_seconds"
+M_MODELED_COMM_S = "magi_plan_modeled_comm_seconds"
+
+# histograms (seconds)
+H_PLAN_BUILD_S = "magi_plan_build_seconds"
+H_DISPATCH_SOLVE_S = "magi_dispatch_solve_seconds"
+
+# the acceptance-criteria floor: one build_dist_attn_plan through the keyed
+# interface must populate at least these (the drift guard's contract)
+REQUIRED_PLAN_METRICS: tuple[str, ...] = (
+    M_PLAN_BUILDS,
+    M_DISPATCH_BUILDS,
+    M_GRPCOLL_BUILDS,
+    M_DISPATCH_TOKEN_IMBALANCE,
+    M_PLAN_AREA_IMBALANCE,
+    M_PLAN_OVERLAP_DEGREE,
+    M_PLAN_KERNEL_STEPS_FWD,
+    M_PLAN_KERNEL_STEPS_BWD,
+    M_COMM_SEND_ROWS,
+    M_COMM_RECV_ROWS,
+    M_COMM_BYTES_RANK,
+    M_MODELED_FLOPS,
+    M_MODELED_CALC_S,
+    M_MODELED_COMM_S,
+    H_PLAN_BUILD_S,
+)
+
+
+def _enabled() -> bool:
+    from . import enabled
+
+    return enabled()
+
+
+# ---------------------------------------------------------------------------
+# dispatch layer
+# ---------------------------------------------------------------------------
+
+
+def record_dispatch_meta(meta) -> None:
+    """One DispatchMeta built (``meta/dispatch_meta.py``): chunk counts and
+    the token-level imbalance of the physical shard (1.0 = perfectly even;
+    >1 means pad slots on the lighter ranks of an uneven shard)."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_DISPATCH_BUILDS)
+    reg.gauge_set(M_DISPATCH_NUM_CHUNKS, meta.num_chunks)
+    reg.gauge_set(M_DISPATCH_UNEVEN, int(meta.is_uneven))
+    valid = meta.rank_valid_lens
+    mean_valid = sum(valid) / max(len(valid), 1)
+    reg.gauge_set(
+        M_DISPATCH_TOKEN_IMBALANCE,
+        (meta.shard_seqlen / mean_valid) if mean_valid else 1.0,
+    )
+    reg.clear_metric(M_DISPATCH_CHUNKS_RANK)  # cp may shrink between plans
+    for r, p in enumerate(meta.partitions):
+        reg.gauge_set(M_DISPATCH_CHUNKS_RANK, len(p), rank=r)
+
+
+def record_dispatch_solution(
+    alg: str, minimax_workload: float, bucket_workloads, solve_seconds: float
+) -> None:
+    """Dispatch-solver quality (``meta/solver/dispatch_solver.py``): the
+    minimax objective, the achieved max/mean balance ratio, and solve
+    latency."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.gauge_set(M_SOLVER_MINIMAX, float(minimax_workload), alg=alg)
+    loads = list(bucket_workloads)
+    mean = sum(loads) / max(len(loads), 1)
+    reg.gauge_set(
+        M_SOLVER_BALANCE,
+        (max(loads) / mean) if mean else 1.0,
+        alg=alg,
+    )
+    reg.histogram_observe(H_DISPATCH_SOLVE_S, solve_seconds, alg=alg)
+
+
+def record_dynamic_solution(solver: str, balance_ratio: float) -> None:
+    """qo-comm plane-partition quality (``meta/solver/dynamic_attn_solver``
+    via ``parallel/qo_comm.py``)."""
+    if not _enabled():
+        return
+    get_registry().gauge_set(
+        M_DYN_SOLVER_BALANCE, float(balance_ratio), solver=solver
+    )
+
+
+# ---------------------------------------------------------------------------
+# comm layer
+# ---------------------------------------------------------------------------
+
+
+def record_group_collective_build(comm) -> None:
+    """One GroupCollectiveMeta routed (``comm/group_collective.py``): counts
+    builds and keeps the latest padded-payload row figure. Per-rank rows
+    are recorded at plan level (:func:`record_plan`) where the *primary*
+    comm meta is known — build() also runs for per-stage sub-metas."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_GRPCOLL_BUILDS)
+    reg.gauge_set(M_COMM_PADDED_ROWS, comm.comm_bytes_per_rank)
+
+
+# ---------------------------------------------------------------------------
+# plan layer
+# ---------------------------------------------------------------------------
+
+
+def record_overlap_choice(degree: int, modeled_makespan_s: float) -> None:
+    """Auto overlap-degree search result (``_choose_overlap_degree``)."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.gauge_set(M_OVERLAP_AUTO_DEGREE, degree)
+    reg.gauge_set(M_OVERLAP_MAKESPAN, modeled_makespan_s)
+
+
+def record_plan(plan, build_seconds: float | None = None) -> None:
+    """One DistAttnPlan built (``parallel/dist_attn.py``): overlap degree,
+    stage count, per-rank comm rows, mask-area balance, and the static
+    kernel-grid step extents the Pallas kernels will run."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_PLAN_BUILDS)
+    reg.gauge_set(M_PLAN_OVERLAP_DEGREE, plan.overlap_degree)
+    reg.gauge_set(M_PLAN_NUM_STAGES, len(plan.stages))
+    reg.gauge_set(M_PLAN_TOTAL_AREA, plan.total_area)
+    reg.gauge_set(M_PLAN_MAX_RANK_AREA, plan.max_rank_area)
+    reg.gauge_set(
+        M_PLAN_AREA_IMBALANCE,
+        plan.max_rank_area / max(plan.total_area / plan.cp_size, 1),
+    )
+    comm = plan.comm
+    reg.clear_metric(M_COMM_SEND_ROWS)  # cp may shrink between plans
+    reg.clear_metric(M_COMM_RECV_ROWS)
+    for r in range(plan.cp_size):
+        reg.gauge_set(M_COMM_SEND_ROWS, comm.send_total[r], rank=r)
+        reg.gauge_set(M_COMM_RECV_ROWS, comm.recv_total[r], rank=r)
+    fwd = bwd = 0
+    for t in (
+        plan.merged_tables,
+        plan.host_tables,
+        *(sp.tables for sp in plan.stages),
+    ):
+        if t is None:
+            continue
+        a, b = t.kernel_steps()
+        fwd = max(fwd, a)
+        bwd = max(bwd, b)
+    reg.gauge_set(M_PLAN_KERNEL_STEPS_FWD, fwd)
+    reg.gauge_set(M_PLAN_KERNEL_STEPS_BWD, bwd)
+    if build_seconds is not None:
+        reg.histogram_observe(H_PLAN_BUILD_S, build_seconds)
+
+
+def record_runtime_costs(
+    plan,
+    *,
+    num_heads_q: int,
+    num_heads_kv: int,
+    head_dim: int,
+    bytes_per_elt: int,
+    generation: str,
+) -> None:
+    """Interface-layer resolution of rows -> bytes and area -> seconds:
+    per-rank comm bytes for the K+V payload, plus the ``utils/cost.py``
+    modeled FLOPs / calc seconds / comm seconds the overlap solver prices
+    plans with (so measured vs modeled can be compared offline)."""
+    if not _enabled():
+        return
+    from ..utils.cost import get_calc_cost_factor, get_comm_cost_factor
+
+    reg = get_registry()
+    comm = plan.comm
+    row_bytes = 2 * num_heads_kv * head_dim * bytes_per_elt  # K + V
+    reg.clear_metric(M_COMM_BYTES_RANK)  # cp may shrink between plans
+    for r in range(plan.cp_size):
+        reg.gauge_set(
+            M_COMM_BYTES_RANK, comm.recv_total[r] * row_bytes, rank=r
+        )
+    flops = 4.0 * plan.total_area * num_heads_q * head_dim
+    reg.gauge_set(M_MODELED_FLOPS, flops)
+    try:
+        calc_f = get_calc_cost_factor(num_heads_q, head_dim, generation)
+        comm_f = get_comm_cost_factor(
+            num_heads_kv, head_dim, generation, bytes_per_elt=bytes_per_elt
+        )
+    except ValueError:
+        # unknown generation string must never take planning down
+        return
+    reg.gauge_set(M_MODELED_CALC_S, plan.max_rank_area * calc_f)
+    reg.gauge_set(
+        M_MODELED_COMM_S, max(comm.recv_total, default=0) * comm_f
+    )
+
+
+def record_cache_access(hit: bool) -> None:
+    """Keyed-runtime LRU behavior (``api/interface.py``)."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_CACHE_HITS if hit else M_CACHE_MISSES)
+
+
+# ---------------------------------------------------------------------------
+# summaries
+# ---------------------------------------------------------------------------
+
+
+def telemetry_summary(snapshot: dict | None = None) -> str:
+    """Human-readable block of the headline plan/comm metrics — what
+    ``bench.py`` prints per run. Works on any snapshot dict (defaults to
+    the live registry's)."""
+    if snapshot is None:
+        snapshot = get_registry().snapshot()
+    g = snapshot.get("gauges", {})
+    c = snapshot.get("counters", {})
+
+    def fmt(v):
+        if v is None:
+            return "-"
+        if isinstance(v, float):
+            return f"{v:.4g}"
+        return str(v)
+
+    def series(prefix):
+        vals = {
+            k: v for k, v in g.items() if k.startswith(prefix + "{")
+        }
+        # (len, str) orders rank=2 before rank=10 without parsing labels
+        return [v for _, v in sorted(vals.items(), key=lambda kv: (len(kv[0]), kv[0]))]
+
+    lines = [
+        "telemetry summary:",
+        f"  plans built: {fmt(c.get(M_PLAN_BUILDS, 0))}  "
+        f"dispatch metas: {fmt(c.get(M_DISPATCH_BUILDS, 0))}  "
+        f"cache hits/misses: {fmt(c.get(M_CACHE_HITS, 0))}/"
+        f"{fmt(c.get(M_CACHE_MISSES, 0))}",
+        f"  overlap degree: {fmt(g.get(M_PLAN_OVERLAP_DEGREE))}  "
+        f"stages: {fmt(g.get(M_PLAN_NUM_STAGES))}  "
+        f"kernel steps fwd/bwd: {fmt(g.get(M_PLAN_KERNEL_STEPS_FWD))}/"
+        f"{fmt(g.get(M_PLAN_KERNEL_STEPS_BWD))}",
+        f"  area imbalance: {fmt(g.get(M_PLAN_AREA_IMBALANCE))}  "
+        f"token imbalance: {fmt(g.get(M_DISPATCH_TOKEN_IMBALANCE))}",
+        f"  comm recv rows/rank: {[int(v) for v in series(M_COMM_RECV_ROWS)]}",
+        f"  comm bytes/rank: {[int(v) for v in series(M_COMM_BYTES_RANK)]}",
+        f"  modeled flops: {fmt(g.get(M_MODELED_FLOPS))}  "
+        f"calc s: {fmt(g.get(M_MODELED_CALC_S))}  "
+        f"comm s: {fmt(g.get(M_MODELED_COMM_S))}",
+    ]
+    return "\n".join(lines)

@@ -1,0 +1,132 @@
+"""Host-side span-event ring buffer + structured trace export.
+
+Role of the reference's nvtx event stream, TPU-shaped: ``jax.named_scope``
+annotates *traced* computations for the XLA profiler, but host-side
+planning work (dispatch solve, comm routing, table emission) never enters
+a trace — this buffer is where those spans land. ``dump_events`` writes
+the Chrome trace-event JSON format (the ``chrome://tracing`` /
+Perfetto / TensorBoard "trace viewer" schema), so host planning spans can
+be laid next to an XLA device trace.
+
+The buffer is a fixed-size ring (``collections.deque(maxlen=...)``): a
+long-running trainer with telemetry left on keeps the most recent N spans
+and never grows without bound. Recording is gated by
+:func:`magiattention_tpu.telemetry.enabled` at every *call site* (the
+``span``/``record_event`` helpers here check it too), so the disabled
+path allocates nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+
+
+class EventBuffer:
+    """Ring buffer of span events (host wall-clock, microsecond stamps)."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._lock = threading.Lock()
+        self._events: deque[dict] = deque(maxlen=maxlen)
+
+    def record(
+        self,
+        name: str,
+        start_s: float,
+        duration_s: float,
+        attrs: dict | None = None,
+    ) -> None:
+        ev = {
+            "name": name,
+            "ph": "X",  # Chrome trace "complete" event
+            "ts": start_s * 1e6,  # trace format wants microseconds
+            "dur": duration_s * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        }
+        if attrs:
+            ev["args"] = dict(attrs)
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def dump(self, path: str) -> str:
+        """Write the buffered spans as Chrome trace-event JSON; returns
+        ``path``. Loadable in Perfetto / chrome://tracing / TensorBoard's
+        trace viewer."""
+        payload = {
+            "traceEvents": self.events(),
+            "displayTimeUnit": "ms",
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        return path
+
+
+def _default_buffer() -> EventBuffer:
+    from .. import env
+
+    return EventBuffer(maxlen=env.telemetry_ring_size())
+
+
+_buffer: EventBuffer | None = None
+_buffer_lock = threading.Lock()
+
+
+def get_event_buffer() -> EventBuffer:
+    """The process-global span ring buffer (lazily sized from
+    ``MAGI_ATTENTION_TELEMETRY_RING_SIZE``)."""
+    global _buffer
+    if _buffer is None:
+        with _buffer_lock:
+            if _buffer is None:
+                _buffer = _default_buffer()
+    return _buffer
+
+
+def record_event(
+    name: str,
+    start_s: float,
+    duration_s: float,
+    attrs: dict | None = None,
+) -> None:
+    """Append one completed span (no-op while telemetry is disabled)."""
+    from . import enabled
+
+    if not enabled():
+        return
+    get_event_buffer().record(name, start_s, duration_s, attrs)
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Time a host-side region into the ring buffer. Disabled mode yields
+    immediately with no clock reads or allocation."""
+    from . import enabled
+
+    if not enabled():
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        get_event_buffer().record(
+            name, t0, time.perf_counter() - t0, attrs or None
+        )

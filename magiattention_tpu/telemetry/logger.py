@@ -1,0 +1,67 @@
+"""Structured logging for the ``magiattention_tpu`` logger tree.
+
+Wires the (previously dead) ``MAGI_ATTENTION_LOG_LEVEL`` env flag
+(``env.log_level()``) to a real ``logging`` configuration at package
+import: the package logger's level always tracks the flag, and an
+explicitly-set flag also attaches a formatted stderr handler (reference
+``magi_attention/__init__.py:61-83``). Unknown level names degrade to
+WARNING instead of crashing the import (reference env/general.py:66-67).
+
+Handler attachment is idempotent (tagged with ``_magi_handler``) so
+re-imports / reloads / repeated ``configure_logging()`` calls never stack
+duplicate handlers.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+LOGGER_NAME = "magiattention_tpu"
+
+
+def resolve_level(name: str | None = None) -> int:
+    """Level-name string -> logging level int, defaulting through
+    ``env.log_level()`` and degrading unknown names to WARNING."""
+    from .. import env
+
+    if name is None:
+        name = env.log_level()
+    level = getattr(logging, name.strip().upper(), None)
+    return level if isinstance(level, int) else logging.WARNING
+
+
+def configure_logging(force_handler: bool = False) -> logging.Logger:
+    """Configure and return the package logger.
+
+    Only an explicitly-set ``MAGI_ATTENTION_LOG_LEVEL`` touches the
+    logger: its level is set from the flag and a formatted stderr handler
+    is attached. With the flag unset the logger is returned as-is
+    (NOTSET), so embedders who configure their own logging tree —
+    ``logging.basicConfig(level=...)`` etc. — keep full control, exactly
+    as before this flag was wired.
+    """
+    logger = logging.getLogger(LOGGER_NAME)
+    explicit = "MAGI_ATTENTION_LOG_LEVEL" in os.environ
+    if explicit:
+        logger.setLevel(resolve_level())
+    if (explicit or force_handler) and not any(
+        getattr(h, "_magi_handler", False) for h in logger.handlers
+    ):
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter(
+                "[%(asctime)s][%(name)s][%(levelname)s] %(message)s"
+            )
+        )
+        handler._magi_handler = True  # idempotence tag
+        logger.addHandler(handler)
+        logger.propagate = False
+    return logger
+
+
+def get_logger(child: str | None = None) -> logging.Logger:
+    """The package logger, or a dotted child (``get_logger("telemetry")``
+    -> ``magiattention_tpu.telemetry``)."""
+    name = LOGGER_NAME if not child else f"{LOGGER_NAME}.{child}"
+    return logging.getLogger(name)

@@ -4,28 +4,75 @@ Role of reference ``utils/nvtx.py`` (instrument_nvtx decorator,
 add_nvtx_event, switch_profile): on TPU the equivalents are
 ``jax.named_scope`` (annotates traced computations so they show up in the
 XLA profiler timeline) plus ``jax.profiler`` trace sessions.
+
+Telemetry integration (ISSUE 1): when the telemetry layer is enabled,
+``instrument_trace`` / ``add_trace_event`` ALSO emit timestamped span
+events into the host-side ring buffer (``telemetry/events.py``) —
+exportable as Chrome-trace JSON via ``telemetry.dump_events`` — so host
+planning time lines up next to device traces. When telemetry AND profile
+mode are both disabled, both helpers are true zero-cost passthroughs:
+the decorator returns the original function object and the context
+manager yields without touching jax.
+
+Gating granularity: ``add_trace_event`` / ``switch_profile`` check
+:func:`instrumentation_active` per use, so flipping
+``telemetry.set_enabled`` or ``MAGI_ATTENTION_PROFILE_MODE`` mid-process
+affects them immediately. ``instrument_trace`` decides at DECORATION
+time — the zero-cost contract means a function decorated while
+instrumentation was off stays un-wrapped; enable telemetry/profile mode
+before importing (or decorating) the code you want traced.
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
+import time
 from typing import Callable, Optional
 
-import jax
+
+def instrumentation_active() -> bool:
+    """Should scopes be annotated / spans recorded right now?"""
+    from .. import env, telemetry
+
+    return telemetry.enabled() or env.is_profile_mode()
 
 
 def instrument_trace(fn: Optional[Callable] = None, *, name: str | None = None):
     """Decorator: wrap a function in a named scope for profiler timelines
-    (reference @nvtx.instrument_nvtx)."""
+    (reference @nvtx.instrument_nvtx) and, with telemetry on, record a
+    host-side span per call.
+
+    Zero-cost passthrough: when telemetry and profile mode are BOTH off
+    at decoration time, the original function object is returned
+    unchanged (``instrument_trace(f) is f``) — no wrapper frame at all.
+    Decorations made while instrumentation is active keep a per-call
+    guard, so turning it off later silences them too.
+    """
 
     def deco(f):
+        if not instrumentation_active():
+            return f  # true zero-cost: no wrapper, identical object
         scope = name or f.__qualname__
 
         @functools.wraps(f)
         def wrapper(*args, **kwargs):
-            with jax.named_scope(scope):
+            if not instrumentation_active():
                 return f(*args, **kwargs)
+            import jax
+
+            from .. import telemetry
+
+            t0 = time.perf_counter()
+            try:
+                with jax.named_scope(scope):
+                    return f(*args, **kwargs)
+            finally:
+                # record even when f raises — a span that vanishes on
+                # failure hides exactly the region being debugged
+                telemetry.record_event(
+                    scope, t0, time.perf_counter() - t0
+                )
 
         return wrapper
 
@@ -34,18 +81,41 @@ def instrument_trace(fn: Optional[Callable] = None, *, name: str | None = None):
 
 @contextlib.contextmanager
 def add_trace_event(name: str):
-    """Context manager named-scope (reference add_nvtx_event)."""
-    with jax.named_scope(name):
+    """Context manager named-scope (reference add_nvtx_event); with
+    telemetry on the region is also recorded as a host-side span."""
+    if not instrumentation_active():
         yield
+        return
+    import jax
+
+    from .. import telemetry
+
+    t0 = time.perf_counter()
+    try:
+        with jax.named_scope(name):
+            yield
+    finally:
+        telemetry.record_event(name, t0, time.perf_counter() - t0)
 
 
 @contextlib.contextmanager
 def switch_profile(trace_dir: str | None = None):
     """Profiler session (reference switch_profile / cudaProfilerStart-Stop):
-    writes an XLA trace viewable in TensorBoard / xprof."""
+    writes an XLA trace viewable in TensorBoard / xprof.
+
+    ``trace_dir=None`` honors ``MAGI_ATTENTION_PROFILE_MODE`` as a
+    default-on switch: profile mode on -> trace into ``env.trace_dir()``
+    (``MAGI_ATTENTION_TRACE_DIR``); off -> no-op, as before.
+    """
+    from .. import env
+
+    if trace_dir is None and env.is_profile_mode():
+        trace_dir = env.trace_dir()
     if trace_dir is None:
         yield
         return
+    import jax
+
     jax.profiler.start_trace(trace_dir)
     try:
         yield

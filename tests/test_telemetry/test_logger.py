@@ -1,0 +1,57 @@
+"""telemetry/logger.py: MAGI_ATTENTION_LOG_LEVEL wiring semantics."""
+
+import logging
+
+from magiattention_tpu.telemetry import logger as tlog
+
+
+def _fresh_logger():
+    lg = logging.getLogger(tlog.LOGGER_NAME)
+    for h in [h for h in lg.handlers if getattr(h, "_magi_handler", False)]:
+        lg.removeHandler(h)
+    return lg
+
+
+def test_resolve_level_known_and_unknown(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_LOG_LEVEL", "debug")
+    assert tlog.resolve_level() == logging.DEBUG
+    monkeypatch.setenv("MAGI_ATTENTION_LOG_LEVEL", "not-a-level")
+    assert tlog.resolve_level() == logging.WARNING  # degrade, don't crash
+    assert tlog.resolve_level("ERROR") == logging.ERROR
+
+
+def test_unset_flag_leaves_logger_untouched(monkeypatch):
+    """Embedders' logging config must survive import: with the flag unset
+    the package logger keeps whatever level it had (NOTSET inherits)."""
+    monkeypatch.delenv("MAGI_ATTENTION_LOG_LEVEL", raising=False)
+    lg = _fresh_logger()
+    before = lg.level
+    out = tlog.configure_logging()
+    assert out is lg
+    assert lg.level == before
+    assert not any(getattr(h, "_magi_handler", False) for h in lg.handlers)
+
+
+def test_explicit_flag_sets_level_and_handler(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_LOG_LEVEL", "INFO")
+    lg = _fresh_logger()
+    old_level, old_prop = lg.level, lg.propagate
+    try:
+        tlog.configure_logging()
+        assert lg.level == logging.INFO
+        magi = [h for h in lg.handlers if getattr(h, "_magi_handler", False)]
+        assert len(magi) == 1
+        # idempotent: re-configuring never stacks handlers
+        tlog.configure_logging()
+        magi = [h for h in lg.handlers if getattr(h, "_magi_handler", False)]
+        assert len(magi) == 1
+    finally:
+        for h in magi:
+            lg.removeHandler(h)
+        lg.setLevel(old_level)
+        lg.propagate = old_prop
+
+
+def test_get_logger_children():
+    assert tlog.get_logger().name == "magiattention_tpu"
+    assert tlog.get_logger("telemetry").name == "magiattention_tpu.telemetry"

@@ -1,0 +1,124 @@
+"""MetricsRegistry semantics: counters / gauges / histograms, labeled
+series, snapshot round-trip, JSON export, reset."""
+
+import json
+
+import pytest
+
+from magiattention_tpu.telemetry.registry import (
+    DEFAULT_BUCKET_BOUNDS,
+    MetricsRegistry,
+    get_registry,
+    series_key,
+)
+
+
+@pytest.fixture
+def reg():
+    return MetricsRegistry()
+
+
+def test_series_key_canonical_label_order():
+    assert series_key("m") == "m"
+    assert series_key("m", {"b": 1, "a": 2}) == "m{a=2,b=1}"
+    assert series_key("m", {"a": 2, "b": 1}) == series_key(
+        "m", {"b": 1, "a": 2}
+    )
+
+
+def test_counter_accumulates(reg):
+    reg.counter_inc("c")
+    reg.counter_inc("c", 2.5)
+    assert reg.counter_value("c") == 3.5
+    # unlabeled and labeled series are distinct
+    reg.counter_inc("c", 1, rank=0)
+    assert reg.counter_value("c") == 3.5
+    assert reg.counter_value("c", rank=0) == 1.0
+    # missing series reads 0
+    assert reg.counter_value("nope") == 0.0
+
+
+def test_counter_rejects_negative(reg):
+    with pytest.raises(ValueError):
+        reg.counter_inc("c", -1)
+
+
+def test_gauge_last_write_wins(reg):
+    reg.gauge_set("g", 1.0)
+    reg.gauge_set("g", 7.0)
+    assert reg.gauge_value("g") == 7.0
+    reg.gauge_set("g", 3.0, rank=1)
+    assert reg.gauge_value("g", rank=1) == 3.0
+    assert reg.gauge_value("missing", default=-1) == -1
+
+
+def test_histogram_stats_and_buckets(reg):
+    for v in (0.5e-5, 5e-4, 5e-4, 2.0):
+        reg.histogram_observe("h", v)
+    h = reg.snapshot()["histograms"]["h"]
+    assert h["count"] == 4
+    assert h["min"] == 0.5e-5 and h["max"] == 2.0
+    assert h["sum"] == pytest.approx(0.5e-5 + 2 * 5e-4 + 2.0)
+    assert h["mean"] == pytest.approx(h["sum"] / 4)
+    assert h["bounds"] == list(DEFAULT_BUCKET_BOUNDS)
+    assert sum(h["bucket_counts"]) == 4
+    # 0.5e-5 <= 1e-5 -> bucket 0; 5e-4 <= 1e-3 -> bucket 2; 2.0 <= 10 -> 6
+    assert h["bucket_counts"][0] == 1
+    assert h["bucket_counts"][2] == 2
+    assert h["bucket_counts"][6] == 1
+
+
+def test_histogram_overflow_bucket_and_custom_bounds(reg):
+    reg.histogram_observe("h", 1e6)
+    assert reg.snapshot()["histograms"]["h"]["bucket_counts"][-1] == 1
+    reg.histogram_observe("h2", 3.0, bounds=(1.0, 5.0))
+    h2 = reg.snapshot()["histograms"]["h2"]
+    assert h2["bounds"] == [1.0, 5.0]
+    assert h2["bucket_counts"] == [0, 1, 0]
+
+
+def test_empty_histogram_never_reports_inf(reg):
+    reg.histogram_observe("h", 1.0)
+    h = reg.snapshot()["histograms"]["h"]
+    assert h["min"] == 1.0
+    # fresh registry snapshot has no histograms at all
+    assert MetricsRegistry().snapshot()["histograms"] == {}
+
+
+def test_snapshot_round_trips_through_json(reg):
+    reg.counter_inc("c", 2, alg="min_heap")
+    reg.gauge_set("g", 1.5, rank=3)
+    reg.histogram_observe("h", 0.01)
+    snap = reg.snapshot()
+    assert json.loads(json.dumps(snap)) == snap
+
+
+def test_snapshot_is_detached_copy(reg):
+    reg.counter_inc("c")
+    snap = reg.snapshot()
+    reg.counter_inc("c")
+    assert snap["counters"]["c"] == 1.0
+    assert reg.snapshot()["counters"]["c"] == 2.0
+
+
+def test_dump_writes_json_file(reg, tmp_path):
+    reg.gauge_set("g", 4.0)
+    path = reg.dump(str(tmp_path / "metrics.json"))
+    with open(path) as f:
+        assert json.load(f) == reg.snapshot()
+
+
+def test_reset_clears_everything(reg):
+    reg.counter_inc("c")
+    reg.gauge_set("g", 1)
+    reg.histogram_observe("h", 1)
+    reg.reset()
+    assert reg.snapshot() == {
+        "counters": {},
+        "gauges": {},
+        "histograms": {},
+    }
+
+
+def test_global_registry_is_a_singleton():
+    assert get_registry() is get_registry()

@@ -1,0 +1,77 @@
+"""First-ever coverage for utils/cost.py: the TPU peak-spec cost factors
+the overlap solver prices plans with."""
+
+import pytest
+
+from magiattention_tpu.utils.cost import (
+    TPU_PEAK_SPECS,
+    get_calc_cost_factor,
+    get_comm_cost_factor,
+)
+
+
+def test_known_generations_present():
+    assert {"v4", "v5e", "v5p", "v6e"} <= set(TPU_PEAK_SPECS)
+
+
+def test_unknown_generation_raises_with_hint():
+    with pytest.raises(ValueError, match="MAGI_ATTENTION_TPU_GENERATION"):
+        get_calc_cost_factor(8, 128, generation="h100")
+    with pytest.raises(ValueError, match="unknown TPU generation"):
+        get_comm_cost_factor(8, 128, generation="")
+
+
+def test_calc_factor_formula():
+    # seconds per unit mask area = 4 * hq * hd / (peak * mfu)
+    spec = TPU_PEAK_SPECS["v5e"]
+    expect = 4.0 * 8 * 128 / (spec.bf16_tflops * 1e12 * spec.mfu)
+    assert get_calc_cost_factor(8, 128, "v5e") == pytest.approx(expect)
+
+
+def test_calc_factor_mfu_override():
+    base = get_calc_cost_factor(8, 128, "v5p")
+    half = get_calc_cost_factor(8, 128, "v5p", mfu=TPU_PEAK_SPECS["v5p"].mfu / 2)
+    assert half == pytest.approx(2 * base)
+
+
+def test_calc_factor_scales_linearly_with_heads_and_dim():
+    assert get_calc_cost_factor(16, 128, "v5e") == pytest.approx(
+        2 * get_calc_cost_factor(8, 128, "v5e")
+    )
+    assert get_calc_cost_factor(8, 256, "v5e") == pytest.approx(
+        2 * get_calc_cost_factor(8, 128, "v5e")
+    )
+
+
+def test_comm_factor_formula():
+    # seconds per KV token row = 2 (K+V) * hkv * hd * bytes / (bw * bwu)
+    spec = TPU_PEAK_SPECS["v5e"]
+    expect = (2.0 * 8 * 128 * 2) / (spec.ici_gbps * 1e9 * 0.6)
+    assert get_comm_cost_factor(8, 128, "v5e") == pytest.approx(expect)
+
+
+def test_comm_factor_dcn_link_slower_than_ici():
+    ici = get_comm_cost_factor(8, 128, "v5e", link="ici")
+    dcn = get_comm_cost_factor(8, 128, "v5e", link="dcn")
+    assert dcn > ici  # inter-slice hop costs more per row
+    spec = TPU_PEAK_SPECS["v5e"]
+    assert dcn / ici == pytest.approx(spec.ici_gbps / spec.dcn_gbps)
+
+
+def test_comm_factor_bytes_per_elt():
+    bf16 = get_comm_cost_factor(8, 128, "v5e", bytes_per_elt=2)
+    fp32 = get_comm_cost_factor(8, 128, "v5e", bytes_per_elt=4)
+    assert fp32 == pytest.approx(2 * bf16)
+
+
+def test_faster_generation_has_cheaper_calc():
+    # v6e has ~2x v5p peak bf16 -> lower per-area cost
+    assert get_calc_cost_factor(8, 128, "v6e") < get_calc_cost_factor(
+        8, 128, "v5p"
+    )
+
+
+def test_factors_positive_and_tiny():
+    for gen in TPU_PEAK_SPECS:
+        assert 0 < get_calc_cost_factor(8, 128, gen) < 1e-6
+        assert 0 < get_comm_cost_factor(8, 128, gen) < 1e-3
