@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -48,3 +48,10 @@ bench-plan:
 # documents (exps/run_telemetry_check.py exits non-zero on drift)
 telemetry-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_telemetry_check.py
+
+# autotuner drift guard: assert the cost model's rung choice on three
+# canonical workloads (64k causal / 16k varlen-block-causal / 16k SWA)
+# against exps/data/autotune_expectations.json (run with --update after
+# an intentional recalibration)
+autotune-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_autotune_check.py

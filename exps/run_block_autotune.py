@@ -1,11 +1,22 @@
-"""On-chip autotune sweep for the kernel block configuration table.
+"""On-chip autotune sweep for the kernel block-candidate table.
 
-`ops/flex_attn._AUTO_BLOCK_CONFIGS` encodes measured preferences
-((block_q, block_k, head_block) rungs and the >=16k wide-rung rule).
-This harness re-derives that table empirically: for each mask family and
-seqlen it times fwd and fwd+bwd across candidate rungs and prints the
-winners, so re-tuning after a kernel change is one command on a chip
-window (one TPU process at a time — see BENCH_CACHE.json provenance).
+`ops/flex_attn._AUTO_BLOCK_CONFIGS` is now the CANDIDATE SET (and
+tie-break preference) of the plan-aware autotuner (`tuning/`,
+docs/autotune.md) — per-workload selection happens through the cost
+model / measure-mode cache, not a static lookup. This harness re-derives
+the candidate table empirically: for each mask family and seqlen it
+times fwd and fwd+bwd across candidate rungs and prints the winners, so
+recalibrating after a kernel change is one command on a chip window (one
+TPU process at a time — see BENCH_CACHE.json provenance). Feed the
+results three ways:
+
+- update `_AUTO_BLOCK_CONFIGS` (candidates + preference order),
+- recalibrate the cost-model constants and refresh the drift guard
+  (`python exps/run_autotune_check.py --update`),
+- or skip the table entirely: run production workloads once under
+  ``MAGI_ATTENTION_AUTOTUNE=measure`` with
+  ``MAGI_ATTENTION_AUTOTUNE_CACHE_DIR`` set and let the persistent
+  tuning cache pin the measured winners per workload fingerprint.
 
     python exps/run_block_autotune.py --seqlens 16384,65536 [--masks causal]
 """

@@ -92,6 +92,13 @@ def check_flag_comb(
             f"MAGI_ATTENTION_KERNEL_BACKEND={backend!r} is not one of "
             "('pallas', 'jnp', 'jnp_online')"
         )
+    from ..tuning.autotuner import AUTOTUNE_MODES
+
+    if env.autotune_mode() not in AUTOTUNE_MODES:
+        raise ValueError(
+            f"MAGI_ATTENTION_AUTOTUNE={env.autotune_mode()!r} is not one "
+            f"of {AUTOTUNE_MODES}"
+        )
     if hier_flag and not hier_axis:
         raise ValueError(
             "MAGI_ATTENTION_HIERARCHICAL_COMM=1 requires a 2-D "
@@ -145,6 +152,11 @@ class DistAttnRuntimeKey:
     interpret: Optional[bool]
     mesh_id: int  # id() of the mesh (meshes aren't hashable by value)
     flags: tuple
+    # autotuned (block_q, block_k, head_block) the plan was built with
+    # (ISSUE 2); None = legacy env-flag blocking. Part of the key so a
+    # re-tuned winner (e.g. a fresh measure-mode result) plans its own
+    # runtime instead of silently reusing one built for another blocking.
+    block_config: Optional[tuple[int, int, int]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -382,6 +394,29 @@ def _resolve_overlap_config(oc, hq, hkv, head_dim, *, hier: bool = False):
     return oc
 
 
+# plan-aware block resolution lives with the tuner (tuning/autotuner.py);
+# the keyed-runtime call sites below use it through this alias
+from ..tuning.autotuner import resolve_block_config as _resolve_block_config
+
+
+def _blocking_from(
+    block_config: "tuple[int, int, int] | None", hq: int, hkv: int
+) -> tuple[int, int, int]:
+    """(block_q, block_k, head_block) for a keyed runtime: the tuner's
+    decision, or the legacy env-flag blocking when the tuner stepped
+    aside (``block_config`` None). The single fallback rule for every
+    keyed entry point — flex, cross, and the after-dispatch re-key."""
+    if block_config is not None:
+        return block_config
+    from ..ops.flex_attn import _auto_head_block
+
+    return (
+        env.block_q(),
+        env.block_k(),
+        _auto_head_block(env.head_block(), hq, max(hq // max(hkv, 1), 1)),
+    )
+
+
 def get_runtime_mgr(key: DistAttnRuntimeKey) -> DistAttnRuntimeMgr:
     mgr = _runtime_dict.get(key)
     if mgr is None:
@@ -521,6 +556,29 @@ def magi_attn_flex_key(
         if sink is not None
         else 0
     )
+    # plan-aware block config (ISSUE 2): resolved BEFORE the LRU lookup —
+    # the decision is part of the key, and the tuning cache (not the LRU)
+    # is what makes the repeat-call path cheap. qo-comm keeps the env
+    # blocking: its dynamic plane partition has its own kernel geometry.
+    block_config = (
+        None
+        if env.is_qo_comm_enable()
+        else _resolve_block_config(
+            q_ranges.to_naive_ranges(),
+            k_ranges.to_naive_ranges(),
+            types,
+            total_seqlen_q + pad,
+            total_seqlen_k + pad,
+            cp_size,
+            hq,
+            hkv,
+            head_dim,
+            str(jnp.dtype(out_dtype)),
+        )
+    )
+    plan_block_q, plan_block_k, plan_head_block = _blocking_from(
+        block_config, hq, hkv
+    )
 
     key = DistAttnRuntimeKey(
         q_ranges=tuple(q_ranges.to_naive_ranges()),
@@ -543,6 +601,7 @@ def magi_attn_flex_key(
         interpret=interpret,
         mesh_id=id(mesh),
         flags=env.flags_fingerprint(),
+        block_config=block_config,
     )
     if key in _runtime_dict:
         telemetry.record_cache_access(hit=True)
@@ -612,8 +671,8 @@ def magi_attn_flex_key(
     plan = build_dist_attn_plan(
         mq,
         bucket,
-        block_q=env.block_q(),
-        block_k=env.block_k(),
+        block_q=plan_block_q,
+        block_k=plan_block_k,
         overlap_config=dist_attn_config.overlap_config,
         cp_mesh_shape=cp_mesh_shape,
     )
@@ -632,8 +691,6 @@ def magi_attn_flex_key(
             total_seqlen_q + pad,
             plan.describe(),
         )
-    from ..ops.flex_attn import _auto_head_block
-
     params = make_attn_params(
         plan,
         head_dim,
@@ -641,7 +698,7 @@ def magi_attn_flex_key(
         has_sink=has_sink,
         out_dtype=out_dtype,
         interpret=interpret,
-        head_block=_auto_head_block(env.head_block(), hq, hq // hkv),
+        head_block=plan_head_block,
     )
     attn_fn = make_dist_attn_fn(
         plan, mesh, params, axis_name=cp_axis, sink=sink,
@@ -766,6 +823,21 @@ def magi_attn_cross_key(
         )
     pad_q = compute_pad_size(total_seqlen_q, cp_size, chunk_size_q)
     pad_k = compute_pad_size(total_seqlen_k, cp_size, chunk_size_k)
+    block_config = _resolve_block_config(
+        q_ranges.to_naive_ranges(),
+        k_ranges.to_naive_ranges(),
+        types,
+        total_seqlen_q + pad_q,
+        total_seqlen_k + pad_k,
+        cp_size,
+        hq,
+        hkv,
+        head_dim,
+        str(jnp.dtype(out_dtype)),
+    )
+    plan_block_q, plan_block_k, plan_head_block = _blocking_from(
+        block_config, hq, hkv
+    )
 
     key = DistAttnRuntimeKey(
         q_ranges=tuple(q_ranges.to_naive_ranges()),
@@ -793,6 +865,7 @@ def magi_attn_cross_key(
         interpret=interpret,
         mesh_id=id(mesh),
         flags=env.flags_fingerprint(),
+        block_config=block_config,
     )
     if key in _runtime_dict:
         telemetry.record_cache_access(hit=True)
@@ -817,8 +890,8 @@ def magi_attn_cross_key(
         mq,
         bucket,
         kv_dispatch_meta=mk,
-        block_q=env.block_q(),
-        block_k=env.block_k(),
+        block_q=plan_block_q,
+        block_k=plan_block_k,
         overlap_config=overlap_config,
     )
     telemetry.record_runtime_costs(
@@ -829,15 +902,13 @@ def magi_attn_cross_key(
         bytes_per_elt=jnp.dtype(out_dtype).itemsize,
         generation=env.tpu_generation(),
     )
-    from ..ops.flex_attn import _auto_head_block
-
     params = make_attn_params(
         plan,
         head_dim,
         softcap=softcap,
         out_dtype=out_dtype,
         interpret=interpret,
-        head_block=_auto_head_block(env.head_block(), hq, hq // hkv),
+        head_block=plan_head_block,
     )
     attn_fn = make_dist_attn_fn(
         plan, mesh, params, axis_name=cp_axis, with_max_logits=True
@@ -934,11 +1005,28 @@ def make_flex_key_for_new_mask_after_dispatch(
         from ..common.sanity import check_slices_non_overlapping
 
         check_slices_non_overlapping(q_ranges, k_ranges, types)
+    # re-tune for the NEW mask on the inherited dispatch geometry — the
+    # whole point of the plan-aware tuner is that a hybrid layer stack's
+    # masks (e.g. dense causal + SWA sharing one dispatch) may want
+    # different rungs
+    block_config = _resolve_block_config(
+        q_ranges.to_naive_ranges(),
+        k_ranges.to_naive_ranges(),
+        types,
+        old_key.total_seqlen_q,
+        old_key.total_seqlen_k,
+        old_key.cp_size,
+        old_key.num_heads_q,
+        old_key.num_heads_kv,
+        old_key.head_dim,
+        old_key.out_dtype,
+    )
     new_key = dataclasses.replace(
         old_key,
         q_ranges=tuple(q_ranges.to_naive_ranges()),
         k_ranges=tuple(k_ranges.to_naive_ranges()),
         attn_type_map=types,
+        block_config=block_config,
     )
     if new_key in _runtime_dict:
         telemetry.record_cache_access(hit=True)
@@ -958,11 +1046,14 @@ def make_flex_key_for_new_mask_after_dispatch(
     )
     old_cfg = old_mgr.dist_attn_config
     overlap = old_cfg.overlap_config if old_cfg is not None else None
+    plan_block_q, plan_block_k, plan_head_block = _blocking_from(
+        block_config, new_key.num_heads_q, new_key.num_heads_kv
+    )
     plan = build_dist_attn_plan(
         meta,
         bucket,
-        block_q=env.block_q(),
-        block_k=env.block_k(),
+        block_q=plan_block_q,
+        block_k=plan_block_k,
         overlap_config=overlap,
         cp_mesh_shape=old_mgr.plan.hier,
     )
@@ -974,8 +1065,6 @@ def make_flex_key_for_new_mask_after_dispatch(
         bytes_per_elt=jnp.dtype(new_key.out_dtype).itemsize,
         generation=env.tpu_generation(),
     )
-    from ..ops.flex_attn import _auto_head_block
-
     params = make_attn_params(
         plan,
         new_key.head_dim,
@@ -983,11 +1072,7 @@ def make_flex_key_for_new_mask_after_dispatch(
         has_sink=False,
         out_dtype=new_key.out_dtype,
         interpret=new_key.interpret,
-        head_block=_auto_head_block(
-            env.head_block(),
-            new_key.num_heads_q,
-            new_key.num_heads_q // new_key.num_heads_kv,
-        ),
+        head_block=plan_head_block,
     )
     attn_fn = make_dist_attn_fn(
         plan, old_mgr.mesh, params, axis_name=new_key.cp_axis,

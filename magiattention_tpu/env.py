@@ -92,10 +92,48 @@ def block_k() -> int:
     return _env_int("MAGI_ATTENTION_BLOCK_K", 128)
 
 
+def block_q_override() -> int | None:
+    """Explicitly-set kernel tile height, or None when the flag is unset.
+
+    The keyed runtime treats an explicit MAGI_ATTENTION_BLOCK_Q/_BLOCK_K
+    as a user-pinned blocking (the autotuner steps aside); :func:`block_q`
+    keeps returning the 128 default for legacy call sites."""
+    v = os.environ.get("MAGI_ATTENTION_BLOCK_Q")
+    return int(v) if v else None
+
+
+def block_k_override() -> int | None:
+    v = os.environ.get("MAGI_ATTENTION_BLOCK_K")
+    return int(v) if v else None
+
+
+def autotune_mode() -> str:
+    """Kernel block-config autotuner mode (``tuning/``): 'off' = the
+    legacy static seqlen-keyed table, 'model' (default) = plan-aware
+    analytic cost-model ranking, 'measure' = additionally time the top
+    model candidates on device and persist winners in the tuning cache.
+    Validated at use (autotuner + check_flag_comb)."""
+    return _env_str("MAGI_ATTENTION_AUTOTUNE", "model").strip().lower()
+
+
+def autotune_cache_dir() -> str:
+    """Disk directory backing the tuning cache ('' = process-level cache
+    only). Winners are stored per workload fingerprint; see
+    docs/autotune.md for the file layout."""
+    return _env_str("MAGI_ATTENTION_AUTOTUNE_CACHE_DIR", "")
+
+
 def head_block() -> int:
     """Q heads batched per kernel grid step in the distributed runtime
     (clamped to a divisor of hq that is a GQA-group multiple)."""
     return _env_int("MAGI_ATTENTION_HEAD_BLOCK", 8)
+
+
+def head_block_override() -> int | None:
+    """Explicitly-set head_block, or None when the flag is unset (the
+    autotuned rung's measured head_block then applies)."""
+    v = os.environ.get("MAGI_ATTENTION_HEAD_BLOCK")
+    return int(v) if v else None
 
 
 def tpu_generation() -> str:
@@ -217,4 +255,5 @@ def flags_fingerprint() -> tuple:
         is_auto_range_merge_enable(),
         is_qo_comm_enable(),
         is_hierarchical_comm_enable(),
+        autotune_mode(),
     )

@@ -64,7 +64,6 @@ def plan_flex_attn(
     ``overlap_config`` forces the overlap degree/algorithm (default:
     OverlapConfig(), i.e. the degree-0 merged no-overlap path; pass
     degree=None for the auto-tuned degree)."""
-    from .. import env
     from ..common.enum import AttnMaskType
     from ..meta.dispatch_meta import make_dispatch_meta_from_qk_ranges
     from ..parallel.dist_attn import build_dist_attn_plan, make_attn_params
@@ -98,18 +97,73 @@ def plan_flex_attn(
         chunk_size=chunk_size,
         cp_size=cp_size,
     )
+    bq, bk, hb = resolve_harness_blocking(
+        cfg, mesh, tp_axis,
+        q_ranges.to_naive_ranges(),
+        k_ranges.to_naive_ranges(),
+        attn_type_map,
+        total_seqlen, cp_size, block_q, block_k,
+    )
     plan = build_dist_attn_plan(
         mq,
         bucket,
-        block_q=block_q or env.block_q(),
-        block_k=block_k or env.block_k(),
+        block_q=bq,
+        block_k=bk,
         overlap_config=overlap_config,
         cp_mesh_shape=cp_mesh_shape,
     )
     attn_params = make_attn_params(
-        plan, cfg.head_dim, out_dtype=cfg.dtype, interpret=interpret
+        plan,
+        cfg.head_dim,
+        out_dtype=cfg.dtype,
+        interpret=interpret,
+        head_block=hb,
     )
     return plan, attn_params, mq
+
+
+def resolve_harness_blocking(
+    cfg, mesh, tp_axis, q_naive, k_naive, attn_type_map,
+    total_seqlen, cp_size, block_q, block_k,
+) -> tuple[int, int, int]:
+    """(block_q, block_k, head_block) for a model-harness plan — ONE
+    policy shared by every bundle builder (ISSUE 2): caller args win;
+    else the plan-aware autotuner (which itself steps aside for env pins /
+    autotune=off / tiny shards); else the legacy env defaults. Heads are
+    the PER-RANK counts the kernels actually run under tp. When the tuner
+    steps aside, an explicit MAGI_ATTENTION_HEAD_BLOCK is honored (snapped
+    to the per-tp-rank GQA geometry); unset keeps the harness's legacy
+    head_block of 1."""
+    from .. import env
+
+    tp = mesh.shape[tp_axis] if tp_axis is not None else 1
+    hq = max(cfg.n_heads // tp, 1)
+    hkv = max(cfg.n_kv_heads // tp, 1)
+    if block_q is None and block_k is None:
+        from ..tuning.autotuner import resolve_block_config
+
+        tuned = resolve_block_config(
+            q_naive,
+            k_naive,
+            tuple(int(t) for t in attn_type_map),
+            total_seqlen,
+            total_seqlen,
+            cp_size,
+            hq,
+            hkv,
+            cfg.head_dim,
+            str(cfg.dtype),
+        )
+        if tuned is not None:
+            return tuned
+    hb_env = env.head_block_override()
+    if hb_env is None:
+        hb = 1
+    else:
+        from ..ops.flex_attn import _auto_head_block
+
+        hb = _auto_head_block(hb_env, hq, max(hq // hkv, 1))
+    return (block_q or env.block_q(), block_k or env.block_k(), hb)
 
 
 def make_model_train_step(model, optimizer):

@@ -388,14 +388,21 @@ def build_magi_dit(
         chunk_size=dispatch_chunk,
         cp_size=cp_size,
     )
-    plan = build_dist_attn_plan(
-        mq,
-        bucket,
-        block_q=block_q or env.block_q(),
-        block_k=block_k or env.block_k(),
+    # plan-aware blocking (ISSUE 2): caller args -> autotuner -> env
+    # default — the one harness policy, shared with plan_flex_attn
+    from ._common import resolve_harness_blocking
+
+    bq, bk, hb = resolve_harness_blocking(
+        cfg, mesh, None, qr, kr, ts,
+        total_tokens, cp_size, block_q, block_k,
     )
+    plan = build_dist_attn_plan(mq, bucket, block_q=bq, block_k=bk)
     attn_params = make_attn_params(
-        plan, cfg.head_dim, out_dtype=cfg.dtype, interpret=interpret
+        plan,
+        cfg.head_dim,
+        out_dtype=cfg.dtype,
+        interpret=interpret,
+        head_block=hb,
     )
     model = MagiDiT(
         cfg=cfg,

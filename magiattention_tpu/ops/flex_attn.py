@@ -1307,7 +1307,7 @@ for _bq, _bk, _hb in _AUTO_BLOCK_CONFIGS:
     _HB_FOR_BK[_bk] = min(_hb, _HB_FOR_BK.get(_bk, _hb))
 
 
-def auto_block_config(
+def _static_block_config(
     q_ranges,
     k_ranges,
     hq: int,
@@ -1316,7 +1316,8 @@ def auto_block_config(
     fixed_block_q: int | None = None,
     fixed_block_k: int | None = None,
 ) -> tuple[int, int, int]:
-    """Pick (block_q, block_k, head_block) for a mask: the fastest measured
+    """LEGACY seqlen-keyed preference table (MAGI_ATTENTION_AUTOTUNE=off,
+    and the fallback for caller-fixed block dims): the fastest measured
     config whose entry-table estimate fits the smem scalar-prefetch budget.
 
     At >= 16k tokens (queries or keys) the (1024, 1024, 1) rung is
@@ -1327,6 +1328,10 @@ def auto_block_config(
 
     Caller-fixed block sizes are honored: the entry estimate and head_block
     choice are computed against the blocking the kernel will actually use.
+
+    Blind by construction to mask sparsity and slice shape — the gap the
+    plan-aware cost model (``tuning/``) closes; see
+    :func:`auto_block_config`.
     """
     group = max(hq // max(hk, 1), 1)
     extent = max(
@@ -1349,6 +1354,64 @@ def auto_block_config(
     return last
 
 
+def auto_block_config(
+    q_ranges,
+    k_ranges,
+    hq: int,
+    hk: int,
+    *,
+    fixed_block_q: int | None = None,
+    fixed_block_k: int | None = None,
+    attn_type_map=None,
+    head_dim: int = 128,
+    dtype: str = "bfloat16",
+    measure_fn=None,
+) -> tuple[int, int, int]:
+    """Pick (block_q, block_k, head_block) for a mask.
+
+    Default path: the plan-aware autotuner (``tuning/``) — workload
+    fingerprint, analytic cost model pricing tile-occupancy waste /
+    grid-step overhead / SMEM pressure, persistent winner cache, optional
+    on-device microbenchmark (``MAGI_ATTENTION_AUTOTUNE=measure`` with a
+    ``measure_fn``). ``MAGI_ATTENTION_AUTOTUNE=off`` or caller-fixed block
+    dims restore the legacy seqlen-keyed table
+    (:func:`_static_block_config`) exactly.
+
+    ``attn_type_map`` (mask type per slice) sharpens the cost model's
+    entry counting; omitted, slices are priced as FULL — uniformly
+    conservative across candidates, so the ranking stays sound.
+    """
+    if fixed_block_q is not None or fixed_block_k is not None:
+        # explicit user blocking: honored verbatim, measured hb mapping
+        return _static_block_config(
+            q_ranges,
+            k_ranges,
+            hq,
+            hk,
+            fixed_block_q=fixed_block_q,
+            fixed_block_k=fixed_block_k,
+        )
+    from .. import env
+
+    if env.autotune_mode() == "off":
+        return _static_block_config(q_ranges, k_ranges, hq, hk)
+    from ..tuning import select_block_config
+
+    decision = select_block_config(
+        q_ranges,
+        k_ranges,
+        attn_type_map,
+        hq,
+        hk,
+        head_dim=head_dim,
+        dtype=dtype,
+        measure_fn=measure_fn,
+    )
+    if decision is None:  # unconstrained call: cannot happen, but stay safe
+        return _static_block_config(q_ranges, k_ranges, hq, hk)
+    return decision.config
+
+
 @functools.lru_cache(maxsize=256)
 def _cached_meta(
     q_ranges_b: bytes,
@@ -1369,6 +1432,49 @@ def _cached_meta(
         block_q=block_q,
         block_k=block_k,
     )
+
+
+def _make_measure_fn(
+    q, k, v, q_arr, k_arr, t_arr, *, scale, softcap, sink, out_dtype,
+    interpret, warmup: int = 1, reps: int = 3,
+):
+    """Microbenchmark closure for MAGI_ATTENTION_AUTOTUNE=measure: time
+    the forward under one candidate blocking on the caller's actual
+    operands (compile excluded via warmup). Plans ride the same
+    ``_cached_meta`` LRU as the real call, so the winning candidate's
+    plan is already built when the tuned call follows."""
+    import time
+
+    def measure(bq: int, bk: int, hb: int) -> float:
+        meta = _cached_meta(
+            q_arr.tobytes(),
+            k_arr.tobytes(),
+            t_arr.tobytes(),
+            int(t_arr.shape[0]),
+            int(q.shape[0]),
+            int(k.shape[0]),
+            int(bq),
+            int(bk),
+        )
+
+        def run():
+            return jax.block_until_ready(
+                flex_attn_with_meta(
+                    q, k, v, meta,
+                    scale=scale, softcap=softcap, sink=sink,
+                    out_dtype=out_dtype, head_block=hb,
+                    interpret=interpret,
+                )[0]
+            )
+
+        for _ in range(warmup):
+            run()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            run()
+        return (time.perf_counter() - t0) / reps
+
+    return measure
 
 
 def flex_flash_attn_func(
@@ -1411,6 +1517,25 @@ def flex_flash_attn_func(
             for a in merge_ranges(q_arr, k_arr, t_arr)
         )
     if block_q is None or block_k is None or head_block is None:
+        measure_fn = None
+        if (
+            head_block is None
+            and interpret is not True
+            and _env.autotune_mode() == "measure"
+            and not isinstance(q, jax.core.Tracer)
+        ):
+            # on-device microbenchmark of one candidate on the REAL
+            # operands (concrete values only — under jit tracing the
+            # tuner degrades to the cost model and records why). A
+            # caller-pinned head_block also degrades to the model:
+            # candidates would otherwise be timed at THEIR head_block
+            # while the real call runs the pinned one, and the persisted
+            # winner would describe a configuration that never executes
+            measure_fn = _make_measure_fn(
+                q, k, v, q_arr, k_arr, t_arr,
+                scale=scale, softcap=softcap, sink=sink,
+                out_dtype=out_dtype, interpret=interpret,
+            )
         abq, abk, ahb = auto_block_config(
             q_arr.tolist(),
             k_arr.tolist(),
@@ -1418,6 +1543,10 @@ def flex_flash_attn_func(
             int(k.shape[1]),
             fixed_block_q=block_q,
             fixed_block_k=block_k,
+            attn_type_map=t_arr.tolist(),
+            head_dim=int(q.shape[2]),
+            dtype=str(q.dtype),
+            measure_fn=measure_fn,
         )
         block_q, block_k = abq, abk
         head_block = ahb if head_block is None else head_block

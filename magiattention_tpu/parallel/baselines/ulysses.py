@@ -105,9 +105,12 @@ def ulysses_attn_local(
         params,
         out_dtype="float32",
         # tables become tracers under the surrounding jit; the row-major
-        # kernels need the static grid extents from the host-side meta
-        fwd_steps=params.fwd_steps or meta.fwd_steps,
-        bwd_steps=params.bwd_steps or meta.bwd_steps,
+        # kernels need the static grid extents from the host-side meta.
+        # max(), not or: a caller-supplied steps value sized for a SMALLER
+        # plan must never truncate this meta's table (entries past the
+        # static extent are silently skipped under tracing)
+        fwd_steps=max(params.fwd_steps, meta.fwd_steps),
+        bwd_steps=max(params.bwd_steps, meta.bwd_steps),
     )
     out_h, lse_lanes, _ = flex_attn_headmajor(
         qh, kh, vh, fwd_tables(meta), bwd_tables(meta), fp32_params

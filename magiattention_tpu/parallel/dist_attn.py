@@ -81,6 +81,12 @@ class StageTables:
     bwd_sid: np.ndarray
     bwd_runs: np.ndarray
     bounds: np.ndarray
+    # major-block counts of the per-rank tables (identical across ranks:
+    # every meta is built against the same shard_q_pad / kv_pad). 0 =
+    # unknown (legacy construction); kernel_steps then falls back to 1,
+    # which is harmless for the max — see kernel_steps.
+    num_q_blocks: int = 0
+    num_k_blocks: int = 0
 
     def arrays(self):
         return (
@@ -100,11 +106,19 @@ class StageTables:
         entries sharing one q block (fwd/dq) resp. k block (dkv). The
         kernels run row-major grids (see FlexAttnParams.fwd_steps) and the
         tables are traced per-rank slices at runtime, so these must be
-        computed host-side and carried in the params."""
+        computed host-side and carried in the params.
+
+        The real major-block counts are passed through to max_row_count
+        for honest bincount sizing; note the MAX is provably insensitive
+        to minlength here (every major block owns >= 1 entry — dummies
+        guarantee it — so bincount's tail padding can only append zeros),
+        which is why the legacy num_major=1 never miscounted."""
         from ..ops.block_meta import max_row_count
 
-        fs = max(max_row_count(row, 1) for row in self.fwd_qblk)
-        bs = max(max_row_count(row, 1) for row in self.bwd_kblk)
+        nq = max(self.num_q_blocks, 1)
+        nk = max(self.num_k_blocks, 1)
+        fs = max(max_row_count(row, nq) for row in self.fwd_qblk)
+        bs = max(max_row_count(row, nk) for row in self.bwd_kblk)
         return fs, bs
 
     @staticmethod
@@ -115,6 +129,8 @@ class StageTables:
         metas = [pad_block_meta(m, e, e2, s) for m in metas]
         return StageTables(
             kv_pad=kv_pad,
+            num_q_blocks=max(m.num_q_blocks for m in metas),
+            num_k_blocks=max(m.num_k_blocks for m in metas),
             fwd_qblk=np.stack([m.fwd_q_block for m in metas]),
             fwd_kblk=np.stack([m.fwd_k_block for m in metas]),
             fwd_sid=np.stack([m.fwd_slice_id for m in metas]),

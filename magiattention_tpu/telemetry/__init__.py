@@ -34,6 +34,10 @@ from __future__ import annotations
 
 from .collectors import (  # noqa: F401
     REQUIRED_PLAN_METRICS,
+    record_autotune_cache,
+    record_autotune_decision,
+    record_autotune_measure_failure,
+    record_autotune_measurement,
     record_cache_access,
     record_dispatch_meta,
     record_dispatch_solution,
@@ -113,6 +117,10 @@ __all__ = [
     "get_event_buffer",
     "get_logger",
     "get_registry",
+    "record_autotune_cache",
+    "record_autotune_decision",
+    "record_autotune_measure_failure",
+    "record_autotune_measurement",
     "record_cache_access",
     "record_dispatch_meta",
     "record_dispatch_solution",

@@ -43,6 +43,10 @@ M_COMM_SEND_ROWS = "magi_comm_send_rows"  # {rank=}
 M_COMM_RECV_ROWS = "magi_comm_recv_rows"  # {rank=}
 M_COMM_PADDED_ROWS = "magi_comm_padded_payload_rows"
 M_COMM_BYTES_RANK = "magi_comm_bytes_per_rank"  # {rank=}, bytes
+# padded a2a payload rows / true send rows across the group (>= 1.0; the
+# SPMD uniform-shape cost the reference pays via split_alignment — never
+# measured before ISSUE 2); 0.0 when the cast moves nothing
+M_COMM_PADDING_OVERHEAD = "magi_comm_padding_overhead_ratio"
 
 # gauges — plan layer
 M_PLAN_OVERLAP_DEGREE = "magi_plan_overlap_degree"
@@ -59,6 +63,19 @@ M_OVERLAP_MAKESPAN = "magi_overlap_modeled_makespan_s"
 M_MODELED_FLOPS = "magi_plan_modeled_flops"
 M_MODELED_CALC_S = "magi_plan_modeled_calc_seconds"
 M_MODELED_COMM_S = "magi_plan_modeled_comm_seconds"
+
+# counters + gauges — kernel autotuner (tuning/; see docs/autotune.md)
+M_AUTOTUNE_CACHE_HITS = "magi_autotune_cache_hits_total"  # {layer=}
+M_AUTOTUNE_CACHE_MISSES = "magi_autotune_cache_misses_total"
+M_AUTOTUNE_MEASUREMENTS = "magi_autotune_measurements_total"
+M_AUTOTUNE_MEASURE_FAILURES = "magi_autotune_measure_failures_total"
+M_AUTOTUNE_BLOCK_Q = "magi_autotune_block_q"
+M_AUTOTUNE_BLOCK_K = "magi_autotune_block_k"
+M_AUTOTUNE_HEAD_BLOCK = "magi_autotune_head_block"
+M_AUTOTUNE_PREDICTED_MS = "magi_autotune_predicted_ms"
+M_AUTOTUNE_MEASURED_MS = "magi_autotune_measured_ms"
+# which rung the last decision chose and why: value 1, labels rung=/source=
+M_AUTOTUNE_CHOICE = "magi_autotune_choice"
 
 # histograms (seconds)
 H_PLAN_BUILD_S = "magi_plan_build_seconds"
@@ -78,6 +95,7 @@ REQUIRED_PLAN_METRICS: tuple[str, ...] = (
     M_COMM_SEND_ROWS,
     M_COMM_RECV_ROWS,
     M_COMM_BYTES_RANK,
+    M_COMM_PADDING_OVERHEAD,
     M_MODELED_FLOPS,
     M_MODELED_CALC_S,
     M_MODELED_COMM_S,
@@ -154,14 +172,25 @@ def record_dynamic_solution(solver: str, balance_ratio: float) -> None:
 
 def record_group_collective_build(comm) -> None:
     """One GroupCollectiveMeta routed (``comm/group_collective.py``): counts
-    builds and keeps the latest padded-payload row figure. Per-rank rows
-    are recorded at plan level (:func:`record_plan`) where the *primary*
-    comm meta is known — build() also runs for per-stage sub-metas."""
+    builds and keeps the latest padded-payload row figure plus the
+    padded-vs-actual overhead ratio — the SPMD uniform-shape tax the a2a
+    pays for uneven send maps (VERDICT: never measured before ISSUE 2).
+    Per-rank rows are recorded at plan level (:func:`record_plan`) where
+    the *primary* comm meta is known — build() also runs for per-stage
+    sub-metas."""
     if not _enabled():
         return
     reg = get_registry()
     reg.counter_inc(M_GRPCOLL_BUILDS)
     reg.gauge_set(M_COMM_PADDED_ROWS, comm.comm_bytes_per_rank)
+    # every rank ships cp * max_send rows through the a2a regardless of
+    # how many are real; the ratio is the group-wide padded/true volume
+    true_rows = sum(comm.send_total)
+    padded_rows = comm.cp_size * comm.cp_size * comm.max_send
+    reg.gauge_set(
+        M_COMM_PADDING_OVERHEAD,
+        (padded_rows / true_rows) if true_rows else 0.0,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -266,6 +295,78 @@ def record_cache_access(hit: bool) -> None:
 
 
 # ---------------------------------------------------------------------------
+# kernel autotuner (tuning/)
+# ---------------------------------------------------------------------------
+
+
+def record_autotune_cache(hit: bool, layer: str) -> None:
+    """Tuning-cache behavior (``tuning/cache.py``): hits are labeled with
+    the layer that answered (memory | disk)."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    if hit:
+        reg.counter_inc(M_AUTOTUNE_CACHE_HITS, layer=layer)
+    else:
+        reg.counter_inc(M_AUTOTUNE_CACHE_MISSES)
+
+
+def record_autotune_measurement() -> None:
+    """One on-device candidate microbenchmark completed (measure mode)."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_AUTOTUNE_MEASUREMENTS)
+
+
+def record_autotune_measure_failure(candidate: str, error: str) -> None:
+    """A measure-mode candidate crashed (disqualified, not fatal)."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_AUTOTUNE_MEASURE_FAILURES)
+    from .events import record_event
+
+    record_event(
+        "autotune_measure_failed",
+        0.0,
+        0.0,
+        {"candidate": candidate, "error": error[:200]},
+    )
+
+
+def record_autotune_decision(decision) -> None:
+    """One resolved block-config decision (``tuning/autotuner.py``): the
+    chosen rung, its provenance (static table / cost model / measured /
+    cache layer), and the predicted/measured cost — so every plan records
+    which rung it chose and why."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.gauge_set(M_AUTOTUNE_BLOCK_Q, decision.block_q)
+    reg.gauge_set(M_AUTOTUNE_BLOCK_K, decision.block_k)
+    reg.gauge_set(M_AUTOTUNE_HEAD_BLOCK, decision.head_block)
+    reg.gauge_set(M_AUTOTUNE_PREDICTED_MS, decision.predicted_ms)
+    if decision.measured_ms is not None:
+        reg.gauge_set(M_AUTOTUNE_MEASURED_MS, decision.measured_ms)
+    reg.clear_metric(M_AUTOTUNE_CHOICE)  # one live choice series at a time
+    rung = f"{decision.block_q}x{decision.block_k}x{decision.head_block}"
+    reg.gauge_set(M_AUTOTUNE_CHOICE, 1, rung=rung, source=decision.source)
+    from .events import record_event
+
+    record_event(
+        "autotune_decision",
+        0.0,
+        0.0,
+        {
+            "rung": rung,
+            "source": decision.source,
+            "cache_layer": decision.cache_layer,
+            "fingerprint": decision.fingerprint_hash,
+            "reason": decision.reason,
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
 # summaries
 # ---------------------------------------------------------------------------
 
@@ -311,4 +412,18 @@ def telemetry_summary(snapshot: dict | None = None) -> str:
         f"calc s: {fmt(g.get(M_MODELED_CALC_S))}  "
         f"comm s: {fmt(g.get(M_MODELED_COMM_S))}",
     ]
+    choice = [
+        k for k in g if k.startswith(M_AUTOTUNE_CHOICE + "{")
+    ]
+    if choice:
+        hits = sum(
+            v for k, v in c.items()
+            if k.startswith(M_AUTOTUNE_CACHE_HITS)
+        )
+        lines.append(
+            f"  autotune: {choice[0][len(M_AUTOTUNE_CHOICE):]} "
+            f"predicted {fmt(g.get(M_AUTOTUNE_PREDICTED_MS))} ms  "
+            f"cache hits/misses: {fmt(hits)}/"
+            f"{fmt(c.get(M_AUTOTUNE_CACHE_MISSES, 0))}"
+        )
     return "\n".join(lines)

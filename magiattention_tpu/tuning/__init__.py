@@ -1,0 +1,67 @@
+"""Plan-aware kernel autotuner (ISSUE 2).
+
+Replaces the static block-preference table of ``ops/flex_attn`` with a
+three-stage pipeline keyed on the *workload*, not just the total seqlen:
+
+1. :mod:`.fingerprint` — a stable, hashable description of the attention
+   workload (seqlen, head config, dtype, and mask-shape statistics derived
+   from the slice ranges: covered-area fraction, slice k-widths, and the
+   entry-count estimate per candidate rung).
+2. :mod:`.cost_model`  — an analytic ranking of the candidate
+   (block_q, block_k, head_block) rungs that prices tile-occupancy waste on
+   narrow slices, grid-step overhead (live + clamped-dead steps), and
+   entry-table SMEM pressure — the failure modes the old seqlen-keyed
+   table was blind to (16k varlen-block-causal at 8.4 TF/s on a dense
+   long-seq rung).
+3. :mod:`.cache`       — a process-level + optional disk-backed
+   (``MAGI_ATTENTION_AUTOTUNE_CACHE_DIR``) winner cache keyed by
+   fingerprint hash, so model decisions are computed once and ``measure``
+   -mode microbenchmark winners survive process restarts.
+
+:mod:`.autotuner` glues the three together behind
+:func:`select_block_config`, honoring ``MAGI_ATTENTION_AUTOTUNE``
+(``off`` = legacy static table | ``model`` = analytic ranking, the default
+| ``measure`` = time the top model candidates on device and persist the
+winner). Consumers: ``ops.flex_attn.auto_block_config`` (single-device)
+and ``api.interface.magi_attn_flex_key`` / ``magi_attn_cross_key``
+(distributed — the decision is folded into ``DistAttnRuntimeKey`` so tuned
+configs ride the existing runtime LRU). See ``docs/autotune.md``.
+"""
+
+from __future__ import annotations
+
+from .autotuner import (  # noqa: F401
+    TuningDecision,
+    resolve_block_config,
+    select_block_config,
+)
+from .cache import (  # noqa: F401
+    TuningCache,
+    TuningRecord,
+    get_tuning_cache,
+    reset_tuning_cache,
+)
+from .cost_model import (  # noqa: F401
+    CandidateScore,
+    estimate_entries,
+    rank_candidates,
+)
+from .fingerprint import (  # noqa: F401
+    WorkloadFingerprint,
+    make_fingerprint,
+)
+
+__all__ = [
+    "CandidateScore",
+    "TuningCache",
+    "TuningDecision",
+    "TuningRecord",
+    "WorkloadFingerprint",
+    "estimate_entries",
+    "get_tuning_cache",
+    "make_fingerprint",
+    "rank_candidates",
+    "reset_tuning_cache",
+    "resolve_block_config",
+    "select_block_config",
+]

@@ -1,0 +1,339 @@
+"""Autotuner front door: mode dispatch, cache orchestration, telemetry.
+
+``MAGI_ATTENTION_AUTOTUNE`` modes:
+
+- ``off``     — the legacy static preference table
+  (``ops.flex_attn._static_block_config``), unchanged.
+- ``model``   — (default) analytic cost-model ranking
+  (:mod:`.cost_model`), cached by workload fingerprint.
+- ``measure`` — model ranking first, then the top candidates are timed
+  on device via the caller-supplied ``measure_fn`` and the measured
+  winner is persisted (process + disk cache). Callers that cannot
+  microbenchmark (traced inputs, distributed planning) degrade to
+  ``model`` for that call — the decision records why.
+
+Every decision is recorded through the telemetry registry (chosen rung,
+source, predicted/measured cost, cache layer) so a plan snapshot shows
+which rung each workload chose and why (``docs/observability.md``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .cache import TuningRecord, get_tuning_cache
+from .cost_model import any_feasible_rung, rank_candidates, smem_feasible
+from .fingerprint import make_fingerprint
+
+AUTOTUNE_MODES = ("off", "model", "measure")
+# candidates microbenchmarked in measure mode (the model's top picks)
+MEASURE_TOP_K = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class TuningDecision:
+    """The resolved block configuration plus its provenance."""
+
+    block_q: int
+    block_k: int
+    head_block: int
+    source: str  # "static" | "model" | "measured" | "measure_failed"
+    cache_layer: str  # "memory" | "disk" | "none"
+    fingerprint_hash: str  # "" for static decisions
+    predicted_ms: float
+    measured_ms: float | None
+    reason: str  # one-line human-readable why
+
+    @property
+    def config(self) -> tuple[int, int, int]:
+        return (self.block_q, self.block_k, self.head_block)
+
+
+def _static_decision(q_ranges, k_ranges, hq: int, hk: int) -> TuningDecision:
+    from ..ops.flex_attn import _static_block_config
+
+    bq, bk, hb = _static_block_config(q_ranges, k_ranges, hq, hk)
+    return TuningDecision(
+        block_q=bq,
+        block_k=bk,
+        head_block=hb,
+        source="static",
+        cache_layer="none",
+        fingerprint_hash="",
+        predicted_ms=0.0,
+        measured_ms=None,
+        reason="MAGI_ATTENTION_AUTOTUNE=off: legacy seqlen-keyed table",
+    )
+
+
+def select_block_config(
+    q_ranges,
+    k_ranges,
+    attn_type_map,
+    hq: int,
+    hk: int,
+    *,
+    head_dim: int = 128,
+    dtype: str = "bfloat16",
+    mode: str | None = None,
+    max_block_q: int | None = None,
+    max_block_k: int | None = None,
+    smem_headroom: float = 1.0,
+    measure_fn=None,
+) -> TuningDecision | None:
+    """Resolve (block_q, block_k, head_block) for one workload.
+
+    ``measure_fn(block_q, block_k, head_block) -> seconds`` times one
+    candidate on device (only consulted in ``measure`` mode; exceptions
+    disqualify the candidate rather than failing the plan).
+
+    Returns ``None`` when the caller's ``max_block_q``/``max_block_k``
+    constraints leave no candidate rung — the caller falls back to its
+    own default blocking (distributed plans with tiny per-rank shards).
+    """
+    from .. import env, telemetry
+
+    if mode is None:
+        mode = env.autotune_mode()
+    if mode not in AUTOTUNE_MODES:
+        raise ValueError(
+            f"MAGI_ATTENTION_AUTOTUNE={mode!r} is not one of "
+            f"{AUTOTUNE_MODES}"
+        )
+    if mode == "off":
+        decision = _static_decision(q_ranges, k_ranges, hq, hk)
+        _record(decision)
+        return decision
+
+    fp = make_fingerprint(
+        q_ranges,
+        k_ranges,
+        attn_type_map,
+        hq,
+        hk,
+        head_dim=head_dim,
+        dtype=dtype,
+        max_block_q=max_block_q,
+        max_block_k=max_block_k,
+    )
+    cache = get_tuning_cache()
+    rec, layer = cache.get(fp)
+    aliased = False
+    if (
+        rec is not None
+        and not smem_feasible(
+            q_ranges,
+            k_ranges,
+            attn_type_map,
+            rec.block_q,
+            rec.block_k,
+            smem_headroom,
+        )
+        and any_feasible_rung(
+            q_ranges,
+            k_ranges,
+            attn_type_map,
+            max_block_q=max_block_q,
+            max_block_k=max_block_k,
+            smem_headroom=smem_headroom,
+        )
+    ):
+        # bucket-edge aliasing: the fingerprint's ~9% log2 buckets can
+        # serve a winner whose entry table does not fit THIS workload's
+        # exact SMEM budget — re-rank instead of failing at kernel launch.
+        # (Unless NO rung fits — then the cached escalation winner is as
+        # good as re-ranking, and serving it keeps the hit path cheap.)
+        rec = None
+        aliased = True
+    if (
+        rec is not None
+        and mode == "measure"
+        and measure_fn is not None
+        and rec.source == "model"
+        and any(c.get("feasible") for c in rec.candidates)
+    ):
+        # a model-sourced winner (e.g. cached under jit tracing, where no
+        # microbenchmark is possible) must not permanently pre-empt the
+        # measurement this call CAN run: fall through and upgrade the
+        # entry. "measure_failed" records stay — every candidate crashed
+        # once already; re-compiling and re-crashing them on every call
+        # would turn one bad workload into a per-step compile storm. The
+        # feasibility check keeps infeasible-everywhere workloads (nothing
+        # will ever be measurable) on the cheap hit path instead of
+        # re-ranking and rewriting the disk entry per call
+        rec = None
+    if rec is not None:
+        telemetry.record_autotune_cache(hit=True, layer=layer)
+        decision = TuningDecision(
+            block_q=rec.block_q,
+            block_k=rec.block_k,
+            head_block=rec.head_block,
+            source=rec.source,
+            cache_layer=layer,
+            fingerprint_hash=fp.stable_hash(),
+            predicted_ms=rec.predicted_ms,
+            measured_ms=rec.measured_ms,
+            reason=f"tuning-cache {layer} hit ({rec.source} winner)",
+        )
+        _record(decision)
+        return decision
+    telemetry.record_autotune_cache(hit=False, layer="miss")
+
+    scores = rank_candidates(
+        q_ranges,
+        k_ranges,
+        attn_type_map,
+        hq,
+        hk,
+        head_dim=head_dim,
+        max_block_q=max_block_q,
+        max_block_k=max_block_k,
+        smem_headroom=smem_headroom,
+    )
+    if not scores:
+        return None  # constraints excluded every rung
+    best = scores[0]
+    source = "model"
+    measured_ms = None
+    reason = (
+        f"cost model: {best.block_q}x{best.block_k}x{best.head_block} "
+        f"~{best.cost_seconds * 1e3:.2f} ms "
+        f"(mxu {best.mxu_seconds * 1e3:.2f} + grid "
+        f"{best.step_seconds * 1e3:.2f}; {best.entries} entries, "
+        f"steps {best.steps})"
+    )
+    if mode == "measure" and measure_fn is not None:
+        timed: list[tuple[float, object]] = []
+        attempted = 0
+        for cand in [s for s in scores if s.feasible][:MEASURE_TOP_K]:
+            attempted += 1
+            try:
+                t = float(
+                    measure_fn(cand.block_q, cand.block_k, cand.head_block)
+                )
+            except Exception as e:  # noqa: BLE001 — a crashing candidate
+                # is disqualified, not fatal (e.g. over-budget SMEM)
+                telemetry.record_autotune_measure_failure(
+                    f"{cand.block_q}x{cand.block_k}x{cand.head_block}",
+                    str(e),
+                )
+                continue
+            timed.append((t, cand))
+            telemetry.record_autotune_measurement()
+        if timed:
+            t_best, best = min(timed, key=lambda x: x[0])
+            source = "measured"
+            measured_ms = t_best * 1e3
+            reason = (
+                f"measured winner {best.block_q}x{best.block_k}x"
+                f"{best.head_block}: {measured_ms:.2f} ms over "
+                f"{len(timed)} candidates (fwd-only timing)"
+            )
+        elif attempted:
+            source = "measure_failed"
+            reason += (
+                f" (all {attempted} microbenchmark candidates failed; "
+                "model winner)"
+            )
+        else:
+            # nothing was feasible to time — that is a model decision,
+            # not a measurement failure
+            reason += " (no feasible candidate to measure)"
+    elif mode == "measure":
+        reason += " (measure requested, no microbenchmark available here)"
+
+    rec = TuningRecord(
+        block_q=best.block_q,
+        block_k=best.block_k,
+        head_block=best.head_block,
+        source=source,
+        predicted_ms=best.cost_seconds * 1e3,
+        measured_ms=measured_ms,
+        candidates=tuple(s.as_dict() for s in scores),
+    )
+    if not aliased:
+        cache.put(fp, rec)
+    # aliased: the fingerprint slot keeps the resident workload's winner
+    # (possibly an expensive on-chip measurement) — caching this exact
+    # workload's re-rank would clobber it and set up an A/B re-tune
+    # ping-pong; the rare collision victim re-ranks per call instead
+    decision = TuningDecision(
+        block_q=best.block_q,
+        block_k=best.block_k,
+        head_block=best.head_block,
+        source=source,
+        cache_layer="none",
+        fingerprint_hash=fp.stable_hash(),
+        predicted_ms=rec.predicted_ms,
+        measured_ms=measured_ms,
+        reason=reason,
+    )
+    _record(decision)
+    return decision
+
+
+def _record(decision: TuningDecision) -> None:
+    from .. import telemetry
+
+    telemetry.record_autotune_decision(decision)
+
+
+def resolve_block_config(
+    q_ranges,
+    k_ranges,
+    types,
+    total_q_padded: int,
+    total_k_padded: int,
+    cp_size: int,
+    hq: int,
+    hkv: int,
+    head_dim: int,
+    out_dtype: str,
+) -> tuple[int, int, int] | None:
+    """Plan-aware block config for a distributed plan (keyed runtime or
+    model-harness builder), or None for the legacy env-flag blocking.
+
+    The autotuner steps aside when the user pinned a blocking via
+    MAGI_ATTENTION_BLOCK_Q/_BLOCK_K, when MAGI_ATTENTION_AUTOTUNE=off, or
+    when the per-rank shard is smaller than every candidate rung (tiny
+    test meshes) — those cases keep the pre-ISSUE-2 behavior bit-for-bit.
+
+    Candidates are constrained to the per-rank shard geometry (a tile
+    wider than the rank's buffer is pure padding) and the SMEM estimate
+    is scaled to per-rank tables (global entries / cp, doubled for run
+    fragmentation). ``measure`` mode degrades to the cost model here —
+    there is no way to microbenchmark a full distributed plan during key
+    creation; the decision's telemetry records that.
+    """
+    from .. import env
+
+    if env.autotune_mode() == "off":
+        return None
+    if env.block_q_override() is not None or env.block_k_override() is not None:
+        return None  # user-pinned blocking wins
+
+    shard_q = max(total_q_padded // max(cp_size, 1), 1)
+    shard_k = max(total_k_padded // max(cp_size, 1), 1)
+    decision = select_block_config(
+        q_ranges,
+        k_ranges,
+        types,
+        hq,
+        hkv,
+        head_dim=head_dim,
+        dtype=str(out_dtype),
+        max_block_q=shard_q,
+        max_block_k=shard_k,
+        smem_headroom=(1.0 if cp_size <= 1 else 2.0 / cp_size),
+    )
+    if decision is None:
+        return None
+    hb_env = env.head_block_override()
+    from ..ops.flex_attn import _auto_head_block
+
+    hb = (
+        decision.head_block
+        if hb_env is None
+        else _auto_head_block(hb_env, hq, max(hq // max(hkv, 1), 1))
+    )
+    return (decision.block_q, decision.block_k, hb)

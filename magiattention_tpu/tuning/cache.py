@@ -1,0 +1,151 @@
+"""Persistent tuning cache: process-level dict + optional disk backing.
+
+Disk layout (``MAGI_ATTENTION_AUTOTUNE_CACHE_DIR``): one JSON file per
+fingerprint, ``magi-autotune-<hash>.json``, holding the full fingerprint
+(verified on load — a truncated-hash collision or version skew silently
+misses instead of mis-tuning), the winning rung, and the per-candidate
+diagnostics it beat. Files are written atomically (temp + rename) so
+concurrent processes sharing a cache dir at worst re-tune; they never read
+torn JSON.
+
+The process-level layer makes repeated plans free regardless of disk
+config; the disk layer is what makes ``measure``-mode winners — minutes of
+on-chip microbenchmarks for a big sweep — survive process restarts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+
+from .fingerprint import WorkloadFingerprint
+
+CACHE_FILE_PREFIX = "magi-autotune-"
+
+
+@dataclasses.dataclass(frozen=True)
+class TuningRecord:
+    """One cached winner for a fingerprint."""
+
+    block_q: int
+    block_k: int
+    head_block: int
+    source: str  # "model" | "measured" | "measure_failed"
+    predicted_ms: float  # cost-model estimate for the winner
+    measured_ms: float | None  # microbenchmark time (measure mode only)
+    candidates: tuple[dict, ...]  # per-rung diagnostics, ranked
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["candidates"] = [dict(c) for c in self.candidates]
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "TuningRecord":
+        return TuningRecord(
+            block_q=int(d["block_q"]),
+            block_k=int(d["block_k"]),
+            head_block=int(d["head_block"]),
+            source=str(d["source"]),
+            predicted_ms=float(d["predicted_ms"]),
+            measured_ms=(
+                float(d["measured_ms"])
+                if d.get("measured_ms") is not None
+                else None
+            ),
+            candidates=tuple(dict(c) for c in d.get("candidates", ())),
+        )
+
+
+class TuningCache:
+    """fingerprint-hash -> :class:`TuningRecord`, memory-first."""
+
+    def __init__(self, cache_dir: str | None = None):
+        self.cache_dir = cache_dir or None
+        self._mem: dict[str, TuningRecord] = {}
+
+    def _path(self, key: str) -> str:
+        assert self.cache_dir
+        return os.path.join(self.cache_dir, f"{CACHE_FILE_PREFIX}{key}.json")
+
+    def get(
+        self, fp: WorkloadFingerprint
+    ) -> tuple[TuningRecord | None, str]:
+        """(record, layer) with layer in {"memory", "disk", "miss"}. Disk
+        hits are promoted to the memory layer."""
+        key = fp.stable_hash()
+        rec = self._mem.get(key)
+        if rec is not None:
+            return rec, "memory"
+        if self.cache_dir:
+            rec = self._load_disk(key, fp)
+            if rec is not None:
+                self._mem[key] = rec
+                return rec, "disk"
+        return None, "miss"
+
+    def put(self, fp: WorkloadFingerprint, rec: TuningRecord) -> None:
+        key = fp.stable_hash()
+        self._mem[key] = rec
+        # measure_failed stays process-local: it exists to stop THIS
+        # process from re-compiling crashing candidates on every call; a
+        # fresh process (healthy chip, transient OOM gone) should retry
+        # rather than inherit the failure forever
+        if self.cache_dir and rec.source != "measure_failed":
+            self._store_disk(key, fp, rec)
+
+    def _load_disk(
+        self, key: str, fp: WorkloadFingerprint
+    ) -> TuningRecord | None:
+        try:
+            with open(self._path(key)) as f:
+                payload = json.load(f)
+            if payload.get("fingerprint") != fp.as_dict():
+                return None  # hash collision or fingerprint-version skew
+            return TuningRecord.from_dict(payload["record"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None  # unreadable/torn/foreign file: treat as a miss
+
+    def _store_disk(
+        self, key: str, fp: WorkloadFingerprint, rec: TuningRecord
+    ) -> None:
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=self.cache_dir, prefix=CACHE_FILE_PREFIX, suffix=".tmp"
+            )
+            with os.fdopen(fd, "w") as f:
+                json.dump(
+                    {"fingerprint": fp.as_dict(), "record": rec.as_dict()},
+                    f,
+                    sort_keys=True,
+                )
+            os.replace(tmp, self._path(key))
+        except OSError:
+            pass  # a read-only cache dir must never take planning down
+
+    def __len__(self) -> int:
+        return len(self._mem)
+
+
+_cache: TuningCache | None = None
+
+
+def get_tuning_cache() -> TuningCache:
+    """Process singleton, rebuilt when the env cache dir changes (tests
+    monkeypatch ``MAGI_ATTENTION_AUTOTUNE_CACHE_DIR`` per case)."""
+    global _cache
+    from .. import env
+
+    want = env.autotune_cache_dir() or None
+    if _cache is None or _cache.cache_dir != want:
+        _cache = TuningCache(want)
+    return _cache
+
+
+def reset_tuning_cache() -> None:
+    """Drop the process-level cache (disk files are left alone)."""
+    global _cache
+    _cache = None

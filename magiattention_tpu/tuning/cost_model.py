@@ -1,0 +1,354 @@
+"""Analytic cost model ranking kernel block configurations per workload.
+
+The round-5 kernels run a row-major grid (heads/head_block, num_q_blocks,
+steps) over a host-built entry table (one entry per (q-block, k-block,
+slice) tile intersecting the mask — ``ops/block_meta.py``). Three costs
+follow directly from that structure, and all three depend on the MASK
+SHAPE, not just the total seqlen the old static table keyed on:
+
+- **tile compute** — every emitted entry pays a full (block_q x block_k)
+  MXU tile regardless of how much of it the mask covers, so narrow slices
+  (SWA bands, short varlen blocks) waste most of a 1024-wide tile;
+- **grid steps** — each live step carries fixed overhead (calibrated from
+  the round-5 stock-flash control: (256,512) at 71.5 vs (1024,1024) at
+  99.9 TF/s on 64k causal with near-identical tile FLOPs), and clamped
+  dead steps (rows shorter than the static ``steps`` extent) still cost a
+  reduced per-step fee;
+- **SMEM pressure** — the scalar-prefetch entry table must fit the ~1 MB
+  scalar core budget (``flex_attn._MAX_SMEM_ENTRIES``), which rules small
+  tiles out for huge dense masks.
+
+Entry/step counts are computed EXACTLY for identity-run layouts by
+intersecting every slice with the candidate's q-block grid (vectorized
+numpy, O(num_slices * num_q_blocks) — host planning scale). Feasibility
+uses the conservative legacy upper bound (misalignment-padded rectangle
+coverage) so distributed plans with fragmented runs stay inside budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..utils.cost import TPU_PEAK_SPECS
+
+# Per-live-grid-step fixed overhead (seconds): calibrated so the modeled
+# gap between the (256,512) and (1024,1024) rungs on 64k dense causal
+# matches the measured 71.5 -> 99.9 TF/s spread (~34 ms over ~132k steps).
+STEP_OVERHEAD_S = 3.0e-7
+# Clamped dead steps skip compute and re-DMA nothing; they still occupy a
+# grid slot. Measured indirectly (leveled-pad experiments, round 4).
+DEAD_STEP_OVERHEAD_S = 5.0e-8
+# Candidates within this relative cost of the best are considered a tie
+# and resolved by the measured preference order (the analytic model is
+# deliberately not trusted below its own error bar — the static table's
+# on-chip measurements are).
+TIE_TOLERANCE = 0.15
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+@dataclasses.dataclass(frozen=True)
+class CandidateScore:
+    """One ranked rung: predicted cost plus the estimates behind it."""
+
+    block_q: int
+    block_k: int
+    head_block: int  # snapped to the workload's GQA group / hq
+    entries: int  # exact tile count (identity runs), incl. dummies
+    steps: int  # max entries on any q block = static inner-grid extent
+    smem_entries: int  # conservative upper bound used for feasibility
+    feasible: bool
+    mxu_seconds: float
+    step_seconds: float
+
+    @property
+    def cost_seconds(self) -> float:
+        return self.mxu_seconds + self.step_seconds
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["cost_seconds"] = self.cost_seconds
+        return d
+
+
+def _normalize_slices(q_ranges, k_ranges, attn_type_map):
+    q = np.asarray(q_ranges, dtype=np.int64).reshape(-1, 2)
+    k = np.asarray(k_ranges, dtype=np.int64).reshape(-1, 2)
+    if attn_type_map is None:
+        t = np.zeros(q.shape[0], dtype=np.int64)  # FULL: conservative
+    else:
+        t = np.asarray(
+            [int(x) for x in np.asarray(attn_type_map).reshape(-1)],
+            dtype=np.int64,
+        )
+    assert q.shape[0] == k.shape[0] == t.shape[0]
+    # degenerate slices attend nothing and must not stretch the extent
+    # (an empty (n, n) sentinel range would otherwise inflate the q-block
+    # grid with dummy rows)
+    live = (q[:, 1] > q[:, 0]) & (k[:, 1] > k[:, 0])
+    return q[live], k[live], t[live]
+
+
+def estimate_entries(
+    q_ranges,
+    k_ranges,
+    attn_type_map,
+    block_q: int,
+    block_k: int,
+) -> tuple[int, int, int]:
+    """(entries, steps, num_q_blocks) for one candidate blocking.
+
+    Exact for identity-run (single-device) layouts: per q block of each
+    slice, the attended k interval is computed mask-type-aware (the same
+    affine spans ``block_meta._slice_k_span`` emits) and counted in
+    k-block units. Uncovered q blocks contribute one dummy entry each
+    (the table invariant); ``steps`` is the max per-block entry count —
+    the kernel's static inner-grid extent.
+
+    Memoized on a digest of the canonical slice bytes (a digest, not the
+    blobs themselves — large varlen range arrays must not be pinned as
+    cache keys): the fingerprint's per-rung entry buckets and the ranker's
+    scoring pass hit the same workload x rung pairs back to back and must
+    not pay the count twice.
+    """
+    q, k, t = _normalize_slices(q_ranges, k_ranges, attn_type_map)
+    key = (slices_digest(q, k, t), int(block_q), int(block_k))
+    hit = _ENTRY_MEMO.get(key)
+    if hit is None:
+        if len(_ENTRY_MEMO) >= _ENTRY_MEMO_CAP:  # crude bound, never grows
+            _ENTRY_MEMO.clear()
+        hit = _ENTRY_MEMO[key] = _estimate_entries_impl(
+            q, k, t, int(block_q), int(block_k)
+        )
+    return hit
+
+
+def slices_digest(q, k, t) -> bytes:
+    """Stable 32-byte identity of a normalized slice set (shared with the
+    fingerprint memo)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for a in (q, k, t):
+        h.update(np.ascontiguousarray(a).tobytes())
+        h.update(b"|")
+    return h.digest()
+
+
+_ENTRY_MEMO: dict = {}
+_ENTRY_MEMO_CAP = 4096
+
+
+def _estimate_entries_impl(
+    q: np.ndarray, k: np.ndarray, t: np.ndarray, block_q: int, block_k: int
+) -> tuple[int, int, int]:
+    extent_q = int(q[:, 1].max()) if q.size else 0
+    nq = max(_cdiv(extent_q, block_q), 1)
+    per_block = np.zeros(nq, dtype=np.int64)
+    for (q0, q1), (k0, k1), mt in zip(q.tolist(), k.tolist(), t.tolist()):
+        if q1 <= q0 or k1 <= k0:
+            continue
+        idx = np.arange(q0 // block_q, _cdiv(q1, block_q), dtype=np.int64)
+        lo = np.maximum(q0, idx * block_q)  # first row (inclusive)
+        hi = np.minimum(q1, (idx + 1) * block_q)  # last row (exclusive)
+        k_lo = np.full(idx.shape, k0, dtype=np.int64)
+        k_hi = np.full(idx.shape, k1, dtype=np.int64)
+        if mt & 1:  # causal: k - ke <= q - qe
+            k_hi = np.minimum(k_hi, k1 - q1 + hi)
+        if mt & 2:  # inv-causal: k - ks >= q - qs
+            k_lo = np.maximum(k_lo, k0 + (lo - q0))
+        covered = k_hi > k_lo
+        nkb = np.where(
+            covered,
+            (np.maximum(k_hi, k_lo + 1) - 1) // block_k - k_lo // block_k + 1,
+            0,
+        )
+        per_block[idx] += nkb
+    dummies = int((per_block == 0).sum())
+    entries = int(per_block.sum()) + dummies
+    steps = max(int(per_block.max()) if per_block.size else 0, 1)
+    return entries, steps, nq
+
+
+def smem_feasible(
+    q_ranges,
+    k_ranges,
+    attn_type_map,
+    block_q: int,
+    block_k: int,
+    smem_headroom: float = 1.0,
+) -> bool:
+    """The ranker's SMEM feasibility test for ONE rung on the EXACT
+    workload — used to re-validate tuning-cache hits: the fingerprint's
+    ~9% log2 buckets can alias a near-budget workload onto a cached winner
+    whose entry table would not fit this workload's table.
+
+    Memoized (digest keys) — it runs on EVERY cache hit, i.e. the keyed
+    runtime's steady-state repeat-call path, where the pre-PR cost was a
+    pure dict hit."""
+    q, k, t = _normalize_slices(q_ranges, k_ranges, attn_type_map)
+    key = (
+        slices_digest(q, k, t),
+        int(block_q),
+        int(block_k),
+        int(round(smem_headroom * 1024)),
+    )
+    hit = _SMEM_MEMO.get(key)
+    if hit is None:
+        from ..ops.flex_attn import _MAX_SMEM_ENTRIES, _est_entries
+
+        naive = [(int(a), int(b)) for a, b in q.tolist()]
+        naive_k = [(int(a), int(b)) for a, b in k.tolist()]
+        est = int(
+            _est_entries(naive, naive_k, block_q, block_k) * smem_headroom
+        )
+        if len(_SMEM_MEMO) >= _ENTRY_MEMO_CAP:  # crude bound, never grows
+            _SMEM_MEMO.clear()
+        hit = _SMEM_MEMO[key] = est <= _MAX_SMEM_ENTRIES
+    return hit
+
+
+_SMEM_MEMO: dict = {}
+
+
+def any_feasible_rung(
+    q_ranges,
+    k_ranges,
+    attn_type_map,
+    *,
+    max_block_q: int | None = None,
+    max_block_k: int | None = None,
+    smem_headroom: float = 1.0,
+) -> bool:
+    """True when at least one candidate rung fits the exact workload's
+    SMEM budget — the re-rank-on-aliased-hit escape hatch: if nothing is
+    feasible, a cached escalation winner is as good as re-ranking."""
+    from ..ops.flex_attn import _AUTO_BLOCK_CONFIGS
+
+    return any(
+        smem_feasible(q_ranges, k_ranges, attn_type_map, bq, bk, smem_headroom)
+        for bq, bk, _hb in _AUTO_BLOCK_CONFIGS
+        if (max_block_q is None or bq <= max_block_q)
+        and (max_block_k is None or bk <= max_block_k)
+    )
+
+
+def _preference_order(extent: int):
+    """The measured rung preference for this extent class — the old static
+    table's ordering, reused as the tie-breaker (on-chip measurements
+    outrank the model inside its error bar)."""
+    from ..ops.flex_attn import (
+        _AUTO_BLOCK_CONFIGS,
+        _LONG_SEQ_BLOCK_THRESHOLD,
+        _LONG_SEQ_CONFIGS,
+    )
+
+    if extent >= _LONG_SEQ_BLOCK_THRESHOLD:
+        rest = tuple(
+            c for c in _AUTO_BLOCK_CONFIGS if c not in _LONG_SEQ_CONFIGS
+        )
+        return _LONG_SEQ_CONFIGS + rest
+    return _AUTO_BLOCK_CONFIGS
+
+
+def rank_candidates(
+    q_ranges,
+    k_ranges,
+    attn_type_map,
+    hq: int,
+    hk: int,
+    *,
+    head_dim: int = 128,
+    generation: str | None = None,
+    max_block_q: int | None = None,
+    max_block_k: int | None = None,
+    smem_headroom: float = 1.0,
+) -> list[CandidateScore]:
+    """Score every candidate rung for the workload, best first.
+
+    The returned order is cost-ascending EXCEPT that candidates within
+    :data:`TIE_TOLERANCE` of the best are resolved by the measured
+    preference order for the workload's extent — so dense workloads keep
+    the on-chip-measured winners while shape-sensitive workloads (narrow
+    varlen blocks, SWA bands) escape to occupancy-correct rungs.
+
+    ``max_block_q``/``max_block_k`` drop rungs larger than the caller's
+    shard geometry (distributed plans: a tile wider than the per-rank
+    buffer is pure padding). ``smem_headroom`` scales the conservative
+    entry upper bound (>1 models per-rank run fragmentation).
+
+    Infeasible-everywhere masks return the legacy escalation order
+    (wide-tile rungs first) with ``feasible=False`` throughout — callers
+    keep the old behavior of launching the least-bad rung and letting the
+    kernel's SMEM check raise a descriptive error.
+    """
+    from .. import env
+    from ..ops.flex_attn import (
+        _MAX_SMEM_ENTRIES,
+        _auto_head_block,
+        _est_entries,
+    )
+
+    q, k, t = _normalize_slices(q_ranges, k_ranges, attn_type_map)
+    extent = 0
+    if q.size:
+        extent = max(int(q[:, 1].max()), int(k[:, 1].max()))
+    gen = generation if generation is not None else env.tpu_generation()
+    spec = TPU_PEAK_SPECS.get(gen) or TPU_PEAK_SPECS["v5e"]
+    eff_flops = spec.bf16_tflops * 1e12 * spec.mfu
+    group = max(hq // max(hk, 1), 1)
+    naive = [(r[0], r[1]) for r in q.tolist()]
+    naive_k = [(r[0], r[1]) for r in k.tolist()]
+
+    scores: list[CandidateScore] = []
+    for bq, bk, hb_pref in _preference_order(extent):
+        if max_block_q is not None and bq > max_block_q:
+            continue
+        if max_block_k is not None and bk > max_block_k:
+            continue
+        hb = _auto_head_block(hb_pref, hq, group)
+        entries, steps, nq = estimate_entries(q, k, t, bq, bk)
+        smem_est = int(_est_entries(naive, naive_k, bq, bk) * smem_headroom)
+        grid_rows = max(hq // max(hb, 1), 1)
+        live = grid_rows * entries
+        dead = max(grid_rows * nq * steps - live, 0)
+        mxu_s = 4.0 * head_dim * hq * entries * bq * bk / eff_flops
+        step_s = live * STEP_OVERHEAD_S + dead * DEAD_STEP_OVERHEAD_S
+        scores.append(
+            CandidateScore(
+                block_q=bq,
+                block_k=bk,
+                head_block=hb,
+                entries=entries,
+                steps=steps,
+                smem_entries=smem_est,
+                feasible=smem_est <= _MAX_SMEM_ENTRIES,
+                mxu_seconds=mxu_s,
+                step_seconds=step_s,
+            )
+        )
+
+    feasible = [s for s in scores if s.feasible]
+    if not feasible:
+        # legacy escalation: biggest tiles first, k-widest on ties — the
+        # static table's entry-budget escalation rung ((512, 2048) for
+        # oversized dense masks), so the launch-time SMEM check is the
+        # one to fail, with its descriptive error
+        return sorted(
+            scores,
+            key=lambda s: (-s.block_q * s.block_k, -s.block_k, s.smem_entries),
+        )
+    best = min(s.cost_seconds for s in feasible)
+    tied = [
+        s for s in feasible if s.cost_seconds <= best * (1.0 + TIE_TOLERANCE)
+    ]
+    rest = sorted(
+        (s for s in scores if s not in tied), key=lambda s: s.cost_seconds
+    )
+    # tied candidates keep the measured preference order they were
+    # generated in; clear winners sort ahead of the tie-pool's losers
+    return tied + rest

@@ -1,0 +1,182 @@
+"""Workload fingerprints: the tuning cache's key space.
+
+A fingerprint captures everything the cost model's answer depends on —
+problem extents, head configuration, dtype, and mask-shape statistics
+derived from the slice ranges — as INTEGERS ONLY (log2 / milli buckets),
+so the stable hash is reproducible across processes and platforms and
+nearly-identical workloads (a few tokens of drift in a varlen batch)
+share a cache entry instead of re-tuning.
+
+The per-rung entry-count estimates are part of the fingerprint: two masks
+with similar aggregate statistics but different tiling behavior (e.g. an
+aligned vs misaligned block-causal layout) must not share a winner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+
+
+def _log2_bucket(x: float, per_octave: int = 8) -> int:
+    """log2 of ``x`` quantized to ``per_octave`` steps per octave (0 for
+    x <= 0): a ~9% relative bucket — coarse enough to absorb token-count
+    jitter, fine enough to separate genuinely different shapes."""
+    if x <= 0:
+        return 0
+    return int(round(math.log2(x) * per_octave))
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadFingerprint:
+    """Hashable workload identity for the tuning cache."""
+
+    version: int
+    generation: str  # TPU generation — winners are chip-specific
+    backend: str  # kernel backend @ jax platform — a jnp/CPU-measured
+    # winner must never be served to a pallas/TPU run sharing the cache dir
+    total_q: int
+    total_k: int
+    num_heads_q: int
+    num_heads_kv: int
+    head_dim: int
+    dtype: str
+    num_slices: int
+    covered_frac_milli: int  # unmasked area / (tq * tk), in 1/1000
+    mean_k_width_bucket: int  # log2 bucket of the mean slice k-width
+    max_k_width_bucket: int
+    mean_q_width_bucket: int
+    causal_frac_milli: int  # slices with a causal/inv-causal bound
+    max_block_q: int  # caller shard constraint (0 = unconstrained)
+    max_block_k: int
+    entry_est: tuple[tuple[int, int, int], ...]  # (bq, bk, bucketed E)
+
+    FINGERPRINT_VERSION = 2
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["entry_est"] = [list(e) for e in self.entry_est]
+        return d
+
+    def stable_hash(self) -> str:
+        """Process-independent content hash (the disk cache's file key)."""
+        payload = json.dumps(
+            self.as_dict(), sort_keys=True, separators=(",", ":")
+        )
+        return hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+
+def make_fingerprint(
+    q_ranges,
+    k_ranges,
+    attn_type_map,
+    hq: int,
+    hk: int,
+    *,
+    head_dim: int = 128,
+    dtype: str = "bfloat16",
+    max_block_q: int | None = None,
+    max_block_k: int | None = None,
+) -> WorkloadFingerprint:
+    """Derive the fingerprint from host-side slice ranges.
+
+    Area uses the exact per-slice closed forms (``common.mask.slice_area``)
+    — the same FLOPs proxy the dispatch solver balances on. The per-rung
+    entry estimates come from the cost model's exact tile counting, log2-
+    bucketed like every other statistic.
+
+    Degenerate (empty) slices are dropped before any statistic is taken —
+    the same filter the cost model applies — so sentinel-padded range
+    lists fingerprint identically to their clean equivalents. The
+    derivation is memoized on the canonical slice bytes: repeat plans pay
+    a dict hit, not a per-slice recount (the tuning cache then serves the
+    decision itself).
+    """
+    import jax
+
+    from .. import env
+    from .cost_model import _normalize_slices, slices_digest
+
+    q, k, t = _normalize_slices(q_ranges, k_ranges, attn_type_map)
+    key = (
+        slices_digest(q, k, t),
+        env.tpu_generation(),
+        f"{env.kernel_backend()}@{jax.default_backend()}",
+        int(hq),
+        int(hk),
+        int(head_dim),
+        str(dtype),
+        int(max_block_q or 0),
+        int(max_block_k or 0),
+    )
+    fp = _FP_MEMO.get(key)
+    if fp is None:
+        if len(_FP_MEMO) >= _FP_MEMO_CAP:  # crude bound, never grows
+            _FP_MEMO.clear()
+        fp = _FP_MEMO[key] = _make_fingerprint_impl(q, k, t, *key[1:])
+    return fp
+
+
+# digest-keyed (32 bytes/entry, not the raw range blobs) so dynamic varlen
+# jobs with per-batch-unique masks cannot pin large arrays as memo keys
+_FP_MEMO: dict = {}
+_FP_MEMO_CAP = 512
+
+
+def _make_fingerprint_impl(
+    q,
+    k,
+    t,
+    generation: str,
+    backend: str,
+    hq: int,
+    hk: int,
+    head_dim: int,
+    dtype: str,
+    max_block_q: int,
+    max_block_k: int,
+) -> WorkloadFingerprint:
+    import numpy as np
+
+    from ..common.mask import slice_area
+    from ..ops.flex_attn import _AUTO_BLOCK_CONFIGS
+    from .cost_model import estimate_entries
+
+    total_q = int(q[:, 1].max()) if q.size else 0
+    total_k = int(k[:, 1].max()) if k.size else 0
+    area = sum(
+        slice_area(int(a), int(b), int(c), int(d), int(mt))
+        for (a, b), (c, d), mt in zip(q.tolist(), k.tolist(), t.tolist())
+    )
+    denom = max(total_q * total_k, 1)
+    k_widths = (k[:, 1] - k[:, 0]) if k.size else np.zeros(1, np.int64)
+    q_widths = (q[:, 1] - q[:, 0]) if q.size else np.zeros(1, np.int64)
+    n = max(int(t.shape[0]), 1)
+    causal = int(((t & 1) | ((t & 2) >> 1)).sum())
+
+    entry_est = tuple(
+        (bq, bk, _log2_bucket(estimate_entries(q, k, t, bq, bk)[0]))
+        for bq, bk, _hb in _AUTO_BLOCK_CONFIGS
+    )
+    return WorkloadFingerprint(
+        version=WorkloadFingerprint.FINGERPRINT_VERSION,
+        generation=generation,
+        backend=backend,
+        total_q=_log2_bucket(total_q),
+        total_k=_log2_bucket(total_k),
+        num_heads_q=int(hq),
+        num_heads_kv=int(hk),
+        head_dim=int(head_dim),
+        dtype=str(dtype),
+        num_slices=_log2_bucket(n),
+        covered_frac_milli=int(round(1000.0 * area / denom)),
+        mean_k_width_bucket=_log2_bucket(float(k_widths.mean())),
+        max_k_width_bucket=_log2_bucket(float(k_widths.max())),
+        mean_q_width_bucket=_log2_bucket(float(q_widths.mean())),
+        causal_frac_milli=int(round(1000.0 * causal / n)),
+        max_block_q=max_block_q,
+        max_block_k=max_block_k,
+        entry_est=entry_est,
+    )

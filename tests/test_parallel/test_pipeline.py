@@ -101,6 +101,36 @@ def _mesh(cp):
     return Mesh(np.array(jax.devices()[:cp]), ("cp",))
 
 
+def test_stage_tables_carry_real_major_block_counts():
+    """StageTables.kernel_steps used to hand max_row_count a misleading
+    num_major=1 (harmless for the max only because dummies guarantee
+    every major >= 1 entry); from_rank_metas now records the real grid
+    geometry and kernel_steps must agree with the per-rank metas."""
+    total, cp, chunk, bq, bk = 1024, 4, 64, 64, 128
+    q_ranges = AttnRanges.from_ranges([(0, total)])
+    k_ranges = AttnRanges.from_ranges([(0, total)])
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        q_ranges, k_ranges, [AttnMaskType.CAUSAL], total, total,
+        chunk_size=chunk, cp_size=cp,
+    )
+    plan = build_dist_attn_plan(mq, bucket, block_q=bq, block_k=bk)
+    t = plan.merged_tables
+    assert t.num_q_blocks == plan.shard_q_pad // bq
+    assert t.num_k_blocks == t.kv_pad // bk
+    fs, bs = t.kernel_steps()
+    assert fs >= 1 and bs >= 1
+    # the extents must cover every per-rank row: re-derive from the
+    # stacked major arrays with the honest minlength
+    from magiattention_tpu.ops.block_meta import max_row_count
+
+    assert fs == max(
+        max_row_count(row, t.num_q_blocks) for row in t.fwd_qblk
+    )
+    assert bs == max(
+        max_row_count(row, t.num_k_blocks) for row in t.bwd_kblk
+    )
+
+
 @pytest.mark.parametrize("cp", [1, 2, 4])
 @pytest.mark.parametrize(
     "name,total,qr,kr,ts", SCENARIOS, ids=[s[0] for s in SCENARIOS]

@@ -102,6 +102,35 @@ def test_comm_bytes_resolution():
     assert g[C.M_MODELED_FLOPS] == 4.0 * plan.total_area * 8 * 64
 
 
+def test_padding_overhead_ratio_recorded():
+    """Satellite of ISSUE 2 (VERDICT: never measured): the group-cast
+    build records padded-vs-true a2a volume. For a causal mask over a
+    contiguous dispatch the send map is uneven, so the ratio must be a
+    real overhead (> 1); its exact value must match the meta's padded
+    geometry."""
+    telemetry.set_enabled(True)
+    plan = _build_plan(cp=4)
+    g = telemetry.snapshot()["gauges"]
+    comm = plan.comm
+    true_rows = sum(comm.send_total)
+    expect = (4 * 4 * comm.max_send) / true_rows
+    assert g[C.M_COMM_PADDING_OVERHEAD] == pytest.approx(expect)
+    assert g[C.M_COMM_PADDING_OVERHEAD] > 1.0
+
+
+def test_padding_overhead_zero_when_cast_moves_nothing():
+    """A fully-local mask (block-diagonal varlen matching the chunking)
+    casts no rows: the ratio reads 0.0, not inf."""
+    telemetry.set_enabled(True)
+    from magiattention_tpu.comm.group_collective import GroupCollectiveMeta
+    import numpy as np
+
+    empty = [[np.empty(0, np.int64)] * 2 for _ in range(2)]
+    GroupCollectiveMeta.build(empty, [8, 8])
+    g = telemetry.snapshot()["gauges"]
+    assert g[C.M_COMM_PADDING_OVERHEAD] == 0.0
+
+
 def test_unknown_generation_does_not_raise():
     telemetry.set_enabled(True)
     plan = _build_plan()

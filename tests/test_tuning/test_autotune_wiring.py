@@ -1,0 +1,421 @@
+"""Autotuner wiring: modes, measure-mode persistence, key-path caching."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu import env, telemetry
+from magiattention_tpu.ops.flex_attn import (
+    _static_block_config,
+    auto_block_config,
+)
+from magiattention_tpu.tuning import (
+    TuningCache,
+    reset_tuning_cache,
+    select_block_config,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_tuner(monkeypatch):
+    """Each case gets a fresh process-level cache and no disk dir."""
+    monkeypatch.delenv("MAGI_ATTENTION_AUTOTUNE", raising=False)
+    monkeypatch.delenv("MAGI_ATTENTION_AUTOTUNE_CACHE_DIR", raising=False)
+    reset_tuning_cache()
+    yield
+    reset_tuning_cache()
+
+
+def test_mode_off_restores_static_table(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_AUTOTUNE", "off")
+    for total in (4096, 16384, 65536):
+        qr, kr = [(0, total)], [(0, total)]
+        assert auto_block_config(qr, kr, 8, 8) == _static_block_config(
+            qr, kr, 8, 8
+        )
+
+
+def test_fixed_blocks_bypass_tuner():
+    """Caller-pinned block dims keep the legacy measured-hb mapping even
+    in model mode."""
+    qr, kr = [(0, 32768)], [(0, 32768)]
+    assert auto_block_config(
+        qr, kr, 8, 8, fixed_block_q=128, fixed_block_k=512
+    ) == (128, 512, 8)
+    assert auto_block_config(qr, kr, 8, 8, fixed_block_k=512) == (
+        1024, 512, 4,
+    )
+
+
+def test_model_mode_repeat_call_hits_cache():
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        qr, kr, ts = [(0, 16384)], [(0, 16384)], [1]
+        first = select_block_config(qr, kr, ts, 8, 8, mode="model")
+        again = select_block_config(qr, kr, ts, 8, 8, mode="model")
+        assert first.config == again.config
+        assert first.cache_layer == "none" and again.cache_layer == "memory"
+        c = telemetry.snapshot()["counters"]
+        assert c["magi_autotune_cache_misses_total"] == 1
+        assert c["magi_autotune_cache_hits_total{layer=memory}"] == 1
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
+def test_cache_hit_revalidates_smem_for_exact_workload():
+    """The fingerprint's ~9% log2 buckets can alias a near-budget workload
+    onto a cached winner whose entry table does not fit the exact
+    workload: the hit path must re-check SMEM feasibility and re-rank
+    rather than hand the kernel a launch-time failure."""
+    from magiattention_tpu.tuning import (
+        TuningRecord,
+        get_tuning_cache,
+        make_fingerprint,
+    )
+
+    qr, kr, ts = [(0, 65536)], [(0, 65536)], [1]
+    fp = make_fingerprint(qr, kr, ts, 8, 8)
+    # seed the cache with a rung whose 64k-dense entry table blows the
+    # SMEM budget (~131k entries vs the 24k cap)
+    get_tuning_cache().put(
+        fp,
+        TuningRecord(
+            block_q=128, block_k=128, head_block=8, source="model",
+            predicted_ms=1.0, measured_ms=None, candidates=(),
+        ),
+    )
+    d = select_block_config(qr, kr, ts, 8, 8, mode="model")
+    assert (d.block_q, d.block_k) != (128, 128)
+    assert d.cache_layer == "none"  # re-ranked, not served
+    # the fingerprint slot keeps the resident workload's winner — an
+    # aliased re-rank must not clobber it (it may be an expensive
+    # measured record), so the collision victim re-ranks per call
+    resident, _ = get_tuning_cache().get(fp)
+    assert (resident.block_q, resident.block_k) == (128, 128)
+
+
+def test_invalid_mode_is_rejected():
+    with pytest.raises(ValueError, match="AUTOTUNE"):
+        select_block_config(
+            [(0, 1024)], [(0, 1024)], [1], 8, 8, mode="fastest"
+        )
+
+
+def test_measure_mode_winner_roundtrips_disk_cache(tmp_path, monkeypatch):
+    """Acceptance criterion: a measure-mode winner lands in the disk
+    cache and a fresh process-level cache (new instance, same dir)
+    serves it back without re-measuring."""
+    monkeypatch.setenv("MAGI_ATTENTION_AUTOTUNE_CACHE_DIR", str(tmp_path))
+    reset_tuning_cache()
+    qr, kr, ts = [(0, 16384)], [(0, 16384)], [1]
+
+    # craft timings so a NON-model-best candidate wins: the measured
+    # winner (not just the model's pick) must be what persists
+    from magiattention_tpu.tuning import rank_candidates
+
+    top = [s for s in rank_candidates(qr, kr, ts, 8, 8) if s.feasible][:3]
+    assert len(top) >= 2
+    target = (top[1].block_q, top[1].block_k)
+    calls = []
+
+    def fake_measure(bq, bk, hb):
+        calls.append((bq, bk, hb))
+        return 0.001 if (bq, bk) == target else 0.010
+
+    d = select_block_config(
+        qr, kr, ts, 8, 8, mode="measure", measure_fn=fake_measure
+    )
+    assert len(calls) >= 2  # top model candidates were actually timed
+    assert d.source == "measured"
+    assert (d.block_q, d.block_k) == target
+    assert d.measured_ms == pytest.approx(1.0)
+
+    # fresh process simulation: new cache over the same dir
+    reset_tuning_cache()
+    d2 = select_block_config(
+        qr, kr, ts, 8, 8, mode="measure",
+        measure_fn=lambda *_: pytest.fail("cache hit must not re-measure"),
+    )
+    assert d2.cache_layer == "disk"
+    assert (d2.block_q, d2.block_k) == target
+    assert d2.source == "measured"
+
+
+def test_measure_mode_upgrades_model_sourced_cache_entry(tmp_path, monkeypatch):
+    """A model-sourced winner (cached by a call that could not
+    microbenchmark, e.g. under jit tracing) must not permanently pre-empt
+    measurement: the next measure-mode call WITH a measure_fn re-times the
+    candidates and upgrades the cache entry to the measured winner."""
+    monkeypatch.setenv("MAGI_ATTENTION_AUTOTUNE_CACHE_DIR", str(tmp_path))
+    reset_tuning_cache()
+    qr, kr, ts = [(0, 16384)], [(0, 16384)], [1]
+
+    first = select_block_config(qr, kr, ts, 8, 8, mode="measure")
+    assert first.source == "model"  # no measure_fn available that call
+
+    from magiattention_tpu.tuning import rank_candidates
+
+    top = [s for s in rank_candidates(qr, kr, ts, 8, 8) if s.feasible][:3]
+    target = (top[1].block_q, top[1].block_k)
+    upgraded = select_block_config(
+        qr, kr, ts, 8, 8, mode="measure",
+        measure_fn=lambda bq, bk, hb: 0.001 if (bq, bk) == target else 0.010,
+    )
+    assert upgraded.source == "measured"
+    assert (upgraded.block_q, upgraded.block_k) == target
+
+    # the upgrade is persistent: measured winners ARE served from cache
+    served = select_block_config(
+        qr, kr, ts, 8, 8, mode="measure",
+        measure_fn=lambda *_: pytest.fail("measured entry must not re-time"),
+    )
+    assert served.source == "measured" and served.cache_layer == "memory"
+    # model mode keeps serving the measured winner too
+    assert select_block_config(qr, kr, ts, 8, 8, mode="model").source == (
+        "measured"
+    )
+
+
+def test_flex_func_measure_mode_honors_pinned_head_block(monkeypatch):
+    """A caller-pinned head_block degrades measure mode to the cost model:
+    candidates would otherwise be timed at THEIR head_block while the real
+    call runs the pinned one, persisting a winner that never executes."""
+    pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from magiattention_tpu.ops import flex_flash_attn_func
+
+    monkeypatch.setenv("MAGI_ATTENTION_AUTOTUNE", "measure")
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        total, h, dh = 256, 4, 32
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((total, h, dh)), jnp.float32)
+        out = flex_flash_attn_func(
+            q, q, q, [(0, total)], [(0, total)], [1], head_block=2
+        )[0]
+        assert out.shape == (total, h, dh)
+        c = telemetry.snapshot()["counters"]
+        assert c.get("magi_autotune_measurements_total", 0) == 0
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
+def test_measure_mode_survives_crashing_candidates():
+    def bomb(bq, bk, hb):
+        if (bq, bk) != (128, 512):
+            raise RuntimeError("smem")
+        return 0.005
+
+    d = select_block_config(
+        [(0, 16384)], [(0, 16384)], [1], 8, 8, mode="measure",
+        measure_fn=bomb,
+    )
+    assert d.source == "measured"
+    assert (d.block_q, d.block_k) == (128, 512)
+
+
+def test_measure_mode_all_candidates_failing_does_not_retry_forever():
+    """When every microbenchmark crashes, the model winner is cached as
+    'measure_failed' and later calls take the cache hit instead of
+    re-compiling and re-crashing the candidates per call."""
+    qr, kr, ts = [(0, 16384)], [(0, 16384)], [1]
+    attempts = []
+
+    def always_bomb(bq, bk, hb):
+        attempts.append((bq, bk))
+        raise RuntimeError("device OOM")
+
+    d = select_block_config(
+        qr, kr, ts, 8, 8, mode="measure", measure_fn=always_bomb
+    )
+    assert d.source == "measure_failed"
+    assert "failed" in d.reason
+    first_attempts = len(attempts)
+    assert first_attempts >= 1
+
+    again = select_block_config(
+        qr, kr, ts, 8, 8, mode="measure", measure_fn=always_bomb
+    )
+    assert len(attempts) == first_attempts  # no re-measurement
+    assert again.cache_layer == "memory"
+    assert again.config == d.config
+
+
+def test_measure_failed_is_not_persisted_to_disk(tmp_path, monkeypatch):
+    """A transient crash (device OOM, busy chip) must not poison the
+    SHARED disk cache forever: measure_failed stays process-local so a
+    fresh process retries the measurement."""
+    import os
+
+    monkeypatch.setenv("MAGI_ATTENTION_AUTOTUNE_CACHE_DIR", str(tmp_path))
+    reset_tuning_cache()
+    qr, kr, ts = [(0, 16384)], [(0, 16384)], [1]
+
+    def always_bomb(bq, bk, hb):
+        raise RuntimeError("transient OOM")
+
+    d = select_block_config(
+        qr, kr, ts, 8, 8, mode="measure", measure_fn=always_bomb
+    )
+    assert d.source == "measure_failed"
+    assert not [
+        f for f in os.listdir(tmp_path) if f.startswith("magi-autotune-")
+    ]
+    # a fresh process (new cache instance, same dir) retries and persists
+    reset_tuning_cache()
+    d2 = select_block_config(
+        qr, kr, ts, 8, 8, mode="measure", measure_fn=lambda *_: 0.002
+    )
+    assert d2.source == "measured"
+    assert [
+        f for f in os.listdir(tmp_path) if f.startswith("magi-autotune-")
+    ]
+
+
+def test_measure_mode_infeasible_everywhere_stays_model():
+    """Nothing feasible to time is a model decision, not a measurement
+    failure — the reason must not claim microbenchmarks crashed."""
+    attempts = []
+    # a dense mask so large every rung blows the SMEM entry budget
+    qr, kr, ts = [(0, 4 * 1024 * 1024)], [(0, 4 * 1024 * 1024)], [0]
+    d = select_block_config(
+        qr, kr, ts, 8, 8, mode="measure",
+        measure_fn=lambda *a: attempts.append(a) or 0.001,
+    )
+    assert attempts == []
+    assert d.source == "model"
+    assert "no feasible candidate" in d.reason
+    # and it converges: nothing will ever be measurable for this workload,
+    # so the next call must take the cache hit, not re-rank per call
+    again = select_block_config(
+        qr, kr, ts, 8, 8, mode="measure",
+        measure_fn=lambda *a: attempts.append(a) or 0.001,
+    )
+    assert attempts == [] and again.cache_layer == "memory"
+
+
+def test_measure_mode_without_bench_degrades_to_model():
+    d = select_block_config(
+        [(0, 16384)], [(0, 16384)], [1], 8, 8, mode="measure",
+    )
+    assert d.source == "model"
+    assert "no microbenchmark" in d.reason
+
+
+def test_flex_func_measure_mode_skips_traced_operands(monkeypatch):
+    """Under jit tracing there is nothing to time: the tuner must fall
+    back to the cost model instead of crashing on tracers."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from magiattention_tpu.ops import flex_flash_attn_func
+
+    monkeypatch.setenv("MAGI_ATTENTION_AUTOTUNE", "measure")
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    total, h, dh = 256, 2, 32
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((total, h, dh)), jnp.float32)
+
+    def f(q):
+        return flex_flash_attn_func(
+            q, q, q, [(0, total)], [(0, total)], [1]
+        )[0]
+
+    out = jax.jit(f)(q)
+    assert out.shape == (total, h, dh)
+
+
+def test_autotune_mode_is_part_of_flags_fingerprint(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_AUTOTUNE", "model")
+    a = env.flags_fingerprint()
+    monkeypatch.setenv("MAGI_ATTENTION_AUTOTUNE", "off")
+    b = env.flags_fingerprint()
+    assert a != b and "model" in a and "off" in b
+
+
+def test_key_path_consults_tuning_cache_before_lru(monkeypatch):
+    """Acceptance criterion: a second magi_attn_flex_key call with an
+    identical plan takes the tuning-cache hit path, observable in the
+    telemetry snapshot. The tuner runs BEFORE the runtime LRU lookup (the
+    decision is part of the key), so this holds regardless of whether the
+    runtime build itself succeeds — on images without jax.shard_map the
+    build fails after the tuner has already recorded its decision."""
+    import jax
+    from jax.sharding import Mesh
+
+    from magiattention_tpu.api.interface import magi_attn_flex_key
+
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        mesh = Mesh(np.array(jax.devices()[:4]), ("cp",))
+        total = 8192
+        kw = dict(
+            num_heads=(4, 4), head_dim=64, chunk_size=256,
+            out_dtype="float32",
+        )
+
+        def make_key():
+            try:
+                return magi_attn_flex_key(
+                    [(0, total)], [(0, total)], [1], total, total, mesh,
+                    **kw,
+                )
+            except ImportError:
+                return None  # jax-version skew: shard_map unavailable
+
+        make_key()
+        make_key()
+        c = telemetry.snapshot()["counters"]
+        assert c.get("magi_autotune_cache_misses_total") == 1
+        hits = sum(
+            v for k, v in c.items()
+            if k.startswith("magi_autotune_cache_hits_total")
+        )
+        assert hits >= 1
+        g = telemetry.snapshot()["gauges"]
+        assert any(
+            k.startswith("magi_autotune_choice{") for k in g
+        ), "the chosen rung must be recorded"
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
+def test_key_path_tiny_shards_keep_legacy_blocking(monkeypatch):
+    """Per-rank shards smaller than every candidate rung: the resolver
+    returns None and the plan keeps the pre-ISSUE-2 env blocking."""
+    from magiattention_tpu.api.interface import _resolve_block_config
+
+    cfg = _resolve_block_config(
+        [(0, 512)], [(0, 512)], (1,), 512, 512, 4, 4, 4, 32, "float32"
+    )
+    assert cfg is None
+
+
+def test_key_path_env_pinned_blocks_win(monkeypatch):
+    from magiattention_tpu.api.interface import _resolve_block_config
+
+    monkeypatch.setenv("MAGI_ATTENTION_BLOCK_Q", "64")
+    cfg = _resolve_block_config(
+        [(0, 16384)], [(0, 16384)], (1,), 16384, 16384, 2, 8, 8, 128,
+        "bfloat16",
+    )
+    assert cfg is None
+
+
+def test_key_path_large_shards_get_tuned_blocking():
+    from magiattention_tpu.api.interface import _resolve_block_config
+
+    cfg = _resolve_block_config(
+        [(0, 16384)], [(0, 16384)], (1,), 16384, 16384, 2, 8, 8, 128,
+        "bfloat16",
+    )
+    assert cfg is not None
+    bq, bk, hb = cfg
+    assert bq <= 8192 and bk <= 8192 and hb >= 1

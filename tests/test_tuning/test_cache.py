@@ -1,0 +1,106 @@
+"""Tuning cache: memory layer, disk round-trip, corruption safety."""
+
+import json
+import os
+
+from magiattention_tpu.tuning import (
+    TuningCache,
+    TuningRecord,
+    get_tuning_cache,
+    make_fingerprint,
+    reset_tuning_cache,
+)
+
+
+def _fp(total=16384):
+    return make_fingerprint([(0, total)], [(0, total)], [1], 8, 8)
+
+
+def _rec(source="model"):
+    return TuningRecord(
+        block_q=128,
+        block_k=512,
+        head_block=8,
+        source=source,
+        predicted_ms=3.1,
+        measured_ms=2.7 if source == "measured" else None,
+        candidates=({"block_q": 128, "block_k": 512, "cost_seconds": 0.003},),
+    )
+
+
+def test_memory_layer_roundtrip():
+    cache = TuningCache(None)
+    fp = _fp()
+    assert cache.get(fp) == (None, "miss")
+    cache.put(fp, _rec())
+    rec, layer = cache.get(fp)
+    assert layer == "memory"
+    assert (rec.block_q, rec.block_k, rec.head_block) == (128, 512, 8)
+
+
+def test_disk_roundtrip_across_instances(tmp_path):
+    """A winner persisted by one process (cache instance) is found by a
+    fresh one pointed at the same dir — the measure-mode contract."""
+    d = str(tmp_path)
+    fp = _fp()
+    TuningCache(d).put(fp, _rec("measured"))
+    files = [f for f in os.listdir(d) if f.startswith("magi-autotune-")]
+    assert len(files) == 1 and files[0].endswith(".json")
+    rec, layer = TuningCache(d).get(fp)
+    assert layer == "disk"
+    assert rec.source == "measured"
+    assert rec.measured_ms == 2.7
+    # second read hits the promoted memory layer
+    cache = TuningCache(d)
+    cache.get(fp)
+    assert cache.get(fp)[1] == "memory"
+
+
+def test_disk_fingerprint_mismatch_is_a_miss(tmp_path):
+    """A file whose stored fingerprint disagrees (hash collision or
+    fingerprint-version skew) must be ignored, not trusted."""
+    d = str(tmp_path)
+    fp = _fp()
+    cache = TuningCache(d)
+    cache.put(fp, _rec())
+    path = cache._path(fp.stable_hash())
+    with open(path) as f:
+        payload = json.load(f)
+    payload["fingerprint"]["num_heads_q"] = 999
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    assert TuningCache(d).get(fp) == (None, "miss")
+
+
+def test_corrupt_disk_file_is_a_miss(tmp_path):
+    d = str(tmp_path)
+    fp = _fp()
+    cache = TuningCache(d)
+    cache.put(fp, _rec())
+    with open(cache._path(fp.stable_hash()), "w") as f:
+        f.write("{torn json")
+    assert TuningCache(d).get(fp) == (None, "miss")
+
+
+def test_unwritable_dir_never_fails_planning(tmp_path):
+    d = tmp_path / "nope"
+    d.mkdir()
+    os.chmod(d, 0o500)
+    try:
+        cache = TuningCache(str(d / "sub"))
+        cache.put(_fp(), _rec())  # must not raise
+        assert cache.get(_fp())[1] == "memory"
+    finally:
+        os.chmod(d, 0o700)
+
+
+def test_singleton_follows_env_dir(tmp_path, monkeypatch):
+    reset_tuning_cache()
+    monkeypatch.delenv("MAGI_ATTENTION_AUTOTUNE_CACHE_DIR", raising=False)
+    c1 = get_tuning_cache()
+    assert c1.cache_dir is None
+    assert get_tuning_cache() is c1
+    monkeypatch.setenv("MAGI_ATTENTION_AUTOTUNE_CACHE_DIR", str(tmp_path))
+    c2 = get_tuning_cache()
+    assert c2 is not c1 and c2.cache_dir == str(tmp_path)
+    reset_tuning_cache()
