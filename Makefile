@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -55,3 +55,21 @@ telemetry-check:
 # an intentional recalibration)
 autotune-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_autotune_check.py
+
+# perf regression sentinel (model-safe CPU mode: pure file parsing, no
+# jax): the newest BENCH_HISTORY.jsonl values must sit inside the
+# checked-in exps/data/perf_expectations.json windows, AND an injected
+# 20% TF/s regression must be caught (--self-test asserts both). Re-seed
+# after an intentional perf change: exps/run_perf_gate.py --update
+perf-gate:
+	$(PY) exps/run_perf_gate.py --self-test
+
+# measured-timeline demo on the virtual CPU mesh: per-stage comm/compute
+# wall times, predicted-vs-measured overlap audit, cross-rank aggregate,
+# multi-track Chrome trace (docs/observability.md "Measured timelines")
+timeline-demo:
+	$(PY) exps/run_timeline_profile.py
+
+# the default check flow: syntax, telemetry catalog + timeline/aggregate
+# semantics, autotuner rung expectations, perf gate — all CPU-safe
+check: lint telemetry-check autotune-check perf-gate

@@ -32,7 +32,14 @@ import time
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _CACHE = os.path.join(_HERE, "BENCH_CACHE.json")
 _TELEMETRY_OUT = os.path.join(_HERE, "BENCH_TELEMETRY.json")
+_HISTORY = os.path.join(_HERE, "BENCH_HISTORY.jsonl")
 _KEYS = ("metric", "value", "unit", "vs_baseline")
+# the headline workload spec: 64k dense causal, 8:8 heads, head_dim 128,
+# bf16. ONE definition shared by the measurement (_measure) and the
+# autotune-rung history record (_bench_autotune_rung) so the recorded
+# rung can never diverge from the shape the kernel actually ran.
+_HEADLINE_T, _HEADLINE_HQ, _HEADLINE_HK, _HEADLINE_D = 65536, 8, 8, 128
+_HEADLINE_DTYPE = "bfloat16"
 
 sys.path.insert(0, _HERE)
 
@@ -107,12 +114,58 @@ def _run_real_and_cache() -> None:
             json.dump(meta, f, indent=1)
             f.write("\n")
         os.replace(tmp, _CACHE)
+        _append_history(meta, extras)
     else:
         print(
             "degraded/CPU/parity-failed measurement: cache left untouched",
             file=sys.stderr,
         )
     print(json.dumps(payload))
+
+
+def _bench_autotune_rung() -> "str | None":
+    """The block-config rung the headline workload resolves to (host-side
+    re-query of the deterministic tuner decision the measured kernel ran
+    with): ``"BQxBKxHB"``. The perf gate flags rung changes between
+    history entries — a TF/s delta with a rung change is a tuning story,
+    without one a kernel/runtime story."""
+    try:
+        from magiattention_tpu.ops.flex_attn import auto_block_config
+
+        t = _HEADLINE_T
+        bq, bk, hb = auto_block_config(
+            [(0, t)], [(0, t)], _HEADLINE_HQ, _HEADLINE_HK,
+            attn_type_map=[1], head_dim=_HEADLINE_D,
+            dtype=_HEADLINE_DTYPE,
+        )
+        return f"{bq}x{bk}x{hb}"
+    except Exception as e:
+        print(f"autotune rung query failed: {e!r}", file=sys.stderr)
+        return None
+
+
+def _append_history(meta: dict, extras: dict) -> None:
+    """Append the cached run to BENCH_HISTORY.jsonl — the machine-readable
+    perf trajectory exps/run_perf_gate.py gates on. Never fatal."""
+    try:
+        from magiattention_tpu.telemetry import baseline
+
+        metrics = {meta["metric"]: meta["value"]}
+        metrics.update(extras or {})
+        baseline.append_history(
+            _HISTORY,
+            baseline.make_history_entry(
+                source="bench.py --real",
+                metrics=metrics,
+                recorded_unix=meta.get("recorded_unix"),
+                device=meta.get("device"),
+                vs_baseline=meta.get("vs_baseline"),
+                autotune_rung=_bench_autotune_rung(),
+            ),
+        )
+        print(f"bench history appended -> {_HISTORY}", file=sys.stderr)
+    except Exception as e:
+        print(f"bench history append failed: {e!r}", file=sys.stderr)
 
 
 def _telemetry_block() -> None:
@@ -381,13 +434,14 @@ def _measure() -> dict:
 
     from magiattention_tpu.ops import flex_flash_attn_func
 
-    tq = 65536
-    hq = hk = 8
-    d = 128
+    tq = _HEADLINE_T
+    hq, hk = _HEADLINE_HQ, _HEADLINE_HK
+    d = _HEADLINE_D
+    dt = jnp.dtype(_HEADLINE_DTYPE)
     rng = np.random.default_rng(0)
-    q = jnp.asarray(rng.standard_normal((tq, hq, d)), jnp.bfloat16)
-    k = jnp.asarray(rng.standard_normal((tq, hk, d)), jnp.bfloat16)
-    v = jnp.asarray(rng.standard_normal((tq, hk, d)), jnp.bfloat16)
+    q = jnp.asarray(rng.standard_normal((tq, hq, d)), dt)
+    k = jnp.asarray(rng.standard_normal((tq, hk, d)), dt)
+    v = jnp.asarray(rng.standard_normal((tq, hk, d)), dt)
     qr, kr, ts = [(0, tq)], [(0, tq)], [1]  # dense causal
 
     area = tq * (tq + 1) // 2

@@ -1,0 +1,197 @@
+"""Perf regression gate (``make perf-gate``).
+
+Ingests the machine-readable bench history (``BENCH_HISTORY.jsonl``, one
+JSON line per on-chip run — seeded from the committed ``BENCH_r*.json``
+rounds, appended by every cached ``bench.py`` run) and gates the newest
+value of each ``flex_attn_*`` throughput metric against the checked-in
+expectation window (``exps/data/perf_expectations.json``), with the
+tolerance from ``MAGI_ATTENTION_PERF_GATE_TOLERANCE`` (default 10% —
+the shared chip's observed run-to-run drift). Autotuner rung changes
+between runs are flagged so a TF/s delta can be attributed (tuning story
+vs kernel/runtime story).
+
+Model-safe CPU mode: pure file parsing, **no jax import anywhere on this
+path** — identical behavior on CPU CI, a laptop, or the TPU host.
+
+Usage:
+  python exps/run_perf_gate.py                 # gate the newest values
+  python exps/run_perf_gate.py --self-test     # gate must PASS as-is AND
+                                               # FAIL on an injected -20%
+  python exps/run_perf_gate.py --inject-regression 0.2   # what-if check
+  python exps/run_perf_gate.py --update        # re-seed the expectation
+                                               # windows from history
+Exit codes: 0 = pass, 1 = regression (or self-test broken), 2 = usage.
+"""
+
+import argparse
+import importlib.util
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+
+
+def _load_baseline():
+    """Load telemetry/baseline.py by FILE PATH, not through the package:
+    importing ``magiattention_tpu`` runs its ``__init__`` which
+    transitively imports jax — exactly what the jax-free gate contract
+    forbids on minimal CI hosts. baseline.py is deliberately free of
+    package-relative imports so this works."""
+    path = os.path.join(
+        _ROOT, "magiattention_tpu", "telemetry", "baseline.py"
+    )
+    spec = importlib.util.spec_from_file_location("_perf_gate_baseline", path)
+    mod = importlib.util.module_from_spec(spec)
+    # register BEFORE exec: dataclasses resolves string annotations via
+    # sys.modules[cls.__module__]
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+baseline = _load_baseline()
+
+DEFAULT_HISTORY = os.path.join(_ROOT, baseline.HISTORY_FILENAME)
+DEFAULT_EXPECTATIONS = os.path.join(_ROOT, baseline.EXPECTATIONS_RELPATH)
+
+
+def _gated_metric(name: str) -> bool:
+    """Gate our kernel/runtime throughput only: ``flex_attn_*`` TF/s.
+    Stock-kernel controls (``jax_flash_*``) and one-off bring-up metrics
+    stay in history for the record but never fail the gate."""
+    return name.startswith("flex_attn_") and "tflops" in name
+
+
+def run_gate(history_path, expectations_path, tolerance, inject=0.0):
+    history = baseline.load_history(history_path)
+    if not history:
+        print(f"perf-gate: no usable history at {history_path}")
+        return 2
+    try:
+        expectations = baseline.load_expectations(expectations_path)
+    except (OSError, ValueError) as e:
+        print(
+            f"perf-gate: cannot read expectations {expectations_path} "
+            f"({e!r}); run with --update to seed them"
+        )
+        return 2
+    # gate the NEWEST entry only: a metric the newest run didn't measure
+    # reads 'missing' (warn), never an old good value standing in for it
+    metrics = {
+        k: v
+        for k, v in baseline.newest_metrics(history).items()
+        if _gated_metric(k)
+    }
+    if inject:
+        metrics = {k: v * (1.0 - inject) for k, v in metrics.items()}
+        print(f"(injected {inject:.0%} regression into every metric)")
+    results = baseline.check_gate(metrics, expectations, tolerance)
+    flags = baseline.rung_changes(history)
+    print(baseline.gate_report(results, flags))
+    return 1 if any(r.failed for r in results) else 0
+
+
+def update_expectations(history_path, expectations_path, window_last):
+    history = baseline.load_history(history_path)
+    if not history:
+        print(f"perf-gate --update: no usable history at {history_path}")
+        return 2
+    # guard the *current* perf level: window over the last N values per
+    # metric (default 1 — older rounds predate autotuner / kernel work
+    # and would make the floor meaninglessly lax)
+    windows = baseline.seed_expectations(
+        history, metrics_filter=_gated_metric, window_last=window_last
+    )
+    baseline.write_expectations(
+        expectations_path,
+        windows,
+        provenance=(
+            "perf-gate expectation windows: [low, high] TF/s per workload "
+            f"metric, seeded from the last {window_last} BENCH_HISTORY "
+            "entry(ies) per metric by exps/run_perf_gate.py --update. The "
+            "gate fails when a newer run falls below low * (1 - "
+            "MAGI_ATTENTION_PERF_GATE_TOLERANCE). Re-run --update after "
+            "an intentional perf change."
+        ),
+    )
+    print(
+        f"perf-gate: seeded {len(windows)} expectation window(s) -> "
+        f"{expectations_path}"
+    )
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--history", default=DEFAULT_HISTORY)
+    p.add_argument("--expectations", default=DEFAULT_EXPECTATIONS)
+    p.add_argument(
+        "--tolerance",
+        type=float,
+        default=None,
+        help="fractional TF/s drift tolerated (default: "
+        "MAGI_ATTENTION_PERF_GATE_TOLERANCE or 0.10)",
+    )
+    p.add_argument(
+        "--inject-regression",
+        type=float,
+        default=0.0,
+        metavar="FRAC",
+        help="scale every gated metric down by FRAC before checking "
+        "(what-if probe of the gate itself)",
+    )
+    p.add_argument(
+        "--self-test",
+        action="store_true",
+        help="assert the gate PASSES on the real history AND FAILS on an "
+        "injected 20%% regression (the acceptance contract of the gate)",
+    )
+    p.add_argument(
+        "--update",
+        action="store_true",
+        help="re-seed expectation windows from history",
+    )
+    p.add_argument(
+        "--window-last",
+        type=int,
+        default=1,
+        help="--update: window over the last N entries per metric",
+    )
+    args = p.parse_args()
+    tolerance = (
+        args.tolerance
+        if args.tolerance is not None
+        else baseline.default_tolerance()
+    )
+
+    if args.update:
+        return update_expectations(
+            args.history, args.expectations, args.window_last
+        )
+    if args.self_test:
+        print("perf-gate self-test 1/2: real history must pass")
+        rc_ok = run_gate(args.history, args.expectations, tolerance)
+        print("\nperf-gate self-test 2/2: injected 20% regression must fail")
+        rc_bad = run_gate(
+            args.history, args.expectations, tolerance, inject=0.20
+        )
+        if rc_ok == 0 and rc_bad == 1:
+            print("\nperf-gate self-test OK: baseline passes, injected "
+                  "20% regression is caught")
+            return 0
+        print(
+            f"\nperf-gate self-test BROKEN: baseline rc={rc_ok} "
+            f"(want 0), injected rc={rc_bad} (want 1)"
+        )
+        return 1
+    return run_gate(
+        args.history,
+        args.expectations,
+        tolerance,
+        inject=args.inject_regression,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,13 @@ metric without updating the catalog/docs, this exits non-zero.
 Also sanity-checks the two structured exporters (metrics JSON + Chrome
 trace events JSON) and the disabled-mode no-op contract, so the guard
 covers the full acceptance surface of ISSUE 1 without needing devices.
+
+ISSUE 3 extensions: a measured-timeline profile on a tiny multi-stage
+CPU-mesh plan must populate every ``REQUIRED_TIMELINE_METRICS`` name the
+docs promise, cross-rank snapshot merging must keep its
+counters-sum/gauge-skew/histogram-bucket semantics with deterministic
+ordering, and Chrome trace dumps must carry track-naming metadata
+events.
 """
 
 import json
@@ -20,6 +27,13 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the timeline step executes a real (tiny) distributed plan: virtual CPU
+# mesh + the any-platform jnp kernel backend, set BEFORE jax initializes
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
 
 from magiattention_tpu import telemetry  # noqa: E402
 from magiattention_tpu.common.enum import AttnMaskType  # noqa: E402
@@ -83,7 +97,8 @@ def main() -> int:
         )
         return 1
 
-    # 3. exporters round-trip through JSON
+    # 3. exporters round-trip through JSON; traces carry track-naming
+    # metadata events (phase M) for Perfetto
     with tempfile.TemporaryDirectory() as d:
         mpath = telemetry.dump_metrics(os.path.join(d, "metrics.json"))
         epath = telemetry.dump_events(os.path.join(d, "events.json"))
@@ -96,12 +111,96 @@ def main() -> int:
         if "traceEvents" not in trace or not trace["traceEvents"]:
             print(f"FAIL: dump_events wrote no trace events: {trace}")
             return 1
+        meta_names = {
+            e["name"] for e in trace["traceEvents"] if e.get("ph") == "M"
+        }
+        if not {"process_name", "thread_name"} <= meta_names:
+            print(
+                "FAIL: dump_events trace lacks process_name/thread_name "
+                f"metadata events (got {sorted(meta_names)})"
+            )
+            return 1
+
+    # 4. measured timeline: profile a tiny multi-stage plan on the CPU
+    # mesh and assert the documented magi_overlap_measured_* catalog
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+    from magiattention_tpu.parallel.dist_attn import make_attn_params
+
+    small_cp = 2  # same 2k mask, smaller mesh: keeps the check fast
+    mq2, _, bucket2 = make_dispatch_meta_from_qk_ranges(
+        qr, kr, [AttnMaskType.CAUSAL], total, total,
+        chunk_size=chunk, cp_size=small_cp,
+    )
+    plan2 = build_dist_attn_plan(
+        mq2, bucket2, block_q=64, block_k=64,
+        overlap_config=OverlapConfig(degree=2, min_stage_rows=64),
+    )
+    if len(plan2.stages) < 2:
+        print("FAIL: timeline-check plan did not produce >= 2 stages")
+        return 1
+    mesh = Mesh(np.array(jax.devices()[:small_cp]), ("cp",))
+    params = make_attn_params(plan2, 64, out_dtype="float32")
+    tl = telemetry.profile_plan_timeline(
+        plan2, mesh, params, num_heads=(2, 2), head_dim=64,
+        reps=1, inner=1,
+    )
+    snap = telemetry.snapshot()
+    missing = [
+        m for m in telemetry.REQUIRED_TIMELINE_METRICS
+        if not has_series(snap, m)
+    ]
+    if missing:
+        print(
+            "FAIL: documented timeline metrics missing after a "
+            f"profile_plan_timeline run (catalog drift): {missing}"
+        )
+        return 1
+    if not (0.0 <= tl.overlap_efficiency <= 1.0):
+        print(f"FAIL: overlap efficiency out of [0,1]: {tl}")
+        return 1
+
+    # 5. cross-rank aggregation semantics + deterministic ordering
+    snap_b = json.loads(json.dumps(snap))  # simulated second rank
+    agg = telemetry.merge_snapshots([snap, snap_b], ranks=[0, 1])
+    plan_builds = agg["counters"].get("magi_plan_builds_total")
+    if plan_builds != 2 * snap["counters"]["magi_plan_builds_total"]:
+        print(f"FAIL: aggregate counters are not summed: {plan_builds}")
+        return 1
+    tot = agg["gauges"].get("magi_overlap_measured_total_ms")
+    if not tot or sorted(tot) != [
+        "argmax", "max", "mean", "min", "per_rank",
+    ] or sorted(tot["per_rank"]) != ["0", "1"]:
+        print(f"FAIL: aggregate gauge skew stats malformed: {tot}")
+        return 1
+    hists = agg["histograms"].get("magi_plan_build_seconds")
+    if not hists or hists["count"] != 2 * snap["histograms"][
+        "magi_plan_build_seconds"
+    ]["count"]:
+        print(f"FAIL: aggregate histograms are not bucket-merged: {hists}")
+        return 1
+    if json.dumps(agg, sort_keys=False) != json.dumps(
+        telemetry.merge_snapshots([snap, snap_b], ranks=[0, 1]),
+        sort_keys=False,
+    ):
+        print("FAIL: aggregate output ordering is not deterministic")
+        return 1
+    agg_loop = telemetry.aggregate_across_mesh(snap)
+    if agg_loop["num_ranks"] != 1 or agg_loop["counters"] != {
+        k: float(v) for k, v in snap["counters"].items()
+    }:
+        print("FAIL: aggregate_across_mesh loopback mismatch")
+        return 1
 
     telemetry.set_enabled(None)
     print(
-        f"telemetry-check OK: {len(telemetry.REQUIRED_PLAN_METRICS)} "
-        "documented metrics present, exporters round-trip, disabled mode "
-        "is a no-op"
+        f"telemetry-check OK: {len(telemetry.REQUIRED_PLAN_METRICS)} plan "
+        f"metrics + {len(telemetry.REQUIRED_TIMELINE_METRICS)} timeline "
+        "metrics present, cross-rank merge semantics hold, exporters "
+        "round-trip with track metadata, disabled mode is a no-op"
     )
     return 0
 

@@ -1165,6 +1165,30 @@ def get_telemetry_snapshot() -> dict:
     return telemetry.snapshot()
 
 
+def aggregate_telemetry_across_mesh(snapshot: dict | None = None) -> dict:
+    """Mesh-wide telemetry aggregate (ISSUE 3): gather every process's
+    registry snapshot and merge — counters summed, gauges with per-rank
+    values plus min/max/mean/argmax skew stats, histograms bucket-merged.
+    Loopback (single merged snapshot, same schema) in a single process.
+    Host-side only; never call inside traced code."""
+    return telemetry.aggregate_across_mesh(snapshot)
+
+
+def profile_attn_timeline(
+    key: "DistAttnRuntimeKey | None" = None, **kwargs
+):
+    """Measure the stage timeline of a planned runtime (default: the most
+    recent key): per-stage cast/kernel wall time with host fencing, the
+    pipelined-vs-serial overlap efficiency, and the predicted-vs-measured
+    delta against the overlap solver's timeline model. Returns a
+    :class:`telemetry.MeasuredTimeline` (see its ``report()``); records
+    ``magi_overlap_measured_*`` gauges while telemetry is enabled.
+    Keyword args are forwarded to
+    :func:`telemetry.timeline.profile_key_timeline` (reps/inner/warmup,
+    ``use_mesh_barrier`` for multi-chip meshes)."""
+    return telemetry.profile_key_timeline(key, **kwargs)
+
+
 def clear_cache(mesh: "jax.sharding.Mesh | None" = None) -> None:
     """Drop cached runtime plans (reference clear_cache,
     api/magi_attn_interface.py:1157). With a ``mesh``, only keys planned

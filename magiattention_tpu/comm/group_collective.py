@@ -142,18 +142,23 @@ def group_cast(
 ):
     """Multicast local rows to their destination set; returns [R, ...] rows
     in (src_rank, send_pos) order (padded rows zeroed)."""
-    si = send_idx[0]  # [cp, S]
-    send_buf = jnp.take(x, si.reshape(-1), axis=0).reshape(
-        si.shape + x.shape[1:]
-    )  # [cp, S, ...]
-    recv = jax.lax.all_to_all(
-        send_buf, axis_name, split_axis=0, concat_axis=0, tiled=False
-    )  # [cp, S, ...]
-    flat = recv.reshape((-1,) + x.shape[1:])
-    # pad entries of recv_sel point one past the end; clip + mask them out
-    out = jnp.take(flat, jnp.minimum(recv_sel[0], flat.shape[0] - 1), axis=0)
-    mask_shape = (out.shape[0],) + (1,) * (out.ndim - 1)
-    return jnp.where(recv_valid[0].reshape(mask_shape), out, 0)
+    from ..utils.instrument import named_scope
+
+    with named_scope("magi_group_cast"):
+        si = send_idx[0]  # [cp, S]
+        send_buf = jnp.take(x, si.reshape(-1), axis=0).reshape(
+            si.shape + x.shape[1:]
+        )  # [cp, S, ...]
+        recv = jax.lax.all_to_all(
+            send_buf, axis_name, split_axis=0, concat_axis=0, tiled=False
+        )  # [cp, S, ...]
+        flat = recv.reshape((-1,) + x.shape[1:])
+        # pad entries of recv_sel point one past the end; clip + mask out
+        out = jnp.take(
+            flat, jnp.minimum(recv_sel[0], flat.shape[0] - 1), axis=0
+        )
+        mask_shape = (out.shape[0],) + (1,) * (out.ndim - 1)
+        return jnp.where(recv_valid[0].reshape(mask_shape), out, 0)
 
 
 def _reverse_a2a(y, recv_sel, recv_valid, cp, S, axis_name):
@@ -162,14 +167,17 @@ def _reverse_a2a(y, recv_sel, recv_valid, cp, S, axis_name):
     Returns [cp, S, ...]: rows that each peer sent back to me, in my original
     send order (= my cast send_idx positions).
     """
-    flat = jnp.zeros((cp * S + 1,) + y.shape[1:], dtype=y.dtype)
-    mask_shape = (y.shape[0],) + (1,) * (y.ndim - 1)
-    y_masked = jnp.where(recv_valid[0].reshape(mask_shape), y, 0)
-    flat = flat.at[recv_sel[0]].set(y_masked)  # pads land in the trash slot
-    send_back = flat[:-1].reshape((cp, S) + y.shape[1:])
-    return jax.lax.all_to_all(
-        send_back, axis_name, split_axis=0, concat_axis=0, tiled=False
-    )
+    from ..utils.instrument import named_scope
+
+    with named_scope("magi_group_reduce_a2a"):
+        flat = jnp.zeros((cp * S + 1,) + y.shape[1:], dtype=y.dtype)
+        mask_shape = (y.shape[0],) + (1,) * (y.ndim - 1)
+        y_masked = jnp.where(recv_valid[0].reshape(mask_shape), y, 0)
+        flat = flat.at[recv_sel[0]].set(y_masked)  # pads -> trash slot
+        send_back = flat[:-1].reshape((cp, S) + y.shape[1:])
+        return jax.lax.all_to_all(
+            send_back, axis_name, split_axis=0, concat_axis=0, tiled=False
+        )
 
 
 def group_reduce_sum(
@@ -185,17 +193,22 @@ def group_reduce_sum(
     counts: jax.Array | None = None,  # [T_local] contributions per row (avg)
 ):
     """Reduce partials back onto owner rows: acc += segment_sum(partials)."""
-    cp, S = seg_ids.shape[1], seg_ids.shape[2]
-    recv = _reverse_a2a(y, recv_sel, recv_valid, cp, S, axis_name)
-    flat = recv.reshape((cp * S,) + y.shape[1:])
-    T = acc.shape[0]
-    seg = seg_ids[0].reshape(-1)
-    contrib = jax.ops.segment_sum(flat, seg, num_segments=T + 1)[:T]
-    if average:
-        assert counts is not None
-        denom = jnp.maximum(counts, 1).reshape((T,) + (1,) * (acc.ndim - 1))
-        return acc + contrib.astype(acc.dtype) / denom.astype(acc.dtype)
-    return acc + contrib.astype(acc.dtype)
+    from ..utils.instrument import named_scope
+
+    with named_scope("magi_group_reduce_sum"):
+        cp, S = seg_ids.shape[1], seg_ids.shape[2]
+        recv = _reverse_a2a(y, recv_sel, recv_valid, cp, S, axis_name)
+        flat = recv.reshape((cp * S,) + y.shape[1:])
+        T = acc.shape[0]
+        seg = seg_ids[0].reshape(-1)
+        contrib = jax.ops.segment_sum(flat, seg, num_segments=T + 1)[:T]
+        if average:
+            assert counts is not None
+            denom = jnp.maximum(counts, 1).reshape(
+                (T,) + (1,) * (acc.ndim - 1)
+            )
+            return acc + contrib.astype(acc.dtype) / denom.astype(acc.dtype)
+        return acc + contrib.astype(acc.dtype)
 
 
 def group_reduce_lse(

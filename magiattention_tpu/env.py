@@ -28,6 +28,11 @@ def _env_str(name: str, default: str) -> str:
     return os.environ.get(name, default)
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v is not None else default
+
+
 def log_level() -> str:
     """Logging level for the ``magiattention_tpu`` logger tree; consumed
     by :func:`magiattention_tpu.telemetry.logger.configure_logging` at
@@ -54,6 +59,29 @@ def trace_dir() -> str:
     ``utils/instrument.py::switch_profile`` when profile mode is on and no
     explicit ``trace_dir`` is passed."""
     return _env_str("MAGI_ATTENTION_TRACE_DIR", "./magi_attention_trace")
+
+
+def perf_gate_tolerance() -> float:
+    """Fractional TF/s regression the perf gate tolerates before failing
+    (``exps/run_perf_gate.py`` / ``make perf-gate``): a run below
+    ``expectation_low * (1 - tolerance)`` fails the gate. 0.10 covers the
+    shared chip's observed run-to-run drift; tighten on dedicated
+    hardware."""
+    return _env_float("MAGI_ATTENTION_PERF_GATE_TOLERANCE", 0.10)
+
+
+def timeline_reps() -> int:
+    """Timed reps per stage in the measured-timeline profiler
+    (``telemetry/timeline.py``); each rep is median-filtered by the
+    do_bench discipline."""
+    return _env_int("MAGI_ATTENTION_TIMELINE_REPS", 5)
+
+
+def timeline_inner() -> int:
+    """Calls per timed rep in the measured-timeline profiler (amortizes
+    the fixed per-dispatch sync latency, which dominates sub-ms stages
+    through remote TPU tunnels)."""
+    return _env_int("MAGI_ATTENTION_TIMELINE_INNER", 2)
 
 
 def is_sanity_check_enabled() -> bool:

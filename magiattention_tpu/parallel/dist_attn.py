@@ -147,6 +147,11 @@ class StageTables:
 class StagePlan:
     comm: GroupCollectiveMeta
     tables: StageTables
+    # mask area of the heaviest rank's kernel work in this stage (0 =
+    # legacy construction). The measured-timeline harness prices the
+    # predicted stage compute from this with the cost-model factors, so
+    # predicted-vs-measured deltas use exactly the plan that executes.
+    max_rank_area: int = 0
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -173,6 +178,12 @@ class DistAttnPlan:
     # hierarchical 2-level comm over a (inter, intra) cp mesh (reference
     # _group_collective_hier.py); None = flat single-axis group collectives
     hier: tuple[int, int] | None = None
+
+    # heaviest rank's host-stage (own-shard) mask area; 0 on the merged
+    # degree-0 path (where max_rank_area covers the single kernel call)
+    # and on legacy constructions. Feeds the measured-timeline harness's
+    # predicted host compute (telemetry/timeline.py).
+    host_max_rank_area: int = 0
 
     @property
     def comm(self) -> GroupCollectiveMeta:
@@ -698,6 +709,7 @@ def _build_dist_attn_plan(
             StagePlan(
                 comm=st_comm,
                 tables=StageTables.from_rank_metas(st_metas, st_kv_pad),
+                max_rank_area=max(m.total_area for m in st_metas),
             )
         )
 
@@ -711,6 +723,7 @@ def _build_dist_attn_plan(
         hier=cp_mesh_shape,
         total_area=total_area,
         max_rank_area=max(rank_area),
+        host_max_rank_area=max(m.total_area for m in host_metas),
         merged_comm=None,
         merged_tables=None,
         host_tables=host_tables,
@@ -877,14 +890,22 @@ def dist_attn_local(
         # dist_attn.py:532 + :3168 all_reduce MAX — Muon QK-Clip support)
         return jnp.max(rowmax_lanes[:, :, 0], axis=1)
 
+    # named scopes (utils/instrument.py): every cast / kernel / merge of
+    # the overlap pipeline carries a magi_* label into the XLA metadata,
+    # so jax.profiler device traces show which stage each op belongs to
+    from ..utils.instrument import named_scope
+
     if plan.overlap_degree == 0:
         tab = take(9)
-        recv = cast_kv(take(plan.num_comm_arrays))
+        with named_scope("magi_merged_cast"):
+            recv = cast_kv(take(plan.num_comm_arrays))
         k_full = jnp.concatenate([k, recv[:, 0]], axis=0)
         v_full = jnp.concatenate([v, recv[:, 1]], axis=0)
-        out_h, lse_lanes, rowmax_lanes = _call_kernel(
-            qh, k_full, v_full, tab, plan.merged_tables.kv_pad, params, sink
-        )
+        with named_scope("magi_merged_kernel"):
+            out_h, lse_lanes, rowmax_lanes = _call_kernel(
+                qh, k_full, v_full, tab, plan.merged_tables.kv_pad, params,
+                sink,
+            )
         out, lse = _headmajor_to_seq(out_h, lse_lanes, plan.shard_q_len)
         return out, lse, _head_max(rowmax_lanes)
 
@@ -901,23 +922,28 @@ def dist_attn_local(
     )
     host_params = dataclasses.replace(params, out_dtype=acc_dtype)
     host_tab = take(9)
-    out_h, lse_lanes, rowmax_lanes = _call_kernel(
-        qh, k, v, host_tab, plan.host_tables.kv_pad, host_params, sink
-    )
+    with named_scope("magi_host_stage_kernel"):
+        out_h, lse_lanes, rowmax_lanes = _call_kernel(
+            qh, k, v, host_tab, plan.host_tables.kv_pad, host_params, sink
+        )
     out, lse = _headmajor_to_seq(out_h, lse_lanes, plan.shard_q_len)
     mx = _head_max(rowmax_lanes)
 
     stage_params = dataclasses.replace(
         params, has_sink=False, out_dtype=acc_dtype
     )
-    for sp in plan.stages:
+    for i, sp in enumerate(plan.stages):
         tab = take(9)
-        recv = cast_kv(take(plan.num_comm_arrays))
-        out_i_h, lse_i_lanes, rowmax_i = _call_kernel(
-            qh, recv[:, 0], recv[:, 1], tab, sp.tables.kv_pad, stage_params, None
-        )
+        with named_scope(f"magi_stage{i}_cast"):
+            recv = cast_kv(take(plan.num_comm_arrays))
+        with named_scope(f"magi_stage{i}_kernel"):
+            out_i_h, lse_i_lanes, rowmax_i = _call_kernel(
+                qh, recv[:, 0], recv[:, 1], tab, sp.tables.kv_pad,
+                stage_params, None,
+            )
         out_i, lse_i = _headmajor_to_seq(out_i_h, lse_i_lanes, plan.shard_q_len)
-        out, lse = correct_attn_out_lse(out, lse, out_i, lse_i)
+        with named_scope(f"magi_stage{i}_lse_merge"):
+            out, lse = correct_attn_out_lse(out, lse, out_i, lse_i)
         mx = jnp.maximum(mx, _head_max(rowmax_i))
     return out.astype(params.out_jnp_dtype), lse, mx
 

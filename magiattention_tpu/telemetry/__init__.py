@@ -32,8 +32,14 @@ Typical use::
 
 from __future__ import annotations
 
+from .aggregate import (  # noqa: F401
+    aggregate_across_mesh,
+    merge_chrome_traces,
+    merge_snapshots,
+)
 from .collectors import (  # noqa: F401
     REQUIRED_PLAN_METRICS,
+    REQUIRED_TIMELINE_METRICS,
     record_autotune_cache,
     record_autotune_decision,
     record_autotune_measure_failure,
@@ -43,6 +49,7 @@ from .collectors import (  # noqa: F401
     record_dispatch_solution,
     record_dynamic_solution,
     record_group_collective_build,
+    record_measured_timeline,
     record_overlap_choice,
     record_plan,
     record_runtime_costs,
@@ -53,6 +60,13 @@ from .events import (  # noqa: F401
     get_event_buffer,
     record_event,
     span,
+    trace_metadata_events,
+)
+from .timeline import (  # noqa: F401
+    MeasuredTimeline,
+    StageTiming,
+    profile_key_timeline,
+    profile_plan_timeline,
 )
 from .logger import configure_logging, get_logger  # noqa: F401
 from .registry import (  # noqa: F401
@@ -108,8 +122,12 @@ def dump_events(path: str) -> str:
 
 __all__ = [
     "EventBuffer",
+    "MeasuredTimeline",
     "MetricsRegistry",
     "REQUIRED_PLAN_METRICS",
+    "REQUIRED_TIMELINE_METRICS",
+    "StageTiming",
+    "aggregate_across_mesh",
     "configure_logging",
     "dump_events",
     "dump_metrics",
@@ -117,6 +135,10 @@ __all__ = [
     "get_event_buffer",
     "get_logger",
     "get_registry",
+    "merge_chrome_traces",
+    "merge_snapshots",
+    "profile_key_timeline",
+    "profile_plan_timeline",
     "record_autotune_cache",
     "record_autotune_decision",
     "record_autotune_measure_failure",
@@ -127,6 +149,7 @@ __all__ = [
     "record_dynamic_solution",
     "record_event",
     "record_group_collective_build",
+    "record_measured_timeline",
     "record_overlap_choice",
     "record_plan",
     "record_runtime_costs",
@@ -136,4 +159,5 @@ __all__ = [
     "snapshot",
     "span",
     "telemetry_summary",
+    "trace_metadata_events",
 ]

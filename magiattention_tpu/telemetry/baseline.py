@@ -1,0 +1,346 @@
+"""Perf regression sentinel: bench history + expectation windows + gate.
+
+The committed ``BENCH_r*.json`` round artifacts record the perf
+trajectory for humans; nothing machine-readable ever gated on them. This
+module closes that loop:
+
+- **History** (``BENCH_HISTORY.jsonl``, repo root): one JSON line per
+  completed on-chip bench run — headline + extra metrics, device, and the
+  autotuner rung the run executed with. ``bench.py`` appends on every
+  run it also caches; seed entries are derived from the committed
+  ``BENCH_r*.json`` rounds.
+- **Expectations** (``exps/data/perf_expectations.json``): a checked-in
+  ``[low, high]`` TF/s window per workload metric, seeded from history.
+- **Gate** (:func:`check_gate`, driven by ``exps/run_perf_gate.py`` /
+  ``make perf-gate``): the newest value per metric must stay above
+  ``low * (1 - tolerance)`` (``MAGI_ATTENTION_PERF_GATE_TOLERANCE``,
+  default 0.10 for the shared chip's run-to-run drift). Values above the
+  window flag an *improvement* (pass + re-seed hint). A changed
+  autotuner rung between consecutive runs is flagged (never fatal by
+  itself): a perf delta with a rung change is a tuning story, without
+  one a kernel/runtime story.
+
+Pure host-side file parsing — no jax import anywhere on this path, so
+the gate runs identically on CPU CI, a laptop, and the TPU host. To keep
+that true on hosts without jax installed, this module has NO package-
+relative imports (importing ``magiattention_tpu.telemetry`` pulls the
+package ``__init__`` and, transitively, jax): ``exps/run_perf_gate.py``
+loads it directly by file path, and the env knob is read here rather
+than through ``magiattention_tpu.env``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+HISTORY_FILENAME = "BENCH_HISTORY.jsonl"
+EXPECTATIONS_RELPATH = os.path.join("exps", "data", "perf_expectations.json")
+
+# bench payload keys that are per-run context, not gateable throughput
+# metrics (everything numeric under "metrics" is gateable)
+_NON_METRIC_KEYS = ("jax_flash_best_tuned_blocks",)
+
+
+def default_tolerance() -> float:
+    """``MAGI_ATTENTION_PERF_GATE_TOLERANCE``, read directly from the
+    environment: the one duplicated env lookup in the tree, so the gate
+    stays loadable by file path on hosts without jax (see module
+    docstring). Must agree with ``env.perf_gate_tolerance`` — guarded by
+    ``tests/test_telemetry/test_baseline.py``."""
+    v = os.environ.get("MAGI_ATTENTION_PERF_GATE_TOLERANCE")
+    return float(v) if v is not None else 0.10
+
+
+# ---------------------------------------------------------------------------
+# history
+# ---------------------------------------------------------------------------
+
+
+def append_history(path: str, entry: dict) -> str:
+    """Append one run entry as a JSON line (append-only; concurrent
+    appenders interleave whole lines on POSIX). Returns ``path``."""
+    line = json.dumps(entry, sort_keys=True)
+    with open(path, "a") as f:
+        f.write(line + "\n")
+    return path
+
+
+def load_history(path: str) -> list[dict]:
+    """Parse a history file, skipping blank/corrupt lines (a truncated
+    append from a killed bench run must not take the gate down)."""
+    entries: list[dict] = []
+    if not os.path.exists(path):
+        return entries
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and isinstance(obj.get("metrics"), dict):
+                entries.append(obj)
+    return entries
+
+
+def make_history_entry(
+    *,
+    source: str,
+    metrics: dict,
+    recorded_unix: int | None = None,
+    device: str | None = None,
+    vs_baseline: float | None = None,
+    autotune_rung: str | None = None,
+) -> dict:
+    """Canonical history-entry schema (one place, so bench.py and the
+    seeding path can never drift)."""
+    entry: dict = {
+        "source": source,
+        "metrics": {
+            k: v
+            for k, v in metrics.items()
+            if k not in _NON_METRIC_KEYS and isinstance(v, (int, float))
+        },
+    }
+    if recorded_unix is not None:
+        entry["recorded_unix"] = int(recorded_unix)
+    if device is not None:
+        entry["device"] = device
+    if vs_baseline is not None:
+        entry["vs_baseline"] = vs_baseline
+    if autotune_rung is not None:
+        entry["autotune_rung"] = autotune_rung
+    return entry
+
+
+def newest_metrics(history: list[dict]) -> dict[str, float]:
+    """The NEWEST entry's metrics — what the gate checks. Deliberately
+    not a fold over the whole history: an old good value must never
+    stand in for a metric the newest run didn't measure (that case is
+    the gate's ``missing`` verdict, a warning, not a silent pass)."""
+    return dict(history[-1].get("metrics", {})) if history else {}
+
+
+def rung_changes(history: list[dict]) -> list[str]:
+    """Human-readable flags for autotuner rung changes between
+    consecutive runs that recorded one. A rung change re-prices every
+    kernel-tier number, so the gate surfaces it next to any TF/s delta."""
+    flags: list[str] = []
+    prev: tuple[str, str] | None = None  # (source, rung)
+    for entry in history:
+        rung = entry.get("autotune_rung")
+        if not rung:
+            continue
+        src = str(entry.get("source", "?"))
+        if prev is not None and prev[1] != rung:
+            flags.append(
+                f"autotune rung changed {prev[1]} -> {rung} "
+                f"(between {prev[0]} and {src})"
+            )
+        prev = (src, rung)
+    return flags
+
+
+# ---------------------------------------------------------------------------
+# expectations
+# ---------------------------------------------------------------------------
+
+
+def seed_expectations(
+    history: list[dict],
+    metrics_filter=None,
+    window_last: int | None = None,
+) -> dict:
+    """Expectation windows from history: per metric, ``low``/``high`` =
+    min/max of the last ``window_last`` observed values (``None`` = the
+    whole history). ``metrics_filter`` (callable or container) restricts
+    which metrics get windows (e.g. only TF/s throughput metrics). The
+    ONE seeding implementation — ``run_perf_gate.py --update`` calls this
+    with ``window_last=1`` so older rounds (pre-autotuner, pre-kernel
+    work) never loosen the guarded floor."""
+    if window_last is not None and window_last < 1:
+        raise ValueError(f"window_last must be >= 1, got {window_last}")
+    values: dict[str, list[float]] = {}
+    for entry in history:
+        for name, value in entry.get("metrics", {}).items():
+            if metrics_filter is not None:
+                keep = (
+                    metrics_filter(name)
+                    if callable(metrics_filter)
+                    else name in metrics_filter
+                )
+                if not keep:
+                    continue
+            values.setdefault(name, []).append(float(value))
+    return {
+        name: {
+            "low": min(vals[-window_last:] if window_last else vals),
+            "high": max(vals[-window_last:] if window_last else vals),
+        }
+        for name, vals in sorted(values.items())
+    }
+
+
+def load_expectations(path: str) -> dict:
+    """Read the expectation file; returns its ``metrics`` window map."""
+    with open(path) as f:
+        data = json.load(f)
+    return data.get("metrics", {})
+
+
+def write_expectations(path: str, windows: dict, provenance: str) -> str:
+    payload = {
+        "_provenance": provenance,
+        "metrics": {k: windows[k] for k in sorted(windows)},
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# gate
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class GateResult:
+    metric: str
+    status: str  # ok | regression | improvement | no-expectation | missing
+    message: str
+    value: float | None = None
+    low: float | None = None
+    high: float | None = None
+
+    @property
+    def failed(self) -> bool:
+        return self.status == "regression"
+
+
+def check_gate(
+    metrics: dict[str, float],
+    expectations: dict[str, dict],
+    tolerance: float | None = None,
+) -> list[GateResult]:
+    """Gate the newest per-metric values against expectation windows.
+
+    Verdicts, per metric union of both maps (deterministic name order):
+
+    - ``regression`` (FAILS): value < ``low * (1 - tolerance)``
+    - ``improvement``: value > ``high * (1 + tolerance)`` — passes, with
+      a hint to re-seed so the new level becomes the guarded floor
+    - ``ok``: inside the tolerated window
+    - ``no-expectation``: measured but never seeded (passes)
+    - ``missing``: expected but absent from the newest run (passes —
+      bench rounds legitimately vary in which extras they measure)
+    """
+    if tolerance is None:
+        tolerance = default_tolerance()
+    results: list[GateResult] = []
+    for name in sorted(set(metrics) | set(expectations)):
+        value = metrics.get(name)
+        window = expectations.get(name)
+        if window is None:
+            results.append(
+                GateResult(
+                    metric=name,
+                    status="no-expectation",
+                    value=value,
+                    message=(
+                        f"{name}={value:g}: no expectation window seeded "
+                        "(run exps/run_perf_gate.py --update to adopt it)"
+                    ),
+                )
+            )
+            continue
+        low, high = float(window["low"]), float(window["high"])
+        if value is None:
+            results.append(
+                GateResult(
+                    metric=name,
+                    status="missing",
+                    low=low,
+                    high=high,
+                    message=(
+                        f"{name}: expected [{low:g}, {high:g}] but the "
+                        "newest run did not measure it"
+                    ),
+                )
+            )
+            continue
+        floor = low * (1.0 - tolerance)
+        ceil = high * (1.0 + tolerance)
+        if value < floor:
+            results.append(
+                GateResult(
+                    metric=name,
+                    status="regression",
+                    value=value,
+                    low=low,
+                    high=high,
+                    message=(
+                        f"{name}={value:g} fell below {floor:g} "
+                        f"(window [{low:g}, {high:g}], tolerance "
+                        f"{tolerance:.0%}) — perf regression"
+                    ),
+                )
+            )
+        elif value > ceil:
+            results.append(
+                GateResult(
+                    metric=name,
+                    status="improvement",
+                    value=value,
+                    low=low,
+                    high=high,
+                    message=(
+                        f"{name}={value:g} exceeds the window "
+                        f"[{low:g}, {high:g}] — improvement; re-seed "
+                        "(--update) to guard the new level"
+                    ),
+                )
+            )
+        else:
+            results.append(
+                GateResult(
+                    metric=name,
+                    status="ok",
+                    value=value,
+                    low=low,
+                    high=high,
+                    message=(
+                        f"{name}={value:g} within [{floor:g}, {ceil:g}]"
+                    ),
+                )
+            )
+    return results
+
+
+def gate_report(results: list[GateResult], flags: list[str]) -> str:
+    """Plain-text gate verdict: one line per metric, rung-change flags,
+    then the PASS/FAIL summary line."""
+    icon = {
+        "ok": "ok  ",
+        "regression": "FAIL",
+        "improvement": "up  ",
+        "no-expectation": "new ",
+        "missing": "n/a ",
+    }
+    lines = [
+        f"  [{icon.get(r.status, '??? ')}] {r.message}" for r in results
+    ]
+    for f in flags:
+        lines.append(f"  [flag] {f}")
+    n_fail = sum(1 for r in results if r.failed)
+    lines.append(
+        f"perf gate: {'FAIL' if n_fail else 'PASS'} "
+        f"({n_fail} regression(s), {len(results)} metric(s) checked)"
+    )
+    return "\n".join(lines)

@@ -15,7 +15,19 @@ the two stay in sync by building a real plan and checking the snapshot for
 
 from __future__ import annotations
 
+import time
+
 from .registry import get_registry
+
+
+def _marker_event(name: str, attrs: dict) -> None:
+    """Zero-duration marker on the same perf_counter clock ``span()``
+    stamps real spans with — a ts=0 marker would stretch the Chrome
+    trace's time axis back to system boot and collapse every real span
+    to an invisible sliver."""
+    from .events import record_event
+
+    record_event(name, time.perf_counter(), 0.0, attrs)
 
 # ---------------------------------------------------------------------------
 # metric catalog (see docs/observability.md for the prose version)
@@ -77,6 +89,17 @@ M_AUTOTUNE_MEASURED_MS = "magi_autotune_measured_ms"
 # which rung the last decision chose and why: value 1, labels rung=/source=
 M_AUTOTUNE_CHOICE = "magi_autotune_choice"
 
+# gauges — measured stage timelines (telemetry/timeline.py): what the
+# hardware actually did, next to what the overlap solver predicted
+M_TL_MEASURED_TOTAL_MS = "magi_overlap_measured_total_ms"  # pipelined e2e
+M_TL_SERIAL_MS = "magi_overlap_measured_serial_ms"  # sum of fenced pieces
+M_TL_COMM_MS = "magi_overlap_measured_comm_ms"  # {stage=}
+M_TL_CALC_MS = "magi_overlap_measured_calc_ms"  # {stage=} incl stage=host
+# fraction of hideable stage-cast time the schedule actually hid [0, 1]
+M_TL_EFFICIENCY = "magi_overlap_measured_efficiency"
+M_TL_PREDICTED_MS = "magi_overlap_predicted_total_ms"  # solver's model
+M_TL_PRED_ERROR = "magi_overlap_prediction_error_ratio"  # measured/pred
+
 # histograms (seconds)
 H_PLAN_BUILD_S = "magi_plan_build_seconds"
 H_DISPATCH_SOLVE_S = "magi_dispatch_solve_seconds"
@@ -100,6 +123,19 @@ REQUIRED_PLAN_METRICS: tuple[str, ...] = (
     M_MODELED_CALC_S,
     M_MODELED_COMM_S,
     H_PLAN_BUILD_S,
+)
+
+# populated by one profile_plan_timeline run (telemetry/timeline.py);
+# asserted by make telemetry-check's timeline step, documented in
+# docs/observability.md "Measured timelines & overlap audit"
+REQUIRED_TIMELINE_METRICS: tuple[str, ...] = (
+    M_TL_MEASURED_TOTAL_MS,
+    M_TL_SERIAL_MS,
+    M_TL_COMM_MS,
+    M_TL_CALC_MS,
+    M_TL_EFFICIENCY,
+    M_TL_PREDICTED_MS,
+    M_TL_PRED_ERROR,
 )
 
 
@@ -287,6 +323,45 @@ def record_runtime_costs(
     )
 
 
+def record_measured_timeline(tl) -> None:
+    """One measured stage timeline (``telemetry/timeline.py``): per-stage
+    comm/calc wall time next to the solver's prediction, the pipelined
+    vs serial totals, and the achieved overlap efficiency. Stage-labeled
+    families are cleared first — a re-profile at a different degree must
+    not leave stale stage series behind."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.clear_metric(M_TL_COMM_MS)
+    reg.clear_metric(M_TL_CALC_MS)
+    for st in tl.stages:
+        if st.stage != "host":  # the host stage has no cast by definition
+            reg.gauge_set(M_TL_COMM_MS, st.comm_ms, stage=st.stage)
+        reg.gauge_set(M_TL_CALC_MS, st.calc_ms, stage=st.stage)
+    reg.gauge_set(M_TL_MEASURED_TOTAL_MS, tl.measured_total_ms)
+    reg.gauge_set(M_TL_SERIAL_MS, tl.serial_total_ms)
+    reg.gauge_set(M_TL_EFFICIENCY, tl.overlap_efficiency)
+    # predicted gauges clear-then-set: a re-profile whose prediction could
+    # not be priced must not pair fresh measured numbers with a stale
+    # prediction from an earlier plan
+    reg.clear_metric(M_TL_PREDICTED_MS)
+    reg.clear_metric(M_TL_PRED_ERROR)
+    if tl.predicted_total_ms is not None:
+        reg.gauge_set(M_TL_PREDICTED_MS, tl.predicted_total_ms)
+    if tl.prediction_error_ratio is not None:
+        reg.gauge_set(M_TL_PRED_ERROR, tl.prediction_error_ratio)
+    _marker_event(
+        "measured_timeline",
+        {
+            "overlap_degree": tl.overlap_degree,
+            "measured_total_ms": tl.measured_total_ms,
+            "serial_total_ms": tl.serial_total_ms,
+            "overlap_efficiency": tl.overlap_efficiency,
+            "predicted_total_ms": tl.predicted_total_ms,
+        },
+    )
+
+
 def record_cache_access(hit: bool) -> None:
     """Keyed-runtime LRU behavior (``api/interface.py``)."""
     if not _enabled():
@@ -323,12 +398,8 @@ def record_autotune_measure_failure(candidate: str, error: str) -> None:
     if not _enabled():
         return
     get_registry().counter_inc(M_AUTOTUNE_MEASURE_FAILURES)
-    from .events import record_event
-
-    record_event(
+    _marker_event(
         "autotune_measure_failed",
-        0.0,
-        0.0,
         {"candidate": candidate, "error": error[:200]},
     )
 
@@ -350,12 +421,8 @@ def record_autotune_decision(decision) -> None:
     reg.clear_metric(M_AUTOTUNE_CHOICE)  # one live choice series at a time
     rung = f"{decision.block_q}x{decision.block_k}x{decision.head_block}"
     reg.gauge_set(M_AUTOTUNE_CHOICE, 1, rung=rung, source=decision.source)
-    from .events import record_event
-
-    record_event(
+    _marker_event(
         "autotune_decision",
-        0.0,
-        0.0,
         {
             "rung": rung,
             "source": decision.source,
@@ -425,5 +492,12 @@ def telemetry_summary(snapshot: dict | None = None) -> str:
             f"predicted {fmt(g.get(M_AUTOTUNE_PREDICTED_MS))} ms  "
             f"cache hits/misses: {fmt(hits)}/"
             f"{fmt(c.get(M_AUTOTUNE_CACHE_MISSES, 0))}"
+        )
+    if g.get(M_TL_MEASURED_TOTAL_MS) is not None:
+        lines.append(
+            f"  measured overlap: e2e {fmt(g.get(M_TL_MEASURED_TOTAL_MS))} ms"
+            f"  serial {fmt(g.get(M_TL_SERIAL_MS))} ms"
+            f"  efficiency {fmt(g.get(M_TL_EFFICIENCY))}"
+            f"  predicted {fmt(g.get(M_TL_PREDICTED_MS))} ms"
         )
     return "\n".join(lines)

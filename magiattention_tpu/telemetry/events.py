@@ -68,15 +68,59 @@ class EventBuffer:
     def dump(self, path: str) -> str:
         """Write the buffered spans as Chrome trace-event JSON; returns
         ``path``. Loadable in Perfetto / chrome://tracing / TensorBoard's
-        trace viewer."""
+        trace viewer. Metadata events (phase ``M``) name each pid/tid
+        track, so the viewer shows "magiattention host (pid N)" instead of
+        a raw number."""
+        events = self.events()
         payload = {
-            "traceEvents": self.events(),
+            "traceEvents": trace_metadata_events(events) + events,
             "displayTimeUnit": "ms",
         }
         with open(path, "w") as f:
             json.dump(payload, f, indent=1)
             f.write("\n")
         return path
+
+
+def trace_metadata_events(
+    events: list[dict],
+    process_name: str | None = None,
+) -> list[dict]:
+    """Chrome-trace metadata (phase ``M``) naming every pid/tid seen in
+    ``events``: one ``process_name`` per distinct pid, one ``thread_name``
+    per distinct (pid, tid). Perfetto then labels the tracks instead of
+    showing raw ids. The cross-rank merge (``telemetry/aggregate.py``)
+    reuses this with a per-rank ``process_name``."""
+    pids: dict[int, set] = {}
+    for ev in events:
+        if ev.get("ph") == "M":
+            continue
+        pid = ev.get("pid", 0)
+        pids.setdefault(pid, set()).add(ev.get("tid", 0))
+    meta: list[dict] = []
+    for pid in sorted(pids):
+        meta.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {
+                    "name": process_name or f"magiattention host (pid {pid})"
+                },
+            }
+        )
+        for tid in sorted(pids[pid]):
+            meta.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": f"host thread {tid}"},
+                }
+            )
+    return meta
 
 
 def _default_buffer() -> EventBuffer:

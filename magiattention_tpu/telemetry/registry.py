@@ -45,6 +45,51 @@ def series_key(name: str, labels: dict | None = None) -> str:
     return f"{name}{{{inner}}}"
 
 
+def estimate_percentiles(
+    bounds,
+    bucket_counts,
+    count: int,
+    vmin: float,
+    vmax: float,
+    qs=(0.5, 0.95, 0.99),
+) -> list[float | None]:
+    """Approximate quantiles from histogram bucket counts (the Prometheus
+    ``histogram_quantile`` method): find the bucket holding the q-th sample
+    and interpolate linearly inside it. Resolution is bounded by the bucket
+    width — with log-scale default bounds an estimate can be off by up to
+    the span of its bucket. The observed ``vmin``/``vmax`` clamp the first
+    and overflow buckets (which have no finite lower resp. upper edge), so
+    single-bucket and extreme quantiles stay inside the observed range.
+
+    Shared by live snapshots and the cross-rank aggregate merge
+    (``telemetry/aggregate.py``), so both report the same estimator.
+    """
+    if count <= 0:
+        return [None] * len(qs)
+    out: list[float | None] = []
+    for q in qs:
+        target = q * count
+        cum = 0.0
+        val: float | None = None
+        for i, c in enumerate(bucket_counts):
+            prev_cum = cum
+            cum += c
+            if cum >= target and c > 0:
+                lo = vmin if i == 0 else float(bounds[i - 1])
+                hi = vmax if i >= len(bounds) else float(bounds[i])
+                lo = max(lo, vmin)
+                hi = min(hi, vmax)
+                if hi < lo:
+                    lo = hi
+                frac = (target - prev_cum) / c
+                val = lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+                break
+        if val is None:  # numeric drift: everything counted, target beyond
+            val = vmax
+        out.append(min(max(val, vmin), vmax))
+    return out
+
+
 @dataclass
 class _Histogram:
     bounds: tuple[float, ...] = DEFAULT_BUCKET_BOUNDS
@@ -71,12 +116,19 @@ class _Histogram:
         self.bucket_counts[-1] += 1
 
     def as_dict(self) -> dict:
+        p50, p95, p99 = estimate_percentiles(
+            self.bounds, self.bucket_counts, self.count, self.vmin, self.vmax
+        )
         return {
             "count": self.count,
             "sum": self.total,
             "min": self.vmin if self.count else None,
             "max": self.vmax if self.count else None,
             "mean": (self.total / self.count) if self.count else None,
+            # approximate (bucket-interpolated; see estimate_percentiles)
+            "p50": p50,
+            "p95": p95,
+            "p99": p99,
             "bounds": list(self.bounds),
             "bucket_counts": list(self.bucket_counts),
         }

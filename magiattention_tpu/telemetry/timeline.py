@@ -1,0 +1,542 @@
+"""Measured stage timelines: re-execute a plan piece-by-piece and time it.
+
+The overlap solver *predicts* a pipelined timeline
+(``simulate_overlap_timeline``) from analytic cost factors and picks the
+overlap degree from it — but until now nothing ever measured what the
+hardware actually did, so a solver misprediction was invisible. This
+module is the measuring half of that loop:
+
+1. split a :class:`~..parallel.dist_attn.DistAttnPlan` into its
+   executable pieces — host-stage kernel, per-stage group cast, per-stage
+   kernel (merged cast + merged kernel on the degree-0 path) — as
+   separate jitted shard_map programs over the same mesh/tables the real
+   runtime uses;
+2. time each piece AND the full pipelined path with the tunnel-safe sync
+   discipline of ``benchmarking/bench.py`` (``do_bench``: warmup, inner
+   batching, scalar host readback per timed region — through remote TPU
+   tunnels ``block_until_ready`` alone does not fully synchronize);
+3. fold the numbers into a :class:`MeasuredTimeline`: per-stage comm/calc
+   ms, serial sum vs measured end-to-end, the overlap efficiency (what
+   fraction of hideable comm the XLA scheduler actually hid), and the
+   predicted-vs-measured delta against the same
+   ``simulate_overlap_timeline`` model the solver chose the degree with.
+
+Everything is host-driven: the pieces are ordinary jitted functions,
+fenced on the host between timings — nothing records from inside traced
+code. Telemetry gauges (``magi_overlap_measured_*``) are written via
+:func:`~.collectors.record_measured_timeline` when telemetry is enabled.
+
+Caveats: the pieces run with ``has_sink=False`` (the sink joins the
+softmax once in the host stage and does not move timing) and the
+default-precision KV payload. Each piece mirrors its slice of
+``dist_attn_local`` — kernel, head-major -> sequence layout, and (remote
+stages) the lse merge, in the same accumulator dtype — so the serial sum
+prices the same numeric work as the pipelined path; the residual bias is
+the per-piece dispatch overhead, which over-counts the serial bound
+slightly. ``overlap_efficiency`` divides by hideable *comm* only, the
+quantity the paper's claim is about.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class StageTiming:
+    """Measured (and modeled) cost of one pipeline piece. ``stage`` is
+    ``"host"``, ``"merged"``, or the remote stage index as a string;
+    ``comm_ms`` is 0 for pieces with no cast (the host stage)."""
+
+    stage: str
+    comm_ms: float
+    calc_ms: float
+    predicted_comm_ms: float | None = None
+    predicted_calc_ms: float | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MeasuredTimeline:
+    """One profiled plan: per-stage measurements plus the aggregate
+    pipelined/serial/predicted comparison."""
+
+    overlap_degree: int
+    cp_size: int
+    stages: tuple[StageTiming, ...]
+    measured_total_ms: float  # full pipelined path, end to end
+    serial_total_ms: float  # sum of the individually-fenced pieces
+    hideable_comm_ms: float  # total stage-cast time overlap could hide
+    overlap_efficiency: float  # hidden / hideable, clamped to [0, 1]
+    predicted_total_ms: float | None  # simulate_overlap_timeline model
+    prediction_error_ratio: float | None  # measured_total / predicted
+
+    def report(self) -> str:
+        """Human-readable predicted-vs-measured table (the overlap
+        audit); one line per stage, then the aggregate verdict."""
+
+        def fmt(v, suffix=""):
+            return "-" if v is None else f"{v:.3f}{suffix}"
+
+        lines = [
+            f"measured stage timeline: overlap_degree={self.overlap_degree} "
+            f"cp={self.cp_size}",
+            f"  {'stage':<8} {'comm ms (pred)':<20} {'calc ms (pred)':<20}",
+        ]
+        for st in self.stages:
+            comm = f"{st.comm_ms:.3f} ({fmt(st.predicted_comm_ms)})"
+            calc = f"{st.calc_ms:.3f} ({fmt(st.predicted_calc_ms)})"
+            lines.append(f"  {st.stage:<8} {comm:<20} {calc:<20}")
+        lines.append(
+            f"  end-to-end measured {self.measured_total_ms:.3f} ms | "
+            f"serial sum {self.serial_total_ms:.3f} ms | "
+            f"predicted {fmt(self.predicted_total_ms, ' ms')}"
+        )
+        # clamp like overlap_efficiency does: serial over-counts each
+        # piece's dispatch overhead, so raw serial-minus-measured can
+        # exceed the hideable comm — never print >100% of it as hidden
+        hidden = min(
+            max(self.serial_total_ms - self.measured_total_ms, 0.0),
+            self.hideable_comm_ms,
+        )
+        lines.append(
+            f"  overlap efficiency {self.overlap_efficiency:.1%}: "
+            f"{hidden:.3f} ms of {self.hideable_comm_ms:.3f} ms hideable "
+            "comm hidden"
+        )
+        if self.prediction_error_ratio is not None:
+            lines.append(
+                "  solver model delta: measured/predicted = "
+                f"{self.prediction_error_ratio:.2f}x "
+                "(>1: hardware slower than the model priced)"
+            )
+        return "\n".join(lines)
+
+
+def _predicted_costs(
+    plan,
+    *,
+    num_heads_q: int,
+    num_heads_kv: int,
+    head_dim: int,
+    bytes_per_elt: int,
+    generation: str | None,
+    calc_cost_factor: float | None = None,
+    comm_cost_factor: float | None = None,
+    stage_overhead_s: float = 30e-6,
+):
+    """(host_calc_s, [stage_comm_s], [stage_calc_s], predicted_total_s)
+    from the same pricing the auto-degree search uses — or None when the
+    cost factors cannot be resolved (unknown generation)."""
+    from ..meta.solver.overlap_solver import simulate_overlap_timeline
+
+    if calc_cost_factor is None or comm_cost_factor is None:
+        from .. import env
+        from ..utils.cost import get_calc_cost_factor, get_comm_cost_factor
+
+        gen = generation or env.tpu_generation()
+        try:
+            calc_cost_factor = get_calc_cost_factor(
+                num_heads_q, head_dim, gen
+            )
+            comm_cost_factor = get_comm_cost_factor(
+                num_heads_kv, head_dim, gen, bytes_per_elt=bytes_per_elt
+            )
+        except ValueError:
+            return None
+    if plan.overlap_degree == 0:
+        comm_s = [max(plan.merged_comm.recv_total, default=0) * comm_cost_factor]
+        calc_s = [plan.max_rank_area * calc_cost_factor]
+        total = simulate_overlap_timeline(0.0, comm_s, calc_s, 0.0)
+        return 0.0, comm_s, calc_s, total
+    host_s = plan.host_max_rank_area * calc_cost_factor
+    comm_s = [
+        max(sp.comm.recv_total, default=0) * comm_cost_factor
+        for sp in plan.stages
+    ]
+    calc_s = [sp.max_rank_area * calc_cost_factor for sp in plan.stages]
+    total = simulate_overlap_timeline(host_s, comm_s, calc_s, stage_overhead_s)
+    return host_s, comm_s, calc_s, total
+
+
+def profile_plan_timeline(
+    plan,
+    mesh,
+    params,
+    *,
+    axis_name="cp",
+    q=None,
+    k=None,
+    v=None,
+    num_heads: tuple[int, int] | None = None,
+    head_dim: int | None = None,
+    dtype=None,
+    shard_k_len: int | None = None,
+    reps: int | None = None,
+    inner: int | None = None,
+    warmup: int = 1,
+    seed: int = 0,
+    generation: str | None = None,
+    calc_cost_factor: float | None = None,
+    comm_cost_factor: float | None = None,
+    stage_overhead_s: float = 30e-6,
+    use_mesh_barrier: bool = False,
+    record: bool = True,
+) -> MeasuredTimeline:
+    """Measure a plan's stage timeline on the given mesh.
+
+    ``q/k/v`` are *dispatched-layout* global arrays (``[cp * shard, h,
+    d]``); omitted, random operands are synthesized from ``num_heads`` /
+    ``head_dim`` / ``dtype`` (default ``params.out_dtype``), with
+    ``shard_k_len`` sizing the K/V shard for cross-attention plans whose
+    KV dispatch differs from the Q one (default: the Q shard length —
+    self-attention). ``reps`` /
+    ``inner`` default to the ``MAGI_ATTENTION_TIMELINE_REPS`` /
+    ``_INNER`` env knobs. ``use_mesh_barrier`` rendezvouses every device
+    before each timed rep (multi-chip meshes).
+
+    With ``record=True`` (and telemetry enabled) the result is also
+    written to the registry as ``magi_overlap_measured_*`` gauges.
+
+    Works for staged (degree >= 1), merged (degree 0), flat and
+    hierarchical self-attention plans; qo-comm plans have their own
+    kernel geometry and are not supported.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .. import env
+    from ..benchmarking.bench import do_bench
+    from ..comm.group_collective import group_cast
+    from ..comm.hier import group_cast_hier
+    from ..ops.correction import correct_attn_out_lse
+    from ..parallel.dist_attn import (
+        _call_kernel,
+        _headmajor_to_seq,
+        _hm,
+        dist_attn_local,
+        ensure_kernel_steps,
+    )
+    from ..utils.compat import shard_map
+
+    if not hasattr(plan, "stages"):
+        raise NotImplementedError(
+            "profile_plan_timeline supports DistAttnPlan runtimes only "
+            f"(got {type(plan).__name__}); qo-comm plans interleave comm "
+            "and compute inside one program and have no stage split to "
+            "re-execute"
+        )
+    reps = env.timeline_reps() if reps is None else reps
+    inner = env.timeline_inner() if inner is None else inner
+    if isinstance(axis_name, (tuple, list)):
+        axis_name = tuple(axis_name)
+    if plan.hier is not None and not (
+        isinstance(axis_name, tuple) and len(axis_name) == 2
+    ):
+        raise ValueError(
+            "hierarchical plan: axis_name must be the (inter, intra) mesh "
+            f"axis pair the plan was built for, got {axis_name!r}"
+        )
+    spec = P(axis_name)
+    shard = NamedSharding(mesh, spec)
+
+    # ---- operands ---------------------------------------------------------
+    if q is None:
+        assert num_heads is not None and head_dim is not None, (
+            "synthesizing operands needs num_heads=(hq, hkv) and head_dim"
+        )
+        hq, hkv = num_heads
+        dt = jnp.dtype(dtype if dtype is not None else params.out_dtype)
+        total = plan.cp_size * plan.shard_q_len
+        total_k = plan.cp_size * (
+            shard_k_len if shard_k_len is not None else plan.shard_q_len
+        )
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.standard_normal((total, hq, head_dim)), dt)
+        k = jnp.asarray(rng.standard_normal((total_k, hkv, head_dim)), dt)
+        v = jnp.asarray(rng.standard_normal((total_k, hkv, head_dim)), dt)
+    hq, head_dim = int(q.shape[1]), int(q.shape[2])
+    hkv = int(k.shape[1])
+    q = jax.device_put(q, shard)
+    k = jax.device_put(k, shard)
+    v = jax.device_put(v, shard)
+
+    params = ensure_kernel_steps(
+        params,
+        (plan.merged_tables, plan.host_tables,
+         *(sp.tables for sp in plan.stages)),
+    )
+    calc_params = dataclasses.replace(params, has_sink=False)
+    # the staged path accumulates out/lse in fp32 when forward
+    # high-precision reduce is on (dist_attn_local's acc_dtype) — the
+    # pieces mirror it so the serial sum prices the same numeric work
+    acc_dtype = (
+        "float32"
+        if env.is_forward_high_precision_reduce()
+        else calc_params.out_dtype
+    )
+    piece_params = dataclasses.replace(calc_params, out_dtype=acc_dtype)
+
+    def put(arrs):
+        return tuple(jax.device_put(jnp.asarray(a), shard) for a in arrs)
+
+    def smap(n_in, body, n_out=1):
+        f = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec,) * n_in,
+            out_specs=spec if n_out == 1 else (spec,) * n_out,
+            check_vma=False,
+        )
+        return jax.jit(f)
+
+    def cast_payload(payload, comm_arrays):
+        if plan.hier is not None:
+            inter_name, intra_name = axis_name
+            return group_cast_hier(
+                payload,
+                comm_arrays,
+                axis_inter=inter_name,
+                axis_intra=intra_name,
+            )
+        send_idx, recv_sel, recv_valid = comm_arrays
+        return group_cast(
+            payload, send_idx, recv_sel, recv_valid, axis_name=axis_name
+        )
+
+    nca = plan.num_comm_arrays
+
+    def make_cast_fn():
+        def body(k_, v_, *cas):
+            return cast_payload(jnp.stack([k_, v_], axis=1), cas)
+
+        return smap(2 + nca, body)
+
+    bench_kw = dict(
+        warmup=warmup, rep=reps, inner=inner,
+        mesh=mesh if use_mesh_barrier else None,
+    )
+
+    def t_ms(fn, *args):
+        return do_bench(fn, *args, **bench_kw).median_ms
+
+    predicted = _predicted_costs(
+        plan,
+        num_heads_q=hq,
+        num_heads_kv=hkv,
+        head_dim=head_dim,
+        bytes_per_elt=jnp.dtype(k.dtype).itemsize,
+        generation=generation,
+        calc_cost_factor=calc_cost_factor,
+        comm_cost_factor=comm_cost_factor,
+        stage_overhead_s=stage_overhead_s,
+    )
+    p_host_ms = p_comm_ms = p_calc_ms = None
+    predicted_total_ms = None
+    if predicted is not None:
+        host_s, comm_s, calc_s, total_s = predicted
+        p_host_ms = host_s * 1e3
+        p_comm_ms = [x * 1e3 for x in comm_s]
+        p_calc_ms = [x * 1e3 for x in calc_s]
+        predicted_total_ms = total_s * 1e3
+
+    # every piece mirrors its slice of dist_attn_local exactly — kernel
+    # plus the head-major -> sequence layout and (remote stages) the lse
+    # merge — so the serial sum prices the same work the pipelined path
+    # runs and the overlap efficiency isolates scheduling alone
+    stages: list[StageTiming] = []
+    if plan.overlap_degree == 0:
+        comm_args = put(plan._comm_arrays(plan.merged_comm))
+        tabs = put(plan.merged_tables.arrays())
+        cast_fn = make_cast_fn()
+
+        def merged_body(q_, k_, v_, recv, *tt):
+            qh = _hm(q_, plan.shard_q_pad)
+            out_h, lse_lanes, _ = _call_kernel(
+                qh,
+                jnp.concatenate([k_, recv[:, 0]], axis=0),
+                jnp.concatenate([v_, recv[:, 1]], axis=0),
+                tt,
+                plan.merged_tables.kv_pad,
+                calc_params,
+                None,
+            )
+            return _headmajor_to_seq(out_h, lse_lanes, plan.shard_q_len)
+
+        calc_fn = smap(4 + 9, merged_body, n_out=2)
+        recv = cast_fn(k, v, *comm_args)
+        comm_ms = t_ms(cast_fn, k, v, *comm_args)
+        calc_ms = t_ms(calc_fn, q, k, v, recv, *tabs)
+        stages.append(
+            StageTiming(
+                stage="merged",
+                comm_ms=comm_ms,
+                calc_ms=calc_ms,
+                predicted_comm_ms=p_comm_ms[0] if p_comm_ms else None,
+                predicted_calc_ms=p_calc_ms[0] if p_calc_ms else None,
+            )
+        )
+        serial_ms = comm_ms + calc_ms
+        hideable_ms = comm_ms
+    else:
+        host_tabs = put(plan.host_tables.arrays())
+        cast_fn = make_cast_fn()  # one program; per-stage shapes recompile
+
+        def host_body(q_, k_, v_, *tt):
+            qh = _hm(q_, plan.shard_q_pad)
+            out_h, lse_lanes, _ = _call_kernel(
+                qh, k_, v_, tt, plan.host_tables.kv_pad, piece_params, None
+            )
+            return _headmajor_to_seq(out_h, lse_lanes, plan.shard_q_len)
+
+        host_fn = smap(3 + 9, host_body, n_out=2)
+        acc_out, acc_lse = host_fn(q, k, v, *host_tabs)
+        host_ms = t_ms(host_fn, q, k, v, *host_tabs)
+        stages.append(
+            StageTiming(
+                stage="host",
+                comm_ms=0.0,
+                calc_ms=host_ms,
+                predicted_comm_ms=None,
+                predicted_calc_ms=p_host_ms,
+            )
+        )
+        serial_ms = host_ms
+        hideable_ms = 0.0
+        for i, sp in enumerate(plan.stages):
+            comm_args = put(plan._comm_arrays(sp.comm))
+            tabs = put(sp.tables.arrays())
+
+            def stage_body(
+                q_, out_acc, lse_acc, recv, *tt, _kv_pad=sp.tables.kv_pad
+            ):
+                qh = _hm(q_, plan.shard_q_pad)
+                out_h, lse_lanes, _ = _call_kernel(
+                    qh, recv[:, 0], recv[:, 1], tt, _kv_pad,
+                    piece_params, None,
+                )
+                out_i, lse_i = _headmajor_to_seq(
+                    out_h, lse_lanes, plan.shard_q_len
+                )
+                return correct_attn_out_lse(out_acc, lse_acc, out_i, lse_i)
+
+            calc_fn = smap(4 + 9, stage_body, n_out=2)
+            recv = cast_fn(k, v, *comm_args)
+            comm_ms = t_ms(cast_fn, k, v, *comm_args)
+            calc_ms = t_ms(calc_fn, q, acc_out, acc_lse, recv, *tabs)
+            acc_out, acc_lse = calc_fn(q, acc_out, acc_lse, recv, *tabs)
+            stages.append(
+                StageTiming(
+                    stage=str(i),
+                    comm_ms=comm_ms,
+                    calc_ms=calc_ms,
+                    predicted_comm_ms=p_comm_ms[i] if p_comm_ms else None,
+                    predicted_calc_ms=p_calc_ms[i] if p_calc_ms else None,
+                )
+            )
+            serial_ms += comm_ms + calc_ms
+            hideable_ms += comm_ms
+
+    # the full pipelined path — the same dist_attn_local body the real
+    # runtime shard_maps, with the pieces' no-sink params, so the
+    # serial-vs-pipelined delta isolates scheduling, not mask content
+    device_tables = put(plan.device_tables())
+    n_tab = len(device_tables)
+
+    def full_body(q_, k_, v_, *tabs):
+        out, _, _ = dist_attn_local(
+            q_, k_, v_, tabs, plan, calc_params,
+            axis_name=axis_name, sink=None,
+        )
+        return out
+
+    full_fn = smap(3 + n_tab, full_body)
+    measured_total_ms = t_ms(full_fn, q, k, v, *device_tables)
+
+    hidden_ms = max(serial_ms - measured_total_ms, 0.0)
+    efficiency = (
+        min(hidden_ms / hideable_ms, 1.0) if hideable_ms > 0 else 0.0
+    )
+    tl = MeasuredTimeline(
+        overlap_degree=plan.overlap_degree,
+        cp_size=plan.cp_size,
+        stages=tuple(stages),
+        measured_total_ms=measured_total_ms,
+        serial_total_ms=serial_ms,
+        hideable_comm_ms=hideable_ms,
+        overlap_efficiency=efficiency,
+        predicted_total_ms=predicted_total_ms,
+        prediction_error_ratio=(
+            measured_total_ms / predicted_total_ms
+            if predicted_total_ms
+            else None
+        ),
+    )
+    if record:
+        from .collectors import record_measured_timeline
+
+        record_measured_timeline(tl)
+    return tl
+
+
+def profile_key_timeline(
+    key=None,
+    *,
+    reps: int | None = None,
+    inner: int | None = None,
+    warmup: int = 1,
+    seed: int = 0,
+    use_mesh_barrier: bool = False,
+    record: bool = True,
+) -> MeasuredTimeline:
+    """Profile the runtime planned for a :class:`DistAttnRuntimeKey`
+    (default: the most recently planned key) with synthesized operands of
+    the keyed shape/dtype. The measured-timeline twin of
+    ``get_runtime_mgr(key).calc_attn`` — one call audits what the plan's
+    overlap schedule actually delivers on the current backend."""
+    from ..api import interface as api_interface
+    from ..parallel.dist_attn import make_attn_params
+
+    if key is None:
+        key = api_interface.get_most_recent_key()
+    mgr = api_interface.get_runtime_mgr(key)
+    plan = mgr.plan
+    if not hasattr(plan, "stages"):
+        raise NotImplementedError(
+            "profile_key_timeline supports group-cast runtimes only "
+            "(qo-comm plans have no stage split to re-execute)"
+        )
+    _, _, head_block = api_interface._blocking_from(
+        key.block_config, key.num_heads_q, key.num_heads_kv
+    )
+    params = make_attn_params(
+        plan,
+        key.head_dim,
+        softcap=key.softcap,
+        has_sink=False,
+        out_dtype=key.out_dtype,
+        interpret=key.interpret,
+        head_block=head_block,
+    )
+    return profile_plan_timeline(
+        plan,
+        mgr.mesh,
+        params,
+        axis_name=key.cp_axis,
+        num_heads=(key.num_heads_q, key.num_heads_kv),
+        head_dim=key.head_dim,
+        dtype=key.out_dtype,
+        # cross-attn keys dispatch K/V separately; size their shard right
+        shard_k_len=(
+            mgr.kv_dispatch_meta.shard_seqlen
+            if mgr.kv_dispatch_meta is not None
+            else None
+        ),
+        reps=reps,
+        inner=inner,
+        warmup=warmup,
+        seed=seed,
+        use_mesh_barrier=use_mesh_barrier,
+        record=record,
+    )

@@ -1,0 +1,37 @@
+"""jax version compatibility shims.
+
+The runtime targets current jax (``jax.shard_map`` stable API); CI /
+bring-up images sometimes carry an older jax where ``shard_map`` still
+lives in ``jax.experimental.shard_map`` with the ``check_rep`` spelling
+of ``check_vma``. New host-tooling code (the measured-timeline profiler,
+which must run anywhere the tests run) goes through this shim; the
+production runtime modules keep the stable-API import — they are
+exercised on real-TPU images where it exists.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` where available, else the
+    ``jax.experimental.shard_map`` fallback (``check_vma`` maps to the
+    old API's ``check_rep``)."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=check_vma,
+    )

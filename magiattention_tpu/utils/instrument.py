@@ -27,8 +27,12 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import logging
+import threading
 import time
 from typing import Callable, Optional
+
+logger = logging.getLogger("magiattention_tpu.utils.instrument")
 
 
 def instrumentation_active() -> bool:
@@ -98,6 +102,33 @@ def add_trace_event(name: str):
         telemetry.record_event(name, t0, time.perf_counter() - t0)
 
 
+def named_scope(name: str):
+    """Plain ``jax.named_scope`` context for traced regions (overlap-stage
+    kernels, group casts/reduces): the scope name survives into XLA
+    metadata, so ``jax.profiler`` / Perfetto device traces show
+    ``magi_stage0_cast``-style labels instead of anonymous fusions.
+
+    Trace-time-only cost (nothing at run time, nothing recorded host-side),
+    so it is applied unconditionally — unlike :func:`add_trace_event`,
+    which also records host spans and must stay out of traced code."""
+    import jax
+
+    return jax.named_scope(name)
+
+
+# jax.profiler supports one trace session per process; this guard makes our
+# wrapper re-entrant (nested/overlapping sessions degrade to a warning
+# no-op instead of raising out of jax.profiler) and exception-safe (the
+# session always stops exactly once, even when the body raises).
+_trace_session_lock = threading.Lock()
+_trace_session_dir: str | None = None
+
+
+def trace_session_active() -> bool:
+    """Is a :func:`switch_profile` session currently recording?"""
+    return _trace_session_dir is not None
+
+
 @contextlib.contextmanager
 def switch_profile(trace_dir: str | None = None):
     """Profiler session (reference switch_profile / cudaProfilerStart-Stop):
@@ -106,7 +137,15 @@ def switch_profile(trace_dir: str | None = None):
     ``trace_dir=None`` honors ``MAGI_ATTENTION_PROFILE_MODE`` as a
     default-on switch: profile mode on -> trace into ``env.trace_dir()``
     (``MAGI_ATTENTION_TRACE_DIR``); off -> no-op, as before.
+
+    Re-entrant and exception-safe: a ``switch_profile`` inside an active
+    session (ours, or one started directly via ``jax.profiler``) warns and
+    no-ops instead of letting ``start_trace`` raise; the outer session
+    keeps recording and is stopped exactly once. A body exception
+    propagates unchanged — the trace is still stopped, and a failing
+    ``stop_trace`` never masks it.
     """
+    global _trace_session_dir
     from .. import env
 
     if trace_dir is None and env.is_profile_mode():
@@ -116,8 +155,44 @@ def switch_profile(trace_dir: str | None = None):
         return
     import jax
 
-    jax.profiler.start_trace(trace_dir)
+    started = False
+    with _trace_session_lock:
+        if _trace_session_dir is not None:
+            logger.warning(
+                "switch_profile(%r): a trace session into %r is already "
+                "active; jax.profiler supports one session per process — "
+                "this nested session is a no-op (the outer one keeps "
+                "recording)",
+                trace_dir,
+                _trace_session_dir,
+            )
+        else:
+            try:
+                jax.profiler.start_trace(trace_dir)
+                started = True
+                _trace_session_dir = trace_dir
+            except Exception as e:
+                # e.g. a session started directly via jax.profiler that
+                # this module cannot see — surface it, keep running
+                logger.warning(
+                    "switch_profile(%r): jax.profiler.start_trace failed "
+                    "(%r); continuing without a trace session",
+                    trace_dir,
+                    e,
+                )
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            with _trace_session_lock:
+                _trace_session_dir = None
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:
+                    # never mask the body's exception with a stop failure
+                    logger.warning(
+                        "switch_profile(%r): jax.profiler.stop_trace "
+                        "failed (%r); trace output may be incomplete",
+                        trace_dir,
+                        e,
+                    )

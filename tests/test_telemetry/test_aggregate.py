@@ -1,0 +1,238 @@
+"""Cross-rank snapshot merging (telemetry/aggregate.py): counter/gauge/
+histogram merge semantics, label-collision handling, empty-and-disabled
+rank snapshots, deterministic ordering, trace track merging."""
+
+import json
+
+import pytest
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.telemetry.aggregate import (
+    aggregate_across_mesh,
+    merge_chrome_traces,
+    merge_snapshots,
+)
+
+
+def _hist(count, total, vmin, vmax, bucket_counts, bounds=(1.0, 10.0)):
+    return {
+        "count": count,
+        "sum": total,
+        "min": vmin,
+        "max": vmax,
+        "mean": total / count if count else None,
+        "bounds": list(bounds),
+        "bucket_counts": list(bucket_counts),
+    }
+
+
+@pytest.fixture
+def two_ranks():
+    snap0 = {
+        "counters": {"magi_plan_builds_total": 2.0, "only_rank0": 1.0},
+        "gauges": {
+            "magi_plan_overlap_degree": 2.0,
+            "magi_comm_recv_rows{rank=0}": 100.0,
+            "magi_comm_recv_rows{rank=1}": 80.0,
+        },
+        "histograms": {
+            "magi_plan_build_seconds": _hist(2, 3.0, 1.0, 2.0, [1, 1, 0]),
+        },
+    }
+    snap1 = {
+        "counters": {"magi_plan_builds_total": 3.0},
+        "gauges": {
+            "magi_plan_overlap_degree": 4.0,
+            # rank 1's own view of the SAME labeled series: must not
+            # collide with rank 0's when merged
+            "magi_comm_recv_rows{rank=0}": 101.0,
+        },
+        "histograms": {
+            "magi_plan_build_seconds": _hist(1, 5.0, 5.0, 5.0, [0, 1, 0]),
+        },
+    }
+    return snap0, snap1
+
+
+def test_counters_sum_across_ranks(two_ranks):
+    agg = merge_snapshots(two_ranks)
+    assert agg["counters"]["magi_plan_builds_total"] == 5.0
+    # a counter only one rank reported still lands in the sum
+    assert agg["counters"]["only_rank0"] == 1.0
+
+
+def test_gauges_keep_per_rank_values_and_skew_stats(two_ranks):
+    agg = merge_snapshots(two_ranks)
+    g = agg["gauges"]["magi_plan_overlap_degree"]
+    assert g["per_rank"] == {"0": 2.0, "1": 4.0}
+    assert g["min"] == 2.0 and g["max"] == 4.0 and g["mean"] == 3.0
+    assert g["argmax"] == "1"
+
+
+def test_inner_rank_labels_do_not_collide_with_outer_ranks(two_ranks):
+    """Each rank's own view of a {rank=...}-labeled series stays distinct
+    after the merge: the outer rank nests in per_rank, the inner label
+    stays in the series key."""
+    agg = merge_snapshots(two_ranks)
+    r0view = agg["gauges"]["magi_comm_recv_rows{rank=0}"]
+    assert r0view["per_rank"] == {"0": 100.0, "1": 101.0}
+    # the series only rank 0 reported aggregates over the reporting subset
+    r1view = agg["gauges"]["magi_comm_recv_rows{rank=1}"]
+    assert r1view["per_rank"] == {"0": 80.0}
+    assert r1view["argmax"] == "0"
+
+
+def test_histograms_merge_bucket_wise(two_ranks):
+    agg = merge_snapshots(two_ranks)
+    h = agg["histograms"]["magi_plan_build_seconds"]
+    assert h["count"] == 3
+    assert h["sum"] == 8.0
+    assert h["min"] == 1.0 and h["max"] == 5.0
+    assert h["bucket_counts"] == [1, 2, 0]
+    assert h["bounds"] == [1.0, 10.0]
+    # percentiles are re-estimated on the MERGED buckets
+    assert h["p50"] is not None and 1.0 <= h["p50"] <= 5.0
+    assert h["p99"] is not None and h["p99"] <= 5.0
+
+
+def test_histogram_bounds_mismatch_degrades_to_scalars(two_ranks):
+    snap0, snap1 = two_ranks
+    snap1 = json.loads(json.dumps(snap1))
+    snap1["histograms"]["magi_plan_build_seconds"]["bounds"] = [2.0, 20.0]
+    agg = merge_snapshots([snap0, snap1])
+    h = agg["histograms"]["magi_plan_build_seconds"]
+    assert h["count"] == 3 and h["sum"] == 8.0  # scalars still merged
+    assert h["bucket_counts"] is None and h["bounds"] is None
+    assert "note" in h
+
+
+def test_empty_and_disabled_rank_snapshots(two_ranks):
+    """A disabled rank contributes {} (or empty sections): it counts in
+    num_ranks but adds no series and is excluded from skew stats."""
+    snap0, _ = two_ranks
+    agg = merge_snapshots([snap0, {}, {"counters": {}}], ranks=[0, 1, 2])
+    assert agg["num_ranks"] == 3
+    assert agg["ranks"] == ["0", "1", "2"]
+    assert agg["counters"]["magi_plan_builds_total"] == 2.0
+    g = agg["gauges"]["magi_plan_overlap_degree"]
+    assert g["per_rank"] == {"0": 2.0}
+    assert g["mean"] == 2.0
+
+
+def test_all_ranks_disabled_yields_empty_aggregate():
+    agg = merge_snapshots([{}, {}])
+    assert agg["num_ranks"] == 2
+    assert agg["counters"] == {} and agg["gauges"] == {}
+    assert agg["histograms"] == {}
+
+
+def test_deterministic_output_ordering(two_ranks):
+    snap0, snap1 = two_ranks
+    a = merge_snapshots([snap0, snap1], ranks=[0, 1])
+    b = merge_snapshots([snap0, snap1], ranks=[0, 1])
+    assert json.dumps(a) == json.dumps(b)
+    # series keys come out sorted, so aggregates diff cleanly
+    assert list(a["counters"]) == sorted(a["counters"])
+    assert list(a["gauges"]) == sorted(a["gauges"])
+    assert list(a["histograms"]) == sorted(a["histograms"])
+
+
+def test_rank_labels_mismatch_rejected(two_ranks):
+    with pytest.raises(ValueError):
+        merge_snapshots(list(two_ranks), ranks=[0])
+
+
+def test_aggregate_is_json_serializable(two_ranks):
+    json.dumps(merge_snapshots(two_ranks))
+
+
+def test_aggregate_across_mesh_loopback():
+    """Single-process: same schema as the distributed path, one rank."""
+    telemetry.set_enabled(True)
+    try:
+        telemetry.reset()
+        telemetry.get_registry().counter_inc("magi_test_counter", 7)
+        agg = aggregate_across_mesh()
+        assert agg["num_ranks"] == 1
+        assert agg["counters"]["magi_test_counter"] == 7.0
+        # explicit snapshot argument wins over the live registry
+        agg2 = aggregate_across_mesh({"counters": {"x": 1.0}})
+        assert agg2["counters"] == {"x": 1.0}
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# multi-track Chrome trace merge
+# ---------------------------------------------------------------------------
+
+
+def _trace(pid, names):
+    return {
+        "traceEvents": [
+            {
+                "name": n,
+                "ph": "X",
+                "ts": 1.0 * i,
+                "dur": 1.0,
+                "pid": pid,
+                "tid": 17,
+            }
+            for i, n in enumerate(names)
+        ],
+        "displayTimeUnit": "ms",
+    }
+
+
+def test_merge_chrome_traces_one_rank_per_track():
+    merged = merge_chrome_traces(
+        [_trace(4242, ["a", "b"]), _trace(4242, ["c"])]
+    )
+    evs = merged["traceEvents"]
+    spans = [e for e in evs if e.get("ph") == "X"]
+    # both ranks had the same OS pid; after the merge they are distinct
+    # tracks keyed by rank
+    assert {e["pid"] for e in spans} == {0, 1}
+    assert [e["name"] for e in spans if e["pid"] == 0] == ["a", "b"]
+    assert [e["name"] for e in spans if e["pid"] == 1] == ["c"]
+    meta = [e for e in evs if e.get("ph") == "M"]
+    proc_names = {
+        e["pid"]: e["args"]["name"]
+        for e in meta
+        if e["name"] == "process_name"
+    }
+    assert proc_names == {0: "rank 0", 1: "rank 1"}
+    assert any(e["name"] == "thread_name" and e["tid"] == 17 for e in meta)
+    sort_idx = {
+        e["pid"]: e["args"]["sort_index"]
+        for e in meta
+        if e["name"] == "process_sort_index"
+    }
+    assert sort_idx == {0: 0, 1: 1}
+
+
+def test_merge_chrome_traces_custom_labels_and_bare_lists():
+    merged = merge_chrome_traces(
+        [_trace(1, ["a"])["traceEvents"], _trace(2, ["b"])["traceEvents"]],
+        labels=["host A", "host B"],
+    )
+    meta = [
+        e for e in merged["traceEvents"] if e["name"] == "process_name"
+    ]
+    assert [e["args"]["name"] for e in meta] == ["host A", "host B"]
+
+
+def test_merge_chrome_traces_drops_stale_rank_local_metadata():
+    tr = _trace(9, ["a"])
+    tr["traceEvents"].append(
+        {"name": "process_name", "ph": "M", "pid": 9, "tid": 0,
+         "args": {"name": "stale"}}
+    )
+    merged = merge_chrome_traces([tr])
+    names = [
+        e["args"]["name"]
+        for e in merged["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    ]
+    assert names == ["rank 0"]

@@ -1,0 +1,329 @@
+"""Perf regression sentinel (telemetry/baseline.py + exps/run_perf_gate.py):
+history round-trip, expectation windows, tolerance gating, rung-change
+flagging, and the end-to-end gate script in model-safe CPU mode."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from magiattention_tpu.telemetry import baseline
+
+_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+# ---------------------------------------------------------------------------
+# history
+# ---------------------------------------------------------------------------
+
+
+def test_history_append_load_roundtrip(tmp_path):
+    path = str(tmp_path / "h.jsonl")
+    e1 = baseline.make_history_entry(
+        source="run1", metrics={"m": 10.0}, autotune_rung="128x512x8",
+        device="TPU v5 lite0", vs_baseline=7.0, recorded_unix=123,
+    )
+    e2 = baseline.make_history_entry(source="run2", metrics={"m": 11.0})
+    baseline.append_history(path, e1)
+    baseline.append_history(path, e2)
+    hist = baseline.load_history(path)
+    assert hist == [e1, e2]
+    assert hist[0]["recorded_unix"] == 123
+
+
+def test_history_skips_corrupt_lines(tmp_path):
+    path = str(tmp_path / "h.jsonl")
+    with open(path, "w") as f:
+        f.write('{"metrics": {"m": 1.0}, "source": "a"}\n')
+        f.write("{truncated garbage\n")
+        f.write("\n")
+        f.write('["not a dict"]\n')
+        f.write('{"no_metrics_key": 1}\n')
+        f.write('{"metrics": {"m": 2.0}, "source": "b"}\n')
+    hist = baseline.load_history(path)
+    assert [e["metrics"]["m"] for e in hist] == [1.0, 2.0]
+
+
+def test_load_history_missing_file_is_empty(tmp_path):
+    assert baseline.load_history(str(tmp_path / "nope.jsonl")) == []
+
+
+def test_make_history_entry_filters_non_numeric_metrics():
+    e = baseline.make_history_entry(
+        source="s",
+        metrics={
+            "m": 1.0,
+            "jax_flash_best_tuned_blocks": [1024, 1024],
+            "junk": "text",
+        },
+    )
+    assert e["metrics"] == {"m": 1.0}
+
+
+def test_newest_metrics_is_the_last_entry_only():
+    """An old good value must never stand in for a metric the newest run
+    didn't measure — that's the gate's `missing` verdict instead."""
+    hist = [
+        {"metrics": {"a": 1.0, "b": 5.0}},
+        {"metrics": {"a": 2.0}},
+    ]
+    assert baseline.newest_metrics(hist) == {"a": 2.0}
+    assert baseline.newest_metrics([]) == {}
+
+
+def test_rung_changes_flagged_between_consecutive_runs():
+    hist = [
+        {"source": "r5", "metrics": {}, "autotune_rung": "1024x1024x1"},
+        {"source": "r6", "metrics": {}},  # no rung recorded: ignored
+        {"source": "r7", "metrics": {}, "autotune_rung": "512x2048x1"},
+        {"source": "r8", "metrics": {}, "autotune_rung": "512x2048x1"},
+    ]
+    flags = baseline.rung_changes(hist)
+    assert len(flags) == 1
+    assert "1024x1024x1 -> 512x2048x1" in flags[0]
+    assert "r5" in flags[0] and "r7" in flags[0]
+
+
+# ---------------------------------------------------------------------------
+# expectations + gate
+# ---------------------------------------------------------------------------
+
+
+def test_seed_expectations_windows_and_filter():
+    hist = [
+        {"metrics": {"flex_a": 10.0, "other": 1.0}},
+        {"metrics": {"flex_a": 12.0}},
+    ]
+    w = baseline.seed_expectations(
+        hist, metrics_filter=lambda n: n.startswith("flex_")
+    )
+    assert w == {"flex_a": {"low": 10.0, "high": 12.0}}
+    # window_last restricts to the newest N values per metric
+    w1 = baseline.seed_expectations(hist, window_last=1)
+    assert w1["flex_a"] == {"low": 12.0, "high": 12.0}
+    assert w1["other"] == {"low": 1.0, "high": 1.0}
+    with pytest.raises(ValueError):
+        baseline.seed_expectations(hist, window_last=0)
+
+
+def test_gate_checks_newest_entry_not_stale_history(tmp_path):
+    """A metric measured 5 rounds ago but absent from the newest run must
+    surface as `missing`, not pass on the stale value."""
+    hist = str(tmp_path / "h.jsonl")
+    baseline.append_history(
+        hist,
+        baseline.make_history_entry(
+            source="old",
+            metrics={"flex_attn_fwd_tflops_a": 100.0,
+                     "flex_attn_bwd_tflops_b": 90.0},
+        ),
+    )
+    baseline.append_history(
+        hist,
+        baseline.make_history_entry(
+            source="new", metrics={"flex_attn_fwd_tflops_a": 99.0}
+        ),
+    )
+    exp = str(tmp_path / "e.json")
+    baseline.write_expectations(
+        exp,
+        {
+            "flex_attn_fwd_tflops_a": {"low": 100.0, "high": 100.0},
+            "flex_attn_bwd_tflops_b": {"low": 90.0, "high": 90.0},
+        },
+        provenance="test",
+    )
+    p = _run_gate("--history", hist, "--expectations", exp)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "newest run did not measure it" in p.stdout
+    assert "flex_attn_bwd_tflops_b=90" not in p.stdout
+
+
+def test_expectations_file_roundtrip(tmp_path):
+    path = str(tmp_path / "exp.json")
+    baseline.write_expectations(
+        path, {"m": {"low": 1.0, "high": 2.0}}, provenance="test"
+    )
+    assert baseline.load_expectations(path) == {
+        "m": {"low": 1.0, "high": 2.0}
+    }
+    with open(path) as f:
+        assert "_provenance" in json.load(f)
+
+
+def test_gate_passes_within_tolerance():
+    exp = {"m": {"low": 100.0, "high": 100.0}}
+    [r] = baseline.check_gate({"m": 91.0}, exp, tolerance=0.10)
+    assert r.status == "ok" and not r.failed
+
+
+def test_gate_fails_beyond_tolerance():
+    exp = {"m": {"low": 100.0, "high": 100.0}}
+    [r] = baseline.check_gate({"m": 89.9}, exp, tolerance=0.10)
+    assert r.status == "regression" and r.failed
+    assert "regression" in r.message
+
+
+def test_gate_flags_improvement_without_failing():
+    exp = {"m": {"low": 100.0, "high": 100.0}}
+    [r] = baseline.check_gate({"m": 140.0}, exp, tolerance=0.10)
+    assert r.status == "improvement" and not r.failed
+    assert "re-seed" in r.message
+
+
+def test_gate_handles_unseeded_and_unmeasured_metrics():
+    exp = {"expected_only": {"low": 1.0, "high": 2.0}}
+    results = baseline.check_gate({"measured_only": 5.0}, exp, 0.1)
+    by = {r.metric: r for r in results}
+    assert by["measured_only"].status == "no-expectation"
+    assert by["expected_only"].status == "missing"
+    assert not any(r.failed for r in results)
+
+
+def test_gate_tolerance_env_default(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_PERF_GATE_TOLERANCE", "0.5")
+    assert baseline.default_tolerance() == 0.5
+    exp = {"m": {"low": 100.0, "high": 100.0}}
+    [r] = baseline.check_gate({"m": 60.0}, exp)  # tolerance from env
+    assert r.status == "ok"
+
+
+def test_gate_report_contains_verdict():
+    exp = {"m": {"low": 100.0, "high": 100.0}}
+    rep = baseline.gate_report(
+        baseline.check_gate({"m": 50.0}, exp, 0.1), ["rung flipped"]
+    )
+    assert "FAIL" in rep and "rung flipped" in rep
+    rep_ok = baseline.gate_report(
+        baseline.check_gate({"m": 100.0}, exp, 0.1), []
+    )
+    assert "PASS" in rep_ok
+
+
+# ---------------------------------------------------------------------------
+# the gate script end-to-end (no jax import: model-safe CPU mode)
+# ---------------------------------------------------------------------------
+
+
+def _run_gate(*args, cwd=_ROOT):
+    return subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "exps", "run_perf_gate.py"),
+         *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        timeout=120,
+    )
+
+
+@pytest.fixture
+def gate_files(tmp_path):
+    hist = str(tmp_path / "hist.jsonl")
+    exp = str(tmp_path / "exp.json")
+    baseline.append_history(
+        hist,
+        baseline.make_history_entry(
+            source="seed", metrics={"flex_attn_fwd_tflops_test": 100.0},
+            autotune_rung="1024x1024x1",
+        ),
+    )
+    baseline.write_expectations(
+        exp,
+        {"flex_attn_fwd_tflops_test": {"low": 100.0, "high": 100.0}},
+        provenance="test",
+    )
+    return hist, exp
+
+
+def test_gate_script_passes_on_seeded_baseline(gate_files):
+    hist, exp = gate_files
+    p = _run_gate("--history", hist, "--expectations", exp)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "PASS" in p.stdout
+
+
+def test_gate_script_fails_on_injected_regression(gate_files):
+    hist, exp = gate_files
+    p = _run_gate(
+        "--history", hist, "--expectations", exp,
+        "--inject-regression", "0.2",
+    )
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "FAIL" in p.stdout
+
+
+def test_gate_script_self_test(gate_files):
+    hist, exp = gate_files
+    p = _run_gate("--history", hist, "--expectations", exp, "--self-test")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "self-test OK" in p.stdout
+
+
+def test_gate_script_update_seeds_expectations(tmp_path):
+    hist = str(tmp_path / "hist.jsonl")
+    exp = str(tmp_path / "exp.json")
+    for v in (80.0, 100.0):
+        baseline.append_history(
+            hist,
+            baseline.make_history_entry(
+                source=f"run{v}",
+                metrics={
+                    "flex_attn_fwd_tflops_test": v,
+                    "jax_flash_fwd_tflops_control": v,  # never gated
+                },
+            ),
+        )
+    p = _run_gate("--history", hist, "--expectations", exp, "--update")
+    assert p.returncode == 0, p.stdout + p.stderr
+    w = baseline.load_expectations(exp)
+    # --update windows over the LAST entry per metric by default (older
+    # rounds predate perf work) and gates flex_attn_* only
+    assert w == {"flex_attn_fwd_tflops_test": {"low": 100.0, "high": 100.0}}
+
+
+def test_gate_script_is_jax_free(tmp_path, gate_files):
+    """The model-safe-CPU-mode contract: the gate must run on a host
+    with NO jax at all. Proven by shadowing jax with a module that
+    explodes on import — any jax import anywhere on the gate path (e.g.
+    via the magiattention_tpu package __init__) fails the run."""
+    shadow = tmp_path / "shadow"
+    shadow.mkdir()
+    (shadow / "jax.py").write_text(
+        'raise ImportError("jax must not be imported by the perf gate")\n'
+    )
+    hist, exp = gate_files
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(shadow)
+    p = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "exps", "run_perf_gate.py"),
+         "--history", hist, "--expectations", exp],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env,
+        timeout=120,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "PASS" in p.stdout
+
+
+def test_default_tolerance_agrees_with_env_module(monkeypatch):
+    """baseline.default_tolerance duplicates env.perf_gate_tolerance so
+    the gate stays loadable without the package; they must agree."""
+    from magiattention_tpu import env as env_mod
+
+    monkeypatch.delenv("MAGI_ATTENTION_PERF_GATE_TOLERANCE", raising=False)
+    assert baseline.default_tolerance() == env_mod.perf_gate_tolerance()
+    monkeypatch.setenv("MAGI_ATTENTION_PERF_GATE_TOLERANCE", "0.25")
+    assert baseline.default_tolerance() == env_mod.perf_gate_tolerance() == 0.25
+
+
+def test_repo_seeded_gate_passes():
+    """The committed BENCH_HISTORY.jsonl + perf_expectations.json must
+    gate green (the acceptance criterion of ISSUE 3), and the injected
+    20% regression must be caught."""
+    if not os.path.exists(os.path.join(_ROOT, "BENCH_HISTORY.jsonl")):
+        pytest.skip("no committed bench history in this checkout")
+    p = _run_gate("--self-test")
+    assert p.returncode == 0, p.stdout + p.stderr

@@ -122,3 +122,61 @@ def test_reset_clears_everything(reg):
 
 def test_global_registry_is_a_singleton():
     assert get_registry() is get_registry()
+
+
+# ---------------------------------------------------------------------------
+# approximate percentiles (ISSUE 3 satellite): p50/p95/p99 derived from
+# bucket counts — bucket-resolution estimates, clamped to [min, max]
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_reports_percentile_estimates(reg):
+    # 100 samples spread across two buckets of (1, 10, 100): 90 low, 10 high
+    for _ in range(90):
+        reg.histogram_observe("lat", 0.5, bounds=(1.0, 10.0, 100.0))
+    for _ in range(10):
+        reg.histogram_observe("lat", 50.0, bounds=(1.0, 10.0, 100.0))
+    h = reg.snapshot()["histograms"]["lat"]
+    # p50 sits inside the first bucket [min, 1.0]; p95/p99 inside the
+    # (10, 100] bucket, clamped by the observed max
+    assert 0.5 <= h["p50"] <= 1.0
+    assert 10.0 <= h["p95"] <= 50.0
+    assert 10.0 <= h["p99"] <= 50.0
+    assert h["p50"] <= h["p95"] <= h["p99"]
+
+
+def test_single_value_histogram_percentiles_collapse_to_value(reg):
+    reg.histogram_observe("one", 0.025)
+    h = reg.snapshot()["histograms"]["one"]
+    # min == max clamps every interpolated estimate to the exact value
+    assert h["p50"] == h["p95"] == h["p99"] == 0.025
+
+
+def test_empty_histogram_percentiles_are_none():
+    from magiattention_tpu.telemetry.registry import _Histogram
+
+    h = _Histogram().as_dict()
+    assert h["p50"] is None and h["p95"] is None and h["p99"] is None
+
+
+def test_percentiles_clamped_to_observed_range(reg):
+    # everything lands in the +inf overflow bucket: estimates must clamp
+    # to the observed [vmin, vmax], not the infinite bucket edge
+    for v in (150.0, 200.0, 250.0):
+        reg.histogram_observe("big", v)
+    h = reg.snapshot()["histograms"]["big"]
+    for q in ("p50", "p95", "p99"):
+        assert 150.0 <= h[q] <= 250.0
+
+
+def test_estimate_percentiles_is_shared_helper():
+    from magiattention_tpu.telemetry.registry import estimate_percentiles
+
+    p50, p95, p99 = estimate_percentiles(
+        (1.0, 10.0), [5, 5, 0], 10, 0.1, 8.0
+    )
+    assert 0.1 <= p50 <= 1.0
+    assert 1.0 <= p95 <= 8.0 and 1.0 <= p99 <= 8.0
+    assert estimate_percentiles((1.0,), [0, 0], 0, 0.0, 0.0) == [
+        None, None, None,
+    ]

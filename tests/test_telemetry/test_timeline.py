@@ -1,0 +1,256 @@
+"""Measured stage timelines (telemetry/timeline.py): the profile harness
+re-executes a plan stage-by-stage on the virtual CPU mesh and must
+produce a coherent measured/predicted timeline plus the documented
+magi_overlap_measured_* gauges.
+
+Runs the any-platform jnp kernel backend: the harness machinery (stage
+splitting, host fencing, efficiency accounting, metric recording) is
+backend-agnostic, and this image's jax lacks the Pallas TPU entry
+points."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.common import AttnMaskType, AttnRanges
+from magiattention_tpu.meta import make_dispatch_meta_from_qk_ranges
+from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+from magiattention_tpu.parallel import build_dist_attn_plan, make_attn_params
+
+
+@pytest.fixture(autouse=True)
+def jnp_backend(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    yield
+    telemetry.set_enabled(None)
+    telemetry.reset()
+
+
+def _plan(total=1024, cp=4, degree=2):
+    chunk = total // (4 * cp)
+    qr = AttnRanges.from_ranges([(0, total)])
+    kr = AttnRanges.from_ranges([(0, total)])
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, [AttnMaskType.CAUSAL], total, total,
+        chunk_size=chunk, cp_size=cp,
+    )
+    oc = (
+        OverlapConfig(degree=degree, min_stage_rows=64)
+        if degree
+        else OverlapConfig(degree=0)
+    )
+    return build_dist_attn_plan(
+        mq, bucket, block_q=64, block_k=64, overlap_config=oc
+    )
+
+
+def _mesh(cp):
+    return Mesh(np.array(jax.devices()[:cp]), ("cp",))
+
+
+def _series(snap, name):
+    return {
+        k: v
+        for sec in snap.values()
+        for k, v in sec.items()
+        if k == name or k.startswith(name + "{")
+    }
+
+
+def test_staged_plan_timeline_measures_every_stage():
+    plan = _plan(degree=2)
+    assert len(plan.stages) == 2
+    params = make_attn_params(plan, 64, out_dtype="float32")
+    tl = telemetry.profile_plan_timeline(
+        plan, _mesh(4), params, num_heads=(4, 2), head_dim=64,
+        reps=1, inner=1,
+    )
+    assert tl.overlap_degree == 2 and tl.cp_size == 4
+    assert [st.stage for st in tl.stages] == ["host", "0", "1"]
+    host = tl.stages[0]
+    assert host.comm_ms == 0.0 and host.calc_ms > 0
+    for st in tl.stages[1:]:
+        assert st.comm_ms > 0 and st.calc_ms > 0
+    assert tl.measured_total_ms > 0
+    assert tl.serial_total_ms == pytest.approx(
+        sum(st.comm_ms + st.calc_ms for st in tl.stages)
+    )
+    assert tl.hideable_comm_ms == pytest.approx(
+        sum(st.comm_ms for st in tl.stages)
+    )
+    assert 0.0 <= tl.overlap_efficiency <= 1.0
+
+
+def test_predicted_vs_measured_delta_reported():
+    plan = _plan(degree=2)
+    params = make_attn_params(plan, 64, out_dtype="float32")
+    tl = telemetry.profile_plan_timeline(
+        plan, _mesh(4), params, num_heads=(4, 2), head_dim=64,
+        reps=1, inner=1, generation="v5e",
+    )
+    # the solver's timeline model prices every piece the plan executes
+    assert tl.predicted_total_ms is not None and tl.predicted_total_ms > 0
+    assert tl.prediction_error_ratio == pytest.approx(
+        tl.measured_total_ms / tl.predicted_total_ms
+    )
+    for st in tl.stages[1:]:
+        assert st.predicted_comm_ms is not None
+        assert st.predicted_calc_ms is not None
+    rep = tl.report()
+    assert "end-to-end measured" in rep
+    assert "overlap efficiency" in rep
+    assert "measured/predicted" in rep
+
+
+def test_unknown_generation_degrades_prediction_to_none():
+    plan = _plan(degree=2)
+    params = make_attn_params(plan, 64, out_dtype="float32")
+    # first profile WITH a priceable generation: predicted gauges set
+    telemetry.profile_plan_timeline(
+        plan, _mesh(4), params, num_heads=(4, 2), head_dim=64,
+        reps=1, inner=1, generation="v5e",
+    )
+    assert _series(
+        telemetry.snapshot(), "magi_overlap_predicted_total_ms"
+    )
+    tl = telemetry.profile_plan_timeline(
+        plan, _mesh(4), params, num_heads=(4, 2), head_dim=64,
+        reps=1, inner=1, generation="not-a-tpu",
+    )
+    assert tl.predicted_total_ms is None
+    assert tl.prediction_error_ratio is None
+    assert "measured/predicted" not in tl.report()
+    # the unpriceable re-profile must not leave the earlier plan's
+    # prediction paired with its fresh measured numbers
+    snap = telemetry.snapshot()
+    assert not _series(snap, "magi_overlap_predicted_total_ms")
+    assert not _series(snap, "magi_overlap_prediction_error_ratio")
+    assert _series(snap, "magi_overlap_measured_total_ms")
+
+
+def test_cross_attn_plan_profiles_with_kv_shard_length():
+    """Cross-attention plans dispatch K/V separately (shard_k_len !=
+    shard_q_len); synthesized operands must size the KV shard from the
+    kv meta, not the Q one."""
+    from magiattention_tpu.meta import make_cross_attn_dispatch_meta
+
+    tq, tk, cp = 512, 1024, 2
+    q_ranges = AttnRanges.from_ranges([(0, 256), (256, 512)])
+    k_ranges = AttnRanges.from_ranges([(0, 512), (256, 1024)])
+    mq, mk, bucket = make_cross_attn_dispatch_meta(
+        q_ranges, k_ranges,
+        [AttnMaskType.FULL, AttnMaskType.CAUSAL], tq, tk,
+        chunk_size_q=64, chunk_size_k=128, cp_size=cp,
+    )
+    plan = build_dist_attn_plan(
+        mq, bucket, kv_dispatch_meta=mk, block_q=64, block_k=64
+    )
+    params = make_attn_params(plan, 64, out_dtype="float32")
+    tl = telemetry.profile_plan_timeline(
+        plan, _mesh(cp), params, num_heads=(2, 2), head_dim=64,
+        shard_k_len=mk.shard_seqlen, reps=1, inner=1,
+    )
+    assert tl.measured_total_ms > 0
+
+
+def test_hier_plan_requires_axis_pair():
+    from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+
+    total, cp = 1024, 4
+    qr = AttnRanges.from_ranges([(0, total)])
+    kr = AttnRanges.from_ranges([(0, total)])
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, [AttnMaskType.CAUSAL], total, total,
+        chunk_size=64, cp_size=cp,
+    )
+    plan = build_dist_attn_plan(
+        mq, bucket, block_q=64, block_k=64,
+        overlap_config=OverlapConfig(degree=0),
+        cp_mesh_shape=(2, 2),
+    )
+    params = make_attn_params(plan, 64, out_dtype="float32")
+    with pytest.raises(ValueError, match="inter, intra"):
+        telemetry.profile_plan_timeline(
+            plan, _mesh(4), params, num_heads=(4, 2), head_dim=64,
+            reps=1, inner=1,
+        )
+
+
+def test_merged_degree0_plan_profiles_as_one_stage():
+    plan = _plan(degree=0)
+    params = make_attn_params(plan, 64, out_dtype="float32")
+    tl = telemetry.profile_plan_timeline(
+        plan, _mesh(4), params, num_heads=(4, 2), head_dim=64,
+        reps=1, inner=1,
+    )
+    assert tl.overlap_degree == 0
+    assert [st.stage for st in tl.stages] == ["merged"]
+    assert tl.stages[0].comm_ms > 0 and tl.stages[0].calc_ms > 0
+
+
+def test_timeline_metrics_recorded_in_registry():
+    plan = _plan(degree=2)
+    params = make_attn_params(plan, 64, out_dtype="float32")
+    telemetry.profile_plan_timeline(
+        plan, _mesh(4), params, num_heads=(4, 2), head_dim=64,
+        reps=1, inner=1,
+    )
+    snap = telemetry.snapshot()
+    for m in telemetry.REQUIRED_TIMELINE_METRICS:
+        assert _series(snap, m), f"missing {m}"
+    # per-stage families carry stage labels incl. the host stage
+    calc = _series(snap, "magi_overlap_measured_calc_ms")
+    assert "magi_overlap_measured_calc_ms{stage=host}" in calc
+    assert "magi_overlap_measured_calc_ms{stage=0}" in calc
+    # a re-profile at a smaller degree clears stale stage series
+    plan1 = _plan(degree=1)
+    telemetry.profile_plan_timeline(
+        plan1, _mesh(4), make_attn_params(plan1, 64, out_dtype="float32"),
+        num_heads=(4, 2), head_dim=64, reps=1, inner=1,
+    )
+    calc = _series(telemetry.snapshot(), "magi_overlap_measured_calc_ms")
+    assert "magi_overlap_measured_calc_ms{stage=1}" not in calc
+
+
+def test_record_false_keeps_registry_clean():
+    plan = _plan(degree=1)
+    params = make_attn_params(plan, 64, out_dtype="float32")
+    telemetry.reset()
+    tl = telemetry.profile_plan_timeline(
+        plan, _mesh(4), params, num_heads=(4, 2), head_dim=64,
+        reps=1, inner=1, record=False,
+    )
+    assert tl.measured_total_ms > 0
+    snap = telemetry.snapshot()
+    assert not _series(snap, "magi_overlap_measured_total_ms")
+
+
+def test_profile_key_timeline_via_interface():
+    if not hasattr(jax, "shard_map"):
+        pytest.skip(
+            "jax-version skew: magi_attn_flex_key's runtime build needs "
+            "jax.shard_map (the profiler itself runs via the compat shim)"
+        )
+    from magiattention_tpu.api import (
+        magi_attn_flex_key,
+        profile_attn_timeline,
+    )
+
+    total, cp = 1024, 2
+    mesh = _mesh(cp)
+    key = magi_attn_flex_key(
+        [(0, total)], [(0, total)], [AttnMaskType.CAUSAL],
+        total, total, mesh,
+        num_heads=(2, 2), head_dim=64, chunk_size=128,
+        out_dtype="float32",
+    )
+    tl = profile_attn_timeline(key, reps=1, inner=1)
+    assert tl.cp_size == cp
+    assert tl.measured_total_ms > 0
+    # default key = most recent
+    tl2 = profile_attn_timeline(reps=1, inner=1, record=False)
+    assert tl2.cp_size == cp
