@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check decode-bench check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -70,6 +70,19 @@ perf-gate:
 timeline-demo:
 	$(PY) exps/run_timeline_profile.py
 
+# serving drift guard (CPU, jnp backend): decode-vs-prefill parity on
+# causal masks over varied page sizes/split counts, cp=2 loopback merge
+# parity, paged-cache invariants (exps/run_serving_check.py exits
+# non-zero on any violation)
+serving-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_serving_check.py
+
+# split-KV decode throughput grid (tokens/s + effective KV bandwidth);
+# CPU uses the jnp reference backend, TPU the Pallas kernel
+decode-bench:
+	$(PY) exps/run_decode_bench.py
+
 # the default check flow: syntax, telemetry catalog + timeline/aggregate
-# semantics, autotuner rung expectations, perf gate — all CPU-safe
-check: lint telemetry-check autotune-check perf-gate
+# semantics, autotuner rung expectations, perf gate, serving parity —
+# all CPU-safe
+check: lint telemetry-check autotune-check perf-gate serving-check

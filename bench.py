@@ -231,6 +231,7 @@ def _telemetry_block() -> None:
         os.replace(tmp, _TELEMETRY_OUT)
         print(telemetry.telemetry_summary(snap), file=sys.stderr)
         print(f"telemetry snapshot -> {_TELEMETRY_OUT}", file=sys.stderr)
+        _decode_summary_line()
     except Exception as e:  # observability must never take the bench down
         print(f"telemetry block failed: {e!r}", file=sys.stderr)
     finally:
@@ -240,6 +241,34 @@ def _telemetry_block() -> None:
             telemetry.set_enabled(None)
         except Exception:
             pass
+
+
+def _decode_summary_line() -> None:
+    """Decode section of the bench summary (ISSUE 4): one steady-state
+    split-KV decode step on the serving subsystem — tokens/s and
+    effective KV bandwidth for the probe config. Runs inside the
+    CPU-pinned telemetry subprocess (jnp backend there; numbers are
+    shape-relative on CPU, chip-real only on TPU). Never fatal."""
+    try:
+        import jax
+
+        from exps.run_decode_bench import bench_one, quick_probe_config
+
+        on_tpu = jax.default_backend() == "tpu"
+        if not on_tpu:
+            os.environ.setdefault("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+        batch, kv_len, ps, splits = quick_probe_config(on_tpu)
+        r = bench_one(batch, kv_len, ps, splits, reps=5)
+        print(
+            f"decode probe: batch {r['batch']} x kv {r['kv_len']} "
+            f"(page {r['page_size']}, splits {r['num_splits']}): "
+            f"{r['step_ms']:.2f} ms/step, {r['tokens_per_s']:.0f} tok/s, "
+            f"{r['kv_gbps']:.2f} GB/s KV "
+            f"[{jax.default_backend()} backend]",
+            file=sys.stderr,
+        )
+    except Exception as e:
+        print(f"decode probe failed: {e!r}", file=sys.stderr)
 
 
 def _start_telemetry_subprocess():

@@ -1,0 +1,237 @@
+"""Serving drift guard (``make serving-check``) — CPU, jnp backend.
+
+The ISSUE 4 acceptance surface, device-free:
+
+1. decode-vs-prefill parity on causal masks: ``magi_attn_decode`` over a
+   paged cache (varied page sizes and split counts) matches the
+   last-token rows of the prefill flex-attention reference within the
+   tolerances of ``testing/precision.py``;
+2. cp=2 loopback merge parity on the virtual CPU mesh: CP-sharded decode
+   equals dense attention over the full history (plus an empty-rank
+   no-op check — the NaN-free zero-coverage corner);
+3. paged-cache invariants: append/gather round-trip, block-table reuse
+   after free, constant jit re-trace count across growing lengths.
+
+Exits non-zero on any violation.
+"""
+
+import math
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] = "jnp"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)  # f64 oracles, like the tests
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+from magiattention_tpu.ops import flex_flash_attn_func  # noqa: E402
+from magiattention_tpu.serving import (  # noqa: E402
+    ServingEngine,
+    append_kv,
+    assign_block_table,
+    cp_decode_attn,
+    decode_attn_paged,
+    gather_kv,
+    make_paged_kv_cache,
+    write_prefill_kv,
+)
+from magiattention_tpu.testing.precision import calc_rel_err  # noqa: E402
+from magiattention_tpu.utils.compat import shard_map  # noqa: E402
+
+HQ, HK, D = 4, 2, 32
+TOL = 1e-5  # f32 rel-err budget (testing/precision.py f32 atol regime)
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def check_decode_prefill_parity() -> int:
+    rng = np.random.default_rng(0)
+    t = 93
+    q = jnp.asarray(rng.standard_normal((t, HQ, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((t, HK, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((t, HK, D)), jnp.float32)
+    ref_out, ref_lse = flex_flash_attn_func(
+        q, k, v, [(0, t)], [(0, t)], [1]
+    )
+    for page_size in (8, 32):
+        mpp = -(-t // page_size) + 1
+        cache = make_paged_kv_cache(
+            mpp + 2, page_size, HK, D, max_seqs=1,
+            max_pages_per_seq=mpp, dtype=jnp.float32,
+        )
+        cache = assign_block_table(cache, 0, list(range(1, 1 + mpp)))
+        cache = write_prefill_kv(cache, 0, k, v)
+        for splits in (1, 2, mpp):
+            out, lse = decode_attn_paged(
+                q[-1][None], cache, jnp.array([0]), num_splits=splits
+            )
+            err = calc_rel_err(out[0], ref_out[-1])
+            if err > TOL:
+                return fail(
+                    f"decode-vs-prefill out rel err {err:.2e} "
+                    f"(page_size={page_size}, splits={splits})"
+                )
+            err_l = calc_rel_err(lse[0], ref_lse[-1])
+            if err_l > TOL:
+                return fail(
+                    f"decode-vs-prefill lse rel err {err_l:.2e} "
+                    f"(page_size={page_size}, splits={splits})"
+                )
+    print("serving-check: decode-vs-prefill parity OK "
+          "(page sizes 8/32, splits 1/2/max)")
+    return 0
+
+
+def check_cp_loopback() -> int:
+    rng = np.random.default_rng(1)
+    cp, T = 2, 96
+    kg = jnp.asarray(rng.standard_normal((T, HK, D)), jnp.float32)
+    vg = jnp.asarray(rng.standard_normal((T, HK, D)), jnp.float32)
+    qd = jnp.asarray(rng.standard_normal((1, HQ, D)), jnp.float32)
+    shard = T // cp
+    caches = []
+    for r in range(cp):
+        c = make_paged_kv_cache(
+            8, 16, HK, D, max_seqs=1, max_pages_per_seq=4,
+            dtype=jnp.float32,
+        )
+        c = assign_block_table(c, 0, [1, 2, 3, 4])
+        c = write_prefill_kv(
+            c, 0, kg[r * shard : (r + 1) * shard],
+            vg[r * shard : (r + 1) * shard],
+        )
+        caches.append(c)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *caches)
+    mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
+
+    def step(cache, q):
+        cache = jax.tree_util.tree_map(lambda x: x[0], cache)
+        return cp_decode_attn(
+            q, cache, jnp.array([0]), axis_name="cp", cp_size=cp,
+            num_splits=2,
+        )
+
+    f = shard_map(step, mesh=mesh, in_specs=(P("cp"), P()),
+                  out_specs=P(), check_vma=False)
+    out, _ = jax.jit(f)(stacked, qd)
+    kf = jnp.repeat(kg.astype(jnp.float64), HQ // HK, axis=1)
+    vf = jnp.repeat(vg.astype(jnp.float64), HQ // HK, axis=1)
+    z = jnp.einsum(
+        "bhd,thd->bht", qd.astype(jnp.float64), kf
+    ) / math.sqrt(D)
+    ref = jnp.einsum("bht,thd->bhd", jax.nn.softmax(z, axis=-1), vf)
+    err = calc_rel_err(out, ref)
+    if err > TOL:
+        return fail(f"cp=2 loopback merge rel err {err:.2e}")
+    if not np.isfinite(np.asarray(out)).all():
+        return fail("cp=2 loopback produced non-finite output")
+
+    # empty-rank no-op: rank 1 holds NOTHING for the sequence (slot
+    # length 0 over stale pages) — its (0, -inf) partial must drop out
+    # of the merge exactly, NaN-free (the zero-coverage corner)
+    from magiattention_tpu.serving import reset_slot
+
+    full = make_paged_kv_cache(
+        8, 16, HK, D, max_seqs=1, max_pages_per_seq=6, dtype=jnp.float32
+    )
+    full = assign_block_table(full, 0, [1, 2, 3, 4, 5, 6])
+    full = write_prefill_kv(full, 0, kg, vg)
+    empty = reset_slot(full, 0)
+    stacked2 = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), full, empty
+    )
+    out2, _ = jax.jit(f)(stacked2, qd)
+    if not np.isfinite(np.asarray(out2)).all():
+        return fail("empty-rank cp merge produced non-finite output")
+    err2 = calc_rel_err(out2, ref)
+    if err2 > TOL:
+        return fail(f"empty-rank cp merge rel err {err2:.2e}")
+    print("serving-check: cp=2 loopback merge parity OK (incl. empty rank)")
+    return 0
+
+
+def check_cache_invariants() -> int:
+    rng = np.random.default_rng(2)
+    ps = 16
+    cache = make_paged_kv_cache(
+        16, ps, HK, D, max_seqs=2, max_pages_per_seq=4, dtype=jnp.float32
+    )
+    cache = assign_block_table(cache, 0, [9, 4, 7, 2])
+    n = 3 * ps - 5  # ends mid-page
+    k = jnp.asarray(rng.standard_normal((n, HK, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((n, HK, D)), jnp.float32)
+    traces = []
+
+    @jax.jit
+    def step(cache, kn, vn):
+        traces.append(None)
+        return append_kv(cache, jnp.array([0]), kn, vn)
+
+    for i in range(n):
+        cache = step(cache, k[i][None], v[i][None])
+    if len(traces) != 1:
+        return fail(f"append re-traced {len(traces)} times across growth")
+    gk, gv = gather_kv(cache, 0)
+    if not np.array_equal(np.asarray(gk[:n]), np.asarray(k)):
+        return fail("append/gather round-trip mismatch")
+    if np.any(np.asarray(gk[n:])):
+        return fail("gather leaked rows past the true length")
+
+    # engine-level slot recycling
+    eng = ServingEngine(
+        num_pages=8, num_kv_heads=HK, head_dim=D, page_size=ps,
+        max_seqs=2, max_pages_per_seq=4, dtype=jnp.float32,
+    )
+    s0 = eng.admit(40)
+    eng.prefill(
+        jnp.zeros((40, HQ, D), jnp.float32),
+        jnp.ones((40, HK, D), jnp.float32),
+        jnp.ones((40, HK, D), jnp.float32), s0,
+    )
+    eng.free(s0)
+    if eng.occupancy()["pages_in_use"] != 0:
+        return fail("free did not return pages to the pool")
+    s1 = eng.admit(16)
+    k1 = jnp.asarray(rng.standard_normal((10, HK, D)), jnp.float32)
+    eng.prefill(jnp.zeros((10, HQ, D), jnp.float32), k1, k1, s1)
+    gk1, _ = gather_kv(eng.cache, s1)
+    if not np.array_equal(np.asarray(gk1[:10]), np.asarray(k1)):
+        return fail("recycled slot read stale data")
+    if np.any(np.asarray(gk1[10:])):
+        return fail("recycled slot leaked the freed sequence's rows")
+    print("serving-check: cache invariants OK "
+          "(round-trip, re-trace=1, slot recycling)")
+    return 0
+
+
+def main() -> int:
+    for check in (
+        check_decode_prefill_parity,
+        check_cp_loopback,
+        check_cache_invariants,
+    ):
+        rc = check()
+        if rc:
+            return rc
+    print("serving-check OK: decode parity + cp=2 merge + cache invariants")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,10 @@ docs promise, cross-rank snapshot merging must keep its
 counters-sum/gauge-skew/histogram-bucket semantics with deterministic
 ordering, and Chrome trace dumps must carry track-naming metadata
 events.
+
+ISSUE 4 extension: one ServingEngine prefill + decode step must populate
+every ``REQUIRED_SERVING_METRICS`` name (the ``magi_decode_*`` /
+``magi_kvcache_*`` catalog documented in docs/observability.md).
 """
 
 import json
@@ -195,10 +199,44 @@ def main() -> int:
         print("FAIL: aggregate_across_mesh loopback mismatch")
         return 1
 
+    # 6. serving catalog: one tiny prefill + decode step through the
+    # engine must populate every magi_decode_* / magi_kvcache_* metric
+    import jax.numpy as jnp
+
+    from magiattention_tpu.serving import ServingEngine
+
+    telemetry.reset()
+    rng = np.random.default_rng(0)
+    hq, hk, d = 4, 2, 32
+    eng = ServingEngine(
+        num_pages=16, num_kv_heads=hk, head_dim=d, page_size=16,
+        max_seqs=2, max_pages_per_seq=4, dtype=jnp.float32,
+    )
+    slot = eng.admit(24)
+    mk = lambda *s: jnp.asarray(rng.standard_normal(s), jnp.float32)  # noqa: E731
+    eng.prefill(mk(24, hq, d), mk(24, hk, d), mk(24, hk, d), slot)
+    eng.decode_step(mk(1, hq, d), mk(1, hk, d), mk(1, hk, d), [slot])
+    snap = telemetry.snapshot()
+    missing = [
+        m for m in telemetry.REQUIRED_SERVING_METRICS
+        if not has_series(snap, m)
+    ]
+    if missing:
+        print(
+            "FAIL: documented serving metrics missing after a prefill + "
+            f"decode step (catalog drift): {missing}"
+        )
+        return 1
+    summary = telemetry.telemetry_summary(snap)
+    if "decode:" not in summary or "kv cache:" not in summary:
+        print(f"FAIL: summary lacks the serving section:\n{summary}")
+        return 1
+
     telemetry.set_enabled(None)
     print(
         f"telemetry-check OK: {len(telemetry.REQUIRED_PLAN_METRICS)} plan "
         f"metrics + {len(telemetry.REQUIRED_TIMELINE_METRICS)} timeline "
+        f"metrics + {len(telemetry.REQUIRED_SERVING_METRICS)} serving "
         "metrics present, cross-rank merge semantics hold, exporters "
         "round-trip with track metadata, disabled mode is a no-op"
     )

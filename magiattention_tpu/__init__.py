@@ -11,6 +11,7 @@ Layering (mirrors reference SURVEY.md layer map, re-designed TPU-first):
 - ``meta/``     : host-side planning — dispatch/overlap/dist-attn solvers
 - ``comm/``     : group_cast/group_reduce collectives over jax.lax + shard_map
 - ``parallel/`` : distributed attention runtime (the hot path)
+- ``serving/``  : inference path — paged KV cache + split-KV decode
 - ``api/``      : user-facing key-cached interface
 - ``models/``   : flagship model families built on the framework
 - ``testing/``  : reference oracles + precision harness
@@ -39,7 +40,7 @@ def __getattr__(name):
 
     if name in (
         "api", "benchmarking", "comm", "config", "env", "meta", "models",
-        "ops", "parallel", "telemetry", "testing", "utils",
+        "ops", "parallel", "serving", "telemetry", "testing", "utils",
     ):
         return importlib.import_module(f".{name}", __name__)
     if name in ("init_dist_attn_runtime_key", "init_dist_attn_runtime_mgr"):
@@ -63,6 +64,7 @@ __all__ = [
     "ops",
     "parallel",
     "recommended_compiler_options",
+    "serving",
     "telemetry",
     "testing",
     "utils",

@@ -151,6 +151,23 @@ def autotune_cache_dir() -> str:
     return _env_str("MAGI_ATTENTION_AUTOTUNE_CACHE_DIR", "")
 
 
+def page_size() -> int:
+    """KV-cache page size in tokens (``serving/kv_cache.py``): the unit
+    of paged allocation and the decode kernel's K-side granularity. Must
+    be a multiple of 8 (TPU sublane tiling of the page's token axis);
+    128 keeps a page one full lane tile at head_dim 128."""
+    return _env_int("MAGI_ATTENTION_PAGE_SIZE", 128)
+
+
+def decode_splits() -> int | None:
+    """Split-KV decode split count (``serving/decode_attn.py``): an
+    integer pins the number of KV splits per sequence; 'auto' (default)
+    resolves through the tuning autotuner's decode fingerprint kind
+    (``tuning.autotuner.select_decode_splits``)."""
+    v = _env_str("MAGI_ATTENTION_DECODE_SPLITS", "auto").strip().lower()
+    return None if v in ("", "auto") else int(v)
+
+
 def head_block() -> int:
     """Q heads batched per kernel grid step in the distributed runtime
     (clamped to a divisor of hq that is a GQA-group multiple)."""

@@ -19,12 +19,23 @@ NEG_INF = float("-inf")
 
 
 def safe_lse_merge(lse1: jax.Array, lse2: jax.Array) -> jax.Array:
-    """logaddexp with -inf-safe gradients (reference safe_lse)."""
+    """logaddexp with -inf-safe values AND gradients (reference safe_lse).
+
+    The all-``-inf`` corner (both rows uncovered — routine in paged
+    decode, where a zero-coverage KV split reports lse=-inf for every
+    sequence that ends before the split starts) must stay exactly
+    ``-inf`` with zero gradients under jit: every ``exp`` argument is
+    pre-masked so no ``-inf - (-inf)`` subtraction ever reaches XLA,
+    in the primal or in either AD branch.
+    """
     m = jnp.maximum(lse1, lse2)
     m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
-    s = jnp.where(jnp.isneginf(lse1), 0.0, jnp.exp(lse1 - m_safe)) + jnp.where(
-        jnp.isneginf(lse2), 0.0, jnp.exp(lse2 - m_safe)
-    )
+    # mask the *arguments*, not just the results: exp(-inf - m_safe) is
+    # well-defined, but its where-branch would still be computed under
+    # jit, and a fused rewrite of (lse - m_safe) can surface inf-inf
+    d1 = jnp.where(jnp.isneginf(lse1), NEG_INF, lse1 - m_safe)
+    d2 = jnp.where(jnp.isneginf(lse2), NEG_INF, lse2 - m_safe)
+    s = jnp.exp(d1) + jnp.exp(d2)
     return jnp.where(s > 0, m_safe + jnp.log(jnp.maximum(s, 1e-38)), NEG_INF)
 
 
@@ -58,14 +69,25 @@ def correct_attn_out(
 ) -> jax.Array:
     """Merge two partial outs given the already-merged ``lse``
     (reference correct_attn_out :322): exp(lse_i - lse)-weighted sum,
-    fp32 internally; rows covered by neither stay 0."""
+    fp32 internally; rows covered by neither stay 0.
+
+    A zero-coverage partial (lse_i = -inf) contributes NOTHING even when
+    its ``out_i`` payload is garbage: a split kernel that normalizes by a
+    zero denominator leaves 0/0 = NaN rows next to lse=-inf, and the
+    naive ``0 * out_i`` would propagate that NaN into the merge. The
+    uncovered payload is therefore masked out entirely, not just
+    zero-weighted.
+    """
     lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
-    w1 = jnp.where(jnp.isneginf(lse1), 0.0, jnp.exp(lse1 - lse_safe))
-    w2 = jnp.where(jnp.isneginf(lse2), 0.0, jnp.exp(lse2 - lse_safe))
-    out = (
-        w1[..., None] * out1.astype(jnp.float32)
-        + w2[..., None] * out2.astype(jnp.float32)
+    w1 = jnp.exp(jnp.where(jnp.isneginf(lse1), NEG_INF, lse1 - lse_safe))
+    w2 = jnp.exp(jnp.where(jnp.isneginf(lse2), NEG_INF, lse2 - lse_safe))
+    o1 = jnp.where(
+        jnp.isneginf(lse1)[..., None], 0.0, out1.astype(jnp.float32)
     )
+    o2 = jnp.where(
+        jnp.isneginf(lse2)[..., None], 0.0, out2.astype(jnp.float32)
+    )
+    out = w1[..., None] * o1 + w2[..., None] * o2
     return out.astype(out1.dtype)
 
 

@@ -36,6 +36,7 @@ from .block_meta import (
     FlexAttnBlockMeta,
     build_block_meta,
 )
+from ..utils.compat import tpu_compiler_params
 
 NEG_INF = float("-inf")
 LANES = 128
@@ -429,7 +430,7 @@ def _fwd_pallas_hb(q, k, v, sink2d, tables, params: FlexAttnParams):
             jax.ShapeDtypeStruct((hq, tqp, LANES), jnp.float32),
         ],
         interpret=params.interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
     )(qblk, kblk, sid, runs, bounds, rs, rc, q, k, v, sink2d)
@@ -587,7 +588,7 @@ def _fwd_pallas(q, k, v, sink2d, tables, params: FlexAttnParams):
             jax.ShapeDtypeStruct((hq, tqp, LANES), jnp.float32),
         ],
         interpret=params.interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -704,7 +705,7 @@ def _dq_pallas(q, k, v, do, lse, delta, tables, params: FlexAttnParams):
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((hq, tqp, d), jnp.float32),
         interpret=params.interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
     )(qblk, kblk, sid, runs, bounds, rs, rc, q, k, v, do, lse, delta)
@@ -840,7 +841,7 @@ def _dkv_pallas(q, k, v, do, lse, delta, tables, params: FlexAttnParams):
             jax.ShapeDtypeStruct((hk, tkp, d), jnp.float32),
         ],
         interpret=params.interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary",
                                  "arbitrary"),
         ),

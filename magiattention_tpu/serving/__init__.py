@@ -1,0 +1,67 @@
+"""Context-parallel serving subsystem: paged KV cache + split-KV decode.
+
+The inference-side counterpart of the distributed training runtime
+(ISSUE 4): the training machinery plans and executes attention over
+arbitrary mask slices; serving needs a distinct engine — paged/ragged KV
+storage and decode-specialized attention (FlashInfer, arxiv 2501.01005)
+— but both reduce partial results with the SAME associative LSE-corrected
+merge (``ops/correction``), which is what lets split-KV decode, CP-decode
+and the trainer's multi-stage overlap share one numerical contract.
+
+Layout:
+
+- :mod:`.kv_cache`    — page pool, block tables, append/gather ops,
+  host-side :class:`PageAllocator`
+- :mod:`.decode_attn` — split-KV decode attention (jnp reference +
+  Pallas TPU kernel behind ``MAGI_ATTENTION_KERNEL_BACKEND``)
+- :mod:`.cp_decode`   — cross-rank LSE-weighted tree merge for
+  CP-sharded KV histories (cp=1 degenerates to pure local)
+- :mod:`.engine`      — :class:`DecodeBatch`, ``magi_attn_decode``,
+  ``prefill_into_cache``, minimal continuous-batching
+  :class:`ServingEngine`
+
+See ``docs/serving.md`` for the architecture walkthrough.
+"""
+
+from .cp_decode import cp_decode_attn, cp_merge_partials  # noqa: F401
+from .decode_attn import (  # noqa: F401
+    decode_attn_paged,
+    merge_split_partials,
+    resolve_num_splits,
+)
+from .engine import (  # noqa: F401
+    DecodeBatch,
+    ServingEngine,
+    magi_attn_decode,
+    prefill_into_cache,
+)
+from .kv_cache import (  # noqa: F401
+    PageAllocator,
+    PagedKVCache,
+    append_kv,
+    assign_block_table,
+    gather_kv,
+    make_paged_kv_cache,
+    reset_slot,
+    write_prefill_kv,
+)
+
+__all__ = [
+    "DecodeBatch",
+    "PageAllocator",
+    "PagedKVCache",
+    "ServingEngine",
+    "append_kv",
+    "assign_block_table",
+    "cp_decode_attn",
+    "cp_merge_partials",
+    "decode_attn_paged",
+    "gather_kv",
+    "magi_attn_decode",
+    "make_paged_kv_cache",
+    "merge_split_partials",
+    "prefill_into_cache",
+    "reset_slot",
+    "resolve_num_splits",
+    "write_prefill_kv",
+]

@@ -1,0 +1,106 @@
+"""Context-parallel decode: merge per-rank split-KV partials across a mesh.
+
+When a sequence's KV history was dispatched across a CP mesh at training
+or prefill time, each rank holds a shard of the history in its LOCAL
+paged cache. Decode then runs in two associative layers of the SAME
+reduction (``ops/correction``):
+
+1. locally, each rank's split-KV partials merge into one rank partial
+   (:func:`magiattention_tpu.serving.decode_attn.decode_attn_paged`);
+2. across ranks, the per-rank ``(out, lse)`` partials merge with an
+   LSE-weighted tree reduce.
+
+The cross-rank step gathers every rank's partial with
+``comm.primitives.all_gather_v`` (decode partials are tiny —
+``[b, hq, d]`` — so an all-gather + log-depth local fold costs less
+latency than a ring of cp-1 dependent exchanges) and folds them pairwise:
+log2(cp) merge levels, each a single fused elementwise map. A rank whose
+shard holds NOTHING for a sequence (its slot length is 0) contributes
+``(0, -inf)`` and drops out of the merge exactly — the NaN-free corner
+``ops/correction.py`` guarantees.
+
+The degenerate ``cp_size=1`` path is pure local: no collective is built,
+so the same entry point serves single-host serving and CP-sharded
+serving unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..comm.primitives import all_gather_v
+from ..utils.instrument import named_scope
+from .decode_attn import decode_attn_paged, merge_split_partials
+from .kv_cache import PagedKVCache
+
+
+def cp_merge_partials(
+    out: jax.Array,  # [b, hq, d] this rank's partial (f32 recommended)
+    lse: jax.Array,  # [b, hq] f32
+    *,
+    axis_name: str,
+    cp_size: int,
+) -> tuple[jax.Array, jax.Array]:
+    """LSE-weighted tree reduce of per-rank decode partials.
+
+    Call inside ``shard_map`` over the cp axis. Every rank returns the
+    fully merged ``(out, lse)`` (decode consumers want the result
+    replicated — the next token's QKV projection runs everywhere).
+    """
+    if cp_size == 1:
+        return out, lse
+    b = out.shape[0]
+    with named_scope("magi_cp_decode_gather"):
+        # equal per-rank batch -> all_gather_v degenerates to a plain
+        # gather, but routes through the same primitive layer as the
+        # trainer's collectives
+        flat_o = all_gather_v(out, [b] * cp_size, axis_name=axis_name)
+        flat_l = all_gather_v(lse, [b] * cp_size, axis_name=axis_name)
+    outs = [flat_o[r * b : (r + 1) * b] for r in range(cp_size)]
+    lses = [flat_l[r * b : (r + 1) * b] for r in range(cp_size)]
+    with named_scope("magi_cp_decode_merge"):
+        # the SAME log-depth tree the split merge uses — one reduction,
+        # two layers (splits within a rank, ranks across the mesh)
+        return merge_split_partials(outs, lses)
+
+
+def cp_decode_attn(
+    q: jax.Array,  # [b, hq, head_dim] (replicated across the cp axis)
+    local_cache: PagedKVCache,  # this rank's KV shard
+    slots: jax.Array,  # [b] slots into the LOCAL cache
+    *,
+    axis_name: str,
+    cp_size: int,
+    num_splits: int | None = None,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    out_dtype=None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Context-parallel decode: local split-KV attention over the rank's
+    shard, then the cross-rank LSE merge. Call inside ``shard_map``
+    (``cp_size=1`` never touches the mesh).
+
+    ``local_cache.seq_lens[slot]`` is the number of history tokens THIS
+    rank holds for the sequence; the global history is the union across
+    ranks (disjoint by construction of the dispatch).
+    """
+    out, lse = decode_attn_paged(
+        q,
+        local_cache,
+        slots,
+        num_splits=num_splits,
+        scale=scale,
+        softcap=softcap,
+        out_dtype=jnp.float32,  # merge in f32; cast after
+        interpret=interpret,
+    )
+    out, lse = cp_merge_partials(
+        out.astype(jnp.float32),
+        lse,
+        axis_name=axis_name,
+        cp_size=cp_size,
+    )
+    final_dtype = jnp.dtype(out_dtype) if out_dtype is not None else q.dtype
+    return out.astype(final_dtype), lse

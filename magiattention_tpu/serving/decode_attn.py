@@ -1,0 +1,382 @@
+"""Split-KV decode attention over the paged cache.
+
+The serving subsystem's compute core (ISSUE 4 tentpole): one query token
+per sequence attends over its whole paged KV history. FlashAttention-2's
+work-partitioning argument (arxiv 2307.08691 §3) is what motivates the
+split-KV ("flash-decoding") layout: with q_len = 1 the only way to keep
+the MXU busy is to parallelize over the KV axis, so each of ``num_splits``
+KV splits computes a partial ``(out, lse)`` masked to the sequence's true
+length, and the partials merge with the associative LSE-corrected
+reduction the distributed trainer already ships
+(:mod:`magiattention_tpu.ops.correction`) — the same math, reused.
+
+Backends mirror ``ops/flex_attn.py``:
+
+- ``MAGI_ATTENTION_KERNEL_BACKEND=jnp``/``jnp_online`` — dense jnp
+  reference over the gathered pages (any platform, differentiable).
+- ``pallas`` (default) — the TPU kernel: grid (batch, split, page); each
+  grid step DMAs ONE page selected through the block table (scalar
+  prefetch, like the flex entry tables), runs the online-softmax update
+  in VMEM scratch, and emits the split's partial at its last page.
+  Non-TPU platforms run it in interpret mode (same default as flex).
+
+A zero-coverage split (the sequence ends before the split starts —
+routine when a sequence occupies a prefix of its pages) reports
+``(out=0, lse=-inf)``; ``correction.correct_attn_out`` guarantees such
+partials merge as exact no-ops even if a payload row were garbage.
+
+Split-count resolution: explicit argument > ``MAGI_ATTENTION_DECODE_SPLITS``
+> the tuning autotuner's ``decode`` fingerprint kind
+(:func:`magiattention_tpu.tuning.autotuner.select_decode_splits`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..ops.correction import correct_attn_out_lse
+from ..utils.compat import tpu_compiler_params
+from ..utils.instrument import named_scope
+from .kv_cache import PagedKVCache
+
+NEG_INF = float("-inf")
+LANES = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeParams:
+    """Static decode-kernel parameters (hashable, closed over by jit)."""
+
+    scale: float
+    softcap: float
+    num_splits: int
+    out_dtype: str
+    interpret: bool
+
+    @property
+    def out_jnp_dtype(self):
+        return jnp.dtype(self.out_dtype)
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def merge_split_partials(
+    outs: list[jax.Array],  # each [..., hq, d] float32
+    lses: list[jax.Array],  # each [..., hq] float32
+) -> tuple[jax.Array, jax.Array]:
+    """Associative binary-tree merge of split partials via the trainer's
+    LSE-corrected reduction (log-depth; order-independent up to fp
+    rounding because the merge is associative and commutative)."""
+    assert len(outs) == len(lses) and outs
+    while len(outs) > 1:
+        next_o, next_l = [], []
+        for i in range(0, len(outs) - 1, 2):
+            o, l = correct_attn_out_lse(
+                outs[i], lses[i], outs[i + 1], lses[i + 1]
+            )
+            next_o.append(o)
+            next_l.append(l)
+        if len(outs) % 2:
+            next_o.append(outs[-1])
+            next_l.append(lses[-1])
+        outs, lses = next_o, next_l
+    return outs[0], lses[0]
+
+
+def _split_partial_jnp(q, k, v, pos0, valid_len, scale, softcap):
+    """One KV split's partial (out, lse) in plain jnp.
+
+    q [b, hq, d]; k/v [b, L, hk, d] (this split's gathered tokens whose
+    global positions are pos0 + arange(L)); valid_len [b] true sequence
+    lengths. Returns (out [b, hq, d] f32, lse [b, hq] f32) with the
+    uncovered convention (0, -inf).
+    """
+    b, hq, d = q.shape
+    hk = k.shape[2]
+    group = hq // hk
+    L = k.shape[1]
+    qr = q.astype(jnp.float32).reshape(b, hk, group, d)
+    z = jnp.einsum(
+        "bhgd,blhd->bhgl", qr, k.astype(jnp.float32)
+    ) * jnp.float32(scale)
+    if softcap > 0.0:
+        cap = jnp.float32(softcap)
+        z = cap * jnp.tanh(z / cap)
+    pos = pos0 + jnp.arange(L, dtype=jnp.int32)  # [L]
+    mask = pos[None, :] < valid_len[:, None]  # [b, L]
+    s = jnp.where(mask[:, None, None, :], z, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [b, hk, g]
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.where(mask[:, None, None, :], jnp.exp(s - m_safe[..., None]), 0.0)
+    l = p.sum(axis=-1)
+    acc = jnp.einsum("bhgl,blhd->bhgd", p, v.astype(jnp.float32))
+    covered = l > 0.0
+    inv = jnp.where(covered, 1.0 / jnp.where(covered, l, 1.0), 0.0)
+    out = (acc * inv[..., None]).reshape(b, hq, d)
+    lse = jnp.where(
+        covered, m_safe + jnp.log(jnp.where(covered, l, 1.0)), NEG_INF
+    ).reshape(b, hq)
+    return out, lse
+
+
+def _decode_jnp(q, cache: PagedKVCache, bt, seq_lens, params: DecodeParams):
+    """Reference backend: gather each split's pages densely, compute the
+    partial, tree-merge. ``bt`` [b, MPP] / ``seq_lens`` [b] are the
+    batch's block-table rows and true lengths."""
+    b = q.shape[0]
+    ps = cache.page_size
+    mpp = bt.shape[1]
+    s = params.num_splits
+    pps = mpp // s
+    outs, lses = [], []
+    for i in range(s):
+        pages = bt[:, i * pps : (i + 1) * pps]  # [b, pps]
+        k = cache.k_pages[pages]  # [b, pps, ps, hk, d]
+        v = cache.v_pages[pages]
+        k = k.reshape(b, pps * ps, cache.num_kv_heads, cache.head_dim)
+        v = v.reshape(b, pps * ps, cache.num_kv_heads, cache.head_dim)
+        o, l = _split_partial_jnp(
+            q, k, v, i * pps * ps, seq_lens, params.scale, params.softcap
+        )
+        outs.append(o)
+        lses.append(l)
+    return merge_split_partials(outs, lses)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: grid (batch, split, page-within-split)
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    bt,  # [b * MPP] flattened block-table rows (scalar prefetch)
+    sl,  # [b] true lengths (scalar prefetch)
+    q_ref,  # (1, hq, d)
+    k_ref,  # (1, ps, hk, d) — the page this step DMA'd
+    v_ref,
+    out_ref,  # (1, 1, hq, d)
+    lse_ref,  # (1, 1, hq, LANES)
+    m_scr,  # (hq, LANES) f32
+    l_scr,
+    acc_scr,  # (hq, d) f32
+    *,
+    params: DecodeParams,
+    group: int,
+):
+    ps = k_ref.shape[1]
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+    p = pl.program_id(2)
+    pps = pl.num_programs(2)
+    hq = q_ref.shape[1]
+    hk = k_ref.shape[2]
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # positions this page's tokens occupy in the sequence
+    base = (s * pps + p) * ps
+    live = base < sl[b]  # page starts inside the sequence
+
+    @pl.when(live)
+    def _compute():
+        qr = q_ref[0].reshape(hk, group, q_ref.shape[2])
+        z = jax.lax.dot_general(
+            qr,
+            k_ref[0],  # [ps, hk, d]
+            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * jnp.float32(params.scale)  # (hk, group, ps)
+        if params.softcap > 0.0:
+            cap = jnp.float32(params.softcap)
+            z = cap * jnp.tanh(z / cap)
+        z = z.reshape(hq, ps)
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, (hq, ps), 1)
+        z = jnp.where(pos < sl[b], z, NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_cur = jnp.max(z, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
+        pexp = jnp.exp(jnp.where(z == NEG_INF, NEG_INF, z - m_safe))
+        l_new = l_scr[:, :1] * alpha + jnp.sum(pexp, axis=1, keepdims=True)
+        # (hk, group, ps) @ (ps, hk, d) batched over hk -> (hk, group, d)
+        pv = jax.lax.dot_general(
+            pexp.reshape(hk, group, ps).astype(v_ref.dtype),
+            v_ref[0],  # [ps, hk, d]: batch over hk, contract ps
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(hq, acc_scr.shape[1])
+        acc_scr[...] = acc_scr[...] * alpha + pv
+        m_scr[:, :1] = m_new
+        l_scr[:, :1] = l_new
+
+    @pl.when(p == pps - 1)
+    def _finalize():
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        covered = l > 0.0
+        inv = jnp.where(covered, 1.0 / jnp.where(covered, l, 1.0), 0.0)
+        out_ref[0, 0] = (acc_scr[...] * inv).astype(out_ref.dtype)
+        m_safe = jnp.where(m == NEG_INF, 0.0, m)
+        lse = jnp.where(
+            covered, m_safe + jnp.log(jnp.where(covered, l, 1.0)), NEG_INF
+        )
+        lse_ref[0, 0] = jnp.broadcast_to(lse, (lse.shape[0], LANES))
+
+
+def _decode_pallas(q, cache: PagedKVCache, bt, seq_lens, params: DecodeParams):
+    """Launcher: partial (out, lse) per (batch, split); splits merged by
+    the caller through ``ops/correction`` (the design's point — the CP
+    merge and the split merge are the same associative reduction)."""
+    b, hq, d = q.shape
+    hk = cache.num_kv_heads
+    group = hq // hk
+    ps = cache.page_size
+    mpp = bt.shape[1]
+    s = params.num_splits
+    pps = mpp // s
+    bt_flat = bt.reshape(-1).astype(jnp.int32)
+    sl = seq_lens.astype(jnp.int32)
+
+    def qmap(b_, s_, p_, bt_, sl_):
+        return (b_, 0, 0)
+
+    def kmap(b_, s_, p_, bt_, sl_):
+        return (bt_[b_ * mpp + s_ * pps + p_], 0, 0, 0)
+
+    def omap(b_, s_, p_, bt_, sl_):
+        return (b_, s_, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, s, pps),
+        in_specs=[
+            pl.BlockSpec((1, hq, d), qmap),
+            pl.BlockSpec((1, ps, hk, d), kmap),
+            pl.BlockSpec((1, ps, hk, d), kmap),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, hq, d), omap),
+            pl.BlockSpec((1, 1, hq, LANES), omap),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((hq, LANES), jnp.float32),
+            pltpu.VMEM((hq, LANES), jnp.float32),
+            pltpu.VMEM((hq, d), jnp.float32),
+        ],
+    )
+    out_parts, lse_parts = pl.pallas_call(
+        functools.partial(_decode_kernel, params=params, group=group),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, hq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, s, hq, LANES), jnp.float32),
+        ],
+        interpret=params.interpret,
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(bt_flat, sl, q, cache.k_pages, cache.v_pages)
+    outs = [out_parts[:, i] for i in range(s)]
+    lses = [lse_parts[:, i, :, 0] for i in range(s)]
+    return merge_split_partials(outs, lses)
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+
+def resolve_num_splits(
+    num_splits: int | None,
+    cache: PagedKVCache,
+    batch: int,
+    hq: int,
+) -> int:
+    """Explicit arg > MAGI_ATTENTION_DECODE_SPLITS > autotuner (decode
+    fingerprint kind). The result always divides max_pages_per_seq."""
+    from .. import env
+
+    mpp = cache.max_pages_per_seq
+    if num_splits is None:
+        num_splits = env.decode_splits()
+    if num_splits is None:
+        from ..tuning.autotuner import select_decode_splits
+
+        decision = select_decode_splits(
+            batch,
+            mpp,
+            cache.page_size,
+            hq,
+            cache.num_kv_heads,
+            head_dim=cache.head_dim,
+            dtype=str(cache.k_pages.dtype),
+        )
+        # the record's head_block IS the split count (ratio-free, so a
+        # bucket-aliased cache hit from a nearby mpp cannot collapse the
+        # chosen parallelism); the divisor clamp below fits it to THIS
+        # geometry
+        num_splits = decision.head_block
+    num_splits = max(1, min(int(num_splits), mpp))
+    while mpp % num_splits:  # largest divisor of mpp not above the ask
+        num_splits -= 1
+    return num_splits
+
+
+def decode_attn_paged(
+    q: jax.Array,  # [b, hq, head_dim] one query token per sequence
+    cache: PagedKVCache,
+    slots: jax.Array,  # [b] int32 cache slots
+    *,
+    num_splits: int | None = None,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    out_dtype=None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Split-KV decode attention over the paged cache.
+
+    Returns ``(out [b, hq, head_dim] in out_dtype, lse [b, hq] f32)``.
+    Each query attends to its sequence's first ``seq_lens[slot]`` cached
+    tokens (append the step's own KV first for standard causal decode).
+    """
+    b, hq, d = q.shape
+    assert d == cache.head_dim, (q.shape, cache.head_dim)
+    assert hq % cache.num_kv_heads == 0
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _default_interpret()
+    out_dtype = jnp.dtype(out_dtype) if out_dtype is not None else q.dtype
+    num_splits = resolve_num_splits(num_splits, cache, b, hq)
+    params = DecodeParams(
+        scale=float(scale),
+        softcap=float(softcap),
+        num_splits=int(num_splits),
+        out_dtype=str(out_dtype),
+        interpret=bool(interpret),
+    )
+    bt = cache.block_tables[slots]  # [b, MPP]
+    seq_lens = cache.seq_lens[slots]  # [b]
+    from .. import env
+
+    with named_scope("magi_decode_attn"):
+        if env.kernel_backend() in ("jnp", "jnp_online"):
+            out, lse = _decode_jnp(q, cache, bt, seq_lens, params)
+        else:
+            out, lse = _decode_pallas(q, cache, bt, seq_lens, params)
+    return out.astype(out_dtype), lse

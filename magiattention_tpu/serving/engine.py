@@ -1,0 +1,279 @@
+"""Serving front end: continuous batching over one paged cache.
+
+The minimal decode engine (ISSUE 4 tentpole): prefill runs through the
+existing flex-attention path and writes its KV into pages, decode steps
+run the split-KV kernel over the same pool — so a sequence's lifetime
+(admit → prefill → N decode steps → free) round-trips through ONE cache
+with no re-layout.
+
+Layers:
+
+- :class:`DecodeBatch` — the ragged batch descriptor the jitted step
+  consumes: per-sequence cache slots; true lengths live in the cache's
+  ``seq_lens`` so growth never re-traces.
+- :func:`magi_attn_decode` — the public decode attention entry
+  (``api.magi_attn_decode``).
+- :func:`prefill_into_cache` — flex-attention prefill + paged KV write.
+- :class:`ServingEngine` — host-side continuous batching: admission via
+  :class:`~magiattention_tpu.serving.kv_cache.PageAllocator`, slot
+  recycling, telemetry (``magi_decode_*`` / ``magi_kvcache_*``).
+
+Every stage records counters/gauges through the telemetry registry and
+annotates device traces with named scopes (``magi_prefill_attn`` /
+``magi_decode_attn`` / ``magi_kvcache_append``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..common.enum import AttnMaskType
+from ..utils.instrument import named_scope
+from .decode_attn import decode_attn_paged, resolve_num_splits
+from .kv_cache import (
+    PagedKVCache,
+    PageAllocator,
+    append_kv,
+    assign_block_table,
+    make_paged_kv_cache,
+    reset_slot,
+    write_prefill_kv,
+)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class DecodeBatch:
+    """One continuous-batching decode step's ragged batch descriptor.
+
+    ``slots`` [b] int32: each sequence's cache slot. The per-sequence KV
+    lengths are NOT duplicated here — they are read from the shared
+    cache's ``seq_lens`` at the slots, which is what lets one traced
+    program serve every mix of sequence lengths.
+    """
+
+    slots: jax.Array  # [b] int32
+
+    @property
+    def batch_size(self) -> int:
+        return self.slots.shape[0]
+
+    def tree_flatten(self):
+        return ((self.slots,), None)
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    @staticmethod
+    def of(slots) -> "DecodeBatch":
+        return DecodeBatch(jnp.asarray(np.asarray(slots), jnp.int32))
+
+
+def magi_attn_decode(
+    q: jax.Array,  # [b, hq, head_dim] the step's query token per sequence
+    cache: PagedKVCache,
+    batch: DecodeBatch,
+    *,
+    num_splits: int | None = None,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    out_dtype=None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Public decode attention over a paged cache (split-KV + LSE merge).
+
+    Attends each query to its sequence's ``seq_lens[slot]`` cached
+    tokens. For standard causal decode, :func:`append_kv` the step's own
+    K/V first, then call this. Returns ``(out [b, hq, d], lse [b, hq])``.
+    """
+    return decode_attn_paged(
+        q,
+        cache,
+        batch.slots,
+        num_splits=num_splits,
+        scale=scale,
+        softcap=softcap,
+        out_dtype=out_dtype,
+        interpret=interpret,
+    )
+
+
+def prefill_into_cache(
+    q: jax.Array,  # [t, hq, head_dim] the prompt's queries
+    k: jax.Array,  # [t, hk, head_dim]
+    v: jax.Array,
+    cache: PagedKVCache,
+    slot,
+    *,
+    length=None,  # traced valid prompt length (None = all t rows)
+    scale: float | None = None,
+    softcap: float = 0.0,
+    out_dtype=None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, PagedKVCache]:
+    """Causal prefill through the existing flex-attention path, with the
+    prompt's KV written into the slot's pages — prefill and decode share
+    one storage layout, so the decode step that follows reads exactly
+    what prefill computed against.
+
+    Returns ``(out [t, hq, d], lse [t, hq], updated cache)``. With a
+    traced ``length`` the attention still runs over the padded ``t`` rows
+    (the mask is static); rows at or past ``length`` are garbage the
+    caller discards — only the CACHE write is masked to ``length``.
+    """
+    from ..ops import flex_flash_attn_func
+
+    t = q.shape[0]
+    with named_scope("magi_prefill_attn"):
+        out, lse = flex_flash_attn_func(
+            q,
+            k,
+            v,
+            [(0, t)],
+            [(0, t)],
+            [int(AttnMaskType.CAUSAL)],
+            scale=scale,
+            softcap=softcap,
+            out_dtype=out_dtype,
+            interpret=interpret,
+        )
+    with named_scope("magi_kvcache_prefill_write"):
+        cache = write_prefill_kv(cache, slot, k, v, length=length)
+    return out, lse, cache
+
+
+class ServingEngine:
+    """Minimal continuous-batching host loop over one paged cache.
+
+    Host-side object: owns the allocator and the (functional) device
+    cache, exposes admit/step/free. The engine methods themselves are
+    host loops (slot bookkeeping, reservation growth, telemetry) and are
+    NOT jittable; the jit boundary is the pure ops they drive — in
+    production, wrap ``append_kv`` + :func:`magi_attn_decode` in one
+    ``jax.jit`` with a donated cache (what ``exps/run_decode_bench.py``
+    measures) and keep the engine's bookkeeping outside it.
+    """
+
+    def __init__(
+        self,
+        *,
+        num_pages: int,
+        num_kv_heads: int,
+        head_dim: int,
+        page_size: int | None = None,
+        max_seqs: int = 64,
+        max_pages_per_seq: int | None = None,
+        dtype=jnp.bfloat16,
+    ):
+        from .. import env
+
+        if page_size is None:
+            page_size = env.page_size()
+        if max_pages_per_seq is None:
+            max_pages_per_seq = max(num_pages // max(max_seqs, 1), 1)
+        self.cache = make_paged_kv_cache(
+            num_pages,
+            page_size,
+            num_kv_heads,
+            head_dim,
+            max_seqs=max_seqs,
+            max_pages_per_seq=max_pages_per_seq,
+            dtype=dtype,
+        )
+        self.allocator = PageAllocator(
+            num_pages, page_size, max_seqs, max_pages_per_seq
+        )
+        self._lengths: dict[int, int] = {}
+        self._record_pool()
+
+    # -- admission / retirement (host) --
+
+    def admit(self, num_tokens: int) -> int:
+        """Reserve a slot + pages for a sequence of ``num_tokens`` prompt
+        tokens (plus later decode growth via :meth:`reserve_growth`)."""
+        slot, pages = self.allocator.allocate(num_tokens)
+        self.cache = assign_block_table(self.cache, slot, pages)
+        self._record_pool()
+        return slot
+
+    def reserve_growth(self, slot: int, total_tokens: int) -> None:
+        """Extend a slot's page reservation to ``total_tokens`` (prompt +
+        planned decode budget) before stepping past its current pages."""
+        pages = self.allocator.extend(slot, total_tokens)
+        self.cache = assign_block_table(self.cache, slot, pages, keep_len=True)
+        self._record_pool()
+
+    def free(self, slot: int) -> None:
+        """Retire a sequence: pages back to the pool, slot reusable."""
+        self.allocator.free(slot)
+        self.cache = reset_slot(self.cache, slot)
+        self._lengths.pop(slot, None)
+        self._record_pool()
+
+    # -- device steps --
+
+    def _ensure_reserved(self, slot: int, total_tokens: int) -> None:
+        """Grow the slot's page reservation to cover ``total_tokens``
+        before any write could land past its installed pages — a write
+        beyond the reservation would otherwise scatter onto pages owned
+        by OTHER sequences (unreserved block-table entries are 0, the
+        first-admitted sequence's page)."""
+        if (
+            self.allocator.pages_needed(total_tokens)
+            > self.allocator.reserved_pages(slot)
+        ):
+            self.reserve_growth(slot, total_tokens)
+
+    def prefill(self, q, k, v, slot: int, **kw):
+        """Prefill a prompt into ``slot``; returns the prefill out/lse."""
+        length = kw.get("length")
+        wrote = q.shape[0] if length is None else int(length)
+        self._ensure_reserved(slot, self._lengths.get(slot, 0) + wrote)
+        out, lse, self.cache = prefill_into_cache(
+            q, k, v, self.cache, slot, **kw
+        )
+        self._lengths[slot] = self._lengths.get(slot, 0) + wrote
+        telemetry.record_prefill(wrote)
+        return out, lse
+
+    def decode_step(self, q, k_new, v_new, slots, **kw):
+        """One continuous-batching decode step: append each sequence's
+        new KV, then attend over the whole history (the new token
+        included — standard causal decode). Page reservations grow
+        automatically when a sequence crosses into an unreserved page."""
+        batch = DecodeBatch.of(slots)
+        slot_list = np.asarray(slots).tolist()
+        for s in slot_list:
+            self._ensure_reserved(s, self._lengths.get(s, 0) + 1)
+        # resolve the split count ONCE (fingerprint + cache lookup) and
+        # hand the concrete int down — decode is the per-token hot loop
+        kw["num_splits"] = resolve_num_splits(
+            kw.get("num_splits"), self.cache, batch.batch_size, q.shape[1]
+        )
+        with named_scope("magi_kvcache_append"):
+            self.cache = append_kv(self.cache, batch.slots, k_new, v_new)
+        for s in slot_list:
+            self._lengths[s] = self._lengths.get(s, 0) + 1
+        out, lse = magi_attn_decode(q, self.cache, batch, **kw)
+        telemetry.record_decode_step(
+            batch_size=batch.batch_size,
+            num_splits=kw["num_splits"],
+            max_seq_len=max(
+                (self._lengths.get(s, 0) for s in slot_list), default=0
+            ),
+        )
+        return out, lse
+
+    # -- introspection --
+
+    def occupancy(self) -> dict:
+        return self.allocator.occupancy()
+
+    def _record_pool(self) -> None:
+        telemetry.record_kvcache_state(self.allocator.occupancy())

@@ -1,0 +1,366 @@
+"""Paged KV cache: static-shape page pool + per-sequence block tables.
+
+The serving subsystem's storage layer (ISSUE 4 tentpole, after FlashInfer's
+block-sparse KV formats, arxiv 2501.01005): decode-time KV history lives in
+a fixed pool of fixed-size pages so the jitted decode step sees ONE static
+shape regardless of how long any sequence has grown — growth changes only
+the *values* of ``seq_lens``/``block_tables``, never an array shape, which
+is what keeps the jit re-trace count constant across a sequence's lifetime
+(asserted by ``tests/test_serving/test_kv_cache.py``).
+
+Layout:
+
+- page pool  ``k_pages`` / ``v_pages``: ``[num_pages, page_size, kv_heads,
+  head_dim]`` — a page is the unit of allocation AND the decode kernel's
+  K-side DMA granularity (one block per grid step).
+- block tables ``[max_seqs, max_pages_per_seq]`` int32: sequence slot ->
+  ordered page ids (unallocated entries are 0 — harmless, reads beyond
+  ``seq_lens`` are masked everywhere).
+- ``seq_lens`` ``[max_seqs]`` int32: tokens currently stored per slot.
+
+All update ops are functional (``x.at[...]``) so callers can donate the
+cache buffers through jit (``jax.jit(step, donate_argnums=...)``) and XLA
+updates the pool in place; they are index-arithmetic only, so ``vmap``
+over a leading batch axis composes (``append_kv`` is already batched).
+
+Page bookkeeping (which pages are free, which slot owns what) is
+host-side Python in :class:`PageAllocator` — allocation decisions happen
+at admission time, not inside jitted code, mirroring how real serving
+engines split host scheduling from device compute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class PagedKVCache:
+    """Device state of the paged cache (a pytree of four arrays)."""
+
+    k_pages: jax.Array  # [num_pages, page_size, kv_heads, head_dim]
+    v_pages: jax.Array  # same shape
+    block_tables: jax.Array  # [max_seqs, max_pages_per_seq] int32 page ids
+    seq_lens: jax.Array  # [max_seqs] int32 tokens stored per slot
+
+    # -- static geometry (derived from shapes; no aux data needed) --
+    @property
+    def num_pages(self) -> int:
+        return self.k_pages.shape[0]
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[1]
+
+    @property
+    def num_kv_heads(self) -> int:
+        return self.k_pages.shape[2]
+
+    @property
+    def head_dim(self) -> int:
+        return self.k_pages.shape[3]
+
+    @property
+    def max_seqs(self) -> int:
+        return self.block_tables.shape[0]
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        return self.block_tables.shape[1]
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+    def tree_flatten(self):
+        return (
+            (self.k_pages, self.v_pages, self.block_tables, self.seq_lens),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+
+def make_paged_kv_cache(
+    num_pages: int,
+    page_size: int,
+    num_kv_heads: int,
+    head_dim: int,
+    *,
+    max_seqs: int,
+    max_pages_per_seq: int | None = None,
+    dtype=jnp.bfloat16,
+) -> PagedKVCache:
+    """Zero-initialized cache. ``max_pages_per_seq`` bounds a sequence's
+    KV history (block-table width); defaults to the whole pool."""
+    assert page_size % 8 == 0, (
+        f"page_size {page_size} must be a multiple of 8 (TPU sublane "
+        "tiling of the page's token axis)"
+    )
+    if max_pages_per_seq is None:
+        max_pages_per_seq = num_pages
+    shape = (num_pages, page_size, num_kv_heads, head_dim)
+    return PagedKVCache(
+        k_pages=jnp.zeros(shape, dtype),
+        v_pages=jnp.zeros(shape, dtype),
+        block_tables=jnp.zeros((max_seqs, max_pages_per_seq), jnp.int32),
+        seq_lens=jnp.zeros((max_seqs,), jnp.int32),
+    )
+
+
+def append_kv(
+    cache: PagedKVCache,
+    slots: jax.Array,  # [b] int32 sequence slots (must be distinct)
+    k_new: jax.Array,  # [b, kv_heads, head_dim] this step's K per sequence
+    v_new: jax.Array,
+) -> PagedKVCache:
+    """Append ONE token of KV per sequence (the decode-step write).
+
+    Static shapes in, static shapes out — the positions come from
+    ``seq_lens``, so a growing sequence re-runs the SAME traced program.
+    Slots must be distinct within the batch (two writes to one slot in a
+    single step would race in the scatter).
+
+    The caller must have INSTALLED enough pages for the new position
+    (``PageAllocator.extend`` + :func:`assign_block_table`): unreserved
+    block-table entries read 0, so a write past the slot's reservation
+    would land on page 0 — which may belong to another live sequence.
+    :class:`~magiattention_tpu.serving.engine.ServingEngine` grows
+    reservations automatically before each step; only the saturating
+    ``max_seq_len`` bound is enforced device-side (shapes are static,
+    the reservation is host state).
+    """
+    ps = cache.page_size
+    pos = cache.seq_lens[slots]  # [b]
+    page_slot = jnp.minimum(pos // ps, cache.max_pages_per_seq - 1)
+    page = jnp.take_along_axis(
+        cache.block_tables[slots], page_slot[:, None], axis=1
+    )[:, 0]
+    off = pos % ps
+    # a full slot (pos == max_seq_len) must not wrap onto page 0: drop it
+    page = jnp.where(pos < cache.max_seq_len, page, cache.num_pages)
+    return PagedKVCache(
+        k_pages=cache.k_pages.at[page, off].set(
+            k_new.astype(cache.k_pages.dtype), mode="drop"
+        ),
+        v_pages=cache.v_pages.at[page, off].set(
+            v_new.astype(cache.v_pages.dtype), mode="drop"
+        ),
+        block_tables=cache.block_tables,
+        seq_lens=cache.seq_lens.at[slots].add(
+            jnp.where(pos < cache.max_seq_len, 1, 0).astype(jnp.int32)
+        ),
+    )
+
+
+def write_prefill_kv(
+    cache: PagedKVCache,
+    slot,  # scalar int sequence slot
+    k: jax.Array,  # [t, kv_heads, head_dim] (t static; may be padded)
+    v: jax.Array,
+    length=None,  # traced valid token count (None = all t rows)
+) -> PagedKVCache:
+    """Write a prefill's KV into the slot's pages starting at its current
+    ``seq_lens`` position. ``t`` is the static (padded) row count;
+    ``length`` masks the tail, so one traced program serves every prompt
+    length up to ``t``."""
+    t = k.shape[0]
+    ps = cache.page_size
+    if length is None:
+        length = t
+    length = jnp.asarray(length, jnp.int32)
+    start = cache.seq_lens[slot]
+    pos = start + jnp.arange(t, dtype=jnp.int32)
+    valid = (jnp.arange(t) < length) & (pos < cache.max_seq_len)
+    page_slot = jnp.minimum(pos // ps, cache.max_pages_per_seq - 1)
+    page = jnp.take(cache.block_tables[slot], page_slot)
+    page = jnp.where(valid, page, cache.num_pages)  # OOB -> dropped
+    off = pos % ps
+    return PagedKVCache(
+        k_pages=cache.k_pages.at[page, off].set(
+            k.astype(cache.k_pages.dtype), mode="drop"
+        ),
+        v_pages=cache.v_pages.at[page, off].set(
+            v.astype(cache.v_pages.dtype), mode="drop"
+        ),
+        block_tables=cache.block_tables,
+        seq_lens=cache.seq_lens.at[slot].add(
+            jnp.minimum(length, cache.max_seq_len - start)
+        ),
+    )
+
+
+def gather_kv(
+    cache: PagedKVCache,
+    slot,  # scalar int sequence slot
+    max_len: int | None = None,  # static row count of the result
+) -> tuple[jax.Array, jax.Array]:
+    """Contiguous ``[max_len, kv_heads, head_dim]`` K/V for one sequence
+    (rows past ``seq_lens[slot]`` are zeroed). The round-trip oracle for
+    the paged layout — ``append``/``write_prefill`` followed by ``gather``
+    must equal the contiguous KV stream (tested property)."""
+    if max_len is None:
+        max_len = cache.max_seq_len
+    ps = cache.page_size
+    pos = jnp.arange(max_len, dtype=jnp.int32)
+    page_slot = jnp.minimum(pos // ps, cache.max_pages_per_seq - 1)
+    page = jnp.take(cache.block_tables[slot], page_slot)
+    off = pos % ps
+    valid = (pos < cache.seq_lens[slot])[:, None, None]
+    k = jnp.where(valid, cache.k_pages[page, off], 0)
+    v = jnp.where(valid, cache.v_pages[page, off], 0)
+    return k, v
+
+
+def assign_block_table(
+    cache: PagedKVCache,
+    slot: int,
+    pages: Sequence[int],
+    *,
+    keep_len: bool = False,
+) -> PagedKVCache:
+    """Install a slot's page list (host-side admission; ``pages`` come
+    from :class:`PageAllocator`). Resets the slot's length to 0 unless
+    ``keep_len`` (a growth re-assignment extending a live sequence's
+    reservation keeps its stored tokens)."""
+    assert len(pages) <= cache.max_pages_per_seq, (
+        f"{len(pages)} pages > max_pages_per_seq {cache.max_pages_per_seq}"
+    )
+    row = np.zeros((cache.max_pages_per_seq,), np.int32)
+    row[: len(pages)] = np.asarray(pages, np.int32)
+    return PagedKVCache(
+        k_pages=cache.k_pages,
+        v_pages=cache.v_pages,
+        block_tables=cache.block_tables.at[slot].set(jnp.asarray(row)),
+        seq_lens=(
+            cache.seq_lens
+            if keep_len
+            else cache.seq_lens.at[slot].set(0)
+        ),
+    )
+
+
+def reset_slot(cache: PagedKVCache, slot: int) -> PagedKVCache:
+    """Logical free of a slot's stored tokens (page recycling is the
+    allocator's job; stale page contents are never read once the length
+    is 0)."""
+    return PagedKVCache(
+        k_pages=cache.k_pages,
+        v_pages=cache.v_pages,
+        block_tables=cache.block_tables,
+        seq_lens=cache.seq_lens.at[slot].set(0),
+    )
+
+
+class PageAllocator:
+    """Host-side page bookkeeping: free list, slot ownership, occupancy.
+
+    Pure Python by design — admission control and page recycling are
+    scheduler decisions made between device steps, and keeping them off
+    the device means the jitted decode step never depends on pool state.
+    Occupancy numbers feed the ``magi_kvcache_*`` telemetry gauges
+    (``telemetry.record_kvcache_state``).
+    """
+
+    def __init__(
+        self,
+        num_pages: int,
+        page_size: int,
+        max_seqs: int,
+        max_pages_per_seq: int,
+    ):
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_seqs = int(max_seqs)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self._free_pages: list[int] = list(range(num_pages - 1, -1, -1))
+        self._free_slots: list[int] = list(range(max_seqs - 1, -1, -1))
+        self._slot_pages: dict[int, list[int]] = {}
+
+    def pages_needed(self, num_tokens: int) -> int:
+        return -(-max(int(num_tokens), 0) // self.page_size)
+
+    def can_admit(self, num_tokens: int) -> bool:
+        need = max(self.pages_needed(num_tokens), 1)
+        return (
+            bool(self._free_slots)
+            and need <= len(self._free_pages)
+            and need <= self.max_pages_per_seq
+        )
+
+    def allocate(self, num_tokens: int) -> tuple[int, list[int]]:
+        """Admit a sequence needing ``num_tokens`` of KV (rounded up to
+        whole pages; at least one). Returns (slot, page list)."""
+        need = max(self.pages_needed(num_tokens), 1)
+        if not self._free_slots:
+            raise RuntimeError("PageAllocator: no free sequence slot")
+        if need > self.max_pages_per_seq:
+            raise RuntimeError(
+                f"PageAllocator: {num_tokens} tokens need {need} pages > "
+                f"max_pages_per_seq {self.max_pages_per_seq}"
+            )
+        if need > len(self._free_pages):
+            raise RuntimeError(
+                f"PageAllocator: {need} pages requested, "
+                f"{len(self._free_pages)} free"
+            )
+        slot = self._free_slots.pop()
+        pages = [self._free_pages.pop() for _ in range(need)]
+        self._slot_pages[slot] = pages
+        return slot, list(pages)
+
+    def extend(self, slot: int, total_tokens: int) -> list[int]:
+        """Grow a slot's reservation to cover ``total_tokens``; returns the
+        FULL page list (existing + newly granted)."""
+        pages = self._slot_pages.get(slot)
+        if pages is None:
+            raise KeyError(f"PageAllocator: slot {slot} not allocated")
+        need = max(self.pages_needed(total_tokens), 1)
+        if need > self.max_pages_per_seq:
+            raise RuntimeError(
+                f"PageAllocator: {total_tokens} tokens exceed "
+                f"max_pages_per_seq {self.max_pages_per_seq}"
+            )
+        while len(pages) < need:
+            if not self._free_pages:
+                raise RuntimeError("PageAllocator: page pool exhausted")
+            pages.append(self._free_pages.pop())
+        return list(pages)
+
+    def free(self, slot: int) -> None:
+        """Return a slot's pages to the pool (block-table reuse tested)."""
+        pages = self._slot_pages.pop(slot, None)
+        if pages is None:
+            raise KeyError(f"PageAllocator: slot {slot} not allocated")
+        self._free_pages.extend(reversed(pages))
+        self._free_slots.append(slot)
+
+    def reserved_pages(self, slot: int) -> int:
+        """Pages currently installed for a slot (0 if unallocated)."""
+        return len(self._slot_pages.get(slot, ()))
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free_pages)
+
+    @property
+    def active_seqs(self) -> int:
+        return len(self._slot_pages)
+
+    def occupancy(self) -> dict:
+        """Plain-dict pool state (the telemetry payload)."""
+        return {
+            "pages_total": self.num_pages,
+            "pages_in_use": self.pages_in_use,
+            "occupancy_ratio": self.pages_in_use / max(self.num_pages, 1),
+            "active_seqs": self.active_seqs,
+            "page_size": self.page_size,
+        }

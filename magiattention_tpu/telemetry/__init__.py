@@ -39,19 +39,23 @@ from .aggregate import (  # noqa: F401
 )
 from .collectors import (  # noqa: F401
     REQUIRED_PLAN_METRICS,
+    REQUIRED_SERVING_METRICS,
     REQUIRED_TIMELINE_METRICS,
     record_autotune_cache,
     record_autotune_decision,
     record_autotune_measure_failure,
     record_autotune_measurement,
     record_cache_access,
+    record_decode_step,
     record_dispatch_meta,
     record_dispatch_solution,
     record_dynamic_solution,
     record_group_collective_build,
+    record_kvcache_state,
     record_measured_timeline,
     record_overlap_choice,
     record_plan,
+    record_prefill,
     record_runtime_costs,
     telemetry_summary,
 )
@@ -125,6 +129,7 @@ __all__ = [
     "MeasuredTimeline",
     "MetricsRegistry",
     "REQUIRED_PLAN_METRICS",
+    "REQUIRED_SERVING_METRICS",
     "REQUIRED_TIMELINE_METRICS",
     "StageTiming",
     "aggregate_across_mesh",
@@ -144,6 +149,7 @@ __all__ = [
     "record_autotune_measure_failure",
     "record_autotune_measurement",
     "record_cache_access",
+    "record_decode_step",
     "record_dispatch_meta",
     "record_dispatch_solution",
     "record_dynamic_solution",
@@ -151,7 +157,9 @@ __all__ = [
     "record_group_collective_build",
     "record_measured_timeline",
     "record_overlap_choice",
+    "record_kvcache_state",
     "record_plan",
+    "record_prefill",
     "record_runtime_costs",
     "reset",
     "series_key",

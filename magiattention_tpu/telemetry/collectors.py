@@ -100,6 +100,21 @@ M_TL_EFFICIENCY = "magi_overlap_measured_efficiency"
 M_TL_PREDICTED_MS = "magi_overlap_predicted_total_ms"  # solver's model
 M_TL_PRED_ERROR = "magi_overlap_prediction_error_ratio"  # measured/pred
 
+# counters + gauges — serving subsystem (serving/; see docs/serving.md).
+# decode layer: per continuous-batching step
+M_DECODE_STEPS = "magi_decode_steps_total"
+M_DECODE_TOKENS = "magi_decode_tokens_total"  # one per sequence per step
+M_DECODE_BATCH = "magi_decode_batch_size"
+M_DECODE_SPLITS = "magi_decode_num_splits"
+M_DECODE_MAX_SEQ_LEN = "magi_decode_max_seq_len"
+M_PREFILL_TOKENS = "magi_prefill_tokens_total"
+# kv-cache layer: page-pool occupancy (PageAllocator accounting)
+M_KVCACHE_PAGES_TOTAL = "magi_kvcache_pages_total"
+M_KVCACHE_PAGES_USED = "magi_kvcache_pages_in_use"
+M_KVCACHE_OCCUPANCY = "magi_kvcache_occupancy_ratio"
+M_KVCACHE_ACTIVE_SEQS = "magi_kvcache_active_seqs"
+M_KVCACHE_PAGE_SIZE = "magi_kvcache_page_size"
+
 # histograms (seconds)
 H_PLAN_BUILD_S = "magi_plan_build_seconds"
 H_DISPATCH_SOLVE_S = "magi_dispatch_solve_seconds"
@@ -136,6 +151,23 @@ REQUIRED_TIMELINE_METRICS: tuple[str, ...] = (
     M_TL_EFFICIENCY,
     M_TL_PREDICTED_MS,
     M_TL_PRED_ERROR,
+)
+
+# populated by one prefill + one ServingEngine decode step; asserted by
+# make telemetry-check's serving step and make serving-check, documented
+# in docs/observability.md "Serving metrics" + docs/serving.md
+REQUIRED_SERVING_METRICS: tuple[str, ...] = (
+    M_DECODE_STEPS,
+    M_DECODE_TOKENS,
+    M_DECODE_BATCH,
+    M_DECODE_SPLITS,
+    M_DECODE_MAX_SEQ_LEN,
+    M_PREFILL_TOKENS,
+    M_KVCACHE_PAGES_TOTAL,
+    M_KVCACHE_PAGES_USED,
+    M_KVCACHE_OCCUPANCY,
+    M_KVCACHE_ACTIVE_SEQS,
+    M_KVCACHE_PAGE_SIZE,
 )
 
 
@@ -434,6 +466,48 @@ def record_autotune_decision(decision) -> None:
 
 
 # ---------------------------------------------------------------------------
+# serving subsystem (serving/)
+# ---------------------------------------------------------------------------
+
+
+def record_decode_step(
+    *, batch_size: int, num_splits: int, max_seq_len: int
+) -> None:
+    """One continuous-batching decode step (``serving/engine.py``):
+    counts steps/tokens and keeps the latest batch geometry — the
+    resolved split count is what the split-KV kernel actually ran."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_DECODE_STEPS)
+    reg.counter_inc(M_DECODE_TOKENS, batch_size)
+    reg.gauge_set(M_DECODE_BATCH, int(batch_size))
+    reg.gauge_set(M_DECODE_SPLITS, int(num_splits))
+    reg.gauge_set(M_DECODE_MAX_SEQ_LEN, int(max_seq_len))
+
+
+def record_prefill(num_tokens: int) -> None:
+    """One prefill written into the paged cache (``prefill_into_cache``
+    via the engine)."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_PREFILL_TOKENS, int(num_tokens))
+
+
+def record_kvcache_state(occupancy: dict) -> None:
+    """Page-pool occupancy after an admission/growth/free event
+    (``serving/kv_cache.PageAllocator.occupancy`` payload)."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.gauge_set(M_KVCACHE_PAGES_TOTAL, int(occupancy["pages_total"]))
+    reg.gauge_set(M_KVCACHE_PAGES_USED, int(occupancy["pages_in_use"]))
+    reg.gauge_set(M_KVCACHE_OCCUPANCY, float(occupancy["occupancy_ratio"]))
+    reg.gauge_set(M_KVCACHE_ACTIVE_SEQS, int(occupancy["active_seqs"]))
+    reg.gauge_set(M_KVCACHE_PAGE_SIZE, int(occupancy["page_size"]))
+
+
+# ---------------------------------------------------------------------------
 # summaries
 # ---------------------------------------------------------------------------
 
@@ -499,5 +573,22 @@ def telemetry_summary(snapshot: dict | None = None) -> str:
             f"  serial {fmt(g.get(M_TL_SERIAL_MS))} ms"
             f"  efficiency {fmt(g.get(M_TL_EFFICIENCY))}"
             f"  predicted {fmt(g.get(M_TL_PREDICTED_MS))} ms"
+        )
+    if c.get(M_DECODE_STEPS):
+        lines.append(
+            f"  decode: steps {fmt(c.get(M_DECODE_STEPS))}  "
+            f"tokens {fmt(c.get(M_DECODE_TOKENS))}  "
+            f"batch {fmt(g.get(M_DECODE_BATCH))}  "
+            f"splits {fmt(g.get(M_DECODE_SPLITS))}  "
+            f"max len {fmt(g.get(M_DECODE_MAX_SEQ_LEN))}"
+        )
+    if g.get(M_KVCACHE_PAGES_TOTAL) is not None:
+        lines.append(
+            f"  kv cache: {fmt(g.get(M_KVCACHE_PAGES_USED))}/"
+            f"{fmt(g.get(M_KVCACHE_PAGES_TOTAL))} pages "
+            f"({fmt(g.get(M_KVCACHE_OCCUPANCY))} occupancy)  "
+            f"active seqs {fmt(g.get(M_KVCACHE_ACTIVE_SEQS))}  "
+            f"page size {fmt(g.get(M_KVCACHE_PAGE_SIZE))}  "
+            f"prefill tokens {fmt(c.get(M_PREFILL_TOKENS, 0))}"
         )
     return "\n".join(lines)

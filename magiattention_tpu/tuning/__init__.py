@@ -34,6 +34,7 @@ from .autotuner import (  # noqa: F401
     TuningDecision,
     resolve_block_config,
     select_block_config,
+    select_decode_splits,
 )
 from .cache import (  # noqa: F401
     TuningCache,
@@ -47,21 +48,26 @@ from .cost_model import (  # noqa: F401
     rank_candidates,
 )
 from .fingerprint import (  # noqa: F401
+    DecodeFingerprint,
     WorkloadFingerprint,
+    make_decode_fingerprint,
     make_fingerprint,
 )
 
 __all__ = [
     "CandidateScore",
+    "DecodeFingerprint",
     "TuningCache",
     "TuningDecision",
     "TuningRecord",
     "WorkloadFingerprint",
     "estimate_entries",
     "get_tuning_cache",
+    "make_decode_fingerprint",
     "make_fingerprint",
     "rank_candidates",
     "reset_tuning_cache",
     "resolve_block_config",
     "select_block_config",
+    "select_decode_splits",
 ]

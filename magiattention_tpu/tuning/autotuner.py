@@ -20,6 +20,7 @@ which rung each workload chose and why (``docs/observability.md``).
 from __future__ import annotations
 
 import dataclasses
+import math
 
 from .cache import TuningRecord, get_tuning_cache
 from .cost_model import any_feasible_rung, rank_candidates, smem_feasible
@@ -276,6 +277,132 @@ def _record(decision: TuningDecision) -> None:
     from .. import telemetry
 
     telemetry.record_autotune_decision(decision)
+
+
+# chips with a megacore pair run the decode grid's "parallel" dimensions
+# on two tensorcores; single-core chips gain nothing from extra splits
+_MEGACORE_GENERATIONS = {"v4": 2, "v5p": 2}
+# a merge level is a fused elementwise map over [batch, hq, d] — cheap,
+# but not free; priced per level of the log-depth tree
+_DECODE_MERGE_LEVEL_US = 3.0
+
+
+def select_decode_splits(
+    batch: int,
+    max_pages_per_seq: int,
+    page_size: int,
+    hq: int,
+    hk: int,
+    *,
+    head_dim: int = 128,
+    dtype: str = "bfloat16",
+) -> TuningDecision:
+    """Resolve the split-KV decode split count (the ``decode``
+    fingerprint kind; ISSUE 4).
+
+    Decode is KV-bandwidth-bound (q_len = 1: every cached K/V byte is
+    read once per step while the MXU sees a rank-1 product), so the
+    model prices candidates as::
+
+        time(s) = kv_bytes / (hbm_bw * min(batch * s, cores) / cores)
+                + log2(s) * merge_level_cost
+
+    i.e. splits only help until the grid's parallel dimensions cover the
+    chip's tensorcore count (megacore pairs on v4/v5p; v5e/v6e run the
+    sequential grid on one core and want s = 1 unless the batch is
+    degenerate), and every extra split level costs one LSE-merge map.
+    Candidates are the divisors of ``max_pages_per_seq`` (a split is a
+    whole number of pages), capped at 16. The winner is cached in the
+    shared tuning cache under the decode fingerprint with the record
+    convention ``block_q = 1, block_k = pages per split, head_block =
+    NUM SPLITS``. Consumers read the split count from ``head_block``,
+    NOT from ``mpp // block_k``: the fingerprint buckets
+    ``max_pages_per_seq`` (~9% log2 buckets), so a cache hit can serve a
+    record computed at a nearby mpp whose ``block_k`` neither divides
+    nor even fits the current geometry — the ratio-free split count
+    survives the aliasing, and the caller clamps it to a divisor.
+    """
+    from .. import env, telemetry
+    from ..utils.cost import TPU_PEAK_SPECS
+    from .fingerprint import make_decode_fingerprint
+
+    mpp = max(int(max_pages_per_seq), 1)
+    fp = make_decode_fingerprint(
+        batch, mpp, page_size, hq, hk, head_dim=head_dim, dtype=dtype
+    )
+    cache = get_tuning_cache()
+    rec, layer = cache.get(fp)
+    if rec is not None:
+        telemetry.record_autotune_cache(hit=True, layer=layer)
+        decision = TuningDecision(
+            block_q=rec.block_q,
+            block_k=rec.block_k,
+            head_block=rec.head_block,
+            source=rec.source,
+            cache_layer=layer,
+            fingerprint_hash=fp.stable_hash(),
+            predicted_ms=rec.predicted_ms,
+            measured_ms=rec.measured_ms,
+            reason=f"decode tuning-cache {layer} hit ({rec.source} winner)",
+        )
+        _record(decision)
+        return decision
+    telemetry.record_autotune_cache(hit=False, layer="miss")
+
+    gen = env.tpu_generation()
+    cores = _MEGACORE_GENERATIONS.get(gen, 1)
+    spec = TPU_PEAK_SPECS.get(gen)
+    hbm_gbps = spec.hbm_gbps if spec else 819.0
+    bytes_per_elt = 2 if "16" in str(dtype) else 4
+    kv_bytes = (
+        2 * batch * mpp * page_size * hk * head_dim * bytes_per_elt
+    )
+    candidates = sorted(
+        s for s in range(1, min(mpp, 16) + 1) if mpp % s == 0
+    )
+    scored = []
+    for s in candidates:
+        speedup = min(max(batch, 1) * s, cores) / cores
+        read_s = kv_bytes / (hbm_gbps * 1e9 * max(speedup, 1e-9))
+        merge_s = math.log2(s) * _DECODE_MERGE_LEVEL_US * 1e-6 if s > 1 else 0.0
+        scored.append((read_s + merge_s, s))
+    scored.sort()
+    best_cost, best_s = scored[0]
+    pages_per_split = mpp // best_s
+    rec = TuningRecord(
+        block_q=1,
+        block_k=pages_per_split,
+        head_block=best_s,  # the split count (see docstring convention)
+        source="model",
+        predicted_ms=best_cost * 1e3,
+        measured_ms=None,
+        candidates=tuple(
+            {
+                "num_splits": s,
+                "pages_per_split": mpp // s,
+                "cost_seconds": c,
+                "feasible": True,
+            }
+            for c, s in scored
+        ),
+    )
+    cache.put(fp, rec)
+    decision = TuningDecision(
+        block_q=1,
+        block_k=pages_per_split,
+        head_block=best_s,
+        source="model",
+        cache_layer="none",
+        fingerprint_hash=fp.stable_hash(),
+        predicted_ms=rec.predicted_ms,
+        measured_ms=None,
+        reason=(
+            f"decode model: {best_s} split(s) x {pages_per_split} pages "
+            f"(~{best_cost * 1e3:.3f} ms, {cores} core(s), batch {batch})"
+        ),
+    )
+    _record(decision)
+    return decision
 
 
 def resolve_block_config(

@@ -125,6 +125,73 @@ _FP_MEMO: dict = {}
 _FP_MEMO_CAP = 512
 
 
+@dataclasses.dataclass(frozen=True)
+class DecodeFingerprint:
+    """Workload identity for the ``decode`` tuning kind (ISSUE 4).
+
+    Split-KV decode has no mask-slice statistics — its shape is fully
+    described by (batch, page geometry, head config, dtype). Buckets
+    follow the same log2 quantization as the flex fingerprint so jittery
+    continuous-batching batch sizes share an entry. The ``kind`` field
+    keeps decode records disjoint from flex records in the shared tuning
+    cache (the file key is the stable hash of the WHOLE payload,
+    ``kind`` included).
+    """
+
+    kind: str
+    version: int
+    generation: str
+    backend: str  # kernel backend @ jax platform (same rule as flex)
+    batch_bucket: int  # log2 bucket of the decode batch size
+    num_heads_q: int
+    num_heads_kv: int
+    head_dim: int
+    dtype: str
+    page_size: int
+    max_pages_bucket: int  # log2 bucket of max_pages_per_seq
+
+    DECODE_FINGERPRINT_VERSION = 1
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def stable_hash(self) -> str:
+        payload = json.dumps(
+            self.as_dict(), sort_keys=True, separators=(",", ":")
+        )
+        return hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+
+def make_decode_fingerprint(
+    batch: int,
+    max_pages_per_seq: int,
+    page_size: int,
+    hq: int,
+    hk: int,
+    *,
+    head_dim: int = 128,
+    dtype: str = "bfloat16",
+) -> DecodeFingerprint:
+    """Derive the decode-kind fingerprint (host-side integers only)."""
+    import jax
+
+    from .. import env
+
+    return DecodeFingerprint(
+        kind="decode",
+        version=DecodeFingerprint.DECODE_FINGERPRINT_VERSION,
+        generation=env.tpu_generation(),
+        backend=f"{env.kernel_backend()}@{jax.default_backend()}",
+        batch_bucket=_log2_bucket(batch),
+        num_heads_q=int(hq),
+        num_heads_kv=int(hk),
+        head_dim=int(head_dim),
+        dtype=str(dtype),
+        page_size=int(page_size),
+        max_pages_bucket=_log2_bucket(max_pages_per_seq),
+    )
+
+
 def _make_fingerprint_impl(
     q,
     k,

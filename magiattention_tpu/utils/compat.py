@@ -35,3 +35,19 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
         out_specs=out_specs,
         check_rep=check_vma,
     )
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` where available, else the pre-rename
+    ``pltpu.TPUCompilerParams`` (identical fields — jax renamed the
+    dataclass without changing its schema). Lets kernels written against
+    current jax run — at least in interpret mode — on old-jax bring-up
+    images: the flex-attention kernels and the serving decode kernel
+    both launch through this, which is what keeps their test suites
+    green on images predating the rename."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -1,0 +1,157 @@
+"""Property-style regression: the all--inf LSE corner is NaN-free under
+jit (ISSUE 4 satellite).
+
+Zero-coverage partials are routine in paged decode (a sequence occupying
+a prefix of its last page leaves later splits empty; an empty CP rank
+contributes nothing), and a kernel that normalizes an empty accumulator
+by a zero denominator emits 0/0 = NaN payload rows next to lse = -inf.
+The merge layer (``safe_lse_merge`` / ``correct_attn_out``) must absorb
+all of that: values stay NaN-free, uncovered rows merge as exact no-ops,
+and gradients through the -inf corner are zero, not NaN — primal, vjp
+and jvp, under jit, across dtypes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.ops.correction import (
+    correct_attn_out,
+    correct_attn_out_lse,
+    safe_lse_merge,
+)
+
+NINF = float("-inf")
+
+
+def _random_case(rng, t=16, h=3, d=8, p_uncovered=0.4, garbage=True):
+    """One random partial pair with random -inf coverage patterns and
+    (optionally) garbage payloads on uncovered rows."""
+    lse1 = rng.standard_normal((t, h)).astype(np.float32)
+    lse2 = rng.standard_normal((t, h)).astype(np.float32)
+    out1 = rng.standard_normal((t, h, d)).astype(np.float32)
+    out2 = rng.standard_normal((t, h, d)).astype(np.float32)
+    m1 = rng.random((t, h)) < p_uncovered
+    m2 = rng.random((t, h)) < p_uncovered
+    lse1[m1] = NINF
+    lse2[m2] = NINF
+    if garbage:
+        # uncovered payloads are whatever the kernel left: NaN and inf
+        out1[m1] = np.nan
+        out2[m2] = np.inf
+    else:
+        out1[m1] = 0.0
+        out2[m2] = 0.0
+    return (
+        jnp.asarray(out1), jnp.asarray(lse1),
+        jnp.asarray(out2), jnp.asarray(lse2),
+        m1, m2,
+    )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_merge_nanfree_and_matches_masked_reference(seed):
+    rng = np.random.default_rng(seed)
+    o1, l1, o2, l2, m1, m2 = _random_case(rng)
+    out, lse = jax.jit(correct_attn_out_lse)(o1, l1, o2, l2)
+    out, lse = np.asarray(out), np.asarray(lse)
+    assert not np.isnan(out).any(), "NaN leaked through uncovered payload"
+    assert not np.isinf(out).any(), "inf leaked through uncovered payload"
+    assert not np.isnan(lse).any()
+
+    # reference in f64 with explicit masking
+    l1n, l2n = np.asarray(l1, np.float64), np.asarray(l2, np.float64)
+    ref_lse = np.logaddexp(l1n, l2n)
+    both = m1 & m2
+    only1, only2 = (~m1) & m2, m1 & (~m2)  # mask = uncovered
+    o1n = np.where(m1[..., None], 0.0, np.asarray(o1, np.float64))
+    o2n = np.where(m2[..., None], 0.0, np.asarray(o2, np.float64))
+    safe = np.where(np.isneginf(ref_lse), 0.0, ref_lse)
+    w1 = np.where(m1, 0.0, np.exp(l1n - safe, where=~m1))
+    w2 = np.where(m2, 0.0, np.exp(l2n - safe, where=~m2))
+    ref_out = w1[..., None] * o1n + w2[..., None] * o2n
+
+    np.testing.assert_array_equal(np.isneginf(lse), both)
+    fin = ~both
+    np.testing.assert_allclose(lse[fin], ref_lse[fin], atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(out, ref_out, atol=1e-5, rtol=1e-5)
+    # one-sided rows pass the covered side through exactly
+    np.testing.assert_allclose(
+        out[only1], o1n[only1], atol=1e-6, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        out[only2], o2n[only2], atol=1e-6, rtol=1e-6
+    )
+    np.testing.assert_array_equal(out[both], 0.0)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_gradients_through_neginf_corner_are_finite(seed):
+    """vjp AND jvp of the merge stay NaN-free with -inf rows present
+    (garbage payloads excluded — AD through NaN payloads is GIGO)."""
+    rng = np.random.default_rng(100 + seed)
+    o1, l1, o2, l2, m1, m2 = _random_case(rng, garbage=False)
+
+    def merged_sum(o1, l1, o2, l2):
+        out, lse = correct_attn_out_lse(o1, l1, o2, l2)
+        return out.sum() + jnp.where(jnp.isneginf(lse), 0.0, lse).sum()
+
+    grads = jax.jit(jax.grad(merged_sum, argnums=(0, 1, 2, 3)))(
+        o1, l1, o2, l2
+    )
+    for name, g in zip(["dout1", "dlse1", "dout2", "dlse2"], grads):
+        ga = np.asarray(g)
+        assert np.isfinite(ga).all(), f"{name} has NaN/inf"
+    # uncovered rows must receive exactly zero gradient
+    np.testing.assert_array_equal(np.asarray(grads[1])[m1], 0.0)
+    np.testing.assert_array_equal(np.asarray(grads[3])[m2], 0.0)
+
+    tangents = tuple(jnp.ones_like(x) for x in (o1, l1, o2, l2))
+    _, jvp_val = jax.jvp(merged_sum, (o1, l1, o2, l2), tangents)
+    assert np.isfinite(np.asarray(jvp_val)), "jvp produced NaN/inf"
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.float16])
+def test_all_neginf_rows_stay_neginf_across_dtypes(dtype):
+    l1 = jnp.full((4, 2), NINF, dtype)
+    l2 = jnp.full((4, 2), NINF, dtype)
+    merged = jax.jit(safe_lse_merge)(l1, l2)
+    assert np.all(np.isneginf(np.asarray(merged, np.float32)))
+    o = jnp.full((4, 2, 8), jnp.nan, dtype)
+    out = jax.jit(correct_attn_out)(o, l1, o, l2, merged)
+    np.testing.assert_array_equal(np.asarray(out, np.float32), 0.0)
+
+
+def test_chained_merges_stay_nanfree():
+    """A log-depth tree over many partials — most uncovered — never
+    produces a NaN at any level (the split-KV merge shape)."""
+    rng = np.random.default_rng(7)
+    partials = []
+    for i in range(8):
+        o = jnp.asarray(rng.standard_normal((4, 2, 8)), jnp.float32)
+        lse = jnp.asarray(rng.standard_normal((4, 2)), jnp.float32)
+        if i != 3:  # only split 3 covers anything
+            o = jnp.full_like(o, jnp.nan)
+            lse = jnp.full_like(lse, NINF)
+        partials.append((o, lse))
+
+    def tree(parts):
+        while len(parts) > 1:
+            nxt = []
+            for j in range(0, len(parts), 2):
+                o, lse = correct_attn_out_lse(
+                    parts[j][0], parts[j][1],
+                    parts[j + 1][0], parts[j + 1][1],
+                )
+                nxt.append((o, lse))
+            parts = nxt
+        return parts[0]
+
+    out, lse = jax.jit(lambda p: tree(p))(partials)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(partials[3][0]), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(lse), np.asarray(partials[3][1]), atol=1e-6
+    )

@@ -1,0 +1,150 @@
+"""CP decode: cross-rank LSE-merge parity on the virtual CPU mesh.
+
+Each rank holds a contiguous shard of a sequence's KV history in its
+local paged cache; the merged decode output must equal dense attention
+over the full history. cp=1 must be pure local (no collective traced).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from magiattention_tpu.serving import (
+    assign_block_table,
+    cp_decode_attn,
+    cp_merge_partials,
+    make_paged_kv_cache,
+    reset_slot,
+    write_prefill_kv,
+)
+from magiattention_tpu.testing import assert_close
+from magiattention_tpu.utils.compat import shard_map
+
+D, HK, HQ = 32, 2, 4
+
+
+def _mesh(cp):
+    return Mesh(np.array(jax.devices()[:cp]), ("cp",))
+
+
+def _stack_caches(caches):
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *caches)
+
+
+def _dense_ref(q, k, v):
+    group = HQ // HK
+    kf = jnp.repeat(k.astype(jnp.float64), group, axis=1)
+    vf = jnp.repeat(v.astype(jnp.float64), group, axis=1)
+    z = jnp.einsum("bhd,thd->bht", q.astype(jnp.float64), kf) / math.sqrt(D)
+    return jnp.einsum("bht,thd->bhd", jax.nn.softmax(z, axis=-1), vf)
+
+
+def _rank_cache(k_shard, v_shard, ps=16, mpp=4):
+    c = make_paged_kv_cache(
+        8, ps, HK, D, max_seqs=2, max_pages_per_seq=mpp, dtype=jnp.float32
+    )
+    c = assign_block_table(c, 0, [1, 2, 3, 4][:mpp])
+    return write_prefill_kv(c, 0, k_shard, v_shard)
+
+
+@pytest.mark.parametrize("cp", [1, 2])
+@pytest.mark.parametrize("num_splits", [1, 2])
+def test_cp_decode_matches_global_dense(cp, num_splits, monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    rng = np.random.default_rng(31)
+    T = 64 * cp
+    kg = jnp.asarray(rng.standard_normal((T, HK, D)), jnp.float32)
+    vg = jnp.asarray(rng.standard_normal((T, HK, D)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((1, HQ, D)), jnp.float32)
+    shard = T // cp
+    caches = [
+        _rank_cache(kg[r * shard : (r + 1) * shard],
+                    vg[r * shard : (r + 1) * shard])
+        for r in range(cp)
+    ]
+    ref = _dense_ref(q, kg, vg)
+
+    if cp == 1:
+        out, _ = cp_decode_attn(
+            q, caches[0], jnp.array([0]), axis_name="cp", cp_size=1,
+            num_splits=num_splits,
+        )
+    else:
+        mesh = _mesh(cp)
+
+        def step(cache, q):
+            cache = jax.tree_util.tree_map(lambda x: x[0], cache)
+            return cp_decode_attn(
+                q, cache, jnp.array([0]), axis_name="cp", cp_size=cp,
+                num_splits=num_splits,
+            )
+
+        f = shard_map(
+            step, mesh=mesh, in_specs=(P("cp"), P()), out_specs=P(),
+            check_vma=False,
+        )
+        out, _ = jax.jit(f)(_stack_caches(caches), q)
+    assert_close(out[0], ref[0], atol=1e-5, rtol=1e-5,
+                 msg=f"cp{cp} s{num_splits}")
+
+
+def test_cp_decode_uneven_shards_and_empty_rank(monkeypatch):
+    """Rank 1 holds NOTHING for the sequence (slot length 0): its
+    (0, -inf) partial must drop out of the merge exactly."""
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    rng = np.random.default_rng(37)
+    T = 48
+    kg = jnp.asarray(rng.standard_normal((T, HK, D)), jnp.float32)
+    vg = jnp.asarray(rng.standard_normal((T, HK, D)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((1, HQ, D)), jnp.float32)
+    c0 = _rank_cache(kg, vg)
+    c1 = reset_slot(_rank_cache(kg, vg), 0)  # stale pages, zero length
+    mesh = _mesh(2)
+
+    def step(cache, q):
+        cache = jax.tree_util.tree_map(lambda x: x[0], cache)
+        return cp_decode_attn(
+            q, cache, jnp.array([0]), axis_name="cp", cp_size=2,
+            num_splits=2,
+        )
+
+    f = shard_map(step, mesh=mesh, in_specs=(P("cp"), P()),
+                  out_specs=P(), check_vma=False)
+    out, lse = jax.jit(f)(_stack_caches([c0, c1]), q)
+    ref = _dense_ref(q, kg, vg)
+    assert np.all(np.isfinite(np.asarray(out)))
+    assert_close(out[0], ref[0], atol=1e-5, rtol=1e-5, msg="empty-rank cp")
+
+
+def test_cp_merge_partials_tree_equals_two_rank_formula():
+    """The tree reduce is the two-partial correction formula at cp=2 and
+    stays finite when one rank is fully uncovered."""
+    rng = np.random.default_rng(41)
+    b = 3
+    mesh = _mesh(2)
+    o = jnp.asarray(rng.standard_normal((2, b, HQ, D)), jnp.float32)
+    l = jnp.asarray(rng.standard_normal((2, b, HQ)), jnp.float32)
+    l = l.at[1, 0].set(-jnp.inf)  # rank 1 uncovered for sequence 0
+    o = o.at[1, 0].set(jnp.nan)  # ...with a garbage payload
+
+    def merge(o_r, l_r):
+        return cp_merge_partials(
+            o_r[0], l_r[0], axis_name="cp", cp_size=2
+        )
+
+    f = shard_map(merge, mesh=mesh, in_specs=(P("cp"), P("cp")),
+                  out_specs=P(), check_vma=False)
+    out, lse = jax.jit(f)(o, l)
+    from magiattention_tpu.ops.correction import correct_attn_out_lse
+
+    ref_o, ref_l = correct_attn_out_lse(
+        jnp.where(jnp.isnan(o[0]), 0.0, o[0]), l[0],
+        jnp.where(jnp.isnan(o[1]), 0.0, o[1]), l[1],
+    )
+    assert np.all(np.isfinite(np.asarray(out)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_o), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_l), atol=1e-6)

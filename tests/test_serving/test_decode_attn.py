@@ -1,0 +1,214 @@
+"""Decode parity (ISSUE 4 acceptance): split-KV paged decode matches the
+last-token output of the prefill flex-attention reference on causal
+masks, across page sizes, split counts, backends, GQA configs and ragged
+batches — within the tolerances of ``testing/precision.py``.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.serving import (
+    assign_block_table,
+    decode_attn_paged,
+    make_paged_kv_cache,
+    merge_split_partials,
+    resolve_num_splits,
+    write_prefill_kv,
+)
+from magiattention_tpu.testing import assert_close
+
+D = 32
+
+
+def _dense_ref(q, k, v, scale=None):
+    """Single-token dense decode oracle in f64 (x64 is on in tests)."""
+    hq, hk = q.shape[1], k.shape[1]
+    group = hq // hk
+    kf = jnp.repeat(k.astype(jnp.float64), group, axis=1)
+    vf = jnp.repeat(v.astype(jnp.float64), group, axis=1)
+    scale = scale or 1.0 / math.sqrt(q.shape[-1])
+    z = jnp.einsum("bhd,thd->bht", q.astype(jnp.float64), kf) * scale
+    p = jax.nn.softmax(z, axis=-1)
+    out = jnp.einsum("bht,thd->bhd", p, vf)
+    lse = jax.scipy.special.logsumexp(z, axis=-1)
+    return out, lse
+
+
+def _build_cache(rng, lengths, page_size, mpp, hk=2, dtype=jnp.float32):
+    cache = make_paged_kv_cache(
+        len(lengths) * mpp + 2, page_size, hk, D,
+        max_seqs=len(lengths), max_pages_per_seq=mpp, dtype=dtype,
+    )
+    ks, vs = [], []
+    next_page = 1  # leave page 0 unreferenced (the dead-page default)
+    for slot, t in enumerate(lengths):
+        pages = list(range(next_page, next_page + mpp))
+        next_page += mpp
+        cache = assign_block_table(cache, slot, pages)
+        k = jnp.asarray(rng.standard_normal((t, hk, D)), dtype)
+        v = jnp.asarray(rng.standard_normal((t, hk, D)), dtype)
+        cache = write_prefill_kv(cache, slot, k, v)
+        ks.append(k)
+        vs.append(v)
+    return cache, ks, vs
+
+
+@pytest.mark.parametrize("backend", ["jnp", "pallas"])
+@pytest.mark.parametrize("page_size", [8, 16, 64])
+@pytest.mark.parametrize("num_splits", [1, 2, 4])
+def test_decode_matches_dense_oracle(
+    backend, page_size, num_splits, monkeypatch
+):
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", backend)
+    rng = np.random.default_rng(7)
+    mpp = 4
+    # ragged: one mid-page length, one page-aligned, one single token
+    lengths = [3 * page_size - page_size // 2, 2 * page_size, 1]
+    cache, ks, vs = _build_cache(rng, lengths, page_size, mpp)
+    q = jnp.asarray(rng.standard_normal((3, 4, D)), jnp.float32)
+    out, lse = decode_attn_paged(
+        q, cache, jnp.arange(3), num_splits=num_splits
+    )
+    for b, t in enumerate(lengths):
+        ref_o, ref_l = _dense_ref(q[b : b + 1], ks[b][:t], vs[b][:t])
+        assert_close(out[b], ref_o[0], atol=1e-5, rtol=1e-5,
+                     msg=f"{backend} ps{page_size} s{num_splits} seq{b} out")
+        assert_close(lse[b], ref_l[0], atol=1e-5, rtol=1e-5,
+                     msg=f"{backend} seq{b} lse")
+
+
+@pytest.mark.parametrize("backend", ["jnp", "pallas"])
+def test_decode_matches_prefill_last_token(backend, monkeypatch):
+    """The acceptance wording: decode over the paged cache equals the
+    last row of the prefill flex-attention reference (causal mask)."""
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    from magiattention_tpu.ops import flex_flash_attn_func
+
+    rng = np.random.default_rng(11)
+    t, hq, hk = 75, 4, 2
+    q_all = jnp.asarray(rng.standard_normal((t, hq, D)), jnp.float32)
+    k_all = jnp.asarray(rng.standard_normal((t, hk, D)), jnp.float32)
+    v_all = jnp.asarray(rng.standard_normal((t, hk, D)), jnp.float32)
+    ref_out, ref_lse = flex_flash_attn_func(
+        q_all, k_all, v_all, [(0, t)], [(0, t)], [1]  # CAUSAL
+    )
+
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", backend)
+    ps, mpp = 16, 8
+    cache = make_paged_kv_cache(
+        16, ps, hk, D, max_seqs=2, max_pages_per_seq=mpp,
+        dtype=jnp.float32,
+    )
+    cache = assign_block_table(cache, 0, list(range(1, 1 + mpp)))
+    # history = everything INCLUDING the last token (causal decode reads
+    # its own position), query = the last token
+    cache = write_prefill_kv(cache, 0, k_all, v_all)
+    out, lse = decode_attn_paged(
+        q_all[-1][None], cache, jnp.array([0]), num_splits=2
+    )
+    assert_close(out[0], ref_out[-1], atol=1e-5, rtol=1e-5,
+                 msg=f"{backend} decode vs prefill out")
+    assert_close(lse[0], ref_lse[-1], atol=1e-5, rtol=1e-5,
+                 msg=f"{backend} decode vs prefill lse")
+
+
+@pytest.mark.parametrize("backend", ["jnp", "pallas"])
+def test_zero_length_sequence_is_uncovered(backend, monkeypatch):
+    """A slot with no stored tokens decodes to (0, -inf) — the NaN-free
+    zero-coverage convention, on both backends."""
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", backend)
+    rng = np.random.default_rng(13)
+    cache, _, _ = _build_cache(rng, [32, 1], 16, 4)
+    from magiattention_tpu.serving import reset_slot
+
+    cache = reset_slot(cache, 1)
+    q = jnp.asarray(rng.standard_normal((2, 4, D)), jnp.float32)
+    out, lse = decode_attn_paged(q, cache, jnp.arange(2), num_splits=2)
+    assert np.all(np.isfinite(np.asarray(out))), "NaN/inf in decode out"
+    np.testing.assert_array_equal(np.asarray(out[1]), 0.0)
+    assert np.all(np.isneginf(np.asarray(lse[1])))
+    assert np.all(np.isfinite(np.asarray(lse[0])))
+
+
+def test_softcap_and_scale_parity(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    rng = np.random.default_rng(17)
+    cache, ks, vs = _build_cache(rng, [40], 16, 4)
+    q = jnp.asarray(rng.standard_normal((1, 4, D)), jnp.float32)
+    softcap, scale = 30.0, 0.17
+    out, _ = decode_attn_paged(
+        q, cache, jnp.array([0]), num_splits=4, scale=scale,
+        softcap=softcap,
+    )
+    k, v = ks[0], vs[0]
+    kf = jnp.repeat(k.astype(jnp.float64), 2, axis=1)
+    vf = jnp.repeat(v.astype(jnp.float64), 2, axis=1)
+    z = jnp.einsum("bhd,thd->bht", q.astype(jnp.float64), kf) * scale
+    z = softcap * jnp.tanh(z / softcap)
+    ref = jnp.einsum("bht,thd->bhd", jax.nn.softmax(z, axis=-1), vf)
+    assert_close(out[0], ref[0], atol=1e-5, rtol=1e-5, msg="softcap out")
+
+
+def test_decode_jit_retrace_constant(monkeypatch):
+    """Growing sequence lengths re-use one traced decode program."""
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    rng = np.random.default_rng(19)
+    cache, _, _ = _build_cache(rng, [16, 16], 16, 4)
+    from magiattention_tpu.serving import append_kv
+
+    traces = []
+
+    @jax.jit
+    def step(q, cache, slots):
+        traces.append(None)
+        return decode_attn_paged(q, cache, slots, num_splits=2)
+
+    for _ in range(5):
+        q = jnp.asarray(rng.standard_normal((2, 4, D)), jnp.float32)
+        step(q, cache, jnp.arange(2))
+        kn = jnp.asarray(rng.standard_normal((2, 2, D)), jnp.float32)
+        cache = append_kv(cache, jnp.arange(2), kn, kn)
+    assert len(traces) == 1, f"decode re-traced {len(traces)} times"
+
+
+def test_resolve_num_splits_priority(monkeypatch):
+    rng = np.random.default_rng(23)
+    cache, _, _ = _build_cache(rng, [16], 16, 8)
+    # explicit argument wins and is clamped to a divisor of mpp
+    assert resolve_num_splits(3, cache, 1, 4) == 2
+    assert resolve_num_splits(8, cache, 1, 4) == 8
+    # env pin next
+    monkeypatch.setenv("MAGI_ATTENTION_DECODE_SPLITS", "4")
+    assert resolve_num_splits(None, cache, 1, 4) == 4
+    # autotuner fallback always returns a divisor
+    monkeypatch.delenv("MAGI_ATTENTION_DECODE_SPLITS", raising=False)
+    s = resolve_num_splits(None, cache, 1, 4)
+    assert s >= 1 and cache.max_pages_per_seq % s == 0
+
+
+def test_merge_split_partials_associativity():
+    """The tree merge equals a left fold (associativity of the LSE
+    merge) and ignores garbage payloads of uncovered partials."""
+    from magiattention_tpu.ops.correction import correct_attn_out_lse
+
+    rng = np.random.default_rng(29)
+    outs, lses = [], []
+    for i in range(5):
+        o = jnp.asarray(rng.standard_normal((3, 4, 8)), jnp.float32)
+        l = jnp.asarray(rng.standard_normal((3, 4)), jnp.float32)
+        if i == 2:  # an uncovered split with a NaN payload
+            o = jnp.full_like(o, jnp.nan)
+            l = jnp.full_like(l, -jnp.inf)
+        outs.append(o)
+        lses.append(l)
+    to, tl = merge_split_partials(list(outs), list(lses))
+    fo, fl = outs[0], lses[0]
+    for i in range(1, 5):
+        fo, fl = correct_attn_out_lse(fo, fl, outs[i], lses[i])
+    assert np.all(np.isfinite(np.asarray(to)))
+    np.testing.assert_allclose(np.asarray(to), np.asarray(fo), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(tl), np.asarray(fl), atol=1e-5)

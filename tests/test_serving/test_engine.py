@@ -1,0 +1,227 @@
+"""Serving engine: prefill -> decode round-trips through one cache,
+continuous batching across sequences, slot recycling, telemetry.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.serving import DecodeBatch, ServingEngine
+from magiattention_tpu.testing import assert_close
+
+D, HK, HQ = 32, 2, 4
+
+
+@pytest.fixture(autouse=True)
+def _jnp_backend(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+
+
+def _engine():
+    return ServingEngine(
+        num_pages=32, num_kv_heads=HK, head_dim=D, page_size=16,
+        max_seqs=4, max_pages_per_seq=8, dtype=jnp.float32,
+    )
+
+
+def _rand(rng, *shape):
+    return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+
+def test_prefill_decode_round_trip_matches_full_prefill():
+    """N decode steps after a prefill equal one prefill of the whole
+    extended sequence — the one-cache contract."""
+    from magiattention_tpu.ops import flex_flash_attn_func
+
+    rng = np.random.default_rng(43)
+    t0, steps = 30, 4
+    eng = _engine()
+    q_all = _rand(rng, t0 + steps, HQ, D)
+    k_all = _rand(rng, t0 + steps, HK, D)
+    v_all = _rand(rng, t0 + steps, HK, D)
+
+    slot = eng.admit(t0 + steps)
+    eng.prefill(q_all[:t0], k_all[:t0], v_all[:t0], slot)
+    decode_outs = []
+    for i in range(t0, t0 + steps):
+        out, _ = eng.decode_step(
+            q_all[i][None], k_all[i][None], v_all[i][None], [slot],
+            num_splits=2,
+        )
+        decode_outs.append(out[0])
+
+    ref_out, _ = flex_flash_attn_func(
+        q_all, k_all, v_all,
+        [(0, t0 + steps)], [(0, t0 + steps)], [1],
+    )
+    for j, got in enumerate(decode_outs):
+        assert_close(got, ref_out[t0 + j], atol=1e-5, rtol=1e-5,
+                     msg=f"decode step {j}")
+
+
+def test_continuous_batching_two_sequences():
+    """Two sequences of different lengths decode in one batched step and
+    each matches its own single-sequence result."""
+    rng = np.random.default_rng(47)
+    eng = _engine()
+    sa = eng.admit(40)
+    sb = eng.admit(40)
+    ka, va = _rand(rng, 25, HK, D), _rand(rng, 25, HK, D)
+    kb, vb = _rand(rng, 9, HK, D), _rand(rng, 9, HK, D)
+    eng.prefill(_rand(rng, 25, HQ, D), ka, va, sa)
+    eng.prefill(_rand(rng, 9, HQ, D), kb, vb, sb)
+
+    q = _rand(rng, 2, HQ, D)
+    kn, vn = _rand(rng, 2, HK, D), _rand(rng, 2, HK, D)
+    out, lse = eng.decode_step(q, kn, vn, [sa, sb], num_splits=2)
+
+    # singles: fresh engine per sequence
+    for idx, (kk, vv, t) in enumerate([(ka, va, 25), (kb, vb, 9)]):
+        e1 = _engine()
+        s = e1.admit(40)
+        e1.prefill(_rand(np.random.default_rng(0), t, HQ, D), kk, vv, s)
+        o1, _ = e1.decode_step(
+            q[idx][None], kn[idx][None], vn[idx][None], [s], num_splits=2
+        )
+        assert_close(out[idx], o1[0], atol=1e-6, rtol=1e-6,
+                     msg=f"batched vs single seq {idx}")
+
+
+def test_free_and_readmit_reuses_slot_cleanly():
+    rng = np.random.default_rng(53)
+    eng = _engine()
+    slot = eng.admit(32)
+    eng.prefill(_rand(rng, 32, HQ, D), _rand(rng, 32, HK, D),
+                _rand(rng, 32, HK, D), slot)
+    assert eng.occupancy()["active_seqs"] == 1
+    eng.free(slot)
+    assert eng.occupancy()["pages_in_use"] == 0
+    slot2 = eng.admit(16)
+    k2, v2 = _rand(rng, 10, HK, D), _rand(rng, 10, HK, D)
+    eng.prefill(_rand(rng, 10, HQ, D), k2, v2, slot2)
+    assert int(eng.cache.seq_lens[slot2]) == 10
+    # decode over the recycled slot sees only the new sequence
+    q = _rand(rng, 1, HQ, D)
+    kn, vn = _rand(rng, 1, HK, D), _rand(rng, 1, HK, D)
+    out, _ = eng.decode_step(q, kn, vn, [slot2], num_splits=1)
+    import math
+
+    kf = jnp.repeat(jnp.concatenate([k2, kn]), HQ // HK, axis=1)
+    vf = jnp.repeat(jnp.concatenate([v2, vn]), HQ // HK, axis=1)
+    z = jnp.einsum("bhd,thd->bht", q, kf) / math.sqrt(D)
+    import jax
+
+    ref = jnp.einsum("bht,thd->bhd", jax.nn.softmax(z, axis=-1), vf)
+    assert_close(out[0], ref[0], atol=1e-5, rtol=1e-5, msg="recycled slot")
+
+
+def test_engine_records_serving_telemetry():
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        rng = np.random.default_rng(59)
+        eng = _engine()
+        slot = eng.admit(20)
+        eng.prefill(_rand(rng, 20, HQ, D), _rand(rng, 20, HK, D),
+                    _rand(rng, 20, HK, D), slot)
+        eng.decode_step(_rand(rng, 1, HQ, D), _rand(rng, 1, HK, D),
+                        _rand(rng, 1, HK, D), [slot])
+        snap = telemetry.snapshot()
+
+        def has_series(snapshot, name):
+            return any(
+                key == name or key.startswith(name + "{")
+                for section in snapshot.values()
+                for key in section
+            )
+
+        missing = [
+            m for m in telemetry.REQUIRED_SERVING_METRICS
+            if not has_series(snap, m)
+        ]
+        assert not missing, f"serving catalog drift: {missing}"
+        assert snap["counters"]["magi_decode_steps_total"] == 1
+        assert snap["counters"]["magi_prefill_tokens_total"] == 20
+        assert snap["gauges"]["magi_kvcache_pages_in_use"] >= 2
+        summary = telemetry.telemetry_summary(snap)
+        assert "decode:" in summary and "kv cache:" in summary
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
+def test_decode_past_reservation_auto_extends_without_corruption():
+    """Regression: decoding past a slot's initial page reservation must
+    grow the reservation, NOT scatter onto page 0 — which belongs to the
+    first-admitted sequence (unreserved block-table entries read 0)."""
+    rng = np.random.default_rng(61)
+    ps = 16
+    eng = ServingEngine(
+        num_pages=16, num_kv_heads=HK, head_dim=D, page_size=ps,
+        max_seqs=4, max_pages_per_seq=8, dtype=jnp.float32,
+    )
+    # victim: the first admission owns page 0 (allocator pops low first)
+    victim = eng.admit(ps)
+    kv_v = _rand(rng, ps, HK, D)
+    eng.prefill(_rand(rng, ps, HQ, D), kv_v, kv_v, victim)
+    victim_page0 = np.asarray(eng.cache.k_pages[
+        int(eng.cache.block_tables[victim, 0])
+    ])
+    # grower: reserved for ps tokens, then decoded past two page
+    # boundaries
+    grower = eng.admit(ps)
+    kv_g = _rand(rng, ps - 2, HK, D)
+    eng.prefill(_rand(rng, ps - 2, HQ, D), kv_g, kv_g, grower)
+    appended = []
+    for _ in range(ps + 4):  # crosses into pages 2 and 3 of the slot
+        kn = _rand(rng, 1, HK, D)
+        appended.append(kn[0])
+        eng.decode_step(_rand(rng, 1, HQ, D), kn, kn, [grower],
+                        num_splits=1)
+    # victim's page is untouched
+    np.testing.assert_array_equal(
+        np.asarray(eng.cache.k_pages[
+            int(eng.cache.block_tables[victim, 0])
+        ]),
+        victim_page0,
+    )
+    # grower's history is complete and correct
+    from magiattention_tpu.serving import gather_kv
+
+    gk, _ = gather_kv(eng.cache, grower)
+    total = ps - 2 + len(appended)
+    assert int(eng.cache.seq_lens[grower]) == total
+    np.testing.assert_array_equal(
+        np.asarray(gk[:total]),
+        np.concatenate([np.asarray(kv_g), np.stack(appended)]),
+    )
+    assert eng.allocator.reserved_pages(grower) >= 3
+
+
+def test_prefill_telemetry_counts_valid_tokens_only():
+    """record_prefill must count the masked length, not padded rows."""
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        rng = np.random.default_rng(67)
+        eng = _engine()
+        slot = eng.admit(64)
+        eng.prefill(_rand(rng, 64, HQ, D), _rand(rng, 64, HK, D),
+                    _rand(rng, 64, HK, D), slot, length=20)
+        snap = telemetry.snapshot()
+        assert snap["counters"]["magi_prefill_tokens_total"] == 20
+        assert int(eng.cache.seq_lens[slot]) == 20
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
+def test_decode_batch_is_a_pytree():
+    import jax
+
+    b = DecodeBatch.of([2, 0, 1])
+    leaves, treedef = jax.tree_util.tree_flatten(b)
+    assert len(leaves) == 1 and b.batch_size == 3
+    b2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    np.testing.assert_array_equal(np.asarray(b2.slots), [2, 0, 1])

@@ -1,0 +1,190 @@
+"""Paged-cache invariants (ISSUE 4 satellite).
+
+The three contracts the serving layer stands on:
+
+1. append/gather round-trip: a sequence written token-by-token (or via
+   prefill) into pages reads back EXACTLY as the contiguous KV stream,
+   for random page sizes and lengths (including lengths that end inside
+   a page — the prefix-of-last-page case).
+2. block-table reuse: freeing a sequence returns its pages/slot, and a
+   newly admitted sequence reusing them never sees stale data.
+3. static tracing: growing a sequence changes array VALUES only — the
+   jitted append/decode programs re-trace exactly once regardless of
+   length.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.serving import (
+    PageAllocator,
+    append_kv,
+    assign_block_table,
+    gather_kv,
+    make_paged_kv_cache,
+    reset_slot,
+    write_prefill_kv,
+)
+
+HK, D = 2, 32
+
+
+def _mk(num_pages, ps, max_seqs=4, mpp=None):
+    return make_paged_kv_cache(
+        num_pages, ps, HK, D,
+        max_seqs=max_seqs,
+        max_pages_per_seq=mpp or (num_pages // max_seqs),
+        dtype=jnp.float32,
+    )
+
+
+@pytest.mark.parametrize("page_size", [8, 16, 48, 128])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_append_gather_round_trip_random_page_sizes(page_size, seed):
+    """Token-by-token appends reconstruct the contiguous stream for a
+    random length that usually ends mid-page."""
+    rng = np.random.default_rng(seed)
+    mpp = 4
+    cache = _mk(num_pages=16, ps=page_size, mpp=mpp)
+    pages = rng.permutation(16)[:mpp].tolist()
+    cache = assign_block_table(cache, 1, pages)
+    length = int(rng.integers(1, mpp * page_size + 1))
+    k = jnp.asarray(rng.standard_normal((length, HK, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((length, HK, D)), jnp.float32)
+    for i in range(length):
+        cache = append_kv(
+            cache, jnp.array([1]), k[i][None], v[i][None]
+        )
+    gk, gv = gather_kv(cache, 1)
+    assert int(cache.seq_lens[1]) == length
+    np.testing.assert_array_equal(np.asarray(gk[:length]), np.asarray(k))
+    np.testing.assert_array_equal(np.asarray(gv[:length]), np.asarray(v))
+    # rows past the true length are zeroed, not stale-page garbage
+    assert not np.any(np.asarray(gk[length:]))
+
+
+@pytest.mark.parametrize("page_size", [8, 32])
+def test_prefill_write_equals_appends(page_size):
+    """One masked prefill write == the same tokens appended one by one."""
+    rng = np.random.default_rng(3)
+    t_pad, length = 3 * page_size, 2 * page_size + page_size // 2
+    k = jnp.asarray(rng.standard_normal((t_pad, HK, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((t_pad, HK, D)), jnp.float32)
+
+    c1 = assign_block_table(_mk(16, page_size), 0, [4, 5, 6])
+    c1 = write_prefill_kv(c1, 0, k, v, length=length)
+    c2 = assign_block_table(_mk(16, page_size), 0, [4, 5, 6])
+    for i in range(length):
+        c2 = append_kv(c2, jnp.array([0]), k[i][None], v[i][None])
+    np.testing.assert_array_equal(
+        np.asarray(gather_kv(c1, 0)[0]), np.asarray(gather_kv(c2, 0)[0])
+    )
+    assert int(c1.seq_lens[0]) == int(c2.seq_lens[0]) == length
+
+
+def test_block_table_reuse_after_free():
+    """Allocator returns freed pages; a new sequence on recycled pages
+    reads only its own data."""
+    rng = np.random.default_rng(4)
+    ps = 16
+    alloc = PageAllocator(num_pages=8, page_size=ps, max_seqs=2,
+                          max_pages_per_seq=4)
+    cache = _mk(8, ps, max_seqs=2, mpp=4)
+
+    slot_a, pages_a = alloc.allocate(3 * ps)
+    cache = assign_block_table(cache, slot_a, pages_a)
+    ka = jnp.asarray(rng.standard_normal((3 * ps, HK, D)), jnp.float32)
+    cache = write_prefill_kv(cache, slot_a, ka, ka)
+    used_before = alloc.pages_in_use
+    alloc.free(slot_a)
+    cache = reset_slot(cache, slot_a)
+    assert alloc.pages_in_use == used_before - 3
+    assert int(cache.seq_lens[slot_a]) == 0
+
+    slot_b, pages_b = alloc.allocate(2 * ps)
+    assert set(pages_b) <= set(pages_a)  # pages actually recycled
+    cache = assign_block_table(cache, slot_b, pages_b)
+    kb = jnp.asarray(rng.standard_normal((2 * ps, HK, D)), jnp.float32)
+    cache = write_prefill_kv(cache, slot_b, kb, kb)
+    gk, _ = gather_kv(cache, slot_b)
+    np.testing.assert_array_equal(np.asarray(gk[: 2 * ps]), np.asarray(kb))
+    assert not np.any(np.asarray(gk[2 * ps:]))  # no leak from seq A
+
+
+def test_allocator_occupancy_and_exhaustion():
+    alloc = PageAllocator(num_pages=4, page_size=8, max_seqs=4,
+                          max_pages_per_seq=4)
+    s0, _ = alloc.allocate(20)  # 3 pages
+    occ = alloc.occupancy()
+    assert occ["pages_in_use"] == 3 and occ["active_seqs"] == 1
+    assert occ["occupancy_ratio"] == pytest.approx(0.75)
+    assert not alloc.can_admit(16)  # 2 pages needed, 1 free
+    with pytest.raises(RuntimeError):
+        alloc.allocate(16)
+    alloc.free(s0)
+    assert alloc.occupancy()["pages_in_use"] == 0
+    assert alloc.can_admit(16)
+
+
+def test_allocator_extend_grows_reservation():
+    alloc = PageAllocator(num_pages=8, page_size=8, max_seqs=2,
+                          max_pages_per_seq=6)
+    slot, pages = alloc.allocate(8)
+    assert len(pages) == 1
+    full = alloc.extend(slot, 33)  # 5 pages
+    assert len(full) == 5 and full[:1] == pages
+    assert alloc.pages_in_use == 5
+
+
+def test_jit_retrace_constant_across_growing_lengths():
+    """The decode-step write must trace ONCE: growth is value-only."""
+    ps = 16
+    cache = assign_block_table(_mk(16, ps), 0, [1, 2, 3, 4])
+    traces = []
+
+    @jax.jit
+    def step(cache, slots, kn, vn):
+        traces.append(None)  # trace-time side effect
+        return append_kv(cache, slots, kn, vn)
+
+    rng = np.random.default_rng(5)
+    for i in range(3 * ps):  # crosses two page boundaries
+        kn = jnp.asarray(rng.standard_normal((1, HK, D)), jnp.float32)
+        cache = step(cache, jnp.array([0]), kn, kn)
+    assert len(traces) == 1, f"append_kv re-traced {len(traces)} times"
+    assert int(cache.seq_lens[0]) == 3 * ps
+
+    # gather at a fixed static max_len is one trace too
+    traces.clear()
+
+    @jax.jit
+    def read(cache):
+        traces.append(None)
+        return gather_kv(cache, 0)
+
+    for _ in range(4):
+        read(cache)
+        cache = append_kv(
+            cache, jnp.array([0]),
+            jnp.zeros((1, HK, D), jnp.float32),
+            jnp.zeros((1, HK, D), jnp.float32),
+        )
+    assert len(traces) == 1
+
+
+def test_full_slot_append_is_dropped_not_wrapped():
+    """Appending past max_seq_len must not corrupt page 0."""
+    ps = 8
+    cache = assign_block_table(_mk(8, ps, mpp=1), 0, [3])
+    k = jnp.ones((ps, HK, D), jnp.float32)
+    cache = write_prefill_kv(cache, 0, k, k)
+    page0_before = np.asarray(cache.k_pages[0])
+    cache = append_kv(
+        cache, jnp.array([0]),
+        jnp.full((1, HK, D), 7.0, jnp.float32),
+        jnp.full((1, HK, D), 7.0, jnp.float32),
+    )
+    assert int(cache.seq_lens[0]) == ps  # saturated, not grown
+    np.testing.assert_array_equal(np.asarray(cache.k_pages[0]), page0_before)
