@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check decode-bench check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check decode-bench comm-check check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -82,7 +82,15 @@ serving-check:
 decode-bench:
 	$(PY) exps/run_decode_bench.py
 
+# group-collective drift guard (CPU, virtual mesh): hops-vs-a2a parity
+# on a canonical skewed varlen plan (bit-identical cast recv buffer, no
+# all_to_all traced), >= 30% scheduled-volume reduction on the 16k
+# headline varlen plan, and auto-mode impl-choice sanity
+# (exps/run_comm_check.py exits non-zero on any violation)
+comm-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_comm_check.py
+
 # the default check flow: syntax, telemetry catalog + timeline/aggregate
-# semantics, autotuner rung expectations, perf gate, serving parity —
-# all CPU-safe
-check: lint telemetry-check autotune-check perf-gate serving-check
+# semantics, autotuner rung expectations, perf gate, serving parity,
+# group-collective parity/volume — all CPU-safe
+check: lint telemetry-check autotune-check perf-gate serving-check comm-check

@@ -232,6 +232,7 @@ def _telemetry_block() -> None:
         print(telemetry.telemetry_summary(snap), file=sys.stderr)
         print(f"telemetry snapshot -> {_TELEMETRY_OUT}", file=sys.stderr)
         _decode_summary_line()
+        _comm_summary_line()
     except Exception as e:  # observability must never take the bench down
         print(f"telemetry block failed: {e!r}", file=sys.stderr)
     finally:
@@ -269,6 +270,28 @@ def _decode_summary_line() -> None:
         )
     except Exception as e:
         print(f"decode probe failed: {e!r}", file=sys.stderr)
+
+
+def _comm_summary_line() -> None:
+    """Comm section of the bench summary (ISSUE 5): true vs scheduled
+    group-cast rows and the auto-chosen collective impl for the headline
+    varlen-heterogeneous plan (16k varlen-block-causal, cp=4). Host-side
+    planning only — works even when the TPU tunnel is wedged. Never
+    fatal."""
+    try:
+        from exps.run_comm_check import comm_probe
+
+        p = comm_probe()
+        print(
+            f"comm probe: 16k varlen cp={p['cp']}: impl {p['impl']} "
+            f"({p['impl_reason']}), true {p['true_rows_total']} rows, "
+            f"scheduled {p['scheduled_rows_per_rank']}/rank vs legacy "
+            f"padded {p['padded_rows_per_rank']}/rank "
+            f"(-{p['volume_reduction']:.1%})",
+            file=sys.stderr,
+        )
+    except Exception as e:
+        print(f"comm probe failed: {e!r}", file=sys.stderr)
 
 
 def _start_telemetry_subprocess():
@@ -631,6 +654,23 @@ def _measure_extras(dt_fwd_64k: float) -> dict:
             extras["jax_flash_best_tuned_blocks"] = list(best_cfg)
     except Exception as e:  # never lose sections 1-3 to the control
         print(f"extras: tuned-baseline control failed: {e!r}", file=sys.stderr)
+
+    # 5. comm-volume metric for the heterogeneous varlen plan (ISSUE 5):
+    #    legacy-padded / scheduled group-cast rows (higher = better), so
+    #    the perf gate catches scheduled-volume regressions like TF/s.
+    #    Host-side planning only; guarded like the control.
+    try:
+        from exps.run_comm_check import HEADLINE_METRIC, comm_probe
+
+        p = comm_probe()
+        extras[HEADLINE_METRIC] = p["volume_reduction_metric"]
+        print(
+            f"extras: comm volume reduction {p['volume_reduction_metric']}x "
+            f"(impl {p['impl']})",
+            file=sys.stderr,
+        )
+    except Exception as e:
+        print(f"extras: comm volume metric failed: {e!r}", file=sys.stderr)
     return extras
 
 

@@ -57,10 +57,15 @@ DEFAULT_EXPECTATIONS = os.path.join(_ROOT, baseline.EXPECTATIONS_RELPATH)
 
 
 def _gated_metric(name: str) -> bool:
-    """Gate our kernel/runtime throughput only: ``flex_attn_*`` TF/s.
-    Stock-kernel controls (``jax_flash_*``) and one-off bring-up metrics
-    stay in history for the record but never fail the gate."""
-    return name.startswith("flex_attn_") and "tflops" in name
+    """Gate our kernel/runtime metrics only: ``flex_attn_*`` TF/s plus
+    the group-collective scheduled-volume reduction ratio (ISSUE 5;
+    higher = better, like TF/s — a regression in scheduled comm volume
+    lowers it). Stock-kernel controls (``jax_flash_*``) and one-off
+    bring-up metrics stay in history for the record but never fail the
+    gate."""
+    return name.startswith("flex_attn_") and (
+        "tflops" in name or "comm_volume" in name
+    )
 
 
 def run_gate(history_path, expectations_path, tolerance, inject=0.0):

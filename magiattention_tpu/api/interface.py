@@ -99,6 +99,12 @@ def check_flag_comb(
             f"MAGI_ATTENTION_AUTOTUNE={env.autotune_mode()!r} is not one "
             f"of {AUTOTUNE_MODES}"
         )
+    if env.group_coll_impl() not in env.GROUP_COLL_IMPLS:
+        raise ValueError(
+            f"MAGI_ATTENTION_GROUP_COLL_IMPL={env.group_coll_impl()!r} is "
+            f"not one of {env.GROUP_COLL_IMPLS}"
+        )
+    env.comm_pad_to()  # raises on a non-power-of-two rung
     if hier_flag and not hier_axis:
         raise ValueError(
             "MAGI_ATTENTION_HIERARCHICAL_COMM=1 requires a 2-D "

@@ -2,9 +2,15 @@
 
 from .group_collective import (
     GroupCollectiveMeta,
+    HopPlan,
     group_cast,
+    group_cast_m,
     group_reduce_lse,
+    group_reduce_lse_m,
     group_reduce_sum,
+    group_reduce_sum_m,
+    hop_cast,
+    predicted_volume_ratio,
 )
 from .hier import HierGroupCollectiveMeta, group_cast_hier
 from .primitives import all2all_v, all_gather_v, scatter_v
@@ -12,11 +18,17 @@ from .primitives import all2all_v, all_gather_v, scatter_v
 __all__ = [
     "GroupCollectiveMeta",
     "HierGroupCollectiveMeta",
+    "HopPlan",
     "group_cast_hier",
     "all2all_v",
     "all_gather_v",
     "scatter_v",
     "group_cast",
+    "group_cast_m",
     "group_reduce_lse",
+    "group_reduce_lse_m",
     "group_reduce_sum",
+    "group_reduce_sum_m",
+    "hop_cast",
+    "predicted_volume_ratio",
 ]

@@ -25,12 +25,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .group_collective import GroupCollectiveMeta, group_cast
+from .group_collective import (
+    GroupCollectiveMeta,
+    HopPlan,
+    _hop_padded_sizes,
+    _resolve_impl,
+    _round_up_to,
+    group_cast,
+    hop_cast,
+)
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
 class HierGroupCollectiveMeta:
-    """Two-hop routing plan. Rank index = inter * n_intra + intra."""
+    """Two-hop routing plan. Rank index = inter * n_intra + intra.
+
+    The intra (fast-link) level composes with hop scheduling (ISSUE 5):
+    when the resolved impl is 'hops', the intra fan-out runs as
+    ``lax.ppermute`` hops over the intra axis, each padded only to that
+    hop's own max pair size — the inter level stays one padded a2a over
+    the slow link (one fused collective per DCN crossing)."""
 
     n_inter: int
     n_intra: int
@@ -46,17 +60,92 @@ class HierGroupCollectiveMeta:
     recv_total: tuple[int, ...]  # valid final rows per rank
     inter_rows_total: tuple[int, ...]  # hop-1 payload rows per rank (dedup'd)
     send_total: tuple[int, ...] = ()  # = inter_rows_total (diagnostics)
+    # intra-level hop schedule (leading axis = all n ranks; the hop world
+    # is the intra axis) — built when impl == 'hops'
+    pad_to: int = 8
+    impl: str = "a2a"
+    impl_reason: str = "legacy"
+    intra_hops: tuple[HopPlan, ...] = ()
+    intra_true_rows: int = 0  # real final-fan-out rows across the group
+    intra_local_rows: int = 0  # gateway-keeps-own rows, never on wire
 
     @property
     def max_recv(self) -> int:
         return int(self.intra_recv_sel.shape[1])
 
     @property
-    def comm_bytes_per_rank(self) -> int:
-        """Padded payload rows across both hops (volume accounting)."""
+    def padded_rows_per_rank(self) -> int:
+        """Legacy both-levels-globally-padded payload rows per rank."""
         return int(
             self.n_inter * self.inter_send_idx.shape[2]
             + self.n_intra * self.intra_send_idx.shape[2]
+        )
+
+    @property
+    def comm_bytes_per_rank(self) -> int:
+        """Back-compat alias of :attr:`padded_rows_per_rank`; prefer
+        :attr:`scheduled_rows_per_rank` (impl-aware) for pricing."""
+        return self.padded_rows_per_rank
+
+    @property
+    def scheduled_rows_per_rank(self) -> int:
+        """Rows per rank the selected impls schedule: the inter a2a's
+        padded buffer plus — per impl — the intra a2a's padded buffer or
+        the sum of wire-crossing intra hop sizes."""
+        inter = self.n_inter * int(self.inter_send_idx.shape[2])
+        if self.impl == "hops":
+            intra = sum(
+                h.size for h in self.intra_hops
+                if h.shift % self.n_intra != 0
+            )
+        else:
+            intra = self.n_intra * int(self.intra_send_idx.shape[2])
+        return inter + intra
+
+    @property
+    def true_rows_total(self) -> int:
+        """Real routed rows across the group, both levels (dedup'd inter
+        unions + final intra fan-out)."""
+        return sum(self.inter_rows_total) + self.intra_true_rows
+
+    @property
+    def scheduled_rows_total(self) -> int:
+        return self.n_inter * self.n_intra * self.scheduled_rows_per_rank
+
+    @property
+    def padding_overhead_ratio(self) -> float:
+        """Scheduled rows / true rows on the scheduled pairs (hop-
+        scheduled intra levels move gateway-keeps-own rows by local
+        copy, so those leave the base — see the flat meta's docstring)."""
+        t = self.true_rows_total
+        if self.impl == "hops":
+            t -= self.intra_local_rows
+        return (self.scheduled_rows_total / t) if t else 0.0
+
+    def cast_device_arrays(self) -> tuple[np.ndarray, ...]:
+        """Flattened numpy routing arrays for one hierarchical cast —
+        inter level first (always the 3 a2a arrays), then the intra
+        level in its impl's layout."""
+        inter = (
+            self.inter_send_idx,
+            self.inter_recv_sel,
+            self.inter_recv_valid,
+        )
+        if self.impl == "hops":
+            intra: list[np.ndarray] = []
+            for h in self.intra_hops:
+                intra += [h.send_idx, h.recv_pos]
+            return inter + tuple(intra)
+        return inter + (
+            self.intra_send_idx,
+            self.intra_recv_sel,
+            self.intra_recv_valid,
+        )
+
+    @property
+    def num_cast_arrays(self) -> int:
+        return 3 + (
+            2 * len(self.intra_hops) if self.impl == "hops" else 3
         )
 
     def device_arrays(self):
@@ -105,7 +194,8 @@ class HierGroupCollectiveMeta:
         num_local_rows: list[int],
         n_inter: int,
         n_intra: int,
-        pad_to: int = 8,
+        pad_to: int | None = None,
+        impl: str | None = None,
     ) -> tuple["HierGroupCollectiveMeta", list[list[tuple[int, np.ndarray]]]]:
         """Build the two-hop plan.
 
@@ -113,7 +203,19 @@ class HierGroupCollectiveMeta:
         (src_rank, src_local_rows) in the FINAL receive order at rank d —
         what the planner needs to lay out runs (global ids =
         pos_ids[src][rows]).
+
+        ``pad_to`` / ``impl`` default to the env flags
+        (``MAGI_ATTENTION_COMM_PAD_TO`` / ``_GROUP_COLL_IMPL``); 'auto'
+        resolves by the INTRA level's predicted wire volume — hop
+        scheduling composes on the inner (fast-link) axis only, the
+        inter a2a always stays one fused collective per DCN crossing.
         """
+        from .. import env
+
+        if pad_to is None:
+            pad_to = env.comm_pad_to()
+        if impl is None:
+            impl = env.group_coll_impl()
         n = n_inter * n_intra
         assert len(send_map) == n
 
@@ -234,6 +336,54 @@ class HierGroupCollectiveMeta:
         inter_rows = tuple(
             sum(len(s1[s][dn]) for dn in range(n_inter)) for s in range(n)
         )
+
+        # intra-level hop schedule: the hop world is the intra axis; the
+        # per-hop max must hold across every node (SPMD uniformity), so
+        # collapse nodes into an effective [n_intra, n_intra] size matrix
+        sizes_intra = np.zeros((n_intra, n_intra), dtype=np.int64)
+        for si in range(n_intra):
+            for di in range(n_intra):
+                sizes_intra[si, di] = max(
+                    len(s2[rank(dn, si)][di]) for dn in range(n_inter)
+                )
+        hop_specs = _hop_padded_sizes(sizes_intra, pad_to)
+        impl_resolved, reason = _resolve_impl(
+            impl, hop_specs, n_intra, S2
+        )
+        intra_hops: tuple[HopPlan, ...] = ()
+        if impl_resolved == "hops":
+            # dst-side offsets of the (gateway si asc) final recv layout
+            plans = []
+            for k, Sk in hop_specs:
+                h_send = np.zeros((n, Sk), np.int32)
+                h_recv = np.full((n, Sk), R2, np.int32)  # pads -> trash
+                h_seg = np.full((n, Sk), R1, np.int32)  # unused (AD path)
+                for dn in range(n_inter):
+                    for si in range(n_intra):
+                        g = rank(dn, si)
+                        rows = s2[g][(si + k) % n_intra]
+                        h_send[g, : len(rows)] = rows
+                        h_seg[g, : len(rows)] = rows
+                    for di in range(n_intra):
+                        d = rank(dn, di)
+                        si_src = (di - k) % n_intra
+                        rows = s2[rank(dn, si_src)][di]
+                        off = sum(
+                            len(s2[rank(dn, sj)][di])
+                            for sj in range(si_src)
+                        )
+                        h_recv[d, : len(rows)] = off + np.arange(len(rows))
+                plans.append(
+                    HopPlan(
+                        shift=k,
+                        size=Sk,
+                        send_idx=h_send,
+                        recv_pos=h_recv,
+                        seg_ids=h_seg,
+                    )
+                )
+            intra_hops = tuple(plans)
+
         meta = HierGroupCollectiveMeta(
             n_inter=n_inter,
             n_intra=n_intra,
@@ -248,7 +398,22 @@ class HierGroupCollectiveMeta:
             # duck-types GroupCollectiveMeta diagnostics: what a rank "sends"
             # is its dedup'd inter-hop payload
             send_total=inter_rows,
+            pad_to=pad_to,
+            impl=impl_resolved,
+            impl_reason=reason,
+            intra_hops=intra_hops,
+            intra_true_rows=int(sum(recv_tot)),
+            intra_local_rows=int(
+                sum(
+                    len(s2[rank(dn, si)][si])
+                    for dn in range(n_inter)
+                    for si in range(n_intra)
+                )
+            ),
         )
+        from .. import telemetry
+
+        telemetry.record_group_collective_build(meta)
         # reorder recv_sources to the actual final layout: (si asc, sn asc)
         ordered: list[list[tuple[int, np.ndarray]]] = [[] for _ in range(n)]
         for dn in range(n_inter):
@@ -265,12 +430,28 @@ class HierGroupCollectiveMeta:
 
 def group_cast_hier(
     x: jax.Array,  # [T_local, ...] rank-local rows (inside shard_map)
-    tables,  # the 6 per-rank routing slices (leading dim 1)
+    tables,  # per-rank routing slices (leading dim 1); layout per meta
     *,
     axis_inter: str = "dcn",
     axis_intra: str = "ici",
+    meta: HierGroupCollectiveMeta | None = None,
 ):
-    """Two-hop multicast: dedup'd inter-axis a2a, then intra-axis a2a."""
+    """Two-hop multicast: dedup'd inter-axis a2a, then the intra-axis
+    fan-out — one a2a (legacy 6-array layout, ``meta=None``) or the
+    meta's hop schedule (``meta.cast_device_arrays()`` layout)."""
+    if meta is not None and meta.impl == "hops":
+        inter_send, inter_sel, inter_valid = tables[:3]
+        gw = group_cast(
+            x, inter_send, inter_sel, inter_valid, axis_name=axis_inter
+        )
+        return hop_cast(
+            gw,
+            meta.intra_hops,
+            tables[3:],
+            meta.max_recv,
+            axis_name=axis_intra,
+            world=meta.n_intra,
+        )
     (
         inter_send,
         inter_sel,
@@ -288,10 +469,11 @@ def group_cast_hier(
 def group_reduce_hier(
     y: jax.Array,  # [R2, ...] partial rows (layout of group_cast_hier output)
     acc: jax.Array,  # [T_local, ...] buffer to accumulate into
-    tables,  # same 6 routing slices as the cast
+    tables,  # same routing slices as the cast (layout per meta)
     *,
     axis_inter: str = "dcn",
     axis_intra: str = "ici",
+    meta: HierGroupCollectiveMeta | None = None,
 ):
     """Hierarchical sum-reduce: the exact reverse of :func:`group_cast_hier`
     (role of reference HierGroupReduceMetaSolver,
@@ -306,7 +488,7 @@ def group_reduce_hier(
     """
     T = acc.shape[0]
     cast = lambda x: group_cast_hier(
-        x, tables, axis_inter=axis_inter, axis_intra=axis_intra
+        x, tables, axis_inter=axis_inter, axis_intra=axis_intra, meta=meta
     )
     spec = jax.ShapeDtypeStruct((T,) + y.shape[1:], y.dtype)
     (contrib,) = jax.linear_transpose(cast, spec)(y)

@@ -186,6 +186,34 @@ def tpu_generation() -> str:
     return _env_str("MAGI_ATTENTION_TPU_GENERATION", "v5e")
 
 
+def group_coll_impl() -> str:
+    """Group-collective realization (``comm/group_collective.py``):
+    'a2a' = one globally-padded ``lax.all_to_all`` per cast (legacy),
+    'hops' = hop-scheduled exact-size ``lax.ppermute`` exchanges (hop k
+    pads only to that hop's max pair size; zero-volume hops trace away),
+    'auto' (default) = pick per collective by predicted wire volume at
+    plan-build time. Validated at use (GroupCollectiveMeta.build +
+    check_flag_comb); folded into :func:`flags_fingerprint`."""
+    return _env_str("MAGI_ATTENTION_GROUP_COLL_IMPL", "auto").strip().lower()
+
+
+GROUP_COLL_IMPLS = ("a2a", "hops", "auto")
+
+
+def comm_pad_to() -> int:
+    """Row-count bucketing rung for group-collective buffers
+    (``MAGI_ATTENTION_COMM_PAD_TO``): every padded send/recv extent is
+    rounded up to a multiple of this. Must be a power of two (sublane
+    alignment); with hop-wise padding the rung actually matters at small
+    pair sizes, hence configurable. Part of the key fingerprint."""
+    v = _env_int("MAGI_ATTENTION_COMM_PAD_TO", 8)
+    if v < 1 or (v & (v - 1)) != 0:
+        raise ValueError(
+            f"MAGI_ATTENTION_COMM_PAD_TO={v} must be a power of two >= 1"
+        )
+    return v
+
+
 def overlap_degree_default() -> int | None:
     """Default multi-stage-overlap degree when no DistAttnConfig is given:
     an integer, or 'auto' for the degree=None cost-model search."""
@@ -301,4 +329,6 @@ def flags_fingerprint() -> tuple:
         is_qo_comm_enable(),
         is_hierarchical_comm_enable(),
         autotune_mode(),
+        group_coll_impl(),
+        comm_pad_to(),
     )

@@ -41,7 +41,11 @@ import numpy as np
 
 from .. import telemetry
 from ..common.ranges import AttnRanges
-from ..comm.group_collective import GroupCollectiveMeta, group_cast
+from ..comm.group_collective import (
+    GroupCollectiveMeta,
+    group_cast_m,
+    predicted_volume_ratio,
+)
 from ..comm.hier import HierGroupCollectiveMeta, group_cast_hier
 from ..meta.containers import AttnBucket
 from ..meta.dispatch_meta import DispatchMeta
@@ -234,9 +238,10 @@ class DistAttnPlan:
         if self.overlap_degree == 0:
             c = self.merged_comm
             lines.append(
-                f"  comm (merged): recv_rows/rank={list(c.recv_total)} "
+                f"  comm (merged, {c.impl}): recv_rows/rank={list(c.recv_total)} "
                 f"send_rows/rank={list(c.send_total)} "
-                f"padded_payload_rows={c.comm_bytes_per_rank}"
+                f"scheduled_payload_rows={c.scheduled_rows_per_rank} "
+                f"(legacy padded {c.padded_rows_per_rank})"
             )
             lines.append(
                 f"  tables: E_fwd={self.merged_tables.fwd_qblk.shape[1]} "
@@ -246,27 +251,20 @@ class DistAttnPlan:
         else:
             for i, sp in enumerate(self.stages):
                 lines.append(
-                    f"  stage {i}: recv_rows/rank={list(sp.comm.recv_total)} "
+                    f"  stage {i} ({sp.comm.impl}): "
+                    f"recv_rows/rank={list(sp.comm.recv_total)} "
+                    f"scheduled_rows={sp.comm.scheduled_rows_per_rank} "
                     f"E_fwd={sp.tables.fwd_qblk.shape[1]} "
                     f"kv_pad={sp.tables.kv_pad}"
                 )
         return "\n".join(lines)
 
     def _comm_arrays(self, comm):
-        if self.hier is not None:
-            return (
-                comm.inter_send_idx,
-                comm.inter_recv_sel,
-                comm.inter_recv_valid,
-                comm.intra_send_idx,
-                comm.intra_recv_sel,
-                comm.intra_recv_valid,
-            )
-        return (comm.send_idx, comm.recv_sel, comm.recv_valid)
-
-    @property
-    def num_comm_arrays(self) -> int:
-        return 6 if self.hier is not None else 3
+        """Device arrays one cast needs — impl-dependent (the selected
+        group-collective impl decides the layout; flat a2a ships 3
+        arrays, hop scheduling 2 per active hop, hierarchical plans the
+        inter level + the intra level's impl layout)."""
+        return comm.cast_device_arrays()
 
     def device_tables(self):
         """Flattened sharded operands, deterministic order (see
@@ -357,6 +355,7 @@ def _choose_overlap_degree(
     config: OverlapConfig,
     block_k: int,
     inter_frac: float | None = None,
+    comm_volume_ratio: float = 1.0,
 ) -> int:
     """Auto overlap degree: simulate the staged pipeline per candidate
     degree with the config's cost factors and return the argmin over the
@@ -365,11 +364,18 @@ def _choose_overlap_degree(
 
     ``inter_frac``: for hierarchical plans, the fraction of recv rows that
     also cross the slow inter hop after dedup — comm is then priced as
-    one intra hop per row plus inter_frac of an inter hop."""
+    one intra hop per row plus inter_frac of an inter hop.
+
+    ``comm_volume_ratio``: scheduled / true rows of the selected
+    group-collective impl on the full send map
+    (:func:`~..comm.group_collective.predicted_volume_ratio`) — stage
+    comm is priced at the volume the wire will actually carry, not the
+    true-row lower bound (the per-stage skew is approximated by the
+    plan-level ratio; the built stages' metas record the exact figure)."""
     from ..common.mask import slice_area
 
     cf = config.calc_cost_factor
-    cmf = config.comm_cost_factor
+    cmf = config.comm_cost_factor * max(comm_volume_ratio, 1e-9)
     if inter_frac is not None and config.comm_cost_factor_inter is not None:
         cmf = cmf + inter_frac * config.comm_cost_factor_inter
     per_rank: list[tuple[float, float, int]] = []  # (host_s, remote_s, rows)
@@ -560,6 +566,10 @@ def _build_dist_attn_plan(
                 if tot
                 else 0.0
             )
+        # price comm at the volume the selected impl will schedule (the
+        # a2a's global pad, or the hop sums — for hier plans the flat
+        # ratio approximates the intra level's skew)
+        vol_ratio, _ = predicted_volume_ratio(send_map)
         degree = _choose_overlap_degree(
             cp,
             slices_per_rank,
@@ -568,6 +578,7 @@ def _build_dist_attn_plan(
             overlap_config,
             block_k,
             inter_frac=inter_frac,
+            comm_volume_ratio=vol_ratio,
         )
 
     def _build_comm(smap):
@@ -864,7 +875,7 @@ def dist_attn_local(
         cur += n
         return out
 
-    def cast(payload, comm_arrays):
+    def cast(payload, comm, comm_arrays):
         if plan.hier is not None:
             inter_name, intra_name = axis_name
             return group_cast_hier(
@@ -872,17 +883,17 @@ def dist_attn_local(
                 comm_arrays,
                 axis_inter=inter_name,
                 axis_intra=intra_name,
+                meta=comm,
             )
-        send_idx, recv_sel, recv_valid = comm_arrays
-        return group_cast(
-            payload, send_idx, recv_sel, recv_valid, axis_name=axis_name
-        )
+        return group_cast_m(payload, comm, comm_arrays, axis_name=axis_name)
 
-    def cast_kv(comm_arrays):
+    def cast_kv(comm):
         # downcast received KV to the kernel dtype; with the fp32 payload
         # the astype transpose upcasts each dKV cotangent before the
         # reduce, giving the high-precision accumulate
-        return cast(kv, comm_arrays).astype(k.dtype)
+        return cast(kv, comm, take(len(plan._comm_arrays(comm)))).astype(
+            k.dtype
+        )
 
     def _head_max(rowmax_lanes):
         # per-head max of masked logits over this rank's rows (pads carry
@@ -898,7 +909,7 @@ def dist_attn_local(
     if plan.overlap_degree == 0:
         tab = take(9)
         with named_scope("magi_merged_cast"):
-            recv = cast_kv(take(plan.num_comm_arrays))
+            recv = cast_kv(plan.merged_comm)
         k_full = jnp.concatenate([k, recv[:, 0]], axis=0)
         v_full = jnp.concatenate([v, recv[:, 1]], axis=0)
         with named_scope("magi_merged_kernel"):
@@ -935,7 +946,7 @@ def dist_attn_local(
     for i, sp in enumerate(plan.stages):
         tab = take(9)
         with named_scope(f"magi_stage{i}_cast"):
-            recv = cast_kv(take(plan.num_comm_arrays))
+            recv = cast_kv(sp.comm)
         with named_scope(f"magi_stage{i}_kernel"):
             out_i_h, lse_i_lanes, rowmax_i = _call_kernel(
                 qh, recv[:, 0], recv[:, 1], tab, sp.tables.kv_pad,

@@ -33,8 +33,8 @@ from ..common.ranges import AttnRanges
 from ..common.rectangle import AttnRectangles
 from ..comm.group_collective import (
     GroupCollectiveMeta,
-    group_cast,
-    group_reduce_lse,
+    group_cast_m,
+    group_reduce_lse_m,
 )
 from ..meta.solver.dynamic_attn_solver import (
     AutoDynamicSolver,
@@ -61,15 +61,11 @@ class QoCommPlan:
 
     def device_tables(self):
         arrs = list(self.tables.arrays())
-        arrs += [
-            self.comm_q.send_idx,
-            self.comm_q.recv_sel,
-            self.comm_q.recv_valid,
-            self.comm_q.seg_ids,
-            self.comm_kv.send_idx,
-            self.comm_kv.recv_sel,
-            self.comm_kv.recv_valid,
-        ]
+        # comm arrays in the metas' impl-dependent layouts: the Q meta
+        # ships the reduce superset (its cast comes back as the O
+        # lse-reduce), the KV meta the cast layout only
+        arrs += list(self.comm_q.reduce_device_arrays())
+        arrs += list(self.comm_kv.cast_device_arrays())
         return tuple(jnp.asarray(a) for a in arrs)
 
 
@@ -302,7 +298,8 @@ def qo_comm_attn_local(
     q: jax.Array,  # [shard, hq, d] contiguous token shard
     k: jax.Array,
     v: jax.Array,
-    tables,  # 9 kernel arrays + 4 q-comm + 3 kv-comm (per-rank slices)
+    tables,  # 9 kernel arrays + q-comm + kv-comm (per-rank slices; comm
+    # array counts follow the metas' impl layouts)
     plan: QoCommPlan,
     params: FlexAttnParams,
     *,
@@ -336,13 +333,14 @@ def qo_comm_attn_local(
     params = ensure_kernel_steps(params, (plan.tables,))
     kt = tables
     ktab = kt[:9]
-    q_send, q_sel, q_valid, q_seg = kt[9:13]
-    kv_send, kv_sel, kv_valid = kt[13:16]
+    nq = plan.comm_q.num_reduce_arrays
+    q_arrays = kt[9 : 9 + nq]
+    kv_arrays = kt[9 + nq : 9 + nq + plan.comm_kv.num_cast_arrays]
 
     hq = q.shape[1]
-    qb = group_cast(q, q_send, q_sel, q_valid, axis_name=axis_name)
+    qb = group_cast_m(q, plan.comm_q, q_arrays, axis_name=axis_name)
     kv = jnp.stack([k, v], axis=1)
-    kvb = group_cast(kv, kv_send, kv_sel, kv_valid, axis_name=axis_name)
+    kvb = group_cast_m(kv, plan.comm_kv, kv_arrays, axis_name=axis_name)
 
     fp32 = dataclasses.replace(params, out_dtype="float32")
     qh = _hm(qb, plan.q_buf_pad)
@@ -353,14 +351,13 @@ def qo_comm_attn_local(
 
     out_acc = jnp.zeros((plan.shard_len, hq, q.shape[2]), jnp.float32)
     lse_acc = jnp.full((plan.shard_len, hq), -jnp.inf, jnp.float32)
-    out, lse = group_reduce_lse(
+    out, lse = group_reduce_lse_m(
         out_p,
         lse_p,
         out_acc,
         lse_acc,
-        q_sel,
-        q_valid,
-        q_seg,
+        plan.comm_q,
+        q_arrays,
         axis_name=axis_name,
     )
     if sink is not None:

@@ -46,6 +46,7 @@ from .collectors import (  # noqa: F401
     record_autotune_measure_failure,
     record_autotune_measurement,
     record_cache_access,
+    record_comm_op,
     record_decode_step,
     record_dispatch_meta,
     record_dispatch_solution,
@@ -149,6 +150,7 @@ __all__ = [
     "record_autotune_measure_failure",
     "record_autotune_measurement",
     "record_cache_access",
+    "record_comm_op",
     "record_decode_step",
     "record_dispatch_meta",
     "record_dispatch_solution",

@@ -55,10 +55,22 @@ M_COMM_SEND_ROWS = "magi_comm_send_rows"  # {rank=}
 M_COMM_RECV_ROWS = "magi_comm_recv_rows"  # {rank=}
 M_COMM_PADDED_ROWS = "magi_comm_padded_payload_rows"
 M_COMM_BYTES_RANK = "magi_comm_bytes_per_rank"  # {rank=}, bytes
-# padded a2a payload rows / true send rows across the group (>= 1.0; the
-# SPMD uniform-shape cost the reference pays via split_alignment — never
-# measured before ISSUE 2); 0.0 when the cast moves nothing
+# rows the SELECTED impl schedules on the wire per rank (a2a: the full
+# cp*max_send globally-padded buffer; hops: sum of per-hop padded maxima)
+# vs the true routed rows across the group — the pair ISSUE 5 splits the
+# old padded-only accounting into
+M_COMM_SCHEDULED_ROWS = "magi_comm_scheduled_payload_rows"
+M_COMM_TRUE_ROWS = "magi_comm_true_rows_total"
+# scheduled payload rows / true rows across the group, per collective
+# kind ({kind=cast|reduce_sum|reduce_lse}; >= 1.0 when anything moves,
+# 0.0 when the collective moves nothing). The SPMD uniform-shape cost the
+# reference pays via split_alignment — never measured before ISSUE 2,
+# per-kind + impl-aware since ISSUE 5 (was one blended padded/true gauge)
 M_COMM_PADDING_OVERHEAD = "magi_comm_padding_overhead_ratio"
+# which group-collective impl the last build selected and why: value 1,
+# labels impl=a2a|hops, reason=env_pinned|auto_volume|auto_zero_volume|
+# auto_near_uniform (mirrors the autotuner's choice gauge)
+M_COMM_IMPL_CHOICE = "magi_comm_impl_choice"
 
 # gauges — plan layer
 M_PLAN_OVERLAP_DEGREE = "magi_plan_overlap_degree"
@@ -134,6 +146,9 @@ REQUIRED_PLAN_METRICS: tuple[str, ...] = (
     M_COMM_RECV_ROWS,
     M_COMM_BYTES_RANK,
     M_COMM_PADDING_OVERHEAD,
+    M_COMM_SCHEDULED_ROWS,
+    M_COMM_TRUE_ROWS,
+    M_COMM_IMPL_CHOICE,
     M_MODELED_FLOPS,
     M_MODELED_CALC_S,
     M_MODELED_COMM_S,
@@ -240,24 +255,38 @@ def record_dynamic_solution(solver: str, balance_ratio: float) -> None:
 
 def record_group_collective_build(comm) -> None:
     """One GroupCollectiveMeta routed (``comm/group_collective.py``): counts
-    builds and keeps the latest padded-payload row figure plus the
-    padded-vs-actual overhead ratio — the SPMD uniform-shape tax the a2a
-    pays for uneven send maps (VERDICT: never measured before ISSUE 2).
-    Per-rank rows are recorded at plan level (:func:`record_plan`) where
-    the *primary* comm meta is known — build() also runs for per-stage
-    sub-metas."""
+    builds and keeps the latest true / legacy-padded / impl-scheduled row
+    figures plus the scheduled-vs-true overhead ratio for the cast — the
+    SPMD uniform-shape tax an uneven send map pays (VERDICT: never
+    measured before ISSUE 2; exact-size hop scheduling shrinks it in
+    ISSUE 5). Per-rank rows are recorded at plan level
+    (:func:`record_plan`) where the *primary* comm meta is known —
+    build() also runs for per-stage sub-metas."""
     if not _enabled():
         return
     reg = get_registry()
     reg.counter_inc(M_GRPCOLL_BUILDS)
-    reg.gauge_set(M_COMM_PADDED_ROWS, comm.comm_bytes_per_rank)
-    # every rank ships cp * max_send rows through the a2a regardless of
-    # how many are real; the ratio is the group-wide padded/true volume
-    true_rows = sum(comm.send_total)
-    padded_rows = comm.cp_size * comm.cp_size * comm.max_send
+    reg.gauge_set(M_COMM_PADDED_ROWS, comm.padded_rows_per_rank)
+    reg.gauge_set(M_COMM_SCHEDULED_ROWS, comm.scheduled_rows_per_rank)
+    reg.gauge_set(M_COMM_TRUE_ROWS, comm.true_rows_total)
     reg.gauge_set(
-        M_COMM_PADDING_OVERHEAD,
-        (padded_rows / true_rows) if true_rows else 0.0,
+        M_COMM_PADDING_OVERHEAD, comm.padding_overhead_ratio, kind="cast"
+    )
+    reg.clear_metric(M_COMM_IMPL_CHOICE)  # one live choice at a time
+    reg.gauge_set(
+        M_COMM_IMPL_CHOICE, 1, impl=comm.impl, reason=comm.impl_reason
+    )
+
+
+def record_comm_op(comm, kind: str) -> None:
+    """One group-collective op traced against a meta (``group_reduce_*_m``
+    dispatchers): keeps the scheduled-vs-true overhead ratio per
+    collective kind. Runs at trace time (host-side, static meta facts
+    only) — once per compiled program, like the named scopes."""
+    if not _enabled():
+        return
+    get_registry().gauge_set(
+        M_COMM_PADDING_OVERHEAD, comm.padding_overhead_ratio, kind=kind
     )
 
 
@@ -549,6 +578,16 @@ def telemetry_summary(snapshot: dict | None = None) -> str:
         f"token imbalance: {fmt(g.get(M_DISPATCH_TOKEN_IMBALANCE))}",
         f"  comm recv rows/rank: {[int(v) for v in series(M_COMM_RECV_ROWS)]}",
         f"  comm bytes/rank: {[int(v) for v in series(M_COMM_BYTES_RANK)]}",
+    ]
+    impl_choice = [k for k in g if k.startswith(M_COMM_IMPL_CHOICE + "{")]
+    if impl_choice or g.get(M_COMM_SCHEDULED_ROWS) is not None:
+        lines.append(
+            f"  comm impl: {impl_choice[0][len(M_COMM_IMPL_CHOICE):] if impl_choice else '-'}  "
+            f"scheduled rows/rank {fmt(g.get(M_COMM_SCHEDULED_ROWS))} "
+            f"(legacy padded {fmt(g.get(M_COMM_PADDED_ROWS))})  "
+            f"true rows total {fmt(g.get(M_COMM_TRUE_ROWS))}"
+        )
+    lines += [
         f"  modeled flops: {fmt(g.get(M_MODELED_FLOPS))}  "
         f"calc s: {fmt(g.get(M_MODELED_CALC_S))}  "
         f"comm s: {fmt(g.get(M_MODELED_COMM_S))}",

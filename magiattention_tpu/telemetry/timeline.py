@@ -143,14 +143,20 @@ def _predicted_costs(
             )
         except ValueError:
             return None
+    # comm is priced at the rows the SELECTED impl schedules on the wire
+    # (a2a: the globally-padded buffer; hops: the per-hop padded sums) —
+    # the volume the hardware will actually move, matching the
+    # auto-degree search's volume-ratio pricing (ISSUE 5)
     if plan.overlap_degree == 0:
-        comm_s = [max(plan.merged_comm.recv_total, default=0) * comm_cost_factor]
+        comm_s = [
+            plan.merged_comm.scheduled_rows_per_rank * comm_cost_factor
+        ]
         calc_s = [plan.max_rank_area * calc_cost_factor]
         total = simulate_overlap_timeline(0.0, comm_s, calc_s, 0.0)
         return 0.0, comm_s, calc_s, total
     host_s = plan.host_max_rank_area * calc_cost_factor
     comm_s = [
-        max(sp.comm.recv_total, default=0) * comm_cost_factor
+        sp.comm.scheduled_rows_per_rank * comm_cost_factor
         for sp in plan.stages
     ]
     calc_s = [sp.max_rank_area * calc_cost_factor for sp in plan.stages]
@@ -208,7 +214,7 @@ def profile_plan_timeline(
 
     from .. import env
     from ..benchmarking.bench import do_bench
-    from ..comm.group_collective import group_cast
+    from ..comm.group_collective import group_cast_m
     from ..comm.hier import group_cast_hier
     from ..ops.correction import correct_attn_out_lse
     from ..parallel.dist_attn import (
@@ -291,7 +297,7 @@ def profile_plan_timeline(
         )
         return jax.jit(f)
 
-    def cast_payload(payload, comm_arrays):
+    def cast_payload(payload, comm, comm_arrays):
         if plan.hier is not None:
             inter_name, intra_name = axis_name
             return group_cast_hier(
@@ -299,17 +305,17 @@ def profile_plan_timeline(
                 comm_arrays,
                 axis_inter=inter_name,
                 axis_intra=intra_name,
+                meta=comm,
             )
-        send_idx, recv_sel, recv_valid = comm_arrays
-        return group_cast(
-            payload, send_idx, recv_sel, recv_valid, axis_name=axis_name
-        )
+        return group_cast_m(payload, comm, comm_arrays, axis_name=axis_name)
 
-    nca = plan.num_comm_arrays
+    def make_cast_fn(comm):
+        # arity follows the meta's impl layout (a2a vs per-hop arrays),
+        # so each comm meta gets its own program
+        nca = len(plan._comm_arrays(comm))
 
-    def make_cast_fn():
         def body(k_, v_, *cas):
-            return cast_payload(jnp.stack([k_, v_], axis=1), cas)
+            return cast_payload(jnp.stack([k_, v_], axis=1), comm, cas)
 
         return smap(2 + nca, body)
 
@@ -349,7 +355,7 @@ def profile_plan_timeline(
     if plan.overlap_degree == 0:
         comm_args = put(plan._comm_arrays(plan.merged_comm))
         tabs = put(plan.merged_tables.arrays())
-        cast_fn = make_cast_fn()
+        cast_fn = make_cast_fn(plan.merged_comm)
 
         def merged_body(q_, k_, v_, recv, *tt):
             qh = _hm(q_, plan.shard_q_pad)
@@ -381,7 +387,6 @@ def profile_plan_timeline(
         hideable_ms = comm_ms
     else:
         host_tabs = put(plan.host_tables.arrays())
-        cast_fn = make_cast_fn()  # one program; per-stage shapes recompile
 
         def host_body(q_, k_, v_, *tt):
             qh = _hm(q_, plan.shard_q_pad)
@@ -407,6 +412,7 @@ def profile_plan_timeline(
         for i, sp in enumerate(plan.stages):
             comm_args = put(plan._comm_arrays(sp.comm))
             tabs = put(sp.tables.arrays())
+            cast_fn = make_cast_fn(sp.comm)
 
             def stage_body(
                 q_, out_acc, lse_acc, recv, *tt, _kv_pad=sp.tables.kv_pad

@@ -103,19 +103,25 @@ def test_comm_bytes_resolution():
 
 
 def test_padding_overhead_ratio_recorded():
-    """Satellite of ISSUE 2 (VERDICT: never measured): the group-cast
-    build records padded-vs-true a2a volume. For a causal mask over a
-    contiguous dispatch the send map is uneven, so the ratio must be a
-    real overhead (> 1); its exact value must match the meta's padded
-    geometry."""
+    """Satellite of ISSUE 2 (VERDICT: never measured), per-kind +
+    impl-aware since ISSUE 5: the group-cast build records the
+    scheduled-vs-true volume of the SELECTED impl under kind=cast, plus
+    the true / legacy-padded / scheduled row gauges and the impl choice.
+    For a causal mask over a contiguous dispatch the send map is uneven,
+    so the ratio must be a real overhead (> 1)."""
     telemetry.set_enabled(True)
     plan = _build_plan(cp=4)
     g = telemetry.snapshot()["gauges"]
     comm = plan.comm
-    true_rows = sum(comm.send_total)
-    expect = (4 * 4 * comm.max_send) / true_rows
-    assert g[C.M_COMM_PADDING_OVERHEAD] == pytest.approx(expect)
-    assert g[C.M_COMM_PADDING_OVERHEAD] > 1.0
+    key = f"{C.M_COMM_PADDING_OVERHEAD}{{kind=cast}}"
+    assert g[key] == pytest.approx(comm.padding_overhead_ratio)
+    assert g[key] > 1.0
+    assert g[C.M_COMM_TRUE_ROWS] == comm.true_rows_total
+    assert g[C.M_COMM_SCHEDULED_ROWS] == comm.scheduled_rows_per_rank
+    assert g[C.M_COMM_PADDED_ROWS] == comm.padded_rows_per_rank
+    assert comm.scheduled_rows_per_rank <= comm.padded_rows_per_rank
+    choice = [k for k in g if k.startswith(C.M_COMM_IMPL_CHOICE + "{")]
+    assert len(choice) == 1 and f"impl={comm.impl}" in choice[0]
 
 
 def test_padding_overhead_zero_when_cast_moves_nothing():
@@ -128,7 +134,7 @@ def test_padding_overhead_zero_when_cast_moves_nothing():
     empty = [[np.empty(0, np.int64)] * 2 for _ in range(2)]
     GroupCollectiveMeta.build(empty, [8, 8])
     g = telemetry.snapshot()["gauges"]
-    assert g[C.M_COMM_PADDING_OVERHEAD] == 0.0
+    assert g[f"{C.M_COMM_PADDING_OVERHEAD}{{kind=cast}}"] == 0.0
 
 
 def test_unknown_generation_does_not_raise():
