@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check decode-bench comm-check check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check decode-bench comm-check analyze check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -90,7 +90,15 @@ decode-bench:
 comm-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_comm_check.py
 
-# the default check flow: syntax, telemetry catalog + timeline/aggregate
-# semantics, autotuner rung expectations, perf gate, serving parity,
-# group-collective parity/volume — all CPU-safe
-check: lint telemetry-check autotune-check perf-gate serving-check comm-check
+# static-analysis gate (ISSUE 7, jax-CPU only, ~15s): AST compat/idiom
+# lint (MAGI001-004 + allowlist), jaxpr trace audit (collective census vs
+# CommMeta across plans x cp x dtypes, upcast census, retrace guard),
+# plan-sanitizer self-check, and --self-test proof that each pass can
+# fail on a seeded violation (docs/static_analysis.md)
+analyze:
+	JAX_PLATFORMS=cpu $(PY) exps/run_static_analysis.py --self-test
+
+# the default check flow: syntax, static analysis, telemetry catalog +
+# timeline/aggregate semantics, autotuner rung expectations, perf gate,
+# serving parity, group-collective parity/volume — all CPU-safe
+check: lint analyze telemetry-check autotune-check perf-gate serving-check comm-check

@@ -232,12 +232,42 @@ def main() -> int:
         print(f"FAIL: summary lacks the serving section:\n{summary}")
         return 1
 
+    # 7. plan-sanitizer counters (ISSUE 7): one clean validate_plan must
+    # tick magi_validate_plan_checks; one seeded-bad validation must tick
+    # magi_validate_failures — both names are documented catalog entries
+    from magiattention_tpu.analysis.plan_sanity import (
+        PlanValidationError,
+        validate_plan,
+        validate_slices,
+    )
+
+    telemetry.reset()
+    validate_plan(plan, total_area=bucket.area)
+    try:
+        validate_slices([(0, 128, 0, 64, 1)], 64, 64)  # OOB: must fail
+        print("FAIL: seeded-bad slice PASSED the plan sanitizer")
+        return 1
+    except PlanValidationError:
+        pass
+    snap = telemetry.snapshot()
+    missing = [
+        m for m in telemetry.REQUIRED_VALIDATE_METRICS
+        if not has_series(snap, m)
+    ]
+    if missing:
+        print(
+            "FAIL: documented validate counters missing after a pass + "
+            f"fail sanitizer round (catalog drift): {missing}"
+        )
+        return 1
+
     telemetry.set_enabled(None)
     print(
         f"telemetry-check OK: {len(telemetry.REQUIRED_PLAN_METRICS)} plan "
         f"metrics + {len(telemetry.REQUIRED_TIMELINE_METRICS)} timeline "
         f"metrics + {len(telemetry.REQUIRED_SERVING_METRICS)} serving "
-        "metrics present, cross-rank merge semantics hold, exporters "
+        f"metrics + {len(telemetry.REQUIRED_VALIDATE_METRICS)} validate "
+        "counters present, cross-rank merge semantics hold, exporters "
         "round-trip with track metadata, disabled mode is a no-op"
     )
     return 0

@@ -1,0 +1,43 @@
+"""Static-analysis subsystem (ISSUE 7): the machine-checked invariants
+the architecture rests on.
+
+Three passes, one CLI (``exps/run_static_analysis.py`` / ``make
+analyze``):
+
+- :mod:`.lint` — AST compat/idiom linter over the package source
+  (MAGI001..MAGI004 rule codes, JSON allowlist + inline pragma).
+- :mod:`.trace_audit` — jaxpr trace auditor: abstract-evals the real
+  entry points over a plan x cp x dtype matrix and asserts the traced
+  collective census against the plan's CommMeta, audits bf16->f32
+  upcasts against a checked-in census, and guards against retraces on
+  plan-value changes.
+- :mod:`.plan_sanity` — structural sanitizer for AttnSlices /
+  DistAttnPlan / GroupCollectiveMeta, callable at plan-build time behind
+  ``MAGI_ATTENTION_VALIDATE=off|plan|trace``.
+
+Everything here is host-side tooling: importing this package never
+touches jax except inside trace-audit entry points that explicitly
+trace.
+"""
+
+from .lint import (  # noqa: F401
+    RULES,
+    Violation,
+    lint_package,
+    lint_paths,
+    lint_source,
+    load_allowlist,
+)
+from .plan_sanity import (  # noqa: F401
+    PlanValidationError,
+    validate_comm_meta,
+    validate_plan,
+    validate_slices,
+)
+from .trace_audit import (  # noqa: F401
+    AuditFailure,
+    collective_census,
+    count_traces,
+    expected_cast_collectives,
+    upcast_census,
+)

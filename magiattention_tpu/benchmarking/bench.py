@@ -42,11 +42,18 @@ def _barrier_cache(mesh):
     would retrace/compile every rep (expensive through a remote tunnel)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ..utils.compat import shard_map
+    from ..utils.instrument import named_scope
+
     names = tuple(mesh.axis_names)
 
+    def _psum_all(v):
+        with named_scope("magi_bench_barrier"):
+            return jax.lax.psum(v, names)
+
     def _b(x):
-        return jax.shard_map(
-            lambda v: jax.lax.psum(v, names),
+        return shard_map(
+            _psum_all,
             mesh=mesh,
             in_specs=P(),
             out_specs=P(),
@@ -370,9 +377,10 @@ def enable_compile_cache(default_dir: str | None = None) -> None:
 
     import jax
 
-    cache_dir = os.environ.get(
-        "MAGI_TPU_COMPILE_CACHE",
-        default_dir or os.path.join(os.getcwd(), ".jax_cache"),
+    from .. import env
+
+    cache_dir = env.tpu_compile_cache_dir() or (
+        default_dir or os.path.join(os.getcwd(), ".jax_cache")
     )
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -503,6 +503,8 @@ def group_reduce_lse(
     (out_i, lse_i):  lse = log Σ exp(lse_i),  out = Σ exp(lse_i - lse) out_i.
     Rows nobody contributed to keep (out_acc, lse_acc).
     """
+    from ..utils.instrument import named_scope
+
     cp, S = seg_ids.shape[1], seg_ids.shape[2]
     # mark invalid rows with -inf lse so they vanish from the merge
     lse_masked = jnp.where(recv_valid[0], lse_partial.T, NEG_INF).T  # [R, h]
@@ -512,13 +514,14 @@ def group_reduce_lse(
         (cp * S + 1,) + lse_partial.shape[1:], NEG_INF, lse_partial.dtype
     )
     flat_lse = flat_lse.at[recv_sel[0]].set(lse_masked)
-    recv_lse = jax.lax.all_to_all(
-        flat_lse[:-1].reshape((cp, S) + lse_partial.shape[1:]),
-        axis_name,
-        split_axis=0,
-        concat_axis=0,
-        tiled=False,
-    )
+    with named_scope("magi_group_reduce_lse_a2a"):
+        recv_lse = jax.lax.all_to_all(
+            flat_lse[:-1].reshape((cp, S) + lse_partial.shape[1:]),
+            axis_name,
+            split_axis=0,
+            concat_axis=0,
+            tiled=False,
+        )
     T = out_acc.shape[0]
     seg = seg_ids[0].reshape(-1)
     flat_out = recv_out.reshape((cp * S,) + out_partial.shape[1:])
@@ -615,6 +618,8 @@ def _hop_reverse(
     owner. Yields (rows [Sk, ...], seg [Sk]) per hop — rows arrive at the
     owner in its original send order, so ``seg`` (= the hop's send_idx
     with a pad sentinel) maps them onto owner rows."""
+    from ..utils.instrument import named_scope
+
     out = []
     for hop, grp in zip(hops, groups):
         recv_pos, seg = grp[1][0], grp[2][0]
@@ -624,9 +629,10 @@ def _hop_reverse(
         fill = NEG_INF if neg_inf_fill else 0
         rows = jnp.where(valid.reshape(mask_shape), rows, fill)
         if hop.shift % world != 0:
-            rows = jax.lax.ppermute(
-                rows, axis_name, _hop_perm(world, -hop.shift)
-            )
+            with named_scope("magi_hop_reverse"):
+                rows = jax.lax.ppermute(
+                    rows, axis_name, _hop_perm(world, -hop.shift)
+                )
         out.append((rows, seg))
     return out
 

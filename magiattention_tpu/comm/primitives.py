@@ -80,12 +80,15 @@ def all2all_v(
     (see comm.group_collective, the general-routing superset that packs
     valid rows for you).
     """
+    from ..utils.instrument import named_scope
+
     cp = len(send_sizes)
     assert x.shape[0] == cp, f"x leading dim {x.shape[0]} != world {cp}"
     pad = int(max(max(int(v) for v in row) for row in send_sizes))
     assert x.shape[1] >= pad, (
         f"x per-dst rows {x.shape[1]} < max send size {pad}"
     )
-    return jax.lax.all_to_all(
-        x, axis_name, split_axis=0, concat_axis=0, tiled=False
-    )
+    with named_scope("magi_all2all_v"):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=0, concat_axis=0, tiled=False
+        )

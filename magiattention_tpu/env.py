@@ -40,6 +40,57 @@ def log_level() -> str:
     return _env_str("MAGI_ATTENTION_LOG_LEVEL", "WARNING")
 
 
+def log_level_explicit() -> bool:
+    """Whether ``MAGI_ATTENTION_LOG_LEVEL`` was set at all: the logging
+    config only claims the logger tree when the user asked (embedders
+    who run their own ``logging.basicConfig`` keep control otherwise)."""
+    return "MAGI_ATTENTION_LOG_LEVEL" in os.environ
+
+
+VALIDATE_MODES = ("off", "plan", "trace")
+
+
+def validate_mode() -> str:
+    """Plan-sanitizer mode (``analysis/plan_sanity.py``), validated here:
+
+    - ``off`` (default): no checks — zero overhead.
+    - ``plan``: every ``build_dist_attn_plan`` output is run through the
+      structural sanitizer (ranges in-bounds, recv-layout permutation,
+      scheduled >= true >= local rows, area accounting) before it is
+      returned; host-side only, adds low single-digit ms per build.
+    - ``trace``: ``plan`` checks plus an abstract-eval collective census
+      of the plan's group casts against its CommMeta (no execution, but
+      traces a small program per comm meta — noticeably slower; meant
+      for CI and debugging, not serving).
+
+    Pure validation — never changes what is built, so NOT part of
+    :func:`flags_fingerprint`."""
+    v = _env_str("MAGI_ATTENTION_VALIDATE", "off").strip().lower()
+    if v not in VALIDATE_MODES:
+        raise ValueError(
+            f"MAGI_ATTENTION_VALIDATE={v!r} must be one of {VALIDATE_MODES}"
+        )
+    return v
+
+
+def mask_skip_disabled() -> bool:
+    """Debug: force the diagnostic needs-mask flag to 1 on every entry
+    in ``ops/block_meta.py``. Since the round-5 rewrite the kernels mask
+    every tile unconditionally via the row-interval form, so this
+    affects plan diagnostics (interior-tile statistics) only — never the
+    execution path. Any non-empty value sets it — mirrors the
+    historical raw ``MAGI_DISABLE_MASK_SKIP`` read this accessor
+    replaced."""
+    return bool(os.environ.get("MAGI_DISABLE_MASK_SKIP"))
+
+
+def tpu_compile_cache_dir() -> str | None:
+    """Persistent XLA compilation-cache directory override for the bench
+    harness (``benchmarking/bench.py::enable_compile_cache``); None =
+    the caller's default (./.jax_cache)."""
+    return os.environ.get("MAGI_TPU_COMPILE_CACHE")
+
+
 def is_telemetry_enabled() -> bool:
     """Turn on the runtime telemetry layer (``telemetry/``): plan/comm/
     solver introspection metrics + host-side span events. Off by default;

@@ -41,10 +41,11 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.flex_attn import FlexAttnParams
+from ..utils.compat import shard_map
+from ..utils.instrument import named_scope
 from ..parallel.dist_attn import (
     DistAttnPlan,
     dist_attn_local,
@@ -292,8 +293,13 @@ class MagiDiT:
                 return (err.astype(jnp.float32) ** 2).sum(), valid.sum()
 
             s, n = jax.vmap(one)(lat, tv, tc, pos, text)
-            s = jax.lax.psum(jax.lax.psum(s.sum(), self.cp_axis), self.dp_axis)
-            n = jax.lax.psum(jax.lax.psum(n.sum(), self.cp_axis), self.dp_axis)
+            with named_scope("magi_dit_loss_psum"):
+                s = jax.lax.psum(
+                    jax.lax.psum(s.sum(), self.cp_axis), self.dp_axis
+                )
+                n = jax.lax.psum(
+                    jax.lax.psum(n.sum(), self.cp_axis), self.dp_axis
+                )
             return s / jnp.maximum(n.astype(jnp.float32) * cfg.in_dim, 1.0)
 
         return _local(params, noised, target_v, t_chunk, pos, text, *tables)

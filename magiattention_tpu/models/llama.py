@@ -21,10 +21,11 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.dist_attn import DistAttnPlan, dist_attn_local
+from ..utils.compat import shard_map
+from ..utils.instrument import named_scope
 from ..ops.flex_attn import FlexAttnParams
 from ._common import masked_ce_sums
 
@@ -138,7 +139,8 @@ def _layer_local(
     )
     attn_out = out.reshape(t, -1) @ layer["wo"].astype(dt)
     if tp_axis is not None:
-        attn_out = jax.lax.psum(attn_out, tp_axis)
+        with named_scope("magi_llama_attn_tp_psum"):
+            attn_out = jax.lax.psum(attn_out, tp_axis)
     x = x + attn_out
 
     h = _rms_norm(x, layer["mlp_norm"])
@@ -146,7 +148,8 @@ def _layer_local(
     up = h @ layer["w_up"].astype(dt)
     mlp_out = (gate * up) @ layer["w_down"].astype(dt)
     if tp_axis is not None:
-        mlp_out = jax.lax.psum(mlp_out, tp_axis)
+        with named_scope("magi_llama_mlp_tp_psum"):
+            mlp_out = jax.lax.psum(mlp_out, tp_axis)
     x = x + mlp_out
     return x
 
@@ -259,12 +262,13 @@ class MagiLlama:
                 return masked_ce_sums(logits, lab1)
 
             loss_sum, count = jax.vmap(one)(tok, lab, pos)
-            loss_sum = jax.lax.psum(
-                jax.lax.psum(loss_sum.sum(), self.cp_axis), self.dp_axis
-            )
-            count = jax.lax.psum(
-                jax.lax.psum(count.sum(), self.cp_axis), self.dp_axis
-            )
+            with named_scope("magi_llama_loss_psum"):
+                loss_sum = jax.lax.psum(
+                    jax.lax.psum(loss_sum.sum(), self.cp_axis), self.dp_axis
+                )
+                count = jax.lax.psum(
+                    jax.lax.psum(count.sum(), self.cp_axis), self.dp_axis
+                )
             return loss_sum / jnp.maximum(count, 1.0)
 
         return _local(params, tokens, labels, pos, *tables)

@@ -28,10 +28,11 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.flex_attn import FlexAttnParams
+from ..utils.compat import shard_map
+from ..utils.instrument import named_scope
 from ..parallel.dist_attn import DistAttnPlan
 from ._common import masked_ce_sums
 from .llama import LlamaConfig, _layer_local, _rms_norm, init_params
@@ -183,11 +184,12 @@ class MagiLlamaPP:
                     ),
                     (y, lab1),
                 )
-                y_next = jax.lax.ppermute(
-                    y,
-                    self.pp_axis,
-                    [(i, (i + 1) % pp) for i in range(pp)],
-                )
+                with named_scope("magi_pp_boundary_ppermute"):
+                    y_next = jax.lax.ppermute(
+                        y,
+                        self.pp_axis,
+                        [(i, (i + 1) % pp) for i in range(pp)],
+                    )
                 return y_next, (ls, cnt)
 
             x0 = jnp.zeros((t_loc, cfg.dim), dt)
@@ -196,9 +198,10 @@ class MagiLlamaPP:
             )
             loss_sum = loss_sums.sum()
             count = counts.sum()
-            for ax in (self.pp_axis, self.cp_axis, self.dp_axis):
-                loss_sum = jax.lax.psum(loss_sum, ax)
-                count = jax.lax.psum(count, ax)
+            with named_scope("magi_pp_loss_psum"):
+                for ax in (self.pp_axis, self.cp_axis, self.dp_axis):
+                    loss_sum = jax.lax.psum(loss_sum, ax)
+                    count = jax.lax.psum(count, ax)
             return loss_sum / jnp.maximum(count, 1.0)
 
         return _local(params, tokens, labels, pos, *tables)

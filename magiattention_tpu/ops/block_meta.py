@@ -246,12 +246,12 @@ def _needs_mask_flags(
     diagnostics — interior-tile fraction is a useful mask statistic —
     and for table-ABI stability with the C++ planner parity tests."""
     e = entries.shape[0]
-    import os
+    from .. import env
     if (
         e == 0
         or slices is None
         or slices.shape[0] == 0  # rank/stage with no work: all dummies
-        or os.environ.get("MAGI_DISABLE_MASK_SKIP")
+        or env.mask_skip_disabled()
     ):
         return np.ones((e,), dtype=np.int64)
     qb = entries[:, 0]

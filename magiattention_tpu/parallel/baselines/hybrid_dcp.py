@@ -161,7 +161,7 @@ def make_hybrid_dcp_attn_fn(
 ):
     """Jittable fn over zigzag-dispatched [total, h, d] arrays sharded
     P(axis_name)."""
-    from jax import shard_map
+    from ...utils.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     tables = tuple(

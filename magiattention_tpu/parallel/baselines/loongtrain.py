@@ -133,15 +133,19 @@ def double_ring_attn_local(
     perm_out = [
         (o, (o + 1) % plan.ring_outer) for o in range(plan.ring_outer)
     ]
+    from ...utils.instrument import named_scope
+
     step = 0
     for so in range(plan.ring_outer):
         if so > 0:
             # advance the outer ring once per inner cycle; the inner axis is
             # back at its start (it wrapped after ring_inner rotations)
-            kv = jax.lax.ppermute(kv, axis_outer, perm_out)
+            with named_scope("magi_loongtrain_outer_ppermute"):
+                kv = jax.lax.ppermute(kv, axis_outer, perm_out)
         for si in range(plan.ring_inner):
             if si > 0:
-                kv = jax.lax.ppermute(kv, axis_inner, perm_in)
+                with named_scope("magi_loongtrain_inner_ppermute"):
+                    kv = jax.lax.ppermute(kv, axis_inner, perm_in)
             tab = tables[step * 9 : (step + 1) * 9]
             out_h, lse_lanes, _ = _call_kernel(
                 qh, kv[0], kv[1], tab, plan.shard_k_pad, fp32, None
@@ -163,7 +167,7 @@ def make_double_ring_attn_fn(
     axis_outer: str = "ring_out",
     axis_inner: str = "ring_in",
 ):
-    from jax import shard_map
+    from ...utils.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     assert mesh.shape[axis_outer] == plan.ring_outer

@@ -169,7 +169,7 @@ def make_usp_nsa_attn_fn(
 ):
     """USP-NSA: ulysses seq->head a2a, full-sequence NSA per head subset,
     a2a back (reference usp_nsa.py composition)."""
-    from jax import shard_map
+    from ...utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from .ulysses import heads_to_seq_a2a, seq_to_heads_a2a

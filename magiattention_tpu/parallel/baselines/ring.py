@@ -116,9 +116,12 @@ def ring_attn_local(
     kv = jnp.stack([k, v], axis=0)  # [2, shard, hk, d]
     out = lse = None
     perm = [(i, (i + 1) % cp) for i in range(cp)]
+    from ...utils.instrument import named_scope
+
     for s in range(cp):
         if s > 0:
-            kv = jax.lax.ppermute(kv, axis_name, perm)
+            with named_scope("magi_ring_kv_ppermute"):
+                kv = jax.lax.ppermute(kv, axis_name, perm)
         tab = tables[s * 9 : (s + 1) * 9]
         out_h, lse_lanes, _ = _call_kernel(
             qh, kv[0], kv[1], tab, plan.shard_k_pad, fp32_params, None
@@ -139,7 +142,7 @@ def make_ring_attn_fn(
     axis_name: str = "cp",
 ):
     """Jittable fn over contiguously sharded [total, h, d] arrays."""
-    from jax import shard_map
+    from ...utils.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     tables = tuple(

@@ -22,16 +22,22 @@ from ..dist_attn import _headmajor_to_seq, _hm
 def seq_to_heads_a2a(x, axis_name: str):
     """[t_loc, h, d] -> [t_glob, h/axis, d]; tiled all_to_all keeps rank
     blocks in order (global-token-major) and transposes cleanly under AD."""
-    return jax.lax.all_to_all(
-        x, axis_name, split_axis=1, concat_axis=0, tiled=True
-    )
+    from ...utils.instrument import named_scope
+
+    with named_scope("magi_ulysses_seq_to_heads_a2a"):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=0, tiled=True
+        )
 
 
 def heads_to_seq_a2a(x, axis_name: str):
     """Inverse of :func:`seq_to_heads_a2a`."""
-    return jax.lax.all_to_all(
-        x, axis_name, split_axis=0, concat_axis=1, tiled=True
-    )
+    from ...utils.instrument import named_scope
+
+    with named_scope("magi_ulysses_heads_to_seq_a2a"):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=0, concat_axis=1, tiled=True
+        )
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -129,7 +135,7 @@ def make_ulysses_attn_fn(
     *,
     axis_name: str = "cp",
 ):
-    from jax import shard_map
+    from ...utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     @functools.partial(

@@ -81,7 +81,7 @@ def make_usp_attn_fn(
 ):
     """Jittable fn over [total, h, d] arrays sharded (ring, ulysses)-major
     on tokens (contiguous global order)."""
-    from jax import shard_map
+    from ...utils.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     assert mesh.shape[axis_ulysses] == plan.ulysses_size, (

@@ -120,7 +120,7 @@ def _roll_p2p(x, meta, src_slot, axis, mesh, cp_axis):
     rem = np.flatnonzero(~local)
     if rem.size == 0:
         # pure permutation within ranks (e.g. shift=0): no comm at all
-        return _shard_roll_apply(
+        return _shard_roll_try(
             x, axis, mesh, names,
             local_src.reshape(cp, shard), None, None, None, shard,
         )
@@ -155,16 +155,33 @@ def _roll_p2p(x, meta, src_slot, axis, mesh, cp_axis):
     recv_valid = np.zeros((cp, shard), dtype=bool)
     recv_valid[d_r, rem % shard] = True
 
-    return _shard_roll_apply(
+    return _shard_roll_try(
         x, axis, mesh, names,
         local_src.reshape(cp, shard), send_idx, recv_sel, recv_valid, shard,
     )
+
+
+def _shard_roll_try(x, axis, mesh, names, *args):
+    """Run the shard_map roll, or return None (-> caller's gather
+    fallback) where the partial-manual program cannot be built — old-jax
+    images whose SPMD partitioner aborts on manual subgroups (the compat
+    shim refuses up front with exactly this exception; any OTHER error
+    from building/tracing the roll body still propagates)."""
+    from ..utils.compat import ShardMapUnsupported
+
+    try:
+        return _shard_roll_apply(x, axis, mesh, names, *args)
+    except ShardMapUnsupported:
+        return None
 
 
 def _shard_roll_apply(
     x, axis, mesh, names, local_src, send_idx, recv_sel, recv_valid, shard
 ):
     from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
+    from ..utils.instrument import named_scope
 
     axis_name = names if len(names) > 1 else names[0]
     # partial-manual shard_map (axis_names=cp only) requires full-rank
@@ -185,10 +202,11 @@ def _shard_roll_apply(
             send_buf = jnp.take(xm, si.reshape(-1), axis=0).reshape(
                 si.shape + xm.shape[1:]
             )
-            recv = jax.lax.all_to_all(
-                send_buf, axis_name, split_axis=0, concat_axis=0,
-                tiled=False,
-            )
+            with named_scope("magi_roll_a2a"):
+                recv = jax.lax.all_to_all(
+                    send_buf, axis_name, split_axis=0, concat_axis=0,
+                    tiled=False,
+                )
             flat = recv.reshape((-1,) + xm.shape[1:])
             remote = jnp.take(
                 flat, jnp.minimum(rs[0], flat.shape[0] - 1), axis=0
@@ -205,7 +223,7 @@ def _shard_roll_apply(
             jnp.asarray(recv_valid),
         )
     specs = tuple(tab_spec(t) for t in tabs)
-    fn = jax.shard_map(
+    fn = shard_map(
         _local,
         mesh=mesh,
         in_specs=(x_spec,) + specs,

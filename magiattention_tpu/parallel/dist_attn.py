@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import telemetry
+from .. import env, telemetry
 from ..common.ranges import AttnRanges
 from ..comm.group_collective import (
     GroupCollectiveMeta,
@@ -468,6 +468,19 @@ def build_dist_attn_plan(
             cp_mesh_shape=cp_mesh_shape,
         )
     telemetry.record_plan(plan, build_seconds=time.perf_counter() - t0)
+    mode = env.validate_mode()
+    if mode != "off":
+        from ..analysis.plan_sanity import validate_plan
+
+        validate_plan(plan, total_area=bucket.area)
+        if mode == "trace":
+            from ..analysis.plan_sanity import PlanValidationError
+            from ..analysis.trace_audit import audit_plan_collectives
+
+            problems = audit_plan_collectives(plan)
+            if problems:
+                telemetry.record_validate(failed=True)
+                raise PlanValidationError("; ".join(problems))
     return plan
 
 
@@ -854,8 +867,6 @@ def dist_attn_local(
     rank-local per-head max logit [hq] — pmax it across the cp axis for
     the global value).
     """
-    from .. import env
-
     params = ensure_kernel_steps(
         params,
         (plan.merged_tables, plan.host_tables,
@@ -975,8 +986,9 @@ def make_dist_attn_fn(
     logit [hq] (pmax over the cp axis; reference reduce_max_logits) as a
     third output.
     """
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..utils.compat import shard_map
 
     assert params.has_sink == (sink is not None), (
         "params.has_sink must match whether a sink array is provided"

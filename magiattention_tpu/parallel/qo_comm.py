@@ -378,8 +378,9 @@ def make_qo_comm_attn_fn(
     sink: jax.Array | None = None,  # [hq] default sink (traceable override)
 ):
     """Jittable fn over contiguously sharded [total, h, d] arrays."""
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..utils.compat import shard_map
 
     tables = tuple(
         jax.device_put(t, NamedSharding(mesh, P(axis_name)))

@@ -41,6 +41,7 @@ from .collectors import (  # noqa: F401
     REQUIRED_PLAN_METRICS,
     REQUIRED_SERVING_METRICS,
     REQUIRED_TIMELINE_METRICS,
+    REQUIRED_VALIDATE_METRICS,
     record_autotune_cache,
     record_autotune_decision,
     record_autotune_measure_failure,
@@ -58,6 +59,7 @@ from .collectors import (  # noqa: F401
     record_plan,
     record_prefill,
     record_runtime_costs,
+    record_validate,
     telemetry_summary,
 )
 from .events import (  # noqa: F401
@@ -132,6 +134,7 @@ __all__ = [
     "REQUIRED_PLAN_METRICS",
     "REQUIRED_SERVING_METRICS",
     "REQUIRED_TIMELINE_METRICS",
+    "REQUIRED_VALIDATE_METRICS",
     "StageTiming",
     "aggregate_across_mesh",
     "configure_logging",
@@ -163,6 +166,7 @@ __all__ = [
     "record_plan",
     "record_prefill",
     "record_runtime_costs",
+    "record_validate",
     "reset",
     "series_key",
     "set_enabled",

@@ -39,6 +39,12 @@ M_DISPATCH_BUILDS = "magi_dispatch_meta_builds_total"
 M_GRPCOLL_BUILDS = "magi_group_collective_builds_total"
 M_CACHE_HITS = "magi_runtime_cache_hits_total"
 M_CACHE_MISSES = "magi_runtime_cache_misses_total"
+# plan-sanitizer counters (analysis/plan_sanity.py): only ticked while
+# MAGI_ATTENTION_VALIDATE != off AND telemetry is enabled. checks counts
+# every sanitizer invocation (pass or fail); failures counts raised
+# PlanValidationErrors — alarm on failures > 0
+M_VALIDATE_CHECKS = "magi_validate_plan_checks"
+M_VALIDATE_FAILURES = "magi_validate_failures"
 
 # gauges — dispatch layer
 M_DISPATCH_NUM_CHUNKS = "magi_dispatch_num_chunks"
@@ -186,10 +192,31 @@ REQUIRED_SERVING_METRICS: tuple[str, ...] = (
 )
 
 
+# populated by the plan sanitizer while MAGI_ATTENTION_VALIDATE != off;
+# asserted by make telemetry-check's validate step, documented in
+# docs/observability.md + docs/static_analysis.md
+REQUIRED_VALIDATE_METRICS: tuple[str, ...] = (
+    M_VALIDATE_CHECKS,
+    M_VALIDATE_FAILURES,
+)
+
+
 def _enabled() -> bool:
     from . import enabled
 
     return enabled()
+
+
+def record_validate(failed: bool) -> None:
+    """One plan-sanitizer outcome (``analysis/plan_sanity.py``): every
+    call ticks the checks counter, failures additionally tick the
+    failure counter."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_VALIDATE_CHECKS)
+    if failed:
+        reg.counter_inc(M_VALIDATE_FAILURES)
 
 
 # ---------------------------------------------------------------------------

@@ -15,7 +15,6 @@ duplicate handlers.
 from __future__ import annotations
 
 import logging
-import os
 
 LOGGER_NAME = "magiattention_tpu"
 
@@ -41,8 +40,10 @@ def configure_logging(force_handler: bool = False) -> logging.Logger:
     ``logging.basicConfig(level=...)`` etc. — keep full control, exactly
     as before this flag was wired.
     """
+    from .. import env
+
     logger = logging.getLogger(LOGGER_NAME)
-    explicit = "MAGI_ATTENTION_LOG_LEVEL" in os.environ
+    explicit = env.log_level_explicit()
     if explicit:
         logger.setLevel(resolve_level())
     if (explicit or force_handler) and not any(

@@ -3,31 +3,68 @@
 The runtime targets current jax (``jax.shard_map`` stable API); CI /
 bring-up images sometimes carry an older jax where ``shard_map`` still
 lives in ``jax.experimental.shard_map`` with the ``check_rep`` spelling
-of ``check_vma``. New host-tooling code (the measured-timeline profiler,
-which must run anywhere the tests run) goes through this shim; the
-production runtime modules keep the stable-API import — they are
-exercised on real-TPU images where it exists.
+of ``check_vma``. EVERY module in this package — production runtime,
+profiler, tests — goes through this shim: ``jax.shard_map`` /
+``pltpu.CompilerParams`` must not be spelled anywhere else in the tree
+(enforced by rule MAGI001 of ``magiattention_tpu/analysis/lint.py``),
+which is what keeps the SPMD suites runnable on old-jax images.
 """
 
 from __future__ import annotations
 
 
-def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+class ShardMapUnsupported(NotImplementedError):
+    """This jax version cannot build the requested shard_map program
+    (old-jax partial-manual mode). Callers with a collective-free
+    alternative catch exactly this and degrade."""
+
+
+def shard_map(
+    f,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    check_vma: bool = True,
+    axis_names=None,
+):
     """``jax.shard_map`` where available, else the
     ``jax.experimental.shard_map`` fallback (``check_vma`` maps to the
-    old API's ``check_rep``)."""
+    old API's ``check_rep``).
+
+    ``axis_names`` (new-API partial-manual mode: only the named mesh axes
+    become manual; the rest stay under GSPMD) is supported on old jax
+    only in the degenerate every-axis-manual case. A genuinely partial
+    manual program CHECK-crashes the old SPMD partitioner
+    (spmd_partitioner.cc "IsManualSubgroup" fatal — it aborts the
+    process, not an exception), so the fallback raises
+    :class:`ShardMapUnsupported` up front; callers with a
+    collective-free alternative (``parallel/dispatch.roll``) catch
+    exactly that and degrade."""
     import jax
 
     if hasattr(jax, "shard_map"):
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
         return jax.shard_map(
             f,
             mesh=mesh,
             in_specs=in_specs,
             out_specs=out_specs,
             check_vma=check_vma,
+            **kwargs,
         )
     from jax.experimental.shard_map import shard_map as _shard_map
 
+    if axis_names is not None and frozenset(mesh.axis_names) - frozenset(
+        axis_names
+    ):
+        raise ShardMapUnsupported(
+            "partial-manual shard_map (axis_names a strict subset of the "
+            "mesh axes) is unsupported on this jax version: the old SPMD "
+            "partitioner fatally aborts on manual subgroups"
+        )
     return _shard_map(
         f,
         mesh=mesh,

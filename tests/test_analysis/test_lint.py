@@ -1,0 +1,282 @@
+"""Rule unit tests for the AST compat/idiom linter (analysis/lint.py):
+one positive (flagged) and one negative (clean) fixture per rule code,
+plus allowlist/pragma mechanics and the whole-tree regression."""
+
+import os
+
+import pytest
+
+from magiattention_tpu.analysis.lint import (
+    Violation,
+    apply_allowlist,
+    lint_package,
+    lint_source,
+    load_allowlist,
+)
+
+PKG = "magiattention_tpu"
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def rules_of(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ---------------------------------------------------------------------------
+# MAGI001 — compat shims
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "src",
+    [
+        "from jax import shard_map\n",
+        "from jax.experimental.shard_map import shard_map\n",
+        # aliased spellings must not evade the rule
+        "from jax.experimental import shard_map\n",
+        "import jax.experimental.shard_map as sm\n",
+        "import jax.experimental.shard_map\n",
+        "import jax\nf = jax.shard_map(lambda x: x, mesh=None,"
+        " in_specs=None, out_specs=None)\n",
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "p = pltpu.CompilerParams(dimension_semantics=())\n",
+        "p = pltpu.TPUCompilerParams()\n",
+        "from jax.experimental.pallas.tpu import CompilerParams\n",
+    ],
+)
+def test_magi001_positive(src):
+    vs = lint_source(src, f"{PKG}/parallel/x.py")
+    assert "MAGI001" in rules_of(vs), src
+
+
+def test_magi001_negative_compat_module_exempt():
+    src = (
+        "import jax\n"
+        "def shard_map(f, *, mesh, in_specs, out_specs):\n"
+        "    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,"
+        " out_specs=out_specs)\n"
+    )
+    assert lint_source(src, f"{PKG}/utils/compat.py") == []
+
+
+def test_magi001_negative_compat_import_ok():
+    src = "from ..utils.compat import shard_map, tpu_compiler_params\n"
+    assert lint_source(src, f"{PKG}/parallel/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# MAGI002 — env reads
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "src",
+    [
+        "import os\nv = os.environ.get('MAGI_X')\n",
+        "import os\nv = os.environ['MAGI_X']\n",
+        "import os\nv = os.getenv('MAGI_X')\n",
+        "import os\nexplicit = 'MAGI_X' in os.environ\n",
+        # importing the names directly must not evade the rule
+        "from os import environ\nv = environ.get('MAGI_X')\n",
+        "from os import getenv\nv = getenv('MAGI_X')\n",
+    ],
+)
+def test_magi002_positive(src):
+    vs = lint_source(src, f"{PKG}/telemetry/x.py")
+    assert "MAGI002" in rules_of(vs)
+
+
+def test_magi002_negative_env_module_exempt():
+    src = "import os\nv = os.environ.get('MAGI_X')\n"
+    assert lint_source(src, f"{PKG}/env.py") == []
+
+
+def test_magi002_negative_accessor_use():
+    src = "from . import env\nv = env.kernel_backend()\n"
+    assert lint_source(src, f"{PKG}/ops/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# MAGI003 — host-sync idioms in traced hot paths
+# ---------------------------------------------------------------------------
+
+
+def test_magi003_item_in_annotated_fn():
+    src = (
+        "import jax\n"
+        "def f(x: jax.Array):\n"
+        "    return x.item()\n"
+    )
+    vs = lint_source(src, f"{PKG}/ops/x.py")
+    assert rules_of(vs) == ["MAGI003"]
+
+
+def test_magi003_float_of_traced_param():
+    src = (
+        "import jax\n"
+        "def f(x: jax.Array):\n"
+        "    return float(x)\n"
+    )
+    assert "MAGI003" in rules_of(lint_source(src, f"{PKG}/serving/x.py"))
+
+
+def test_magi003_asarray_of_traced_param():
+    src = (
+        "import jax\nimport numpy as np\n"
+        "def f(x: jax.Array):\n"
+        "    return np.asarray(x)\n"
+    )
+    assert "MAGI003" in rules_of(lint_source(src, f"{PKG}/parallel/x.py"))
+
+
+def test_magi003_shard_map_decorated_params_all_traced():
+    src = (
+        "import functools\n"
+        "from ..utils.compat import shard_map\n"
+        "@functools.partial(shard_map, mesh=None, in_specs=None,"
+        " out_specs=None)\n"
+        "def f(x, tab):\n"
+        "    return float(tab)\n"
+    )
+    assert "MAGI003" in rules_of(lint_source(src, f"{PKG}/parallel/x.py"))
+
+
+def test_magi003_negative_host_static_param():
+    # scale: float next to q: jax.Array is host-side — must NOT flag
+    src = (
+        "import jax\n"
+        "def f(q: jax.Array, scale: float):\n"
+        "    return q * float(scale)\n"
+    )
+    assert lint_source(src, f"{PKG}/ops/x.py") == []
+
+
+def test_magi003_negative_outside_hot_paths():
+    src = (
+        "import jax\n"
+        "def f(x: jax.Array):\n"
+        "    return x.item()\n"
+    )
+    # telemetry/ is host-side tooling: the rule is scoped to hot paths
+    assert lint_source(src, f"{PKG}/telemetry/x.py") == []
+
+
+def test_magi003_negative_plain_host_function():
+    src = (
+        "import numpy as np\n"
+        "def f(sizes):\n"
+        "    return float(np.asarray(sizes).max())\n"
+    )
+    assert lint_source(src, f"{PKG}/comm/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# MAGI004 — collectives under named_scope
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("coll", ["ppermute", "all_to_all", "psum"])
+def test_magi004_positive(coll):
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        f"    return jax.lax.{coll}(x, 'cp')\n"
+    )
+    assert "MAGI004" in rules_of(lint_source(src, f"{PKG}/comm/x.py"))
+
+
+def test_magi004_negative_wrapped():
+    src = (
+        "import jax\n"
+        "from ..utils.instrument import named_scope\n"
+        "def f(x):\n"
+        "    with named_scope('magi_x'):\n"
+        "        return jax.lax.ppermute(x, 'cp', [(0, 1)])\n"
+    )
+    assert lint_source(src, f"{PKG}/comm/x.py") == []
+
+
+def test_magi004_negative_non_collective_lax():
+    src = "import jax\nf = jax.lax.axis_index('cp')\n"
+    assert lint_source(src, f"{PKG}/comm/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# pragma + allowlist mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_inline_pragma_suppresses():
+    src = "from jax import shard_map  # magi-allow: MAGI001\n"
+    assert lint_source(src, f"{PKG}/parallel/x.py") == []
+
+
+def test_inline_pragma_wrong_rule_does_not_suppress():
+    src = "from jax import shard_map  # magi-allow: MAGI002\n"
+    assert "MAGI001" in rules_of(lint_source(src, f"{PKG}/parallel/x.py"))
+
+
+def test_allowlist_filters_and_reports_stale():
+    v1 = Violation("MAGI002", f"{PKG}/a.py", 3, "f", "m")
+    v2 = Violation("MAGI002", f"{PKG}/b.py", 5, "g", "m")
+    entries = [
+        {"rule": "MAGI002", "path": f"{PKG}/a.py", "symbol": "f",
+         "justification": "deliberate"},
+        {"rule": "MAGI002", "path": f"{PKG}/gone.py", "symbol": "*",
+         "justification": "obsolete"},
+    ]
+    remaining, stale = apply_allowlist([v1, v2], entries)
+    assert remaining == [v2]
+    assert stale == [entries[1]]
+
+
+def test_allowlist_wildcard_symbol():
+    v = Violation("MAGI004", f"{PKG}/a.py", 3, "deep.nested.fn", "m")
+    entries = [
+        {"rule": "MAGI004", "path": f"{PKG}/a.py", "symbol": "*",
+         "justification": "legacy"},
+    ]
+    remaining, _ = apply_allowlist([v], entries)
+    assert remaining == []
+
+
+def test_allowlist_requires_justification(tmp_path):
+    import json
+
+    p = tmp_path / "allow.json"
+    p.write_text(json.dumps(
+        [{"rule": "MAGI001", "path": "x", "symbol": "*",
+          "justification": "  "}]
+    ))
+    with pytest.raises(ValueError, match="justification"):
+        load_allowlist(str(p))
+
+
+# ---------------------------------------------------------------------------
+# the tree itself
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean_through_allowlist():
+    """The checked-in tree has no unallowlisted violations and no stale
+    allowlist entries — the same assertion `make analyze` gates on."""
+    allow = load_allowlist(
+        os.path.join(REPO, "exps", "data", "analysis_allowlist.json")
+    )
+    remaining, stale = apply_allowlist(lint_package(REPO), allow)
+    assert remaining == [], [v.render() for v in remaining]
+    assert stale == [], stale
+
+
+def test_symbols_are_dotted_scopes():
+    src = (
+        "class C:\n"
+        "    def m(self):\n"
+        "        import os\n"
+        "        return os.getenv('X')\n"
+    )
+    (v,) = lint_source(src, f"{PKG}/ops/x.py")
+    assert v.symbol == "C.m"
+    assert v.rule == "MAGI002"

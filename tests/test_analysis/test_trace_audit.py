@@ -1,0 +1,302 @@
+"""Trace-auditor fixtures (analysis/trace_audit.py): a deliberately
+planted extra collective / f32 upcast / value-baking retrace must each
+be caught, and the census expectations must match real comm metas."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from magiattention_tpu.analysis.trace_audit import (
+    collective_census,
+    count_traces,
+    expected_cast_collectives,
+    expected_plan_cast_collectives,
+    expected_reduce_collectives,
+    upcast_census,
+)
+from magiattention_tpu.comm.group_collective import GroupCollectiveMeta
+from magiattention_tpu.utils.compat import shard_map
+
+
+def _mesh(cp):
+    return Mesh(np.array(jax.devices()[:cp]), ("cp",))
+
+
+# ---------------------------------------------------------------------------
+# census walker
+# ---------------------------------------------------------------------------
+
+
+def test_census_counts_planted_ppermute():
+    mesh = _mesh(2)
+
+    def f(x):
+        return jax.lax.ppermute(x, "cp", [(0, 1), (1, 0)])
+
+    g = shard_map(f, mesh=mesh, in_specs=P("cp"), out_specs=P("cp"),
+                  check_vma=False)
+    jaxpr = jax.make_jaxpr(g)(jnp.zeros((2, 4), jnp.float32))
+    assert collective_census(jaxpr) == {"ppermute": 1}
+
+
+def test_census_counts_through_jit_nesting():
+    mesh = _mesh(2)
+
+    def f(x):
+        return jax.lax.all_to_all(
+            x[0], "cp", split_axis=0, concat_axis=0, tiled=False
+        )[None]
+
+    g = jax.jit(shard_map(f, mesh=mesh, in_specs=P("cp"),
+                          out_specs=P("cp"), check_vma=False))
+    jaxpr = jax.make_jaxpr(g)(jnp.zeros((2, 2, 4), jnp.float32))
+    assert collective_census(jaxpr) == {"all_to_all": 1}
+
+
+def test_census_ignores_empty_axes_psum():
+    """shard_map transpose artifacts (psum with axes=()) are not wire
+    traffic and must not count."""
+    mesh = _mesh(2)
+
+    def f(x):
+        return jax.lax.psum(x, ())  # explicit empty-axes no-op
+
+    g = shard_map(f, mesh=mesh, in_specs=P("cp"), out_specs=P("cp"),
+                  check_vma=False)
+    jaxpr = jax.make_jaxpr(g)(jnp.zeros((2, 4), jnp.float32))
+    assert collective_census(jaxpr) == {}
+
+
+def test_census_counts_real_psum():
+    mesh = _mesh(2)
+
+    def f(x):
+        return jax.lax.psum(x, "cp")
+
+    g = shard_map(f, mesh=mesh, in_specs=P("cp"), out_specs=P("cp", None),
+                  check_vma=False)
+    jaxpr = jax.make_jaxpr(g)(jnp.zeros((2, 4), jnp.float32))
+    assert collective_census(jaxpr) == {"psum": 1}
+
+
+# ---------------------------------------------------------------------------
+# upcast census
+# ---------------------------------------------------------------------------
+
+
+def test_upcast_census_counts_planted_convert():
+    def f(x):
+        return (x.astype(jnp.float32) * 2.0).astype(jnp.bfloat16)
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((4,), jnp.bfloat16))
+    assert upcast_census(jaxpr).get("convert_element_type") == 1
+
+
+def test_upcast_census_counts_accumulating_dot():
+    def f(a, b):
+        return jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    jaxpr = jax.make_jaxpr(f)(
+        jnp.zeros((4, 4), jnp.bfloat16), jnp.zeros((4, 4), jnp.bfloat16)
+    )
+    assert upcast_census(jaxpr) == {"dot_general": 1}
+
+
+def test_upcast_census_clean_on_pure_bf16():
+    def f(x):
+        return x * 2
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((4,), jnp.bfloat16))
+    assert upcast_census(jaxpr) == {}
+
+
+def test_upcast_census_clean_on_pure_f32():
+    def f(x):
+        return jnp.exp(x) + 1.0
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((4,), jnp.float32))
+    assert upcast_census(jaxpr) == {}
+
+
+def test_upcast_census_skips_container_eqns():
+    """A jit/shard_map wrapper whose body legitimately returns f32 from
+    bf16 inputs must contribute only its BODY's boundary eqns, not the
+    container itself."""
+
+    @jax.jit
+    def f(x):
+        return x.astype(jnp.float32).sum()
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((4,), jnp.bfloat16))
+    assert upcast_census(jaxpr) == {"convert_element_type": 1}
+
+
+# ---------------------------------------------------------------------------
+# retrace guard harness
+# ---------------------------------------------------------------------------
+
+
+def test_count_traces_stable_on_value_change():
+    body = count_traces(lambda x, t: x * t)
+    f = jax.jit(body)
+    f(jnp.zeros((4,), jnp.float32), jnp.ones((4,), jnp.float32))
+    first = body.traces
+    assert first >= 1
+    # same shape/dtype (strongly typed), different values: cache hit
+    f(
+        jnp.zeros((4,), jnp.float32),
+        jnp.asarray(np.full((4,), 7.0, np.float32)),
+    )
+    assert body.traces == first
+
+
+def test_count_traces_catches_baked_values():
+    body = count_traces(lambda x, t: x * t)
+    jax.jit(lambda x: body(x, 2.0))(jnp.zeros(()))
+    jax.jit(lambda x: body(x, 3.0))(jnp.zeros(()))  # new closure: retrace
+    assert body.traces == 2
+
+
+# ---------------------------------------------------------------------------
+# expectations from comm metas
+# ---------------------------------------------------------------------------
+
+
+def _skewed_send_map(cp, T=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        [
+            rng.choice(T, size=int(rng.integers(1, 8)), replace=False)
+            if s != d else np.empty(0, np.int64)
+            for d in range(cp)
+        ]
+        for s in range(cp)
+    ]
+
+
+def test_expected_cast_a2a_always_one():
+    meta = GroupCollectiveMeta.build(
+        _skewed_send_map(4), [32] * 4, impl="a2a"
+    )
+    assert expected_cast_collectives(meta) == {"all_to_all": 1}
+    assert expected_reduce_collectives(meta, "sum") == {"all_to_all": 1}
+    assert expected_reduce_collectives(meta, "lse") == {"all_to_all": 2}
+
+
+def test_expected_cast_hops_counts_active_hops():
+    meta = GroupCollectiveMeta.build(
+        _skewed_send_map(4), [32] * 4, impl="hops"
+    )
+    n = sum(1 for h in meta.hops if h.shift % 4 != 0)
+    assert n >= 1
+    assert expected_cast_collectives(meta) == {"ppermute": n}
+    assert expected_reduce_collectives(meta, "lse") == {"ppermute": 2 * n}
+
+
+def test_expected_zero_for_empty_map():
+    empty = [[np.empty(0, np.int64)] * 4 for _ in range(4)]
+    meta = GroupCollectiveMeta.build(empty, [32] * 4, impl="auto")
+    assert expected_cast_collectives(meta) == {}
+    assert expected_reduce_collectives(meta, "sum") == {}
+
+
+def test_expected_zero_for_cp1():
+    meta = GroupCollectiveMeta.build(
+        [[np.arange(4)]], [8], impl="a2a"
+    )
+    assert expected_cast_collectives(meta) == {}
+
+
+def test_traced_cast_matches_expectation_both_impls():
+    """End-to-end: the actual traced census equals the meta-derived
+    expectation — the assertion `make analyze` runs across the matrix."""
+    from magiattention_tpu.comm.group_collective import group_cast_m
+
+    cp = 4
+    mesh = _mesh(cp)
+    send_map = _skewed_send_map(cp)
+    for impl in ("a2a", "hops"):
+        meta = GroupCollectiveMeta.build(send_map, [32] * cp, impl=impl)
+        arrays = tuple(jnp.asarray(a) for a in meta.cast_device_arrays())
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P("cp"),) * (1 + len(arrays)),
+            out_specs=P("cp"), check_vma=False,
+        )
+        def cast(x, *arrs, _m=meta):
+            return group_cast_m(x[0], _m, arrs, axis_name="cp")[None]
+
+        x = jnp.zeros((cp, 32, 2), jnp.float32)
+        got = collective_census(jax.make_jaxpr(cast)(x, *arrays))
+        assert got == expected_cast_collectives(meta), impl
+
+
+def test_planted_extra_collective_breaks_expectation():
+    """The audit's core promise: wrap the cast with one stray ppermute
+    and the census no longer matches the CommMeta."""
+    from magiattention_tpu.comm.group_collective import group_cast_m
+
+    cp = 2
+    mesh = _mesh(cp)
+    meta = GroupCollectiveMeta.build(
+        _skewed_send_map(cp), [32] * cp, impl="hops"
+    )
+    arrays = tuple(jnp.asarray(a) for a in meta.cast_device_arrays())
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("cp"),) * (1 + len(arrays)),
+        out_specs=P("cp"), check_vma=False,
+    )
+    def cast_with_stray(x, *arrs):
+        y = group_cast_m(x[0], meta, arrs, axis_name="cp")
+        # the planted bug: an extra hop nobody priced
+        return jax.lax.ppermute(y[None], "cp", [(0, 1), (1, 0)])
+
+    x = jnp.zeros((cp, 32, 2), jnp.float32)
+    got = collective_census(jax.make_jaxpr(cast_with_stray)(x, *arrays))
+    assert got != expected_cast_collectives(meta)
+    want = dict(expected_cast_collectives(meta))
+    want["ppermute"] = want.get("ppermute", 0) + 1
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# plan-level expectation
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl", ["a2a", "hops"])
+def test_expected_plan_cast_collectives(impl, monkeypatch):
+    from magiattention_tpu.common.enum import AttnMaskType
+    from magiattention_tpu.common.ranges import AttnRanges
+    from magiattention_tpu.meta.dispatch_meta import (
+        make_dispatch_meta_from_qk_ranges,
+    )
+    from magiattention_tpu.parallel.dist_attn import build_dist_attn_plan
+
+    monkeypatch.setenv("MAGI_ATTENTION_GROUP_COLL_IMPL", impl)
+    total, cp = 512, 4
+    qr = AttnRanges.from_ranges([(0, total)])
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, qr, [AttnMaskType.CAUSAL], total, total,
+        chunk_size=total // 16, cp_size=cp,
+    )
+    plan = build_dist_attn_plan(mq, bucket)
+    expect = expected_plan_cast_collectives(plan)
+    if impl == "a2a":
+        assert expect == {"all_to_all": 1}
+    else:
+        n = sum(
+            1 for h in plan.merged_comm.hops
+            if h.shift % cp != 0
+        )
+        assert expect == {"ppermute": n} and n >= 1

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from magiattention_tpu.utils.compat import shard_map
 
 from magiattention_tpu.comm import (
     GroupCollectiveMeta,

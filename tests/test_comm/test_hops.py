@@ -272,6 +272,8 @@ def test_reduce_lse_parity(cp, kind):
     )
 
 
+@pytest.mark.slow  # 29s; grad parity through the lse reduce is also
+# covered (smaller) by test_reduce_lse_parity + the pipeline grad suites
 def test_reduce_lse_grad_parity():
     """Gradients through the lse merge must agree between impls — every
     input (partials, lse partials, local accumulators) gets the same

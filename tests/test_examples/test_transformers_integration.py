@@ -80,6 +80,7 @@ def test_backend_rejects_batched_input():
             model(ids)
 
 
+@pytest.mark.slow  # 24s; fwd parity stays live above (ISSUE 7 re-tier)
 def test_registered_backend_gradients_match_eager():
     """The torch<->jax autograd bridge: parameter gradients of a full HF
     model trained through the magi backend must match eager attention —
@@ -126,6 +127,7 @@ def test_registered_backend_gradients_match_eager():
     assert g_magi["model.embed_tokens.weight"].abs().max().item() > 0
 
 
+@pytest.mark.slow  # 50s; HF trainer round-trip (ISSUE 7 re-tier)
 def test_magi_trainer_two_steps(tmp_path):
     """MagiTrainer end to end: per-batch key creation + training through
     the differentiable bridge (reference examples/transformers/
@@ -169,6 +171,7 @@ def test_magi_trainer_two_steps(tmp_path):
     assert np.isfinite(out.training_loss)
 
 
+@pytest.mark.slow  # 13s (ISSUE 7 re-tier)
 def test_magi_trainer_padded_batch_excludes_pads(tmp_path):
     """A right-padded batch routes through the padded-mask adapter: the
     key's q coverage stops at the valid length (pad rows attend nothing
@@ -220,6 +223,7 @@ def test_magi_trainer_padded_batch_excludes_pads(tmp_path):
     assert max(e for _, e in key.q_ranges) == valid, key.q_ranges
 
 
+@pytest.mark.slow  # 18s (ISSUE 7 re-tier)
 def test_magi_trainer_eval_batch_squashes(tmp_path):
     """Mid-training evaluation with the default eval batch size (8 > 1)
     squashes [b, s] -> [1, b*s] with per-sample key + RoPE restarts

@@ -154,6 +154,9 @@ def test_dit_cp_invariance():
     np.testing.assert_allclose(outs[0], outs[1], atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # 18s on this box; liveness + causality + cp
+# invariance keep default-tier DiT coverage (ISSUE 7 budget note in
+# docs/testing.md)
 def test_dit_remat_matches_no_remat():
     """DiTConfig(remat=True): one train step's loss and updated params are
     identical to the stored-activation path."""

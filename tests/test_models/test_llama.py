@@ -107,9 +107,12 @@ def _tree_close(a, b, rtol=2e-4, atol=2e-5):
 @pytest.mark.parametrize(
     "axes,tp_axis",
     [
-        ({"dp": 2, "cp": 4}, None),
-        # tp wiring stays proven by the 4-D driver dryrun + pp tests;
-        # the extra ~100s oracle-exactness run is slow-tier
+        # both oracle-exactness runs are slow-tier since the ISSUE 7
+        # compat refactor resurrected this suite in CI (46s + 100s on
+        # this 1-core box vs the 870s tier-1 budget); default-tier
+        # dp/cp+oracle wiring is proven by test_magi_llama_pp_matches_
+        # oracle[axes0] below, which shares the layer stack
+        pytest.param({"dp": 2, "cp": 4}, None, marks=pytest.mark.slow),
         pytest.param(
             {"dp": 2, "cp": 2, "tp": 2}, "tp", marks=pytest.mark.slow
         ),
@@ -137,7 +140,11 @@ def test_magi_llama_matches_oracle(oracle, axes, tp_axis):
     "axes,tp_axis",
     [
         ({"pp": 2, "dp": 2, "cp": 2}, None),
-        ({"pp": 2, "dp": 1, "cp": 2, "tp": 2}, "tp"),
+        # the tp variant is slow-tier (16s; budget note above)
+        pytest.param(
+            {"pp": 2, "dp": 1, "cp": 2, "tp": 2}, "tp",
+            marks=pytest.mark.slow,
+        ),
     ],
 )
 def test_magi_llama_pp_matches_oracle(oracle, axes, tp_axis):
@@ -201,6 +208,7 @@ def test_build_validation():
         )
 
 
+@pytest.mark.slow  # 12s; remat parity is redundant with the dp/cp oracle
 def test_remat_matches_no_remat():
     """cfg.remat=True recomputes layers in backward; loss and gradients
     must match the stored-activation path (same math, different
@@ -241,6 +249,7 @@ def test_remat_matches_no_remat():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+@pytest.mark.slow  # 9s; see test_remat_matches_no_remat note
 def test_pp_remat_matches_no_remat():
     """cfg.remat inside the pipeline-parallel stage scan: one train step's
     loss and updated params identical to the stored-activation path on a
@@ -287,7 +296,10 @@ def test_pp_remat_matches_no_remat():
 @pytest.mark.parametrize(
     "cp_axes",
     [
-        {"cpo": 2, "cpi": 4},  # hierarchical 2-level cp (inter, intra)
+        # hierarchical 2-level cp (inter, intra); slow-tier since the
+        # ISSUE 7 resurrection (41s on this box) — the 2-level comm path
+        # keeps default-tier coverage in tests/test_comm/test_hier.py
+        pytest.param({"cpo": 2, "cpi": 4}, marks=pytest.mark.slow),
         pytest.param({"cpo": 4, "cpi": 2}, marks=pytest.mark.slow),
     ],
 )

@@ -48,7 +48,11 @@ MASKS = [
 ]
 
 
-@pytest.mark.parametrize("cp", [2, 4])
+# ISSUE 7 budget re-tier: resurrected in CI; heaviest params are
+# slow-tier to keep tier-1 inside its 870s budget (docs/testing.md)
+@pytest.mark.parametrize(
+    "cp", [2, pytest.param(4, marks=pytest.mark.slow)]
+)
 @pytest.mark.parametrize("name,total,qr,kr,ts", MASKS, ids=[m[0] for m in MASKS])
 def test_ring_attention(name, total, qr, kr, ts, cp):
     hq, hk, d = 4, 2, 64
@@ -77,7 +81,9 @@ def test_ring_attention(name, total, qr, kr, ts, cp):
     assert_close(g, gr, atol=1e-4, rtol=1e-4, msg=f"ring {name} dk")
 
 
-@pytest.mark.parametrize("cp", [2, 4])
+@pytest.mark.parametrize(
+    "cp", [2, pytest.param(4, marks=pytest.mark.slow)]
+)
 @pytest.mark.parametrize("name,total,qr,kr,ts", MASKS, ids=[m[0] for m in MASKS])
 def test_ulysses_attention(name, total, qr, kr, ts, cp):
     hq, hk, d = 4, 4, 32
@@ -102,7 +108,11 @@ def test_ulysses_attention(name, total, qr, kr, ts, cp):
     assert_close(g, gr, atol=1e-4, rtol=1e-4, msg=f"ulysses {name} dv")
 
 
-@pytest.mark.parametrize("u,r", [(2, 2), (4, 2), (2, 4)])
+@pytest.mark.parametrize(
+    "u,r",
+    [(2, 2), pytest.param(4, 2, marks=pytest.mark.slow),
+     pytest.param(2, 4, marks=pytest.mark.slow)],
+)
 def test_usp_attention(u, r):
     """USP = ulysses (heads) x ring (seq) over a 2-D mesh."""
     from magiattention_tpu.parallel.baselines import build_usp_plan, make_usp_attn_fn
@@ -150,7 +160,8 @@ def test_usp_attention(u, r):
 
 @pytest.mark.parametrize(
     "ro,ri",
-    [(2, 2), (2, 4), pytest.param(4, 2, marks=pytest.mark.slow)],
+    [(2, 2), pytest.param(2, 4, marks=pytest.mark.slow),
+     pytest.param(4, 2, marks=pytest.mark.slow)],
 )
 def test_double_ring_attention(ro, ri):
     """LoongTrain-style double ring (outer x inner KV rotation)."""

@@ -78,7 +78,12 @@ def _pipeline(total, qr, kr, ts, cp, dtype, out_dtype):
     return q, k, v, out, lse, g
 
 
-@pytest.mark.parametrize("backend", ["jnp", "jnp_online"])
+# ISSUE 7 budget re-tier: resurrected in CI; heaviest params are
+# slow-tier to keep tier-1 inside its 870s budget (docs/testing.md)
+@pytest.mark.parametrize(
+    "backend",
+    ["jnp", pytest.param("jnp_online", marks=pytest.mark.slow)],
+)
 @pytest.mark.parametrize(
     "name,total,qr,kr,ts", SCENARIOS, ids=[s[0] for s in SCENARIOS]
 )

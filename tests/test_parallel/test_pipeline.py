@@ -133,7 +133,16 @@ def test_stage_tables_carry_real_major_block_counts():
 
 @pytest.mark.parametrize("cp", [1, 2, 4])
 @pytest.mark.parametrize(
-    "name,total,qr,kr,ts", SCENARIOS, ids=[s[0] for s in SCENARIOS]
+    "name,total,qr,kr,ts",
+    # full_attn is the heaviest scenario post-resurrection (18s at cp=1
+    # on this box); causal + varlen keep every cp live in tier-1
+    # (ISSUE 7 budget re-tier, docs/testing.md)
+    [
+        pytest.param(*s, marks=pytest.mark.slow)
+        if s[0] == "full_attn_1k" else s
+        for s in SCENARIOS
+    ],
+    ids=[s[0] for s in SCENARIOS],
 )
 def test_pipeline_fwd_bwd(name, total, qr, kr, ts, cp):
     hq, hk, d = 4, 2, 64
@@ -484,6 +493,7 @@ def test_load_balanced_plan_beats_sequential():
     assert plan_b.max_rank_area < plan_s.max_rank_area
 
 
+@pytest.mark.slow  # 12s cp=8 stress variant (ISSUE 7 re-tier)
 def test_large_varlen_block_causal_cp8():
     """Scaled version of the reference's varlen_block_causal_144k flagship
     scenario: 4k tokens, 5 docs, cp=8, chunk 64."""

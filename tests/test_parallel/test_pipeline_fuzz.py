@@ -106,7 +106,12 @@ def test_empty_rank_stage_regression():
     assert_close(out, ref_out, atol=5e-5, rtol=5e-5, msg="empty-stage mask")
 
 
-@pytest.mark.parametrize("seed", range(12))
+# ISSUE 7 budget re-tier: resurrected in CI; heaviest params are
+# slow-tier to keep tier-1 inside its 870s budget (docs/testing.md)
+@pytest.mark.parametrize(
+    "seed",
+    [0] + [pytest.param(s, marks=pytest.mark.slow) for s in range(1, 12)],
+)
 def test_pipeline_fuzz(seed):
     rng = np.random.default_rng(1000 + seed)
     total = int(rng.choice([512, 768, 1024]))

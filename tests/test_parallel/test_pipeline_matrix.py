@@ -253,6 +253,7 @@ def test_sink_through_distributed_path(degree):
     assert_close(g, gr, atol=5e-5, rtol=5e-5, msg=f"dsink d{degree}")
 
 
+@pytest.mark.slow  # 16s; scale variant of the default-tier overlap cases
 def test_q_overlap_at_scale():
     """Overlapping q ranges with disjoint (q,k) coverage at 4k, cp=8
     (reference q-overlap scenarios at scale)."""

@@ -73,10 +73,18 @@ def _solver_for(kind):
     }[kind]()
 
 
+# ISSUE 7 budget re-tier: resurrected in CI; heaviest params are
+# slow-tier to keep tier-1 inside its 870s budget (docs/testing.md)
 @pytest.mark.parametrize(
-    "solver_kind", ["kd", "ncq", "locality", "grid", "auto", "snf"]
+    "solver_kind",
+    ["auto"] + [
+        pytest.param(s, marks=pytest.mark.slow)
+        for s in ("kd", "ncq", "locality", "grid", "snf")
+    ],
 )
-@pytest.mark.parametrize("cp", [2, 4])
+@pytest.mark.parametrize(
+    "cp", [2, pytest.param(4, marks=pytest.mark.slow)]
+)
 @pytest.mark.parametrize("name,total,slices", CASES, ids=[c[0] for c in CASES])
 def test_qo_comm_pipeline(name, total, slices, cp, solver_kind):
     hq, hk, d = 2, 2, 64
@@ -167,11 +175,13 @@ def test_qo_comm_sink(cp):
 
 @pytest.mark.parametrize(
     "solver_kind",
-    # one full case stays in the default tier; the rest of the matrix is
-    # slow-tier (each ~100s on this 1-core box; the wiring they share is
-    # identical, only the planner differs — and planners are covered
-    # kernel-free in test_qo_comm_pipeline and test_meta)
-    ["auto", pytest.param("kd", marks=pytest.mark.slow),
+    # the whole matrix is slow-tier since the ISSUE 7 compat refactor
+    # resurrected it in CI: the remaining default-tier case measured 70s
+    # of the 870s tier-1 budget on this 1-core box. The wiring the cases
+    # share is covered kernel-free in test_qo_comm_pipeline and
+    # test_meta, and the oracle-exactness matrix runs under --run-slow
+    [pytest.param("auto", marks=pytest.mark.slow),
+     pytest.param("kd", marks=pytest.mark.slow),
      pytest.param("grid", marks=pytest.mark.slow)],
 )
 @pytest.mark.parametrize(
