@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check decode-bench comm-check analyze check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check decode-bench comm-check analyze resilience-check check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -98,7 +98,20 @@ comm-check:
 analyze:
 	JAX_PLATFORMS=cpu $(PY) exps/run_static_analysis.py --self-test
 
+# resilience gate (ISSUE 8, CPU, ~4 min): every chaos injector is
+# caught by its matching guard or degradation path (zero silent
+# corruptions) — stage/split guard detection + repair with grad parity,
+# wire-corruption containment, straggler tracing, backpressure +
+# evict-then-retry, plan/hops build fallbacks, prefill-fault page
+# release, tuning-io counters — and a no-chaos GUARD=check run is
+# bit-identical to off with the trace count unchanged
+# (docs/resilience.md; exps/run_resilience_check.py --overhead times
+# the guard modes with the timeline profiler)
+resilience-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_resilience_check.py
+
 # the default check flow: syntax, static analysis, telemetry catalog +
 # timeline/aggregate semantics, autotuner rung expectations, perf gate,
-# serving parity, group-collective parity/volume — all CPU-safe
-check: lint analyze telemetry-check autotune-check perf-gate serving-check comm-check
+# serving parity, group-collective parity/volume, resilience gate —
+# all CPU-safe
+check: lint analyze telemetry-check autotune-check perf-gate serving-check comm-check resilience-check

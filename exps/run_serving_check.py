@@ -198,7 +198,7 @@ def check_cache_invariants() -> int:
         num_pages=8, num_kv_heads=HK, head_dim=D, page_size=ps,
         max_seqs=2, max_pages_per_seq=4, dtype=jnp.float32,
     )
-    s0 = eng.admit(40)
+    s0 = eng.admit(40).slot
     eng.prefill(
         jnp.zeros((40, HQ, D), jnp.float32),
         jnp.ones((40, HK, D), jnp.float32),
@@ -207,7 +207,7 @@ def check_cache_invariants() -> int:
     eng.free(s0)
     if eng.occupancy()["pages_in_use"] != 0:
         return fail("free did not return pages to the pool")
-    s1 = eng.admit(16)
+    s1 = eng.admit(16).slot
     k1 = jnp.asarray(rng.standard_normal((10, HK, D)), jnp.float32)
     eng.prefill(jnp.zeros((10, HQ, D), jnp.float32), k1, k1, s1)
     gk1, _ = gather_kv(eng.cache, s1)

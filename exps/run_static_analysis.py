@@ -14,7 +14,9 @@ walking or abstract tracing; nothing executes on a device):
    local plans and cp=1; ppermutes == active hops; a2a counts), group
    cast/reduce census for both impls, decode census, bf16->f32 upcast
    census vs ``exps/data/trace_audit_expectations.json``, retrace
-   guard.
+   guard, and the ISSUE 8 guard census (``MAGI_ATTENTION_GUARD=off``
+   traces zero ``is_finite`` guard ops; ``check`` traces detection for
+   real with unchanged output avals).
 3. **Plan sanitizer self-check** (``analysis/plan_sanity.py``):
    canonical plans validate clean, and a battery of deliberately
    mutated plans/metas each FAIL (OOB ranges, non-permutation recv
@@ -103,6 +105,12 @@ def run_trace_audit(update: bool) -> tuple[list[str], dict]:
     report.update(r)
 
     e, r = ta.audit_decode()
+    errors += e
+    report.update(r)
+
+    # ISSUE 8: GUARD=off traces zero guard ops (is_finite census) and
+    # GUARD=check actually puts detection in the program
+    e, r = ta.audit_guard_ops()
     errors += e
     report.update(r)
 
@@ -370,6 +378,16 @@ def run_self_test() -> list[str]:
         errors.append(
             f"self-test: planted upcast census {up} missed the "
             "bf16->f32 convert"
+        )
+
+    # pass 2b': a planted guard sentinel must appear in the guard census
+    gc = ta.guard_census(
+        jax.make_jaxpr(lambda x: jnp.isfinite(x))(jnp.zeros((4,)))
+    )
+    if gc != 1:
+        errors.append(
+            f"self-test: planted is_finite guard census {gc} != 1 — the "
+            "guard-census walker missed a sentinel"
         )
 
     # pass 2c: a planted value-baking closure must register as a retrace

@@ -12,6 +12,7 @@ Layering (mirrors reference SURVEY.md layer map, re-designed TPU-first):
 - ``comm/``     : group_cast/group_reduce collectives over jax.lax + shard_map
 - ``parallel/`` : distributed attention runtime (the hot path)
 - ``serving/``  : inference path — paged KV cache + split-KV decode
+- ``resilience/``: fault injection + numerical guards + degradation
 - ``api/``      : user-facing key-cached interface
 - ``models/``   : flagship model families built on the framework
 - ``testing/``  : reference oracles + precision harness
@@ -39,8 +40,9 @@ def __getattr__(name):
     import importlib
 
     if name in (
-        "api", "benchmarking", "comm", "config", "env", "meta", "models",
-        "ops", "parallel", "serving", "telemetry", "testing", "utils",
+        "analysis", "api", "benchmarking", "comm", "config", "env",
+        "meta", "models", "ops", "parallel", "resilience", "serving",
+        "telemetry", "testing", "utils",
     ):
         return importlib.import_module(f".{name}", __name__)
     if name in ("init_dist_attn_runtime_key", "init_dist_attn_runtime_mgr"):
@@ -64,6 +66,7 @@ __all__ = [
     "ops",
     "parallel",
     "recommended_compiler_options",
+    "resilience",
     "serving",
     "telemetry",
     "testing",

@@ -96,6 +96,20 @@ def collective_census(jaxpr) -> dict[str, int]:
     return counts
 
 
+def guard_census(jaxpr) -> int:
+    """Count of numerical-guard sentinel eqns in a traced program.
+
+    The guards (``resilience/guards.py``) funnel every detection through
+    ``jnp.isfinite`` — the ``is_finite`` primitive is their census
+    marker by construction (nothing else in the runtime traces it; the
+    legitimate -inf handling uses ``eq``-based ``isneginf``). A
+    ``MAGI_ATTENTION_GUARD=off`` trace must census ZERO — the off path
+    is provably free, not just probably."""
+    return sum(
+        1 for eqn in iter_eqns(jaxpr) if eqn.primitive.name == "is_finite"
+    )
+
+
 def upcast_census(jaxpr) -> dict[str, int]:
     """Per-primitive counts of bf16 -> f32 boundary eqns: any eqn with a
     bfloat16 array input and a float32 array output. The documented
@@ -414,30 +428,81 @@ def audit_flex_matrix(
     return errors, report
 
 
-class _pinned_impl:
-    """Temporarily pin MAGI_ATTENTION_GROUP_COLL_IMPL (None = leave)."""
+class _pinned_env:
+    """Temporarily pin one env var (None value = leave untouched)."""
 
-    def __init__(self, impl: str | None):
-        self.impl = impl
+    def __init__(self, name: str, value: str | None):
+        self.name = name
+        self.value = value
 
     def __enter__(self):
         import os
 
         # save/restore pin, not a config read
-        self.prev = os.environ.get("MAGI_ATTENTION_GROUP_COLL_IMPL")  # magi-allow: MAGI002
-        if self.impl is not None:
-            os.environ["MAGI_ATTENTION_GROUP_COLL_IMPL"] = self.impl  # magi-allow: MAGI002
+        self.prev = os.environ.get(self.name)  # magi-allow: MAGI002
+        if self.value is not None:
+            os.environ[self.name] = self.value  # magi-allow: MAGI002
         return self
 
     def __exit__(self, *exc):
         import os
 
-        if self.impl is not None:
+        if self.value is not None:
             if self.prev is None:
-                os.environ.pop("MAGI_ATTENTION_GROUP_COLL_IMPL", None)  # magi-allow: MAGI002
+                os.environ.pop(self.name, None)  # magi-allow: MAGI002
             else:
-                os.environ["MAGI_ATTENTION_GROUP_COLL_IMPL"] = self.prev  # magi-allow: MAGI002
+                os.environ[self.name] = self.prev  # magi-allow: MAGI002
         return False
+
+
+class _pinned_impl(_pinned_env):
+    """Temporarily pin MAGI_ATTENTION_GROUP_COLL_IMPL (None = leave)."""
+
+    def __init__(self, impl: str | None):
+        super().__init__("MAGI_ATTENTION_GROUP_COLL_IMPL", impl)
+
+
+def audit_guard_ops(*, total: int = 512, chunk: int = 64) -> tuple[list[str], dict]:
+    """Guard census over the real flex entry (ISSUE 8 satellite).
+
+    ``MAGI_ATTENTION_GUARD=off`` must trace ZERO guard ops in calc AND
+    grad — the guards' disabled path is provably free. ``check`` must
+    trace at least one per guarded merge site (detection is actually in
+    the program, not just claimed) while keeping the output avals
+    identical to the off trace (bit-transparency has an execution-level
+    proof in ``make resilience-check``; here we pin the structural
+    half)."""
+    errors: list[str] = []
+    report: dict = {}
+    mesh = _mesh(2)
+    with _pinned_env("MAGI_ATTENTION_GUARD", "off"):
+        key_off = _build_key(2, "causal", mesh, "bfloat16", total, chunk)
+        off_fwd = _trace_calc(key_off, "bfloat16", total, False)
+        off_grad = _trace_calc(key_off, "bfloat16", total, True)
+        n_off = guard_census(off_fwd) + guard_census(off_grad)
+        off_avals = [str(a) for a in off_fwd.out_avals]
+    with _pinned_env("MAGI_ATTENTION_GUARD", "check"):
+        key_chk = _build_key(2, "causal", mesh, "bfloat16", total, chunk)
+        chk_fwd = _trace_calc(key_chk, "bfloat16", total, False)
+        n_chk = guard_census(chk_fwd)
+        chk_avals = [str(a) for a in chk_fwd.out_avals]
+    report["guard_census"] = {"off": n_off, "check_fwd": n_chk}
+    if n_off:
+        errors.append(
+            f"GUARD=off traced {n_off} guard op(s) (is_finite) — the "
+            "off path must be provably free"
+        )
+    if n_chk == 0:
+        errors.append(
+            "GUARD=check traced zero guard ops — detection is not in "
+            "the program"
+        )
+    if off_avals != chk_avals:
+        errors.append(
+            f"GUARD=check changed the entry's output avals: off="
+            f"{off_avals} check={chk_avals}"
+        )
+    return errors, report
 
 
 def audit_group_collectives(*, cp: int = 4) -> tuple[list[str], dict]:

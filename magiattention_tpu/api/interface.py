@@ -105,6 +105,8 @@ def check_flag_comb(
             f"not one of {env.GROUP_COLL_IMPLS}"
         )
     env.comm_pad_to()  # raises on a non-power-of-two rung
+    env.guard_mode()  # raises on an unknown guard mode
+    env.chaos_spec()  # raises on a malformed chaos spec
     if hier_flag and not hier_axis:
         raise ValueError(
             "MAGI_ATTENTION_HIERARCHICAL_COMM=1 requires a 2-D "

@@ -262,36 +262,57 @@ class GroupCollectiveMeta:
         impl_resolved, reason = _resolve_impl(impl, hop_specs, cp, S)
         hops: tuple[HopPlan, ...] = ()
         if impl_resolved == "hops":
-            # dst-side segment offsets of the (src-rank-major) recv layout
-            offsets = np.zeros((cp, cp), dtype=np.int64)
-            offsets[1:] = np.cumsum(sizes, axis=0)[:-1]  # [src, dst]
-            plans = []
-            for k, Sk in hop_specs:
-                h_send = np.zeros((cp, Sk), dtype=np.int32)
-                h_recv = np.full((cp, Sk), R, dtype=np.int32)  # pads->trash
-                h_seg = np.zeros((cp, Sk), dtype=np.int32)
-                for r in range(cp):
-                    d = (r + k) % cp
-                    idx = np.asarray(
-                        send_map[r][d], dtype=np.int32
-                    ).reshape(-1)
-                    h_send[r, : idx.size] = idx
-                    h_seg[r, : idx.size] = idx
-                    h_seg[r, idx.size :] = num_local_rows[r]
-                for d in range(cp):
-                    s = (d - k) % cp
-                    n = int(sizes[s, d])
-                    h_recv[d, :n] = offsets[s, d] + np.arange(n)
-                plans.append(
-                    HopPlan(
-                        shift=k,
-                        size=Sk,
-                        send_idx=h_send,
-                        recv_pos=h_recv,
-                        seg_ids=h_seg,
+            try:
+                from ..resilience import chaos
+
+                chaos.maybe_fail("hops_build_error")
+                # dst-side segment offsets of the (src-rank-major) recv
+                # layout
+                offsets = np.zeros((cp, cp), dtype=np.int64)
+                offsets[1:] = np.cumsum(sizes, axis=0)[:-1]  # [src, dst]
+                plans = []
+                for k, Sk in hop_specs:
+                    h_send = np.zeros((cp, Sk), dtype=np.int32)
+                    h_recv = np.full((cp, Sk), R, dtype=np.int32)
+                    h_seg = np.zeros((cp, Sk), dtype=np.int32)
+                    for r in range(cp):
+                        d = (r + k) % cp
+                        idx = np.asarray(
+                            send_map[r][d], dtype=np.int32
+                        ).reshape(-1)
+                        h_send[r, : idx.size] = idx
+                        h_seg[r, : idx.size] = idx
+                        h_seg[r, idx.size :] = num_local_rows[r]
+                    for d in range(cp):
+                        s = (d - k) % cp
+                        n = int(sizes[s, d])
+                        h_recv[d, :n] = offsets[s, d] + np.arange(n)
+                    plans.append(
+                        HopPlan(
+                            shift=k,
+                            size=Sk,
+                            send_idx=h_send,
+                            recv_pos=h_recv,
+                            seg_ids=h_seg,
+                        )
                     )
+                hops = tuple(plans)
+            except Exception as exc:  # noqa: BLE001 — degradation path
+                # graceful degradation (ISSUE 8): a failed hop-schedule
+                # construction falls back to the always-available
+                # globally-padded a2a realization (correct, just more
+                # wire volume) — recorded, never silent
+                telemetry.record_degraded_path("hops_build_error")
+                from ..telemetry.logger import get_logger
+
+                get_logger("resilience").warning(
+                    "hop-schedule build failed (%s: %s) — degrading "
+                    "this collective to the a2a impl",
+                    type(exc).__name__,
+                    exc,
                 )
-            hops = tuple(plans)
+                impl_resolved, reason = "a2a", "degraded_hops_build_error"
+                hops = ()
         meta = GroupCollectiveMeta(
             cp_size=cp,
             max_send=S,
@@ -587,6 +608,9 @@ def hop_cast(
     collective); an empty hop list traces nothing at all."""
     from ..utils.instrument import named_scope
 
+    from ..resilience import chaos
+
+    straggle = chaos.enabled()
     with named_scope("magi_group_cast"):
         out = jnp.zeros((max_recv + 1,) + x.shape[1:], x.dtype)
         if hops:
@@ -597,6 +621,10 @@ def hop_cast(
                     buf = jax.lax.ppermute(
                         buf, axis_name, _hop_perm(world, hop.shift)
                     )
+                if straggle:
+                    # injectable straggler: a serialization loop on the
+                    # chosen hop (bit-transparent to the payload)
+                    buf = chaos.straggler_delay(buf, hop.shift)
                 # pads point at the trash slot max_recv; real rows land at
                 # their (src-rank-major, send-pos) position
                 out = out.at[recv_pos].set(buf)
@@ -755,7 +783,7 @@ def group_cast_m(
     """Multicast through the meta's selected impl. ``arrays`` may be the
     cast or the reduce layout (the hop stride / a2a prefix adapts)."""
     if meta.impl == "hops":
-        return hop_cast(
+        out = hop_cast(
             x,
             meta.hops,
             arrays,
@@ -763,8 +791,18 @@ def group_cast_m(
             axis_name=axis_name,
             world=meta.cp_size,
         )
-    send_idx, recv_sel, recv_valid = arrays[:3]
-    return group_cast(x, send_idx, recv_sel, recv_valid, axis_name=axis_name)
+    else:
+        send_idx, recv_sel, recv_valid = arrays[:3]
+        out = group_cast(
+            x, send_idx, recv_sel, recv_valid, axis_name=axis_name
+        )
+    from ..resilience import chaos
+
+    if chaos.enabled():
+        # injectable wire corruption: faults land on the recv buffer,
+        # the exact surface a corrupted comm payload would poison
+        out = chaos.corrupt_cast_payload(out, axis_name=axis_name)
+    return out
 
 
 def group_reduce_sum_m(
@@ -778,6 +816,10 @@ def group_reduce_sum_m(
     counts: jax.Array | None = None,
 ):
     telemetry.record_comm_op(meta, "reduce_sum")
+    from ..resilience import chaos
+
+    if chaos.enabled():
+        y = chaos.corrupt_reduce_payload(y, axis_name=axis_name)
     if meta.impl == "hops":
         return hop_reduce_sum(
             y,
@@ -815,6 +857,21 @@ def group_reduce_lse_m(
     axis_name,
 ):
     telemetry.record_comm_op(meta, "reduce_lse")
+    from ..resilience import chaos, guards
+
+    if chaos.enabled():
+        out_partial = chaos.corrupt_reduce_payload(
+            out_partial, axis_name=axis_name
+        )
+        lse_partial = chaos.corrupt_reduce_payload(
+            lse_partial, axis_name=axis_name
+        )
+    # repair-mode containment: a poisoned partial row merges as a no-op
+    # (lse -> -inf drops it from the segment logsumexp exactly); check
+    # detection is owned by the callers that thread an error code
+    out_partial, lse_partial = guards.quarantine_if_repair(
+        out_partial, lse_partial, "reduce_lse"
+    )
     if meta.impl == "hops":
         return hop_reduce_lse(
             out_partial,

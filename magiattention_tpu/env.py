@@ -73,6 +73,57 @@ def validate_mode() -> str:
     return v
 
 
+GUARD_MODES = ("off", "check", "repair")
+
+
+def guard_mode() -> str:
+    """Numerical-guard mode (``resilience/guards.py``), validated here:
+
+    - ``off`` (default): no sentinels — the traced programs contain ZERO
+      guard ops (proved by the trace audit's guard census).
+    - ``check``: non-finite partials at the guarded merge boundaries
+      accumulate an in-graph error code; at the jit boundary a typed
+      ``NumericalGuardError`` is raised naming the failing stage/site.
+      Data is bit-identical to ``off``.
+    - ``repair``: bad rows are additionally quarantined in-graph
+      (lse -> -inf, out -> 0) so one poisoned partial merges as a no-op
+      through the hardened correction path.
+
+    Changes the traced program, so part of :func:`flags_fingerprint`."""
+    v = _env_str("MAGI_ATTENTION_GUARD", "off").strip().lower()
+    if v not in GUARD_MODES:
+        raise ValueError(
+            f"MAGI_ATTENTION_GUARD={v!r} must be one of {GUARD_MODES}"
+        )
+    return v
+
+
+# last spec that passed grammar validation: chaos hooks sit on per-
+# admission / per-allocate host paths and call the accessor repeatedly,
+# so an unchanged spec must not re-parse every time
+_chaos_spec_validated: str | None = None
+
+
+def chaos_spec() -> str:
+    """Raw fault-injection spec (``resilience/chaos.py``); '' = chaos
+    off (the default — every hook is then a single predicate). A
+    non-empty spec is grammar-validated here (one clause per injector,
+    ``kind:key=value,...`` joined by ';' — see docs/resilience.md),
+    once per distinct value.
+
+    Injectors edit the traced program / host control flow, so the spec
+    is part of :func:`flags_fingerprint` — a chaos run can never share a
+    runtime key with a clean one."""
+    global _chaos_spec_validated
+    v = _env_str("MAGI_ATTENTION_CHAOS", "").strip()
+    if v and v != _chaos_spec_validated:
+        from .resilience.chaos import parse_chaos_spec
+
+        parse_chaos_spec(v)  # raises ValueError on bad grammar
+        _chaos_spec_validated = v
+    return v
+
+
 def mask_skip_disabled() -> bool:
     """Debug: force the diagnostic needs-mask flag to 1 on every entry
     in ``ops/block_meta.py``. Since the round-5 rewrite the kernels mask
@@ -382,4 +433,6 @@ def flags_fingerprint() -> tuple:
         autotune_mode(),
         group_coll_impl(),
         comm_pad_to(),
+        guard_mode(),
+        chaos_spec(),
     )

@@ -49,7 +49,19 @@ def correct_attn_out_lse(
 
     out = exp(lse1 - lse) * out1 + exp(lse2 - lse) * out2;
     rows covered by neither stay (0, -inf). fp32 internally.
+
+    Under ``MAGI_ATTENTION_GUARD=repair`` (resilience/guards.py) each
+    input partial is quarantined first: rows with a nan/+inf lse or a
+    non-finite out merge as (0, -inf) no-ops instead of poisoning the
+    result — the in-graph containment every merge in the tree inherits
+    (split-KV decode, CP decode, the staged trainer). ``off`` traces
+    zero extra ops; ``check`` detection is owned by the callers that can
+    thread an error code (dist_attn, decode_attn).
     """
+    from ..resilience.guards import quarantine_if_repair
+
+    out1, lse1 = quarantine_if_repair(out1, lse1, "correction")
+    out2, lse2 = quarantine_if_repair(out2, lse2, "correction")
     lse = safe_lse_merge(lse1, lse2)
     return correct_attn_out(out1, lse1, out2, lse2, lse), lse
 

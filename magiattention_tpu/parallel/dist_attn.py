@@ -458,15 +458,43 @@ def build_dist_attn_plan(
     with telemetry.span(
         "build_dist_attn_plan", cp=dispatch_meta.cp_size
     ):
-        plan = _build_dist_attn_plan(
-            dispatch_meta,
-            bucket,
-            kv_dispatch_meta=kv_dispatch_meta,
-            block_q=block_q,
-            block_k=block_k,
-            overlap_config=overlap_config,
-            cp_mesh_shape=cp_mesh_shape,
-        )
+        try:
+            plan = _build_dist_attn_plan(
+                dispatch_meta,
+                bucket,
+                kv_dispatch_meta=kv_dispatch_meta,
+                block_q=block_q,
+                block_k=block_k,
+                overlap_config=overlap_config,
+                cp_mesh_shape=cp_mesh_shape,
+            )
+        except Exception as exc:  # noqa: BLE001 — degradation, recorded
+            # graceful degradation (ISSUE 8): a solver/staged-build
+            # failure falls back to the dense single-bucket degree-0
+            # plan — one merged cast + one kernel call, no overlap
+            # solver, no stage assignment. Never silent: the reason is
+            # recorded as magi_degraded_path and logged.
+            cfg = overlap_config or OverlapConfig()
+            if cfg.degree == 0:
+                raise  # the fallback IS the path that failed
+            telemetry.record_degraded_path("plan_build_error")
+            from ..telemetry.logger import get_logger
+
+            get_logger("resilience").warning(
+                "plan build failed (%s: %s) — degrading to the dense "
+                "single-bucket degree-0 plan",
+                type(exc).__name__,
+                exc,
+            )
+            plan = _build_dist_attn_plan(
+                dispatch_meta,
+                bucket,
+                kv_dispatch_meta=kv_dispatch_meta,
+                block_q=block_q,
+                block_k=block_k,
+                overlap_config=dataclasses.replace(cfg, degree=0),
+                cp_mesh_shape=cp_mesh_shape,
+            )
     telemetry.record_plan(plan, build_seconds=time.perf_counter() - t0)
     mode = env.validate_mode()
     if mode != "off":
@@ -494,6 +522,9 @@ def _build_dist_attn_plan(
     overlap_config: OverlapConfig | None = None,
     cp_mesh_shape: tuple[int, int] | None = None,
 ) -> DistAttnPlan:
+    from ..resilience import chaos
+
+    chaos.maybe_fail("plan_error")  # injectable solver/build failure
     cp = dispatch_meta.cp_size
     shard_len = dispatch_meta.shard_seqlen
     kv_meta = kv_dispatch_meta or dispatch_meta
@@ -860,13 +891,42 @@ def dist_attn_local(
     *,
     axis_name: str = "cp",
     sink: jax.Array | None = None,
+    with_guard_code: bool = False,
 ):
     """The SPMD hot path — call inside shard_map over the cp axis.
 
     Returns (out [shard_q_len, hq, d], lse [shard_q_len, hq], and the
     rank-local per-head max logit [hq] — pmax it across the cp axis for
     the global value).
+
+    ``with_guard_code``: additionally return the rank-local int32 guard
+    error code as a 4th output (ISSUE 8 — every stage partial is guarded
+    when ``MAGI_ATTENTION_GUARD`` != off; the keyed runtime consumes the
+    code at the jit boundary). Default False keeps the 3-tuple contract
+    for direct callers (models, timeline profiler, trace audit).
     """
+    from ..resilience import chaos, guards
+
+    gmode = guards.guard_mode()
+    code = guards.new_error_code() if with_guard_code else None
+
+    def _resilient(out_p, lse_p, site, site_index):
+        # chaos upstream of the guard — injected faults must travel the
+        # exact path an organic kernel NaN would
+        nonlocal code
+        if chaos.enabled():
+            out_p, lse_p = chaos.corrupt_partial(
+                out_p,
+                lse_p,
+                site,
+                axis_name=axis_name if plan.hier is None else None,
+            )
+        if gmode != "off":
+            out_p, lse_p, code = guards.guard_partial(
+                out_p, lse_p, code, site_index, site
+            )
+        return out_p, lse_p
+
     params = ensure_kernel_steps(
         params,
         (plan.merged_tables, plan.host_tables,
@@ -929,6 +989,9 @@ def dist_attn_local(
                 sink,
             )
         out, lse = _headmajor_to_seq(out_h, lse_lanes, plan.shard_q_len)
+        out, lse = _resilient(out, lse, "merged", 0)
+        if with_guard_code:
+            return out, lse, _head_max(rowmax_lanes), code
         return out, lse, _head_max(rowmax_lanes)
 
     # staged path: host stage + D lse-merged remote stages.
@@ -949,6 +1012,7 @@ def dist_attn_local(
             qh, k, v, host_tab, plan.host_tables.kv_pad, host_params, sink
         )
     out, lse = _headmajor_to_seq(out_h, lse_lanes, plan.shard_q_len)
+    out, lse = _resilient(out, lse, "host", 0)
     mx = _head_max(rowmax_lanes)
 
     stage_params = dataclasses.replace(
@@ -964,10 +1028,14 @@ def dist_attn_local(
                 stage_params, None,
             )
         out_i, lse_i = _headmajor_to_seq(out_i_h, lse_i_lanes, plan.shard_q_len)
+        out_i, lse_i = _resilient(out_i, lse_i, f"stage{i}", 1 + i)
         with named_scope(f"magi_stage{i}_lse_merge"):
             out, lse = correct_attn_out_lse(out, lse, out_i, lse_i)
         mx = jnp.maximum(mx, _head_max(rowmax_i))
-    return out.astype(params.out_jnp_dtype), lse, mx
+    out = out.astype(params.out_jnp_dtype)
+    if with_guard_code:
+        return out, lse, mx, code
+    return out, lse, mx
 
 
 def make_dist_attn_fn(
@@ -988,11 +1056,18 @@ def make_dist_attn_fn(
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ..resilience import guards
     from ..utils.compat import shard_map
 
     assert params.has_sink == (sink is not None), (
         "params.has_sink must match whether a sink array is provided"
     )
+    # ISSUE 8: with guards on, the local body threads an int32 error
+    # code out of the traced program; this wrapper consumes it at the
+    # jit boundary (check mode raises NumericalGuardError naming the
+    # failing stage; repair mode records the quarantines)
+    thread_code = guards.guards_active()
+    guard_sites = guards.plan_guard_sites(plan) if thread_code else ()
     tables = plan.device_tables()
     if all(d.process_index == jax.process_index() for d in mesh.devices.flat):
         tables = tuple(
@@ -1013,6 +1088,8 @@ def make_dist_attn_fn(
         # axis is equivalent and transparently differentiable — the
         # kernel vjp drops rowmax cotangents anyway)
         out_specs = out_specs + (P(axis_name),)
+    if thread_code:
+        out_specs = out_specs + (P(axis_name),)  # per-rank guard codes
 
     @functools.partial(
         shard_map,
@@ -1027,12 +1104,17 @@ def make_dist_attn_fn(
     def _local(q, k, v, *rest):
         tabs = rest[:n_tab]
         s = rest[n_tab] if len(rest) > n_tab else None
-        out, lse, mx = dist_attn_local(
-            q, k, v, tabs, plan, params, axis_name=axis_name, sink=s
+        res = dist_attn_local(
+            q, k, v, tabs, plan, params, axis_name=axis_name, sink=s,
+            with_guard_code=thread_code,
         )
-        if not with_max_logits:
-            return out, lse
-        return out, lse, mx[None]
+        out, lse, mx = res[:3]
+        outs = (out, lse)
+        if with_max_logits:
+            outs = outs + (mx[None],)
+        if thread_code:
+            outs = outs + (res[3][None],)
+        return outs
 
     def fn(q, k, v, sink_override=None):
         # sink is a *traced* argument: callers may pass an updated (e.g.
@@ -1045,8 +1127,11 @@ def make_dist_attn_fn(
         )
         extra = (s,) if s is not None else ()
         res = _local(q, k, v, *tables, *extra)
+        if thread_code:
+            *res, code = res
+            guards.consume_error_code(code, guard_sites)
         if not with_max_logits:
-            return res
+            return res[0], res[1]
         out, lse, mxs = res
         return out, lse, jnp.max(mxs, axis=0)
 

@@ -1,0 +1,70 @@
+"""Resilience subsystem: fault injection, numerical guards, degradation.
+
+ISSUE 8: the runtime's partial results flow through LSE-corrected merges
+across stages and ranks — one non-finite partial, one corrupted comm
+payload, or one exhausted page pool used to poison the merged output or
+kill a serving batch silently. This package makes every such failure
+mode *injectable*, every guard *provable*, and every degradation path
+*tested*:
+
+- :mod:`.chaos`  — deterministic, seedable fault injection behind
+  ``MAGI_ATTENTION_CHAOS`` (kernel-partial nan/inf, cast/reduce payload
+  corruption, pool exhaustion, plan/hops build failure, tuning-cache
+  disk faults, hop stragglers) — each injector addressable by site.
+- :mod:`.guards` — jit-compatible numerical sentinels behind
+  ``MAGI_ATTENTION_GUARD=off|check|repair``: in-graph error-code
+  accumulation (no host sync), typed :class:`NumericalGuardError` at the
+  jit boundary, where-based quarantine (lse -> -inf / out -> 0) that
+  merges a poisoned partial as a no-op through the hardened correction
+  path.
+- graceful degradation lives at its call sites: ``ServingEngine.admit``
+  returns a typed ``AdmissionResult`` with a bounded
+  evict-lowest-priority-then-retry policy, plan-build failure falls back
+  to the dense single-bucket (degree-0) plan, hop-impl build failure
+  falls back to the a2a impl — all recording
+  ``magi_degraded_path{reason=}`` so degradation is observable, never
+  silent.
+
+Proof: ``exps/run_resilience_check.py`` / ``make resilience-check``
+asserts every injector is caught by its matching guard or degradation
+path, and that a no-chaos run is bit-transparent and trace-count
+neutral. See ``docs/resilience.md``.
+"""
+
+from .chaos import (  # noqa: F401
+    ChaosClause,
+    ChaosInjectedError,
+    ChaosInjectedIOError,
+    enabled as chaos_enabled,
+    get_chaos,
+    parse_chaos_spec,
+    reset_chaos,
+)
+from .guards import (  # noqa: F401
+    NumericalGuardError,
+    consume_error_code,
+    guard_mode,
+    guard_partial,
+    guards_active,
+    new_error_code,
+    plan_guard_sites,
+    quarantine_if_repair,
+)
+
+__all__ = [
+    "ChaosClause",
+    "ChaosInjectedError",
+    "ChaosInjectedIOError",
+    "NumericalGuardError",
+    "chaos_enabled",
+    "consume_error_code",
+    "get_chaos",
+    "guard_mode",
+    "guard_partial",
+    "guards_active",
+    "new_error_code",
+    "parse_chaos_spec",
+    "plan_guard_sites",
+    "quarantine_if_repair",
+    "reset_chaos",
+]

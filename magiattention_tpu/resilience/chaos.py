@@ -1,0 +1,340 @@
+"""Deterministic, seedable fault injection (``MAGI_ATTENTION_CHAOS``).
+
+The chaos harness of the resilience subsystem (ISSUE 8): every failure
+mode the runtime claims to survive is *injectable* here, addressable by
+site (stage index, rank, hop), so chaos tests are reproducible bit for
+bit. Off by default — with ``MAGI_ATTENTION_CHAOS`` unset every hook is
+a single host-side predicate and the traced programs are untouched.
+The spec is validated by ``env.chaos_spec()`` and folded into
+``flags_fingerprint`` (an injector changes the traced program, so a
+chaos run must never share a runtime key with a clean one).
+
+Spec grammar (see ``docs/resilience.md`` for the prose version)::
+
+    spec   := clause ( ';' clause )*
+    clause := kind [ ':' key '=' value ( ',' key '=' value )* ]
+
+Injector kinds and their parameters:
+
+===================  =====================================================
+``corrupt_partial``  Plant ``value`` (nan|inf) into a per-stage kernel
+                     partial at guard site ``site=`` (host | merged |
+                     stageN | splitN), ``field=`` out|lse|both (default
+                     both), ``rank=`` (-1 = every rank), ``seed=``
+                     (position derivation).
+``corrupt_cast``     Plant ``value`` into one row of a group-cast recv
+                     payload (``rank=``, ``seed=``).
+``permute_cast``     Reverse the rows of a group-cast recv payload
+                     (finite-value corruption — numerically undetectable
+                     by design; caught only by parity harnesses).
+``corrupt_reduce``   Plant ``value`` into one row of the partial
+                     (out, lse) fed to a group reduce (``rank=``,
+                     ``seed=``).
+``straggler``        Insert a ``delay``-iteration serialization loop on
+                     hop ``hop=`` of a hop-scheduled cast (traced as a
+                     while loop; bit-transparent to the payload).
+``pool_exhaust``     ``PageAllocator`` reports/behaves as out of pages.
+``alloc_fail``       ``PageAllocator.allocate`` raises
+                     :class:`ChaosInjectedError` (``times=`` bound).
+``prefill_error``    ``ServingEngine.prefill`` fails mid-write
+                     (``times=``).
+``plan_error``       ``build_dist_attn_plan`` primary attempt raises
+                     (``times=``, default 1 so the fallback succeeds).
+``hops_build_error`` The hop-schedule construction in
+                     ``GroupCollectiveMeta.build`` raises (``times=``).
+``cache_io_error``   Tuning-cache disk IO raises (``op=`` load|store,
+                     ``times=``, 0 = every time).
+===================  =====================================================
+
+Exception injectors fire at most ``times`` times per process (default 1;
+0 = unlimited) — :func:`reset_chaos` rearms them. Value injectors fire
+on every matching call (they are trace-time program edits, not events).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+class ChaosInjectedError(RuntimeError):
+    """An injected (not organic) failure — raised by exception injectors."""
+
+
+class ChaosInjectedIOError(ChaosInjectedError, OSError):
+    """Injected disk fault: also an ``OSError`` so it travels the exact
+    except path a real disk fault would."""
+
+
+_VALUES = ("nan", "inf")
+_FIELDS = ("out", "lse", "both")
+_OPS = ("load", "store")
+
+# kind -> (allowed params, int-valued params)
+_KINDS: dict[str, set[str]] = {
+    "corrupt_partial": {"site", "field", "value", "rank", "seed"},
+    "corrupt_cast": {"value", "rank", "seed"},
+    "permute_cast": {"rank"},
+    "corrupt_reduce": {"value", "rank", "seed"},
+    "straggler": {"hop", "delay"},
+    "pool_exhaust": set(),
+    "alloc_fail": {"times"},
+    "prefill_error": {"times"},
+    "plan_error": {"times"},
+    "hops_build_error": {"times"},
+    "cache_io_error": {"op", "times"},
+}
+_INT_PARAMS = {"rank", "seed", "hop", "delay", "times"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosClause:
+    """One parsed injector clause."""
+
+    kind: str
+    site: str | None = None  # guard-site name for corrupt_partial
+    field: str = "both"  # out | lse | both
+    value: str = "nan"  # nan | inf
+    rank: int = -1  # -1 = every rank
+    seed: int = 0  # deterministic position derivation
+    hop: int = 1  # straggler hop shift
+    delay: int = 32  # straggler loop iterations
+    op: str = "load"  # cache_io_error: load | store
+    times: int = 1  # exception injectors: max fires (0 = unlimited)
+
+    @property
+    def fill(self) -> float:
+        return float("nan") if self.value == "nan" else float("inf")
+
+
+def parse_chaos_spec(spec: str) -> tuple[ChaosClause, ...]:
+    """Parse + validate a chaos spec; raises ``ValueError`` on bad
+    grammar, unknown kinds/params, or out-of-domain values."""
+    clauses: list[ChaosClause] = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        kind, _, rest = raw.partition(":")
+        kind = kind.strip()
+        if kind not in _KINDS:
+            raise ValueError(
+                f"MAGI_ATTENTION_CHAOS: unknown injector {kind!r} "
+                f"(known: {sorted(_KINDS)})"
+            )
+        params: dict = {}
+        if rest.strip():
+            for item in rest.split(","):
+                key, eq, value = item.partition("=")
+                key, value = key.strip(), value.strip()
+                if not eq or not key or not value:
+                    raise ValueError(
+                        f"MAGI_ATTENTION_CHAOS: malformed param {item!r} "
+                        f"in clause {raw!r} (want key=value)"
+                    )
+                if key not in _KINDS[kind]:
+                    raise ValueError(
+                        f"MAGI_ATTENTION_CHAOS: {kind} takes "
+                        f"{sorted(_KINDS[kind])}, not {key!r}"
+                    )
+                if key in _INT_PARAMS:
+                    try:
+                        params[key] = int(value)
+                    except ValueError:
+                        raise ValueError(
+                            f"MAGI_ATTENTION_CHAOS: {key}={value!r} must "
+                            "be an integer"
+                        ) from None
+                else:
+                    params[key] = value
+        clause = ChaosClause(kind=kind, **params)
+        if kind == "corrupt_partial" and clause.site is None:
+            raise ValueError(
+                "MAGI_ATTENTION_CHAOS: corrupt_partial requires site= "
+                "(host | merged | stageN | splitN) — a site-less clause "
+                "matches no guard site and would be silently inert"
+            )
+        if clause.value not in _VALUES:
+            raise ValueError(
+                f"MAGI_ATTENTION_CHAOS: value={clause.value!r} must be "
+                f"one of {_VALUES}"
+            )
+        if clause.field not in _FIELDS:
+            raise ValueError(
+                f"MAGI_ATTENTION_CHAOS: field={clause.field!r} must be "
+                f"one of {_FIELDS}"
+            )
+        if clause.op not in _OPS:
+            raise ValueError(
+                f"MAGI_ATTENTION_CHAOS: op={clause.op!r} must be one of "
+                f"{_OPS}"
+            )
+        if clause.delay < 1 or clause.times < 0 or clause.hop < 0:
+            raise ValueError(
+                f"MAGI_ATTENTION_CHAOS: bad numeric range in {raw!r}"
+            )
+        clauses.append(clause)
+    return tuple(clauses)
+
+
+# parsed-config cache keyed on the raw spec string (tests flip the env
+# var per case; re-parsing a short string is cheap but not free on the
+# per-admission host path) + per-clause fire counters for the
+# exception injectors
+_parsed: tuple[str, tuple[ChaosClause, ...]] = ("", ())
+_fire_counts: dict[tuple[str, int], int] = {}
+
+
+def get_chaos() -> tuple[ChaosClause, ...]:
+    """The active injector clauses (empty when chaos is off)."""
+    global _parsed
+    from .. import env
+
+    spec = env.chaos_spec()
+    if spec != _parsed[0]:
+        _parsed = (spec, parse_chaos_spec(spec))
+    return _parsed[1]
+
+
+def enabled() -> bool:
+    return bool(get_chaos())
+
+
+def reset_chaos() -> None:
+    """Rearm the exception injectors (tests run several scenarios per
+    process)."""
+    _fire_counts.clear()
+
+
+def _matching(kind: str, **want) -> list[tuple[int, ChaosClause]]:
+    out = []
+    for i, cl in enumerate(get_chaos()):
+        if cl.kind != kind:
+            continue
+        if any(getattr(cl, k) != v for k, v in want.items()):
+            continue
+        out.append((i, cl))
+    return out
+
+
+def _should_fire(index: int, cl: ChaosClause) -> bool:
+    """Consume one fire of a bounded exception injector."""
+    if cl.times == 0:
+        return True
+    key = (_parsed[0], index)
+    fired = _fire_counts.get(key, 0)
+    if fired >= cl.times:
+        return False
+    _fire_counts[key] = fired + 1
+    return True
+
+
+# ---------------------------------------------------------------------------
+# host-side exception injectors
+# ---------------------------------------------------------------------------
+
+
+def maybe_fail(kind: str, **want) -> None:
+    """Raise :class:`ChaosInjectedError` when a matching exception
+    injector is armed (``cache_io_error`` raises the OSError flavor)."""
+    for i, cl in enumerate(get_chaos()):
+        if cl.kind != kind:
+            continue
+        if any(getattr(cl, k) != v for k, v in want.items()):
+            continue
+        if _should_fire(i, cl):
+            exc = (
+                ChaosInjectedIOError
+                if kind == "cache_io_error"
+                else ChaosInjectedError
+            )
+            raise exc(f"chaos: injected {kind} ({_parsed[0]!r})")
+
+
+def pool_exhausted() -> bool:
+    """Is the page pool chaos-exhausted? (``PageAllocator`` consults
+    this in ``can_admit``/``allocate``/``extend``.)"""
+    return bool(_matching("pool_exhaust"))
+
+
+# ---------------------------------------------------------------------------
+# traced value injectors (pure jnp; deterministic positions from seed)
+# ---------------------------------------------------------------------------
+
+
+def _rank_gate(corrupted, clean, rank: int, axis_name):
+    """Select the corrupted value only on the targeted rank (traced
+    ``axis_index``); rank < 0 or no axis = every rank."""
+    if rank < 0 or axis_name is None:
+        return corrupted
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.where(
+        jax.lax.axis_index(axis_name) == rank, corrupted, clean
+    )
+
+
+def corrupt_partial(out, lse, site: str, *, axis_name=None):
+    """Plant nan/inf into a kernel partial at guard site ``site``:
+    ``out`` [..., h, d] gets element (r0, h0, 0), ``lse`` [..., h] gets
+    (r0, h0) — positions derived from the clause seed, so re-runs plant
+    the identical fault."""
+    clauses = _matching("corrupt_partial", site=site)
+    if not clauses:
+        return out, lse
+    import jax.numpy as jnp
+
+    for _, cl in clauses:
+        t, h = lse.shape[-2], lse.shape[-1]
+        r0, h0 = cl.seed % t, (cl.seed // 7) % h
+        if cl.field in ("out", "both"):
+            bad = out.at[..., r0, h0, 0].set(cl.fill)
+            out = _rank_gate(bad, out, cl.rank, axis_name)
+        if cl.field in ("lse", "both"):
+            bad = lse.at[..., r0, h0].set(
+                jnp.asarray(cl.fill, lse.dtype)
+            )
+            lse = _rank_gate(bad, lse, cl.rank, axis_name)
+    return out, lse
+
+
+def corrupt_cast_payload(x, *, axis_name=None):
+    """Apply ``corrupt_cast`` / ``permute_cast`` clauses to a group-cast
+    recv buffer ``x`` [R, ...]."""
+    for _, cl in _matching("corrupt_cast"):
+        bad = x.at[cl.seed % x.shape[0]].set(cl.fill)
+        x = _rank_gate(bad, x, cl.rank, axis_name)
+    for _, cl in _matching("permute_cast"):
+        x = _rank_gate(x[::-1], x, cl.rank, axis_name)
+    return x
+
+
+def corrupt_reduce_payload(x, *, axis_name=None):
+    """Apply ``corrupt_reduce`` clauses to a partial row buffer fed to a
+    group reduce (out or lse payload)."""
+    for _, cl in _matching("corrupt_reduce"):
+        bad = x.at[cl.seed % x.shape[0]].set(cl.fill)
+        x = _rank_gate(bad, x, cl.rank, axis_name)
+    return x
+
+
+def straggler_delay(x, hop_shift: int):
+    """Insert the ``straggler`` clause's serialization loop on hop
+    ``hop_shift``: a while_loop of optimization barriers — traced (a
+    ``while`` eqn appears in the jaxpr; fori_loop would lower to scan),
+    bit-transparent to ``x``."""
+    clauses = _matching("straggler", hop=hop_shift)
+    if not clauses:
+        return x
+    import jax
+    import jax.numpy as jnp
+
+    delay = max(cl.delay for _, cl in clauses)
+
+    def body(carry):
+        i, acc = carry
+        return i + 1, jax.lax.optimization_barrier(acc)
+
+    return jax.lax.while_loop(
+        lambda c: c[0] < delay, body, (jnp.int32(0), x)
+    )[1]

@@ -30,6 +30,7 @@ from .decode_attn import (  # noqa: F401
     resolve_num_splits,
 )
 from .engine import (  # noqa: F401
+    AdmissionResult,
     DecodeBatch,
     ServingEngine,
     magi_attn_decode,
@@ -47,6 +48,7 @@ from .kv_cache import (  # noqa: F401
 )
 
 __all__ = [
+    "AdmissionResult",
     "DecodeBatch",
     "PageAllocator",
     "PagedKVCache",

@@ -92,6 +92,28 @@ def merge_split_partials(
     return outs[0], lses[0]
 
 
+def _apply_split_resilience(outs, lses):
+    """ISSUE 8: chaos injection + numerical guards over the split
+    partials, upstream of the merge tree. Returns ``(outs, lses, code)``
+    — ``code`` is the accumulated int32 guard error code (None with
+    guards off, when this is a pure passthrough tracing zero extra
+    ops)."""
+    from ..resilience import chaos, guards
+
+    if not (chaos.enabled() or guards.guards_active()):
+        return outs, lses, None
+    code = guards.new_error_code() if guards.guards_active() else None
+    new_outs, new_lses = [], []
+    for i, (o, l) in enumerate(zip(outs, lses)):
+        site = f"split{i}"
+        o, l = chaos.corrupt_partial(o, l, site)
+        if guards.guards_active():
+            o, l, code = guards.guard_partial(o, l, code, i, site)
+        new_outs.append(o)
+        new_lses.append(l)
+    return new_outs, new_lses, code
+
+
 def _split_partial_jnp(q, k, v, pos0, valid_len, scale, softcap):
     """One KV split's partial (out, lse) in plain jnp.
 
@@ -149,7 +171,8 @@ def _decode_jnp(q, cache: PagedKVCache, bt, seq_lens, params: DecodeParams):
         )
         outs.append(o)
         lses.append(l)
-    return merge_split_partials(outs, lses)
+    outs, lses, code = _apply_split_resilience(outs, lses)
+    return merge_split_partials(outs, lses) + (code,)
 
 
 # ---------------------------------------------------------------------------
@@ -293,7 +316,8 @@ def _decode_pallas(q, cache: PagedKVCache, bt, seq_lens, params: DecodeParams):
     )(bt_flat, sl, q, cache.k_pages, cache.v_pages)
     outs = [out_parts[:, i] for i in range(s)]
     lses = [lse_parts[:, i, :, 0] for i in range(s)]
-    return merge_split_partials(outs, lses)
+    outs, lses, code = _apply_split_resilience(outs, lses)
+    return merge_split_partials(outs, lses) + (code,)
 
 
 # ---------------------------------------------------------------------------
@@ -376,7 +400,16 @@ def decode_attn_paged(
 
     with named_scope("magi_decode_attn"):
         if env.kernel_backend() in ("jnp", "jnp_online"):
-            out, lse = _decode_jnp(q, cache, bt, seq_lens, params)
+            out, lse, code = _decode_jnp(q, cache, bt, seq_lens, params)
         else:
-            out, lse = _decode_pallas(q, cache, bt, seq_lens, params)
+            out, lse, code = _decode_pallas(q, cache, bt, seq_lens, params)
+    if code is not None:
+        # jit boundary of the split guards: eager callers (the serving
+        # engine's host loop) get a concrete code here — check mode
+        # raises NumericalGuardError naming the failing split
+        from ..resilience import guards
+
+        guards.consume_error_code(
+            code, tuple(f"split{i}" for i in range(params.num_splits))
+        )
     return out.astype(out_dtype), lse

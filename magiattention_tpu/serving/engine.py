@@ -46,6 +46,33 @@ from .kv_cache import (
 )
 
 
+@dataclasses.dataclass(frozen=True)
+class AdmissionResult:
+    """Typed outcome of :meth:`ServingEngine.admit` (ISSUE 8).
+
+    Admission control never raises on resource pressure: a full pool is
+    an operating condition of a loaded serving fleet, not a crash. The
+    caller checks ``admitted`` — ``backpressure`` means "retry later /
+    shed upstream" and is recorded as ``magi_admission_rejected``.
+
+    - ``admitted``: True with a usable ``slot``; False = backpressure
+      (``slot`` is None).
+    - ``reason``: ``"ok"`` | ``"pool_exhausted"`` | ``"no_free_slot"``
+      | ``"too_long"`` | ``"alloc_error"``.
+    - ``evicted``: slots freed by the bounded
+      evict-lowest-priority-then-retry policy on the way to this verdict
+      (possibly non-empty on BOTH verdicts).
+    """
+
+    admitted: bool
+    slot: int | None
+    reason: str = "ok"
+    evicted: tuple[int, ...] = ()
+
+    def __bool__(self) -> bool:
+        return self.admitted
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass(frozen=True)
 class DecodeBatch:
@@ -170,6 +197,7 @@ class ServingEngine:
         max_seqs: int = 64,
         max_pages_per_seq: int | None = None,
         dtype=jnp.bfloat16,
+        max_admission_evictions: int = 4,
     ):
         from .. import env
 
@@ -190,17 +218,85 @@ class ServingEngine:
             num_pages, page_size, max_seqs, max_pages_per_seq
         )
         self._lengths: dict[int, int] = {}
+        self._priorities: dict[int, int] = {}
+        self.max_admission_evictions = int(max_admission_evictions)
         self._record_pool()
 
     # -- admission / retirement (host) --
 
-    def admit(self, num_tokens: int) -> int:
+    def admit(self, num_tokens: int, *, priority: int = 0) -> AdmissionResult:
         """Reserve a slot + pages for a sequence of ``num_tokens`` prompt
-        tokens (plus later decode growth via :meth:`reserve_growth`)."""
-        slot, pages = self.allocator.allocate(num_tokens)
-        self.cache = assign_block_table(self.cache, slot, pages)
+        tokens (plus later decode growth via :meth:`reserve_growth`).
+
+        Returns a typed :class:`AdmissionResult` — NEVER raises on
+        resource pressure (ISSUE 8). When the pool/slots are exhausted,
+        a bounded evict-lowest-priority-then-retry policy frees up to
+        ``max_admission_evictions`` live sequences whose ``priority`` is
+        strictly below the incoming one; if that still doesn't fit, the
+        verdict is backpressure (``magi_admission_rejected{reason=}``).
+        """
+        need = max(self.allocator.pages_needed(num_tokens), 1)
+        if need > self.allocator.max_pages_per_seq:
+            # no amount of evicting makes an over-long sequence fit
+            res = AdmissionResult(False, None, "too_long")
+            telemetry.record_admission(res)
+            return res
+        evicted: list[int] = []
+        while True:
+            if self.allocator.can_admit(num_tokens):
+                try:
+                    slot, pages = self.allocator.allocate(num_tokens)
+                except RuntimeError:
+                    # raced/injected allocator failure after the
+                    # can_admit probe — degrade to backpressure
+                    res = AdmissionResult(
+                        False, None, "alloc_error", tuple(evicted)
+                    )
+                    telemetry.record_admission(res)
+                    self._record_pool()
+                    return res
+                try:
+                    self.cache = assign_block_table(self.cache, slot, pages)
+                except Exception:
+                    # device-side install failed: roll the allocator
+                    # back so the reservation is not leaked
+                    self.allocator.free(slot)
+                    self._record_pool()
+                    raise
+                self._priorities[slot] = int(priority)
+                res = AdmissionResult(True, slot, "ok", tuple(evicted))
+                telemetry.record_admission(res)
+                self._record_pool()
+                return res
+            if len(evicted) >= self.max_admission_evictions:
+                break  # bounded: give up rather than churn the pool
+            victim = self._eviction_candidate(int(priority))
+            if victim is None:
+                break
+            self.free(victim)
+            evicted.append(victim)
+        reason = (
+            "no_free_slot"
+            if self.allocator.active_seqs >= self.allocator.max_seqs
+            else "pool_exhausted"
+        )
+        res = AdmissionResult(False, None, reason, tuple(evicted))
+        telemetry.record_admission(res)
         self._record_pool()
-        return slot
+        return res
+
+    def _eviction_candidate(self, incoming_priority: int) -> int | None:
+        """Lowest-priority live slot strictly below the incoming
+        priority (ties -> lowest slot id, deterministic); None when
+        nothing is evictable."""
+        candidates = [
+            (p, s)
+            for s, p in self._priorities.items()
+            if p < incoming_priority
+        ]
+        if not candidates:
+            return None
+        return min(candidates)[1]
 
     def reserve_growth(self, slot: int, total_tokens: int) -> None:
         """Extend a slot's page reservation to ``total_tokens`` (prompt +
@@ -210,10 +306,17 @@ class ServingEngine:
         self._record_pool()
 
     def free(self, slot: int) -> None:
-        """Retire a sequence: pages back to the pool, slot reusable."""
+        """Retire a sequence: pages back to the pool, slot reusable.
+
+        Exception-safe ordering: the device-side slot reset is computed
+        BEFORE the allocator mutates — if it throws, the allocator still
+        owns the pages and nothing is half-freed; once the allocator has
+        released them, the reset commits unconditionally."""
+        fresh = reset_slot(self.cache, slot)
         self.allocator.free(slot)
-        self.cache = reset_slot(self.cache, slot)
+        self.cache = fresh
         self._lengths.pop(slot, None)
+        self._priorities.pop(slot, None)
         self._record_pool()
 
     # -- device steps --
@@ -231,16 +334,54 @@ class ServingEngine:
             self.reserve_growth(slot, total_tokens)
 
     def prefill(self, q, k, v, slot: int, **kw):
-        """Prefill a prompt into ``slot``; returns the prefill out/lse."""
+        """Prefill a prompt into ``slot``; returns the prefill out/lse.
+
+        Exception-safe (ISSUE 8 satellite): a failure mid prefill —
+        attention crash, cache-write crash, injected ``prefill_error``
+        chaos — releases the half-admitted slot entirely (pages back to
+        the pool, bookkeeping cleared) before re-raising, so the next
+        admission reuses those pages instead of leaking them. The cache
+        update itself only commits on success (``prefill_into_cache`` is
+        functional)."""
+        from ..resilience import chaos
+
         length = kw.get("length")
         wrote = q.shape[0] if length is None else int(length)
+        # reservation growth stays OUTSIDE the fault cleanup: a refused
+        # extension (transient pool exhaustion) mutates nothing —
+        # allocator.extend is check-before-pop — and must leave the
+        # slot's committed KV intact, exactly like the identical error
+        # from decode_step's growth path (resource pressure is an
+        # operating condition, not a reason to destroy the sequence)
         self._ensure_reserved(slot, self._lengths.get(slot, 0) + wrote)
-        out, lse, self.cache = prefill_into_cache(
-            q, k, v, self.cache, slot, **kw
-        )
+        try:
+            chaos.maybe_fail("prefill_error")
+            out, lse, new_cache = prefill_into_cache(
+                q, k, v, self.cache, slot, **kw
+            )
+        except Exception:
+            self._release_after_fault(slot)
+            raise
+        self.cache = new_cache
         self._lengths[slot] = self._lengths.get(slot, 0) + wrote
         telemetry.record_prefill(wrote)
         return out, lse
+
+    def _release_after_fault(self, slot: int) -> None:
+        """Tear a faulted slot all the way down (best-effort, never
+        raises over the original fault): allocator pages returned, slot
+        length zeroed, bookkeeping dropped."""
+        try:
+            self.free(slot)
+        except Exception:
+            from ..telemetry.logger import get_logger
+
+            get_logger("resilience").warning(
+                "fault cleanup could not release slot %s", slot
+            )
+        self._lengths.pop(slot, None)
+        self._priorities.pop(slot, None)
+        self._record_pool()
 
     def decode_step(self, q, k_new, v_new, slots, **kw):
         """One continuous-batching decode step: append each sequence's

@@ -289,6 +289,10 @@ class PageAllocator:
         return -(-max(int(num_tokens), 0) // self.page_size)
 
     def can_admit(self, num_tokens: int) -> bool:
+        from ..resilience import chaos
+
+        if chaos.pool_exhausted():
+            return False
         need = max(self.pages_needed(num_tokens), 1)
         return (
             bool(self._free_slots)
@@ -298,19 +302,27 @@ class PageAllocator:
 
     def allocate(self, num_tokens: int) -> tuple[int, list[int]]:
         """Admit a sequence needing ``num_tokens`` of KV (rounded up to
-        whole pages; at least one). Returns (slot, page list)."""
+        whole pages; at least one). Returns (slot, page list).
+
+        Atomic: every failure (and every chaos injector —
+        ``alloc_fail`` / ``pool_exhaust``) raises BEFORE any free-list
+        mutation, so a failed admission never leaks state."""
+        from ..resilience import chaos
+
+        chaos.maybe_fail("alloc_fail")
         need = max(self.pages_needed(num_tokens), 1)
+        if chaos.pool_exhausted() or need > len(self._free_pages):
+            raise RuntimeError(
+                f"PageAllocator: {need} pages requested, "
+                f"{0 if chaos.pool_exhausted() else len(self._free_pages)}"
+                " free"
+            )
         if not self._free_slots:
             raise RuntimeError("PageAllocator: no free sequence slot")
         if need > self.max_pages_per_seq:
             raise RuntimeError(
                 f"PageAllocator: {num_tokens} tokens need {need} pages > "
                 f"max_pages_per_seq {self.max_pages_per_seq}"
-            )
-        if need > len(self._free_pages):
-            raise RuntimeError(
-                f"PageAllocator: {need} pages requested, "
-                f"{len(self._free_pages)} free"
             )
         slot = self._free_slots.pop()
         pages = [self._free_pages.pop() for _ in range(need)]
@@ -319,7 +331,11 @@ class PageAllocator:
 
     def extend(self, slot: int, total_tokens: int) -> list[int]:
         """Grow a slot's reservation to cover ``total_tokens``; returns the
-        FULL page list (existing + newly granted)."""
+        FULL page list (existing + newly granted). The grant check runs
+        before any page is popped, so a refused extension leaves both
+        the pool and the slot's reservation exactly as they were."""
+        from ..resilience import chaos
+
         pages = self._slot_pages.get(slot)
         if pages is None:
             raise KeyError(f"PageAllocator: slot {slot} not allocated")
@@ -329,9 +345,12 @@ class PageAllocator:
                 f"PageAllocator: {total_tokens} tokens exceed "
                 f"max_pages_per_seq {self.max_pages_per_seq}"
             )
-        while len(pages) < need:
-            if not self._free_pages:
-                raise RuntimeError("PageAllocator: page pool exhausted")
+        grow = need - len(pages)
+        if grow > 0 and (
+            chaos.pool_exhausted() or grow > len(self._free_pages)
+        ):
+            raise RuntimeError("PageAllocator: page pool exhausted")
+        for _ in range(max(grow, 0)):
             pages.append(self._free_pages.pop())
         return list(pages)
 

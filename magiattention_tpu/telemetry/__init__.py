@@ -39,9 +39,11 @@ from .aggregate import (  # noqa: F401
 )
 from .collectors import (  # noqa: F401
     REQUIRED_PLAN_METRICS,
+    REQUIRED_RESILIENCE_METRICS,
     REQUIRED_SERVING_METRICS,
     REQUIRED_TIMELINE_METRICS,
     REQUIRED_VALIDATE_METRICS,
+    record_admission,
     record_autotune_cache,
     record_autotune_decision,
     record_autotune_measure_failure,
@@ -49,16 +51,21 @@ from .collectors import (  # noqa: F401
     record_cache_access,
     record_comm_op,
     record_decode_step,
+    record_degraded_path,
     record_dispatch_meta,
     record_dispatch_solution,
     record_dynamic_solution,
     record_group_collective_build,
+    record_guard_check,
+    record_guard_repair,
+    record_guard_violation,
     record_kvcache_state,
     record_measured_timeline,
     record_overlap_choice,
     record_plan,
     record_prefill,
     record_runtime_costs,
+    record_tuning_cache_io_error,
     record_validate,
     telemetry_summary,
 )
@@ -132,6 +139,7 @@ __all__ = [
     "MeasuredTimeline",
     "MetricsRegistry",
     "REQUIRED_PLAN_METRICS",
+    "REQUIRED_RESILIENCE_METRICS",
     "REQUIRED_SERVING_METRICS",
     "REQUIRED_TIMELINE_METRICS",
     "REQUIRED_VALIDATE_METRICS",
@@ -148,6 +156,7 @@ __all__ = [
     "merge_snapshots",
     "profile_key_timeline",
     "profile_plan_timeline",
+    "record_admission",
     "record_autotune_cache",
     "record_autotune_decision",
     "record_autotune_measure_failure",
@@ -155,17 +164,22 @@ __all__ = [
     "record_cache_access",
     "record_comm_op",
     "record_decode_step",
+    "record_degraded_path",
     "record_dispatch_meta",
     "record_dispatch_solution",
     "record_dynamic_solution",
     "record_event",
     "record_group_collective_build",
+    "record_guard_check",
+    "record_guard_repair",
+    "record_guard_violation",
     "record_measured_timeline",
     "record_overlap_choice",
     "record_kvcache_state",
     "record_plan",
     "record_prefill",
     "record_runtime_costs",
+    "record_tuning_cache_io_error",
     "record_validate",
     "reset",
     "series_key",

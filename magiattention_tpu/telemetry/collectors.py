@@ -133,6 +133,27 @@ M_KVCACHE_OCCUPANCY = "magi_kvcache_occupancy_ratio"
 M_KVCACHE_ACTIVE_SEQS = "magi_kvcache_active_seqs"
 M_KVCACHE_PAGE_SIZE = "magi_kvcache_page_size"
 
+# counters + gauges — resilience layer (resilience/; docs/resilience.md).
+# guard counters ({site=host|merged|stageN|splitN|correction|reduce_lse}):
+# checks ticks once per guard TRACED (trace-time, like record_comm_op);
+# violations/repairs tick when an accumulated error code decodes nonzero
+# at the jit boundary (check resp. repair mode)
+M_GUARD_CHECKS = "magi_guard_checks"
+M_GUARD_VIOLATIONS = "magi_guard_violations"
+M_GUARD_REPAIRS = "magi_guard_repairs"
+# admission control (serving/engine.py): rejections ({reason=}) and
+# evictions performed by the bounded evict-lowest-priority-then-retry
+# policy before a rejection or a late admission
+M_ADMISSION_REJECTED = "magi_admission_rejected"
+M_ADMISSION_EVICTIONS = "magi_admission_evictions"
+# which degradation path last engaged: value 1, label reason=
+# plan_build_error | hops_build_error — degradation is observable,
+# never silent
+M_DEGRADED_PATH = "magi_degraded_path"
+# tuning-cache disk faults ({op=load|store}): previously swallowed
+# silently by the load/store except paths
+M_TUNING_CACHE_IO = "magi_tuning_cache_io_errors"
+
 # histograms (seconds)
 H_PLAN_BUILD_S = "magi_plan_build_seconds"
 H_DISPATCH_SOLVE_S = "magi_dispatch_solve_seconds"
@@ -189,6 +210,21 @@ REQUIRED_SERVING_METRICS: tuple[str, ...] = (
     M_KVCACHE_OCCUPANCY,
     M_KVCACHE_ACTIVE_SEQS,
     M_KVCACHE_PAGE_SIZE,
+)
+
+
+# populated by one guarded run + one chaos-degraded admission/build +
+# one injected tuning-cache fault; asserted by make telemetry-check's
+# resilience step and exercised end-to-end by make resilience-check,
+# documented in docs/observability.md + docs/resilience.md
+REQUIRED_RESILIENCE_METRICS: tuple[str, ...] = (
+    M_GUARD_CHECKS,
+    M_GUARD_VIOLATIONS,
+    M_GUARD_REPAIRS,
+    M_ADMISSION_REJECTED,
+    M_ADMISSION_EVICTIONS,
+    M_DEGRADED_PATH,
+    M_TUNING_CACHE_IO,
 )
 
 
@@ -519,6 +555,68 @@ def record_autotune_decision(decision) -> None:
             "reason": decision.reason,
         },
     )
+
+
+# ---------------------------------------------------------------------------
+# resilience layer (resilience/ + its call sites)
+# ---------------------------------------------------------------------------
+
+
+def record_guard_check(site: str) -> None:
+    """One numerical guard traced at ``site`` (``resilience/guards.py``):
+    runs at trace time — once per compiled program, like the named
+    scopes and :func:`record_comm_op`."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_GUARD_CHECKS, site=site)
+
+
+def record_guard_violation(site: str) -> None:
+    """A check-mode guard's error code decoded nonzero at the jit
+    boundary — a non-finite partial reached ``site``. Alarm on this."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_GUARD_VIOLATIONS, site=site)
+
+
+def record_guard_repair(site: str) -> None:
+    """A repair-mode guard quarantined a poisoned partial at ``site``
+    (the merge proceeded with that contribution weighted to zero)."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_GUARD_REPAIRS, site=site)
+
+
+def record_admission(result) -> None:
+    """One ``ServingEngine.admit`` outcome (``AdmissionResult``):
+    rejections count by reason, evictions by the retry policy count
+    regardless of the final verdict."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    if result.evicted:
+        reg.counter_inc(M_ADMISSION_EVICTIONS, len(result.evicted))
+    if not result.admitted:
+        reg.counter_inc(M_ADMISSION_REJECTED, reason=result.reason)
+
+
+def record_degraded_path(reason: str) -> None:
+    """A degradation path engaged (plan-build -> dense degree-0 plan,
+    hops build -> a2a impl): gauge value 1 labeled with the reason, plus
+    a marker event so traces show WHEN it happened."""
+    if not _enabled():
+        return
+    get_registry().gauge_set(M_DEGRADED_PATH, 1, reason=reason)
+    _marker_event("degraded_path", {"reason": reason})
+
+
+def record_tuning_cache_io_error(op: str) -> None:
+    """A tuning-cache disk load/store failed (``tuning/cache.py``): the
+    failure is still non-fatal (a miss / skipped persist), but no longer
+    invisible."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_TUNING_CACHE_IO, op=op)
 
 
 # ---------------------------------------------------------------------------

@@ -25,6 +25,20 @@ from .fingerprint import WorkloadFingerprint
 CACHE_FILE_PREFIX = "magi-autotune-"
 
 
+def _record_io_error(op: str, key: str, exc: Exception) -> None:
+    """Surface a disk fault: ``magi_tuning_cache_io_errors{op=}`` +
+    debug log. Imports stay lazy — this module is jax-free until an
+    actual fault happens."""
+    from ..telemetry import record_tuning_cache_io_error
+    from ..telemetry.logger import get_logger
+
+    record_tuning_cache_io_error(op)
+    get_logger("tuning.cache").debug(
+        "tuning cache %s failed for %s: %s: %s",
+        op, key, type(exc).__name__, exc,
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class TuningRecord:
     """One cached winner for a fingerprint."""
@@ -100,18 +114,30 @@ class TuningCache:
         self, key: str, fp: WorkloadFingerprint
     ) -> TuningRecord | None:
         try:
+            from ..resilience import chaos
+
+            chaos.maybe_fail("cache_io_error", op="load")
             with open(self._path(key)) as f:
                 payload = json.load(f)
             if payload.get("fingerprint") != fp.as_dict():
                 return None  # hash collision or fingerprint-version skew
             return TuningRecord.from_dict(payload["record"])
-        except (OSError, ValueError, KeyError, TypeError):
-            return None  # unreadable/torn/foreign file: treat as a miss
+        except FileNotFoundError:
+            return None  # a cold cache is not a fault, just a miss
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            # unreadable/torn/foreign file: still a miss, but VISIBLE
+            # (ISSUE 8 satellite) — a flaky shared cache dir used to
+            # degrade every process to re-tuning with zero signal
+            _record_io_error("load", key, exc)
+            return None
 
     def _store_disk(
         self, key: str, fp: WorkloadFingerprint, rec: TuningRecord
     ) -> None:
         try:
+            from ..resilience import chaos
+
+            chaos.maybe_fail("cache_io_error", op="store")
             os.makedirs(self.cache_dir, exist_ok=True)
             fd, tmp = tempfile.mkstemp(
                 dir=self.cache_dir, prefix=CACHE_FILE_PREFIX, suffix=".tmp"
@@ -123,8 +149,11 @@ class TuningCache:
                     sort_keys=True,
                 )
             os.replace(tmp, self._path(key))
-        except OSError:
-            pass  # a read-only cache dir must never take planning down
+        except OSError as exc:
+            # a read-only cache dir must never take planning down — but
+            # measure-mode winners silently failing to persist is worth
+            # a counter + debug line
+            _record_io_error("store", key, exc)
 
     def __len__(self) -> int:
         return len(self._mem)

@@ -1,0 +1,145 @@
+"""Chaos harness: spec grammar, injector determinism, fire bounds."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.resilience import chaos as C
+
+
+def test_parse_empty_spec_is_off():
+    assert C.parse_chaos_spec("") == ()
+    assert C.parse_chaos_spec(" ; ; ") == ()
+
+
+def test_parse_full_grammar():
+    clauses = C.parse_chaos_spec(
+        "corrupt_partial:site=stage1,field=lse,value=inf,rank=2,seed=9;"
+        "straggler:hop=3,delay=64;"
+        "cache_io_error:op=store,times=0"
+    )
+    assert [c.kind for c in clauses] == [
+        "corrupt_partial", "straggler", "cache_io_error",
+    ]
+    cp = clauses[0]
+    assert (cp.site, cp.field, cp.value, cp.rank, cp.seed) == (
+        "stage1", "lse", "inf", 2, 9,
+    )
+    assert (clauses[1].hop, clauses[1].delay) == (3, 64)
+    assert (clauses[2].op, clauses[2].times) == ("store", 0)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "frobnicate",  # unknown kind
+        "corrupt_partial:bogus=1",  # unknown param
+        "corrupt_partial:rank=x",  # non-integer
+        "corrupt_partial:value=zero",  # bad value domain
+        "corrupt_partial:field=mid",  # bad field domain
+        "cache_io_error:op=append",  # bad op domain
+        "straggler:delay=0",  # out of range
+        "corrupt_partial:site",  # malformed param (no '=')
+        "corrupt_partial:value=nan",  # site-less: would be silently inert
+    ],
+)
+def test_parse_rejects_bad_specs(bad):
+    with pytest.raises(ValueError):
+        C.parse_chaos_spec(bad)
+
+
+def test_env_accessor_validates_and_fingerprints(monkeypatch):
+    from magiattention_tpu import env
+
+    monkeypatch.delenv("MAGI_ATTENTION_CHAOS", raising=False)
+    clean = env.flags_fingerprint()
+    monkeypatch.setenv("MAGI_ATTENTION_CHAOS", "pool_exhaust")
+    assert env.chaos_spec() == "pool_exhaust"
+    assert env.flags_fingerprint() != clean  # chaos re-keys runtimes
+    monkeypatch.setenv("MAGI_ATTENTION_CHAOS", "nope")
+    with pytest.raises(ValueError):
+        env.chaos_spec()
+
+
+def test_guard_env_accessor_validates(monkeypatch):
+    from magiattention_tpu import env
+
+    monkeypatch.setenv("MAGI_ATTENTION_GUARD", "repair")
+    assert env.guard_mode() == "repair"
+    monkeypatch.setenv("MAGI_ATTENTION_GUARD", "maybe")
+    with pytest.raises(ValueError):
+        env.guard_mode()
+
+
+def test_exception_injector_fire_bound(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_CHAOS", "alloc_fail:times=2")
+    C.reset_chaos()
+    for _ in range(2):
+        with pytest.raises(C.ChaosInjectedError):
+            C.maybe_fail("alloc_fail")
+    C.maybe_fail("alloc_fail")  # armed fires exhausted: no raise
+    C.reset_chaos()  # rearm
+    with pytest.raises(C.ChaosInjectedError):
+        C.maybe_fail("alloc_fail")
+
+
+def test_cache_io_error_is_an_oserror(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_CHAOS", "cache_io_error:op=load")
+    C.reset_chaos()
+    with pytest.raises(OSError):
+        C.maybe_fail("cache_io_error", op="load")
+    # wrong op does not fire
+    C.reset_chaos()
+    C.maybe_fail("cache_io_error", op="store")
+
+
+def test_corrupt_partial_is_deterministic_and_site_scoped(monkeypatch):
+    import jax.numpy as jnp
+
+    monkeypatch.setenv(
+        "MAGI_ATTENTION_CHAOS",
+        "corrupt_partial:site=stage0,field=out,value=nan,seed=5",
+    )
+    C.reset_chaos()
+    out = jnp.zeros((8, 2, 4))
+    lse = jnp.zeros((8, 2))
+    o1, l1 = C.corrupt_partial(out, lse, "stage0")
+    o2, l2 = C.corrupt_partial(out, lse, "stage0")
+    assert np.array_equal(
+        np.isnan(np.asarray(o1)), np.isnan(np.asarray(o2))
+    )
+    assert np.isnan(np.asarray(o1)).sum() == 1  # one planted element
+    assert np.isfinite(np.asarray(l1)).all()  # field=out leaves lse
+    # a different site is untouched
+    o3, l3 = C.corrupt_partial(out, lse, "stage1")
+    assert np.isfinite(np.asarray(o3)).all()
+
+
+def test_straggler_traces_a_loop_and_is_bit_transparent(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from magiattention_tpu.analysis.trace_audit import iter_eqns
+
+    monkeypatch.setenv("MAGI_ATTENTION_CHAOS", "straggler:hop=2,delay=8")
+    C.reset_chaos()
+    x = jnp.arange(12.0)
+    jaxpr = jax.make_jaxpr(lambda a: C.straggler_delay(a, 2))(x)
+    assert any(e.primitive.name == "while" for e in iter_eqns(jaxpr))
+    assert np.array_equal(np.asarray(C.straggler_delay(x, 2)), np.asarray(x))
+    # the untargeted hop traces nothing
+    jaxpr_other = jax.make_jaxpr(lambda a: C.straggler_delay(a, 1))(x)
+    assert not any(
+        e.primitive.name == "while" for e in iter_eqns(jaxpr_other)
+    )
+
+
+def test_chaos_off_injectors_are_passthrough(monkeypatch):
+    import jax.numpy as jnp
+
+    monkeypatch.delenv("MAGI_ATTENTION_CHAOS", raising=False)
+    assert not C.enabled()
+    x = jnp.arange(6.0)
+    assert C.corrupt_cast_payload(x) is x
+    assert C.straggler_delay(x, 1) is x
+    C.maybe_fail("plan_error")  # no-op
+    assert not C.pool_exhausted()

@@ -1,0 +1,336 @@
+"""Graceful degradation: admission control, build fallbacks, fault
+cleanup, tuning-cache visibility."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.resilience import ChaosInjectedError, reset_chaos
+from magiattention_tpu.serving import AdmissionResult, ServingEngine
+
+HK, HQ, D = 2, 4, 32
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    monkeypatch.delenv("MAGI_ATTENTION_CHAOS", raising=False)
+    monkeypatch.delenv("MAGI_ATTENTION_GUARD", raising=False)
+    reset_chaos()
+    yield
+    reset_chaos()
+
+
+def _engine(num_pages=8, max_seqs=4, mpp=4, ps=16, **kw):
+    return ServingEngine(
+        num_pages=num_pages, num_kv_heads=HK, head_dim=D, page_size=ps,
+        max_seqs=max_seqs, max_pages_per_seq=mpp, dtype=jnp.float32, **kw
+    )
+
+
+def _rand(rng, *shape):
+    return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+
+# -- admission ---------------------------------------------------------------
+
+
+def test_admit_returns_typed_result():
+    eng = _engine()
+    res = eng.admit(20)
+    assert isinstance(res, AdmissionResult)
+    assert res.admitted and res.slot is not None and res.reason == "ok"
+    assert bool(res) is True
+
+
+def test_real_exhaustion_is_backpressure_not_raise():
+    eng = _engine(num_pages=4, mpp=4)
+    assert eng.admit(4 * 16).admitted  # whole pool
+    res = eng.admit(16)
+    assert not res.admitted and res.slot is None
+    assert res.reason == "pool_exhausted"
+    assert bool(res) is False
+
+
+def test_injected_exhaustion_and_alloc_failure(monkeypatch):
+    eng = _engine()
+    monkeypatch.setenv("MAGI_ATTENTION_CHAOS", "pool_exhaust")
+    reset_chaos()
+    res = eng.admit(16)
+    assert not res.admitted and res.reason == "pool_exhausted"
+    monkeypatch.setenv("MAGI_ATTENTION_CHAOS", "alloc_fail:times=1")
+    reset_chaos()
+    res = eng.admit(16)
+    assert not res.admitted and res.reason == "alloc_error"
+    monkeypatch.delenv("MAGI_ATTENTION_CHAOS")
+    assert eng.admit(16).admitted  # recovers once chaos clears
+
+
+def test_too_long_is_rejected_without_eviction():
+    eng = _engine(num_pages=8, mpp=2)
+    eng.admit(16, priority=0)
+    res = eng.admit(3 * 16, priority=9)  # > mpp pages: can never fit
+    assert not res.admitted and res.reason == "too_long"
+    assert res.evicted == ()
+
+
+def test_evict_lowest_priority_then_retry():
+    eng = _engine(num_pages=4, max_seqs=4, mpp=4)
+    slots = {eng.admit(16, priority=p).slot: p for p in (3, 1, 2, 1)}
+    res = eng.admit(2 * 16, priority=5)
+    assert res.admitted and len(res.evicted) == 2
+    # victims are the two priority-1 residents, lowest slot id first
+    assert all(slots[s] == 1 for s in res.evicted)
+    # equal priority never evicts
+    res2 = eng.admit(2 * 16, priority=2)
+    assert not res2.admitted and res2.evicted == ()
+
+
+def test_eviction_bound_is_respected():
+    eng = _engine(
+        num_pages=4, max_seqs=4, mpp=4, max_admission_evictions=1
+    )
+    for _ in range(4):
+        eng.admit(16, priority=0)
+    res = eng.admit(3 * 16, priority=9)  # needs 3 pages, bound allows 1
+    assert not res.admitted
+    assert len(res.evicted) == 1
+
+
+def test_admission_telemetry(monkeypatch):
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        eng = _engine(num_pages=2, max_seqs=2, mpp=2)
+        eng.admit(2 * 16)
+        eng.admit(16)  # rejected
+        snap = telemetry.snapshot()
+        assert (
+            snap["counters"].get(
+                "magi_admission_rejected{reason=pool_exhausted}"
+            )
+            == 1
+        )
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
+# -- prefill fault cleanup (satellite regression) ----------------------------
+
+
+def test_prefill_fault_releases_pages_and_readmit_reuses(monkeypatch):
+    rng = np.random.default_rng(0)
+    eng = _engine(num_pages=4, max_seqs=2, mpp=4)
+    res = eng.admit(48)
+    pages = set(eng.allocator._slot_pages[res.slot])
+    in_use = eng.occupancy()["pages_in_use"]
+    assert in_use == 3
+    monkeypatch.setenv("MAGI_ATTENTION_CHAOS", "prefill_error:times=1")
+    reset_chaos()
+    with pytest.raises(ChaosInjectedError):
+        eng.prefill(
+            _rand(rng, 48, HQ, D), _rand(rng, 48, HK, D),
+            _rand(rng, 48, HK, D), res.slot,
+        )
+    # no leak: pages back, slot fully released, lengths cleared
+    assert eng.occupancy()["pages_in_use"] == 0
+    assert eng.occupancy()["active_seqs"] == 0
+    assert res.slot not in eng._lengths
+    monkeypatch.delenv("MAGI_ATTENTION_CHAOS")
+    res2 = eng.admit(48)
+    assert res2.admitted
+    assert set(eng.allocator._slot_pages[res2.slot]) == pages
+    out, _ = eng.prefill(
+        _rand(rng, 48, HQ, D), _rand(rng, 48, HK, D),
+        _rand(rng, 48, HK, D), res2.slot,
+    )
+    assert np.isfinite(np.asarray(out)).all()
+    assert eng._lengths[res2.slot] == 48
+
+
+def test_prefill_growth_exhaustion_keeps_slot_intact():
+    """A REFUSED reservation growth (transient pool exhaustion before
+    any write) must raise without destroying the slot's committed KV —
+    unlike a fault mid-write, nothing was half-done, and decode_step's
+    identical growth error leaves the sequence recoverable too."""
+    rng = np.random.default_rng(1)
+    eng = _engine(num_pages=2, max_seqs=2, mpp=4, ps=16)
+    res = eng.admit(16)
+    eng.prefill(
+        _rand(rng, 16, HQ, D), _rand(rng, 16, HK, D),
+        _rand(rng, 16, HK, D), res.slot,
+    )
+    assert eng.admit(16).admitted  # second sequence drains the pool
+    with pytest.raises(RuntimeError):
+        eng.prefill(  # needs a second page; none free
+            _rand(rng, 16, HQ, D), _rand(rng, 16, HK, D),
+            _rand(rng, 16, HK, D), res.slot,
+        )
+    assert eng._lengths[res.slot] == 16  # committed KV intact
+    assert eng.occupancy()["active_seqs"] == 2  # slot NOT torn down
+
+
+def test_admit_rolls_back_on_block_table_failure(monkeypatch):
+    eng = _engine()
+    import magiattention_tpu.serving.engine as engine_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("install failed")
+
+    monkeypatch.setattr(engine_mod, "assign_block_table", boom)
+    with pytest.raises(RuntimeError):
+        eng.admit(16)
+    assert eng.occupancy()["pages_in_use"] == 0
+    assert eng.occupancy()["active_seqs"] == 0
+
+
+# -- plan + hops build fallbacks --------------------------------------------
+
+
+def test_plan_build_falls_back_to_degree0(monkeypatch):
+    from magiattention_tpu.common.enum import AttnMaskType
+    from magiattention_tpu.common.ranges import AttnRanges
+    from magiattention_tpu.meta.dispatch_meta import (
+        make_dispatch_meta_from_qk_ranges,
+    )
+    from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+    from magiattention_tpu.parallel.dist_attn import build_dist_attn_plan
+
+    total, cp, chunk = 1024, 2, 128
+    qr = AttnRanges.from_ranges([(0, total)])
+    kr = AttnRanges.from_ranges([(0, total)])
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, [AttnMaskType.CAUSAL], total, total,
+        chunk_size=chunk, cp_size=cp,
+    )
+    monkeypatch.setenv("MAGI_ATTENTION_CHAOS", "plan_error:times=1")
+    reset_chaos()
+    plan = build_dist_attn_plan(
+        mq, bucket,
+        overlap_config=OverlapConfig(degree=2, min_stage_rows=64),
+    )
+    assert plan.overlap_degree == 0 and plan.merged_comm is not None
+
+    # an unlimited injector (times=0) kills the fallback too: the error
+    # must then surface, not loop
+    monkeypatch.setenv("MAGI_ATTENTION_CHAOS", "plan_error:times=0")
+    reset_chaos()
+    with pytest.raises(ChaosInjectedError):
+        build_dist_attn_plan(
+            mq, bucket,
+            overlap_config=OverlapConfig(degree=2, min_stage_rows=64),
+        )
+
+
+def test_hops_build_falls_back_to_a2a(monkeypatch):
+    from magiattention_tpu.comm.group_collective import GroupCollectiveMeta
+
+    smap = [
+        [
+            np.arange(4, dtype=np.int64) if s != d else
+            np.empty(0, np.int64)
+            for d in range(2)
+        ]
+        for s in range(2)
+    ]
+    monkeypatch.setenv("MAGI_ATTENTION_CHAOS", "hops_build_error:times=1")
+    reset_chaos()
+    meta = GroupCollectiveMeta.build(smap, [8, 8], impl="hops")
+    assert meta.impl == "a2a"
+    assert meta.impl_reason == "degraded_hops_build_error"
+    assert meta.hops == ()
+    # the degraded meta still routes: its a2a arrays are complete
+    assert meta.cast_device_arrays()[0].shape[0] == 2
+    meta2 = GroupCollectiveMeta.build(smap, [8, 8], impl="hops")
+    assert meta2.impl == "hops"  # injector exhausted: healthy again
+
+
+# -- tuning-cache io visibility (satellite) ----------------------------------
+
+
+def test_tuning_cache_io_errors_are_counted(monkeypatch, tmp_path):
+    from magiattention_tpu.tuning import (
+        TuningCache,
+        TuningRecord,
+        make_fingerprint,
+    )
+
+    fp = make_fingerprint([(0, 512)], [(0, 512)], [1], 4, 4)
+    rec = TuningRecord(128, 128, 1, "model", 1.0, None, ())
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        TuningCache(str(tmp_path)).put(fp, rec)
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_CHAOS", "cache_io_error:op=load,times=1"
+        )
+        reset_chaos()
+        got, layer = TuningCache(str(tmp_path)).get(fp)
+        assert got is None and layer == "miss"
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_CHAOS", "cache_io_error:op=store,times=1"
+        )
+        reset_chaos()
+        TuningCache(str(tmp_path)).put(fp, rec)  # must not raise
+        snap = telemetry.snapshot()
+        assert snap["counters"].get(
+            "magi_tuning_cache_io_errors{op=load}"
+        ) == 1
+        assert snap["counters"].get(
+            "magi_tuning_cache_io_errors{op=store}"
+        ) == 1
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
+def test_tuning_cache_corrupt_file_is_counted_miss(tmp_path):
+    """A real torn/garbage cache file (no chaos): visible counter, miss,
+    and a later healthy write recovers."""
+    from magiattention_tpu.tuning import (
+        TuningCache,
+        TuningRecord,
+        make_fingerprint,
+    )
+
+    fp = make_fingerprint([(0, 256)], [(0, 256)], [1], 2, 2)
+    rec = TuningRecord(64, 64, 1, "model", 1.0, None, ())
+    cache = TuningCache(str(tmp_path))
+    cache.put(fp, rec)
+    path = cache._path(fp.stable_hash())
+    with open(path, "w") as f:
+        f.write("{torn json")
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        got, layer = TuningCache(str(tmp_path)).get(fp)
+        assert got is None and layer == "miss"
+        snap = telemetry.snapshot()
+        assert snap["counters"].get(
+            "magi_tuning_cache_io_errors{op=load}"
+        ) == 1
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+    cache2 = TuningCache(str(tmp_path))
+    cache2.put(fp, rec)
+    assert cache2.get(fp)[1] == "memory"
+
+
+def test_cold_cache_miss_is_not_a_fault(tmp_path):
+    from magiattention_tpu.tuning import TuningCache, make_fingerprint
+
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        fp = make_fingerprint([(0, 128)], [(0, 128)], [1], 2, 2)
+        assert TuningCache(str(tmp_path)).get(fp) == (None, "miss")
+        snap = telemetry.snapshot()
+        assert not any(
+            "tuning_cache_io" in k for k in snap.get("counters", {})
+        )
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()

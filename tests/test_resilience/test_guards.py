@@ -1,0 +1,160 @@
+"""Numerical guards: detection semantics, quarantine, code decode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.resilience import guards as G
+
+NEG_INF = float("-inf")
+
+
+def _partial(bad=None):
+    """(out [4,2,3], lse [4,2]) with an optional fault planted."""
+    rng = np.random.default_rng(0)
+    out = jnp.asarray(rng.standard_normal((4, 2, 3)), jnp.float32)
+    lse = jnp.asarray(rng.standard_normal((4, 2)), jnp.float32)
+    if bad == "nan_out":
+        out = out.at[1, 0, 2].set(jnp.nan)
+    elif bad == "inf_lse":
+        lse = lse.at[2, 1].set(jnp.inf)
+    elif bad == "nan_lse":
+        lse = lse.at[0, 0].set(jnp.nan)
+    return out, lse
+
+
+def test_neg_inf_lse_is_healthy(monkeypatch):
+    """The zero-coverage convention (lse=-inf, out=0) must NOT trip the
+    guard — it is the merge algebra's legitimate identity element."""
+    monkeypatch.setenv("MAGI_ATTENTION_GUARD", "check")
+    out = jnp.zeros((4, 2, 3))
+    lse = jnp.full((4, 2), NEG_INF)
+    o, l, code = G.guard_partial(out, lse, G.new_error_code(), 0, "s")
+    assert int(code) == 0
+    assert np.array_equal(np.asarray(l), np.asarray(lse))
+
+
+@pytest.mark.parametrize("fault", ["nan_out", "inf_lse", "nan_lse"])
+def test_check_mode_detects_and_passes_through(monkeypatch, fault):
+    monkeypatch.setenv("MAGI_ATTENTION_GUARD", "check")
+    out, lse = _partial(fault)
+    o, l, code = G.guard_partial(out, lse, G.new_error_code(), 3, "s")
+    assert int(code) == 1 << 3
+    # bit-transparent: the data itself is untouched in check mode
+    assert np.array_equal(
+        np.asarray(o), np.asarray(out), equal_nan=True
+    )
+    assert np.array_equal(
+        np.asarray(l), np.asarray(lse), equal_nan=True
+    )
+
+
+@pytest.mark.parametrize("fault", ["nan_out", "inf_lse", "nan_lse"])
+def test_repair_mode_quarantines_bad_rows_only(monkeypatch, fault):
+    monkeypatch.setenv("MAGI_ATTENTION_GUARD", "repair")
+    out, lse = _partial(fault)
+    clean_out, clean_lse = _partial()
+    o, l, code = G.guard_partial(out, lse, G.new_error_code(), 0, "s")
+    o, l = np.asarray(o), np.asarray(l)
+    assert int(code) == 1
+    bad = np.isnan(np.asarray(lse)) | (np.asarray(lse) == np.inf) | (
+        ~np.isfinite(np.asarray(out)).all(-1)
+    )
+    assert bad.any()
+    assert (l[bad] == NEG_INF).all()
+    assert (o[bad] == 0).all()
+    # healthy rows are bit-identical
+    assert np.array_equal(o[~bad], np.asarray(clean_out)[~bad])
+    assert np.array_equal(l[~bad], np.asarray(clean_lse)[~bad])
+
+
+def test_quarantined_partial_merges_as_noop(monkeypatch):
+    """repair + the hardened correction: a fully poisoned partial must
+    contribute NOTHING to the merge."""
+    from magiattention_tpu.ops.correction import correct_attn_out_lse
+
+    monkeypatch.setenv("MAGI_ATTENTION_GUARD", "repair")
+    good_out, good_lse = _partial()
+    poison_out = jnp.full_like(good_out, jnp.nan)
+    poison_lse = jnp.full_like(good_lse, jnp.inf)
+    out, lse = correct_attn_out_lse(
+        good_out, good_lse, poison_out, poison_lse
+    )
+    assert np.allclose(np.asarray(out), np.asarray(good_out), atol=1e-6)
+    assert np.allclose(np.asarray(lse), np.asarray(good_lse), atol=1e-6)
+
+
+def test_correction_off_mode_unchanged(monkeypatch):
+    """GUARD=off: correction must still propagate the poison (the guard
+    is opt-in; off means bit-for-bit legacy behavior)."""
+    from magiattention_tpu.ops.correction import correct_attn_out_lse
+
+    monkeypatch.delenv("MAGI_ATTENTION_GUARD", raising=False)
+    good_out, good_lse = _partial()
+    poison_out = jnp.full_like(good_out, jnp.nan)
+    poison_lse = jnp.zeros_like(good_lse)  # finite lse, poisoned payload
+    out, _ = correct_attn_out_lse(good_out, good_lse, poison_out, poison_lse)
+    assert np.isnan(np.asarray(out)).any()
+
+
+def test_consume_raises_typed_error_with_sites(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_GUARD", "check")
+    code = jnp.asarray([0b101], jnp.int32)  # bits 0 and 2
+    with pytest.raises(G.NumericalGuardError) as exc:
+        G.consume_error_code(code, ("host", "stage0", "stage1"))
+    assert exc.value.sites == ("host", "stage1")
+
+
+def test_consume_repair_records_not_raises(monkeypatch):
+    from magiattention_tpu import telemetry
+
+    monkeypatch.setenv("MAGI_ATTENTION_GUARD", "repair")
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        G.consume_error_code(jnp.asarray([0b10], jnp.int32), ("a", "b"))
+        snap = telemetry.snapshot()
+        assert snap["counters"].get("magi_guard_repairs{site=b}") == 1
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
+def test_consume_zero_and_none_are_silent(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_GUARD", "check")
+    G.consume_error_code(jnp.zeros((2,), jnp.int32), ("a",))
+    G.consume_error_code(None, ("a",))
+
+
+def test_off_mode_traces_zero_guard_ops(monkeypatch):
+    from magiattention_tpu.analysis.trace_audit import guard_census
+    from magiattention_tpu.ops.correction import correct_attn_out_lse
+
+    monkeypatch.delenv("MAGI_ATTENTION_GUARD", raising=False)
+    out, lse = _partial()
+    # fresh lambdas per trace: this jax caches make_jaxpr on function
+    # identity, so re-tracing the same callable after an env flip would
+    # silently serve the stale program
+    jaxpr = jax.make_jaxpr(
+        lambda *a: correct_attn_out_lse(*a)
+    )(out, lse, out, lse)
+    assert guard_census(jaxpr) == 0
+    monkeypatch.setenv("MAGI_ATTENTION_GUARD", "repair")
+    jaxpr_r = jax.make_jaxpr(
+        lambda *a: correct_attn_out_lse(*a)
+    )(out, lse, out, lse)
+    assert guard_census(jaxpr_r) > 0
+
+
+def test_guard_partial_is_jittable(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_GUARD", "repair")
+    out, lse = _partial("nan_out")
+
+    @jax.jit
+    def f(o, l):
+        return G.guard_partial(o, l, G.new_error_code(), 0, "s")
+
+    o, l, code = f(out, lse)
+    assert np.isfinite(np.asarray(o)).all()
+    assert int(code) == 1

@@ -41,7 +41,7 @@ def test_prefill_decode_round_trip_matches_full_prefill():
     k_all = _rand(rng, t0 + steps, HK, D)
     v_all = _rand(rng, t0 + steps, HK, D)
 
-    slot = eng.admit(t0 + steps)
+    slot = eng.admit(t0 + steps).slot
     eng.prefill(q_all[:t0], k_all[:t0], v_all[:t0], slot)
     decode_outs = []
     for i in range(t0, t0 + steps):
@@ -65,8 +65,8 @@ def test_continuous_batching_two_sequences():
     each matches its own single-sequence result."""
     rng = np.random.default_rng(47)
     eng = _engine()
-    sa = eng.admit(40)
-    sb = eng.admit(40)
+    sa = eng.admit(40).slot
+    sb = eng.admit(40).slot
     ka, va = _rand(rng, 25, HK, D), _rand(rng, 25, HK, D)
     kb, vb = _rand(rng, 9, HK, D), _rand(rng, 9, HK, D)
     eng.prefill(_rand(rng, 25, HQ, D), ka, va, sa)
@@ -79,7 +79,7 @@ def test_continuous_batching_two_sequences():
     # singles: fresh engine per sequence
     for idx, (kk, vv, t) in enumerate([(ka, va, 25), (kb, vb, 9)]):
         e1 = _engine()
-        s = e1.admit(40)
+        s = e1.admit(40).slot
         e1.prefill(_rand(np.random.default_rng(0), t, HQ, D), kk, vv, s)
         o1, _ = e1.decode_step(
             q[idx][None], kn[idx][None], vn[idx][None], [s], num_splits=2
@@ -91,13 +91,13 @@ def test_continuous_batching_two_sequences():
 def test_free_and_readmit_reuses_slot_cleanly():
     rng = np.random.default_rng(53)
     eng = _engine()
-    slot = eng.admit(32)
+    slot = eng.admit(32).slot
     eng.prefill(_rand(rng, 32, HQ, D), _rand(rng, 32, HK, D),
                 _rand(rng, 32, HK, D), slot)
     assert eng.occupancy()["active_seqs"] == 1
     eng.free(slot)
     assert eng.occupancy()["pages_in_use"] == 0
-    slot2 = eng.admit(16)
+    slot2 = eng.admit(16).slot
     k2, v2 = _rand(rng, 10, HK, D), _rand(rng, 10, HK, D)
     eng.prefill(_rand(rng, 10, HQ, D), k2, v2, slot2)
     assert int(eng.cache.seq_lens[slot2]) == 10
@@ -122,7 +122,7 @@ def test_engine_records_serving_telemetry():
     try:
         rng = np.random.default_rng(59)
         eng = _engine()
-        slot = eng.admit(20)
+        slot = eng.admit(20).slot
         eng.prefill(_rand(rng, 20, HQ, D), _rand(rng, 20, HK, D),
                     _rand(rng, 20, HK, D), slot)
         eng.decode_step(_rand(rng, 1, HQ, D), _rand(rng, 1, HK, D),
@@ -162,7 +162,7 @@ def test_decode_past_reservation_auto_extends_without_corruption():
         max_seqs=4, max_pages_per_seq=8, dtype=jnp.float32,
     )
     # victim: the first admission owns page 0 (allocator pops low first)
-    victim = eng.admit(ps)
+    victim = eng.admit(ps).slot
     kv_v = _rand(rng, ps, HK, D)
     eng.prefill(_rand(rng, ps, HQ, D), kv_v, kv_v, victim)
     victim_page0 = np.asarray(eng.cache.k_pages[
@@ -170,7 +170,7 @@ def test_decode_past_reservation_auto_extends_without_corruption():
     ])
     # grower: reserved for ps tokens, then decoded past two page
     # boundaries
-    grower = eng.admit(ps)
+    grower = eng.admit(ps).slot
     kv_g = _rand(rng, ps - 2, HK, D)
     eng.prefill(_rand(rng, ps - 2, HQ, D), kv_g, kv_g, grower)
     appended = []
@@ -206,7 +206,7 @@ def test_prefill_telemetry_counts_valid_tokens_only():
     try:
         rng = np.random.default_rng(67)
         eng = _engine()
-        slot = eng.admit(64)
+        slot = eng.admit(64).slot
         eng.prefill(_rand(rng, 64, HQ, D), _rand(rng, 64, HK, D),
                     _rand(rng, 64, HK, D), slot, length=20)
         snap = telemetry.snapshot()
