@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check decode-bench comm-check analyze resilience-check check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze resilience-check check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -77,6 +77,17 @@ timeline-demo:
 serving-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_serving_check.py
 
+# shared-prefix serving drift guard (ISSUE 9, CPU): multi-tenant trace
+# (one system prompt x many users) asserting cascade decode parity vs
+# dense oracles on BOTH backends (jnp + pallas-interpret), shared prefix
+# pages resident exactly once (+1 CoW boundary page per diverging user
+# on unaligned prefixes), chunked-prefill round-trip parity, and that no
+# scheduler step with an active decode batch skips decode while a long
+# prefill drains under the token budget
+# (exps/run_scheduler_check.py exits non-zero on any violation)
+sched-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_scheduler_check.py
+
 # split-KV decode throughput grid (tokens/s + effective KV bandwidth);
 # CPU uses the jnp reference backend, TPU the Pallas kernel
 decode-bench:
@@ -112,6 +123,6 @@ resilience-check:
 
 # the default check flow: syntax, static analysis, telemetry catalog +
 # timeline/aggregate semantics, autotuner rung expectations, perf gate,
-# serving parity, group-collective parity/volume, resilience gate —
-# all CPU-safe
-check: lint analyze telemetry-check autotune-check perf-gate serving-check comm-check resilience-check
+# serving parity, shared-prefix/scheduler gate, group-collective
+# parity/volume, resilience gate — all CPU-safe
+check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check

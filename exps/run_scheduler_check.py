@@ -1,0 +1,394 @@
+"""Shared-prefix serving drift guard (``make sched-check``) — CPU.
+
+The ISSUE 9 acceptance surface, device-free, on a multi-tenant synthetic
+trace (one shared system prompt x many users, token ids -> deterministic
+KV through a fixed embedding table so identical tokens mean identical
+cached KV):
+
+1. **cascade parity on BOTH backends** (jnp reference and the Pallas
+   kernel in interpret mode): every user's decode output — cascade
+   forced ON, flat split-KV, and cascade 'auto' — matches dense
+   attention over the concatenated prefix+suffix KV, across page sizes
+   and split counts;
+2. **memory win asserted, not claimed**: after admitting + prefilling N
+   prefix-sharing users, ``PageAllocator.pages_in_use ==
+   pages_needed(P) + sum_i pages_needed(suffix_i)`` exactly for a
+   page-aligned prefix (the shared pages are resident ONCE), and within
+   +N boundary pages for an unaligned prefix (each diverging user
+   copy-on-writes the tail page once);
+3. **chunked prefill round-trips**: a prompt longer than the chunk
+   prefills chunk-by-chunk through the cross path and the decode outputs
+   match a single-shot engine bit-for-bit within tolerance;
+4. **no decode starvation**: while an 80-token prompt drains in chunks
+   under a token budget, EVERY scheduler step with an active decode
+   batch runs a decode step, and no step exceeds the budget.
+
+Exits non-zero on any violation.
+"""
+
+import math
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)  # f64 oracles, like the tests
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from magiattention_tpu import telemetry  # noqa: E402
+from magiattention_tpu.serving import (  # noqa: E402
+    Request,
+    Scheduler,
+    ServingEngine,
+)
+from magiattention_tpu.testing.precision import calc_rel_err  # noqa: E402
+
+HQ, HK, D = 4, 2, 32
+TOL = 1e-5
+VOCAB = 97
+
+_rng = np.random.default_rng(0)
+EMB_K = _rng.standard_normal((VOCAB, HK, D)).astype(np.float32)
+EMB_V = _rng.standard_normal((VOCAB, HK, D)).astype(np.float32)
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def kv_of(tokens):
+    idx = np.asarray(tokens, np.int64)
+    return jnp.asarray(EMB_K[idx]), jnp.asarray(EMB_V[idx])
+
+
+def dense_ref(q_row, tokens):
+    """f64 dense attention of one query over the token stream's KV."""
+    kf = np.repeat(EMB_K[np.asarray(tokens)].astype(np.float64), HQ // HK, 1)
+    vf = np.repeat(EMB_V[np.asarray(tokens)].astype(np.float64), HQ // HK, 1)
+    z = np.einsum("hd,thd->ht", np.asarray(q_row, np.float64), kf)
+    z /= math.sqrt(D)
+    w = np.exp(z - z.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    return np.einsum("ht,thd->hd", w, vf)
+
+
+def _admit_prefill(eng, rng, tokens):
+    res = eng.admit(len(tokens), tokens=tokens)
+    assert res.admitted, res
+    suffix = list(tokens[res.prefix_len :])
+    k, v = kv_of(suffix)
+    q = jnp.asarray(rng.standard_normal((len(suffix), HQ, D)), jnp.float32)
+    eng.prefill(q, k, v, res.slot)
+    return res
+
+
+def check_cascade_parity_and_memory(backend: str) -> int:
+    os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] = backend
+    rng = np.random.default_rng(1)
+    for ps, prefix_pages, n_users in ((8, 3, 4), (16, 2, 3)):
+        prefix = list(rng.integers(0, VOCAB, prefix_pages * ps))
+        eng = ServingEngine(
+            num_pages=96, num_kv_heads=HK, head_dim=D, page_size=ps,
+            max_seqs=8, max_pages_per_seq=16, dtype=jnp.float32,
+        )
+        suffixes = [
+            list(rng.integers(0, VOCAB, int(rng.integers(3, 2 * ps))))
+            for _ in range(n_users)
+        ]
+        # user 0's prompt IS the system prompt: its pages become the
+        # trie's resident copy and its cascade group key matches the
+        # forks', so the whole tenant set lands in ONE group
+        prompts = [prefix] + [prefix + s for s in suffixes[1:]]
+        results = [_admit_prefill(eng, rng, p) for p in prompts]
+        # -- memory: shared pages resident exactly once (aligned prefix)
+        expect = math.ceil(len(prefix) / ps) + sum(
+            math.ceil(len(p) / ps) - prefix_pages for p in prompts
+        )
+        if eng.allocator.pages_in_use != expect:
+            return fail(
+                f"[{backend}] aligned-prefix residency: "
+                f"{eng.allocator.pages_in_use} pages in use, expected "
+                f"exactly {expect} (ps={ps})"
+            )
+        # every fork must reference the SAME prefix page ids
+        rows = [
+            eng.allocator.slot_pages(r.slot)[:prefix_pages] for r in results
+        ]
+        if any(row != rows[0] for row in rows[1:]):
+            return fail(f"[{backend}] forks hold different prefix pages: {rows}")
+        for r in results[1:]:
+            if r.prefix_len != len(prefix):
+                return fail(
+                    f"[{backend}] fork matched {r.prefix_len} tokens, "
+                    f"expected {len(prefix)}"
+                )
+        # -- decode parity: cascade ON vs flat OFF vs auto vs dense
+        for splits in (None, 1, 2):
+            qd = jnp.asarray(
+                rng.standard_normal((n_users, HQ, D)), jnp.float32
+            )
+            new_toks = list(rng.integers(0, VOCAB, n_users))
+            kn, vn = kv_of(new_toks)
+            slots = [r.slot for r in results]
+            before = [eng._lengths[s] for s in slots]
+            streams = [
+                p + [t] for p, t in zip(prompts, new_toks)
+            ]
+            out_on, _ = eng.decode_step(
+                qd, kn, vn, slots, cascade=True, num_splits=splits
+            )
+            # rewind the append so each mode decodes the same state
+            for mode in ("off", "auto"):
+                for s, b in zip(slots, before):
+                    eng._lengths[s] = b
+                eng.cache = eng.cache.tree_unflatten(
+                    None,
+                    (
+                        eng.cache.k_pages, eng.cache.v_pages,
+                        eng.cache.block_tables,
+                        eng.cache.seq_lens.at[jnp.asarray(slots)].set(
+                            jnp.asarray(before, jnp.int32)
+                        ),
+                    ),
+                )
+                out_m, _ = eng.decode_step(
+                    qd, kn, vn, slots, cascade=mode, num_splits=splits
+                )
+                err = calc_rel_err(out_m, out_on)
+                if err > TOL:
+                    return fail(
+                        f"[{backend}] cascade-vs-{mode} rel err {err:.2e} "
+                        f"(ps={ps}, splits={splits})"
+                    )
+            for j in range(n_users):
+                ref = dense_ref(qd[j], streams[j])
+                err = calc_rel_err(out_on[j], ref)
+                if err > TOL:
+                    return fail(
+                        f"[{backend}] cascade-vs-dense rel err {err:.2e} "
+                        f"(user {j}, ps={ps}, splits={splits})"
+                    )
+            # bring bookkeeping forward for the next splits round
+            for j, p in enumerate(prompts):
+                prompts[j] = streams[j]
+        for r in results:
+            eng.free(r.slot)
+    print(
+        f"sched-check[{backend}]: cascade==flat==dense parity OK across "
+        "page sizes x splits; shared prefix pages resident exactly once"
+    )
+    return 0
+
+
+def check_unaligned_cow_memory() -> int:
+    os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] = "jnp"
+    rng = np.random.default_rng(2)
+    ps = 8
+    prefix = list(rng.integers(0, VOCAB, 2 * ps + 5))  # unaligned: 5-tok tail
+    eng = ServingEngine(
+        num_pages=64, num_kv_heads=HK, head_dim=D, page_size=ps,
+        max_seqs=8, max_pages_per_seq=12, dtype=jnp.float32,
+    )
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    n_users = 4
+    prompts = [prefix] + [
+        prefix + list(rng.integers(0, VOCAB, 6)) for _ in range(n_users - 1)
+    ]
+    results = [_admit_prefill(eng, rng, p) for p in prompts]
+    for r in results[1:]:
+        if r.prefix_len != len(prefix):
+            return fail(
+                f"unaligned fork matched {r.prefix_len}, want {len(prefix)}"
+            )
+    ideal = math.ceil(len(prefix) / ps) + sum(
+        math.ceil(max(len(p) - len(prefix), 0) / ps) for p in prompts
+    )
+    used = eng.allocator.pages_in_use
+    if not ideal <= used <= ideal + n_users:
+        return fail(
+            f"unaligned-prefix residency {used} outside "
+            f"[{ideal}, {ideal + n_users}] (+1 CoW boundary page/user)"
+        )
+    snap = telemetry.snapshot()
+    cows = snap["counters"].get("magi_prefix_cow_splits_total", 0)
+    if not cows:
+        return fail("unaligned forks never triggered a CoW split")
+    # decode parity after the CoW splits
+    qd = jnp.asarray(rng.standard_normal((n_users, HQ, D)), jnp.float32)
+    new_toks = list(rng.integers(0, VOCAB, n_users))
+    kn, vn = kv_of(new_toks)
+    out, _ = eng.decode_step(
+        qd, kn, vn, [r.slot for r in results], cascade="auto"
+    )
+    for j in range(n_users):
+        err = calc_rel_err(out[j], dense_ref(qd[j], prompts[j] + [new_toks[j]]))
+        if err > TOL:
+            return fail(f"post-CoW decode rel err {err:.2e} (user {j})")
+    telemetry.set_enabled(None)
+    print(
+        f"sched-check: unaligned prefix OK — {used} pages for ideal "
+        f"{ideal} (+{used - ideal} CoW tail copies), {int(cows)} CoW "
+        "splits, post-CoW parity clean"
+    )
+    return 0
+
+
+def _mk_request(rng, rid, tokens, gen, priority=0):
+    k, v = kv_of(tokens)
+    return Request(
+        rid=rid,
+        prompt_q=jnp.asarray(
+            rng.standard_normal((len(tokens), HQ, D)), jnp.float32
+        ),
+        prompt_k=k,
+        prompt_v=v,
+        decode_q=jnp.asarray(rng.standard_normal((gen, HQ, D)), jnp.float32),
+        decode_k=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        decode_v=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        tokens=list(tokens),
+        priority=priority,
+    )
+
+
+def check_chunked_prefill_round_trip() -> int:
+    os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] = "jnp"
+    rng = np.random.default_rng(3)
+    ps, t = 8, 70  # not chunk- or page-aligned
+    toks = list(rng.integers(0, VOCAB, t))
+    q = jnp.asarray(rng.standard_normal((t, HQ, D)), jnp.float32)
+    k, v = kv_of(toks)
+    qd = jnp.asarray(rng.standard_normal((3, HQ, D)), jnp.float32)
+    kd = jnp.asarray(rng.standard_normal((3, HK, D)), jnp.float32)
+    vd = jnp.asarray(rng.standard_normal((3, HK, D)), jnp.float32)
+
+    outs = {}
+    for chunk in (None, 32):
+        if chunk is None:
+            os.environ.pop("MAGI_ATTENTION_PREFILL_CHUNK", None)
+        else:
+            os.environ["MAGI_ATTENTION_PREFILL_CHUNK"] = str(chunk)
+        eng = ServingEngine(
+            num_pages=32, num_kv_heads=HK, head_dim=D, page_size=ps,
+            max_seqs=2, max_pages_per_seq=16, dtype=jnp.float32,
+            prefix_sharing=False,
+        )
+        slot = eng.admit(t).slot
+        pf_out, _ = eng.prefill(q, k, v, slot)
+        dec = []
+        for i in range(3):
+            o, _ = eng.decode_step(qd[i][None], kd[i][None], vd[i][None], [slot])
+            dec.append(o[0])
+        outs[chunk] = (pf_out, dec)
+    os.environ.pop("MAGI_ATTENTION_PREFILL_CHUNK", None)
+    err_p = calc_rel_err(outs[32][0], outs[None][0])
+    if err_p > TOL:
+        return fail(f"chunked-vs-single prefill out rel err {err_p:.2e}")
+    for i in range(3):
+        err_d = calc_rel_err(outs[32][1][i], outs[None][1][i])
+        if err_d > TOL:
+            return fail(f"chunked round-trip decode {i} rel err {err_d:.2e}")
+    print(
+        "sched-check: chunked prefill (chunk=32, t=70) round-trips "
+        "prefill+decode against single-shot"
+    )
+    return 0
+
+
+def check_scheduler_interleave() -> int:
+    os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] = "jnp"
+    rng = np.random.default_rng(4)
+    ps = 8
+    sysp = list(rng.integers(0, VOCAB, 3 * ps))
+    eng = ServingEngine(
+        num_pages=128, num_kv_heads=HK, head_dim=D, page_size=ps,
+        max_seqs=8, max_pages_per_seq=20, dtype=jnp.float32,
+    )
+    budget = 24
+    sched = Scheduler(eng, token_budget=budget, chunk=16)
+    for i in range(3):
+        sched.submit(
+            _mk_request(
+                rng, i, sysp + list(rng.integers(0, VOCAB, 4)), gen=10
+            )
+        )
+    warm = [sched.step() for _ in range(3)]
+    # the decode batch is live; now a long prompt arrives
+    sched.submit(_mk_request(rng, 99, list(rng.integers(0, VOCAB, 80)), gen=2))
+    reports = warm + sched.run()
+    over = [r for r in reports if r.tokens_used > budget]
+    if over:
+        return fail(f"scheduler exceeded the token budget: {over[0]}")
+    chunk_steps = [
+        r
+        for r in reports
+        if any(rid == 99 and n > 0 for rid, n in r.prefill_chunks)
+    ]
+    if len(chunk_steps) < 3:
+        return fail(
+            f"80-token prompt drained in {len(chunk_steps)} chunk steps — "
+            "chunking did not engage"
+        )
+    starved = [r for r in chunk_steps if not r.decode_ran]
+    if starved:
+        return fail(
+            "decode starved while the long prefill drained: "
+            f"step {starved[0].step} ran chunks without a decode step"
+        )
+    if not sched.done:
+        return fail("scheduler did not drain the trace")
+    st = sched.result(99)
+    if len(st.decode_outs) != 2:
+        return fail(f"long request produced {len(st.decode_outs)} tokens")
+    print(
+        f"sched-check: scheduler OK — long prefill drained over "
+        f"{len(chunk_steps)} chunk steps, decode ran in every one, "
+        f"budget {budget} never exceeded"
+    )
+    return 0
+
+
+def main() -> int:
+    env_backup = {
+        k: os.environ.get(k)
+        for k in (
+            "MAGI_ATTENTION_KERNEL_BACKEND",
+            "MAGI_ATTENTION_PREFILL_CHUNK",
+            "MAGI_ATTENTION_CASCADE",
+        )
+    }
+    try:
+        for check in (
+            lambda: check_cascade_parity_and_memory("jnp"),
+            lambda: check_cascade_parity_and_memory("pallas"),
+            check_unaligned_cow_memory,
+            check_chunked_prefill_round_trip,
+            check_scheduler_interleave,
+        ):
+            rc = check()
+            if rc:
+                return rc
+    finally:
+        for kk, vv in env_backup.items():
+            if vv is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = vv
+    print(
+        "sched-check OK: cascade parity (jnp + pallas-interpret), "
+        "one-resident-copy memory, CoW splits, chunked round-trip, "
+        "starvation-free scheduling"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,6 +101,36 @@ def main() -> int:
         )
         return 1
 
+    # 2b. plan-LRU visibility (ISSUE 9 satellite): one cold + one warm
+    # resolution through the KEYED interface must tick the canonical
+    # magi_plan_cache_hits/misses counters the docs promise
+    import numpy as _np
+    import jax as _jax
+    from jax.sharding import Mesh as _Mesh
+
+    from magiattention_tpu.api import magi_attn_flex_key
+
+    mesh_lru = _Mesh(_np.array(_jax.devices()[:2]), ("cp",))
+    for _ in range(2):  # miss, then hit
+        magi_attn_flex_key(
+            [(0, 1024)], [(0, 1024)], [1], 1024, 1024, mesh_lru,
+            num_heads=(2, 2), head_dim=64, chunk_size=256,
+        )
+    snap = telemetry.snapshot()
+    missing = [
+        m for m in telemetry.REQUIRED_PLAN_CACHE_METRICS
+        if not has_series(snap, m)
+    ]
+    if missing:
+        print(
+            "FAIL: plan-LRU counters missing after a cold+warm keyed "
+            f"resolution (catalog drift): {missing}"
+        )
+        return 1
+    if snap["counters"].get("magi_plan_cache_hits", 0) < 1:
+        print("FAIL: warm keyed resolution did not count a plan-cache hit")
+        return 1
+
     # 3. exporters round-trip through JSON; traces carry track-naming
     # metadata events (phase M) for Perfetto
     with tempfile.TemporaryDirectory() as d:
@@ -374,11 +404,73 @@ def main() -> int:
         )
         return 1
 
+    # 9. shared-prefix + scheduler catalogs (ISSUE 9): a miss+hit+fork
+    # admission with an unaligned prefix (forces a CoW split), pool
+    # pressure (forces an LRU prefix eviction), then a few Scheduler
+    # ticks over a mixed prefill/decode trace must populate every
+    # magi_prefix_* / magi_sched_* / magi_request_* metric documented
+    from magiattention_tpu.serving import Request, Scheduler
+
+    telemetry.reset()
+    rng = np.random.default_rng(9)
+    ps = 8
+    eng9 = ServingEngine(
+        num_pages=8, num_kv_heads=hk, head_dim=d, page_size=ps,
+        max_seqs=4, max_pages_per_seq=8, dtype=jnp.float32,
+    )
+    prefix9 = [int(t) for t in rng.integers(0, 50, 2 * ps + 3)]
+
+    def _req(rid, toks, gen, prio=0):
+        return Request(
+            rid=rid,
+            prompt_q=mk(len(toks), hq, d),
+            prompt_k=mk(len(toks), hk, d),
+            prompt_v=mk(len(toks), hk, d),
+            decode_q=mk(gen, hq, d),
+            decode_k=mk(gen, hk, d),
+            decode_v=mk(gen, hk, d),
+            tokens=toks,
+            priority=prio,
+        )
+
+    sched9 = Scheduler(eng9, token_budget=32, chunk=16)
+    sched9.submit(_req(0, prefix9, gen=2))  # prefix miss + registration
+    for _ in range(3):  # drain request 0's prefill so the trie is warm
+        sched9.step()
+    sched9.submit(_req(1, prefix9 + [1, 2, 3], gen=2))  # hit + CoW split
+    sched9.run()
+    # pressure round: a prompt that only fits if the trie's now-unused
+    # prefix pages are LRU-evicted (3 trie pages resident, 5 free, 6
+    # needed)
+    res9 = eng9.admit(6 * ps, tokens=None)
+    if not res9.admitted:
+        print(f"FAIL: pressure admission did not evict prefix pages: {res9}")
+        return 1
+    eng9.free(res9.slot)
+    snap = telemetry.snapshot()
+    missing = [
+        m
+        for m in (
+            telemetry.REQUIRED_PREFIX_METRICS
+            + telemetry.REQUIRED_SCHED_METRICS
+        )
+        if not has_series(snap, m)
+    ]
+    if missing:
+        print(
+            "FAIL: documented shared-prefix/scheduler metrics missing "
+            f"after a multi-tenant trace (catalog drift): {missing}"
+        )
+        return 1
+
     telemetry.set_enabled(None)
     print(
         f"telemetry-check OK: {len(telemetry.REQUIRED_PLAN_METRICS)} plan "
-        f"metrics + {len(telemetry.REQUIRED_TIMELINE_METRICS)} timeline "
-        f"metrics + {len(telemetry.REQUIRED_SERVING_METRICS)} serving "
+        f"+ {len(telemetry.REQUIRED_PLAN_CACHE_METRICS)} plan-LRU "
+        f"+ {len(telemetry.REQUIRED_TIMELINE_METRICS)} timeline "
+        f"+ {len(telemetry.REQUIRED_SERVING_METRICS)} serving "
+        f"+ {len(telemetry.REQUIRED_PREFIX_METRICS)} prefix "
+        f"+ {len(telemetry.REQUIRED_SCHED_METRICS)} scheduler "
         f"metrics + {len(telemetry.REQUIRED_VALIDATE_METRICS)} validate "
         f"counters + {len(telemetry.REQUIRED_RESILIENCE_METRICS)} "
         "resilience metrics present, cross-rank merge semantics hold, "

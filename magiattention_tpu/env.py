@@ -261,6 +261,52 @@ def page_size() -> int:
     return _env_int("MAGI_ATTENTION_PAGE_SIZE", 128)
 
 
+def prefill_chunk() -> int | None:
+    """Chunked-prefill chunk size in tokens (``serving/engine.py``,
+    ``serving/scheduler.py``): prompts longer than this are prefilled in
+    chunk-sized steps, each attending to the already-written cache via
+    the cross path, so a long prompt never stalls the decode batch — the
+    scheduler interleaves one chunk per step. Unset/0/'off' (default) =
+    single-shot prefill. Serving-host behavior only (it never changes a
+    plan or a distributed runtime key), so NOT part of
+    :func:`flags_fingerprint`."""
+    v = _env_str("MAGI_ATTENTION_PREFILL_CHUNK", "0").strip().lower()
+    if v in ("", "0", "off", "none"):
+        return None
+    iv = int(v)
+    if iv < 1:
+        raise ValueError(
+            f"MAGI_ATTENTION_PREFILL_CHUNK={v!r} must be a positive token "
+            "count (or 0/off to disable chunking)"
+        )
+    return iv
+
+
+CASCADE_MODES = ("auto", "on", "off")
+
+
+def cascade_mode() -> str:
+    """Cascade (two-level shared-prefix) decode attention mode
+    (``serving/prefix.py``), validated here:
+
+    - ``auto`` (default): cascade whenever >= 2 decode-batch members
+      share a resident full-page prefix; flat split-KV otherwise.
+    - ``on``: cascade for every prefix-carrying sequence, singleton
+      groups included (the parity-test mode).
+    - ``off``: always the flat split-KV path (prefix pages are still
+      shared for memory — only the decode compute shape changes).
+
+    Bit-parity between the paths (within dtype tolerance) is asserted by
+    ``make sched-check``, so the mode is a performance choice, not a
+    semantic one — and therefore NOT part of :func:`flags_fingerprint`."""
+    v = _env_str("MAGI_ATTENTION_CASCADE", "auto").strip().lower()
+    if v not in CASCADE_MODES:
+        raise ValueError(
+            f"MAGI_ATTENTION_CASCADE={v!r} must be one of {CASCADE_MODES}"
+        )
+    return v
+
+
 def decode_splits() -> int | None:
     """Split-KV decode split count (``serving/decode_attn.py``): an
     integer pins the number of KV splits per sequence; 'auto' (default)

@@ -66,6 +66,33 @@ def correct_attn_out_lse(
     return correct_attn_out(out1, lse1, out2, lse2, lse), lse
 
 
+def merge_partials(
+    outs: list[jax.Array],  # each [..., h, d] (fp32 recommended)
+    lses: list[jax.Array],  # each [..., h]
+) -> tuple[jax.Array, jax.Array]:
+    """Associative binary-tree merge of N partial ``(out, lse)`` pairs.
+
+    THE reduction every multi-partial consumer shares (ISSUE 9 moved it
+    here from ``serving/decode_attn.py`` so split-KV decode, CP decode
+    and cascade prefix/suffix merging are one function): log-depth, and
+    order-independent up to fp rounding because
+    :func:`correct_attn_out_lse` is associative and commutative."""
+    assert len(outs) == len(lses) and outs
+    while len(outs) > 1:
+        next_o, next_l = [], []
+        for i in range(0, len(outs) - 1, 2):
+            o, l = correct_attn_out_lse(
+                outs[i], lses[i], outs[i + 1], lses[i + 1]
+            )
+            next_o.append(o)
+            next_l.append(l)
+        if len(outs) % 2:
+            next_o.append(outs[-1])
+            next_l.append(lses[-1])
+        outs, lses = next_o, next_l
+    return outs[0], lses[0]
+
+
 def correct_attn_lse(lse1: jax.Array, lse2: jax.Array) -> jax.Array:
     """Merged lse of two partials (reference correct_attn_lse :286 —
     the reference's explicit spelling of :func:`safe_lse_merge`)."""

@@ -17,8 +17,13 @@ Layout:
 - :mod:`.cp_decode`   — cross-rank LSE-weighted tree merge for
   CP-sharded KV histories (cp=1 degenerates to pure local)
 - :mod:`.engine`      — :class:`DecodeBatch`, ``magi_attn_decode``,
-  ``prefill_into_cache``, minimal continuous-batching
-  :class:`ServingEngine`
+  ``prefill_into_cache`` / ``continue_prefill_into_cache``, the
+  continuous-batching :class:`ServingEngine`
+- :mod:`.prefix`      — shared-prefix trie (:class:`PrefixCache`),
+  copy-on-write page sharing, two-level cascade decode
+  (:func:`cascade_decode_attn`) — ISSUE 9
+- :mod:`.scheduler`   — chunked-prefill token-budget
+  :class:`Scheduler` with per-request SLO telemetry — ISSUE 9
 
 See ``docs/serving.md`` for the architecture walkthrough.
 """
@@ -26,6 +31,7 @@ See ``docs/serving.md`` for the architecture walkthrough.
 from .cp_decode import cp_decode_attn, cp_merge_partials  # noqa: F401
 from .decode_attn import (  # noqa: F401
     decode_attn_paged,
+    decode_partials_for_tables,
     merge_split_partials,
     resolve_num_splits,
 )
@@ -33,37 +39,67 @@ from .engine import (  # noqa: F401
     AdmissionResult,
     DecodeBatch,
     ServingEngine,
+    continue_prefill_into_cache,
     magi_attn_decode,
     prefill_into_cache,
 )
 from .kv_cache import (  # noqa: F401
+    InvalidFreeError,
     PageAllocator,
+    PageAllocatorError,
     PagedKVCache,
+    PageShareError,
     append_kv,
     assign_block_table,
+    copy_page,
     gather_kv,
     make_paged_kv_cache,
     reset_slot,
+    swap_block_table_page,
     write_prefill_kv,
 )
+from .prefix import (  # noqa: F401
+    CascadeGroup,
+    PrefixCache,
+    PrefixMatch,
+    cascade_decode_attn,
+    plan_cascade_groups,
+)
+from .scheduler import Request, RequestState, Scheduler, StepReport  # noqa: F401
 
 __all__ = [
     "AdmissionResult",
+    "CascadeGroup",
     "DecodeBatch",
+    "InvalidFreeError",
     "PageAllocator",
+    "PageAllocatorError",
     "PagedKVCache",
+    "PageShareError",
+    "PrefixCache",
+    "PrefixMatch",
+    "Request",
+    "RequestState",
+    "Scheduler",
     "ServingEngine",
+    "StepReport",
     "append_kv",
     "assign_block_table",
+    "cascade_decode_attn",
+    "continue_prefill_into_cache",
+    "copy_page",
     "cp_decode_attn",
     "cp_merge_partials",
     "decode_attn_paged",
+    "decode_partials_for_tables",
     "gather_kv",
     "magi_attn_decode",
     "make_paged_kv_cache",
     "merge_split_partials",
+    "plan_cascade_groups",
     "prefill_into_cache",
     "reset_slot",
     "resolve_num_splits",
+    "swap_block_table_page",
     "write_prefill_kv",
 ]

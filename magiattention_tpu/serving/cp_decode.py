@@ -30,8 +30,9 @@ import jax
 import jax.numpy as jnp
 
 from ..comm.primitives import all_gather_v
+from ..ops.correction import merge_partials
 from ..utils.instrument import named_scope
-from .decode_attn import decode_attn_paged, merge_split_partials
+from .decode_attn import decode_attn_paged
 from .kv_cache import PagedKVCache
 
 
@@ -60,9 +61,11 @@ def cp_merge_partials(
     outs = [flat_o[r * b : (r + 1) * b] for r in range(cp_size)]
     lses = [flat_l[r * b : (r + 1) * b] for r in range(cp_size)]
     with named_scope("magi_cp_decode_merge"):
-        # the SAME log-depth tree the split merge uses — one reduction,
-        # two layers (splits within a rank, ranks across the mesh)
-        return merge_split_partials(outs, lses)
+        # the SAME log-depth tree the split merge uses (the canonical
+        # ops/correction.merge_partials since ISSUE 9) — one reduction,
+        # three users: splits within a rank, ranks across the mesh,
+        # cascade prefix/suffix levels
+        return merge_partials(outs, lses)
 
 
 def cp_decode_attn(

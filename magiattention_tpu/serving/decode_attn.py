@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..ops.correction import correct_attn_out_lse
+from ..ops.correction import merge_partials
 from ..utils.compat import tpu_compiler_params
 from ..utils.instrument import named_scope
 from .kv_cache import PagedKVCache
@@ -69,27 +69,10 @@ def _default_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def merge_split_partials(
-    outs: list[jax.Array],  # each [..., hq, d] float32
-    lses: list[jax.Array],  # each [..., hq] float32
-) -> tuple[jax.Array, jax.Array]:
-    """Associative binary-tree merge of split partials via the trainer's
-    LSE-corrected reduction (log-depth; order-independent up to fp
-    rounding because the merge is associative and commutative)."""
-    assert len(outs) == len(lses) and outs
-    while len(outs) > 1:
-        next_o, next_l = [], []
-        for i in range(0, len(outs) - 1, 2):
-            o, l = correct_attn_out_lse(
-                outs[i], lses[i], outs[i + 1], lses[i + 1]
-            )
-            next_o.append(o)
-            next_l.append(l)
-        if len(outs) % 2:
-            next_o.append(outs[-1])
-            next_l.append(lses[-1])
-        outs, lses = next_o, next_l
-    return outs[0], lses[0]
+# the split merge IS the trainer's LSE-corrected tree reduction —
+# re-exported under the historical serving name (ISSUE 9 moved the
+# implementation to ops/correction so cascade/CP/split share one fn)
+merge_split_partials = merge_partials
 
 
 def _apply_split_resilience(outs, lses):
@@ -330,12 +313,22 @@ def resolve_num_splits(
     cache: PagedKVCache,
     batch: int,
     hq: int,
+    *,
+    mpp: int | None = None,
+    prefix_groups: int = 0,
 ) -> int:
     """Explicit arg > MAGI_ATTENTION_DECODE_SPLITS > autotuner (decode
-    fingerprint kind). The result always divides max_pages_per_seq."""
+    fingerprint kind). The result always divides the table width —
+    ``max_pages_per_seq`` by default, or an explicit ``mpp`` (cascade
+    resolves splits per phase: the shared-prefix table and the
+    unique-suffix table have their own widths). ``prefix_groups``
+    threads the cascade grouping into the decode fingerprint (0 = plain
+    decode) so cascade and flat workloads never share a tuned winner."""
     from .. import env
 
-    mpp = cache.max_pages_per_seq
+    if mpp is None:
+        mpp = cache.max_pages_per_seq
+    mpp = max(int(mpp), 1)
     if num_splits is None:
         num_splits = env.decode_splits()
     if num_splits is None:
@@ -349,6 +342,7 @@ def resolve_num_splits(
             cache.num_kv_heads,
             head_dim=cache.head_dim,
             dtype=str(cache.k_pages.dtype),
+            prefix_groups=prefix_groups,
         )
         # the record's head_block IS the split count (ratio-free, so a
         # bucket-aliased cache hit from a nearby mpp cannot collapse the
@@ -359,6 +353,66 @@ def resolve_num_splits(
     while mpp % num_splits:  # largest divisor of mpp not above the ask
         num_splits -= 1
     return num_splits
+
+
+def decode_partials_for_tables(
+    q: jax.Array,  # [b, hq, head_dim]
+    cache: PagedKVCache,
+    bt: jax.Array,  # [b, W] page-id rows (any width W >= 1)
+    seq_lens: jax.Array,  # [b] covered tokens WITHIN these tables
+    *,
+    num_splits: int = 1,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Split-KV partial attention over EXPLICIT page tables — the
+    building block cascade attention composes (ISSUE 9).
+
+    Unlike :func:`decode_attn_paged` (which reads a slot's own
+    block-table row and full length), the caller supplies the table rows
+    and the covered length: cascade runs this twice per group — once on
+    the shared prefix row (broadcast across the group) and once on the
+    per-sequence suffix rows — and merges the two partials with the same
+    ``ops/correction`` algebra the split merge already used. ``seq_lens``
+    counts tokens from the START of these tables (positions are
+    table-relative; softmax is position-free so partials over disjoint
+    KV subsets merge exactly).
+
+    Returns fp32 ``(out [b, hq, d], lse [b, hq])`` in the uncovered
+    convention (rows with ``seq_lens == 0`` are ``(0, -inf)``).
+    """
+    b, hq, d = q.shape
+    assert d == cache.head_dim and hq % cache.num_kv_heads == 0
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _default_interpret()
+    width = bt.shape[1]
+    num_splits = max(1, min(int(num_splits), width))
+    while width % num_splits:
+        num_splits -= 1
+    params = DecodeParams(
+        scale=float(scale),
+        softcap=float(softcap),
+        num_splits=int(num_splits),
+        out_dtype="float32",
+        interpret=bool(interpret),
+    )
+    seq_lens = jnp.asarray(seq_lens, jnp.int32)
+    from .. import env
+
+    if env.kernel_backend() in ("jnp", "jnp_online"):
+        out, lse, code = _decode_jnp(q, cache, bt, seq_lens, params)
+    else:
+        out, lse, code = _decode_pallas(q, cache, bt, seq_lens, params)
+    if code is not None:
+        from ..resilience import guards
+
+        guards.consume_error_code(
+            code, tuple(f"split{i}" for i in range(params.num_splits))
+        )
+    return out.astype(jnp.float32), lse
 
 
 def decode_attn_paged(

@@ -26,6 +26,7 @@ annotates device traces with named scopes (``magi_prefill_attn`` /
 from __future__ import annotations
 
 import dataclasses
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
@@ -38,12 +39,17 @@ from .decode_attn import decode_attn_paged, resolve_num_splits
 from .kv_cache import (
     PagedKVCache,
     PageAllocator,
+    PageAllocatorError,
     append_kv,
     assign_block_table,
+    copy_page,
+    gather_kv,
     make_paged_kv_cache,
     reset_slot,
+    swap_block_table_page,
     write_prefill_kv,
 )
+from .prefix import PrefixCache, cascade_decode_attn, plan_cascade_groups
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,12 +68,17 @@ class AdmissionResult:
     - ``evicted``: slots freed by the bounded
       evict-lowest-priority-then-retry policy on the way to this verdict
       (possibly non-empty on BOTH verdicts).
+    - ``prefix_len`` (ISSUE 9): tokens of the prompt already resident as
+      a shared prefix (0 without prefix sharing / on a miss). The
+      caller prefills ONLY rows ``prefix_len:`` — the cache's
+      ``seq_lens`` already stands at ``prefix_len`` for this slot.
     """
 
     admitted: bool
     slot: int | None
     reason: str = "ok"
     evicted: tuple[int, ...] = ()
+    prefix_len: int = 0
 
     def __bool__(self) -> bool:
         return self.admitted
@@ -175,6 +186,65 @@ def prefill_into_cache(
     return out, lse, cache
 
 
+def continue_prefill_into_cache(
+    q: jax.Array,  # [t, hq, head_dim] this CHUNK's queries
+    k: jax.Array,  # [t, hk, head_dim]
+    v: jax.Array,
+    cache: PagedKVCache,
+    slot,
+    *,
+    start: int,  # host-side: tokens already written for this slot
+    scale: float | None = None,
+    softcap: float = 0.0,
+    out_dtype=None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, PagedKVCache]:
+    """One chunked-prefill step: the cross path (ISSUE 9).
+
+    Writes the chunk's KV at the slot's current position, then runs
+    causal flex attention of the chunk's queries against the WHOLE
+    written history gathered from the cache — bottom-right-aligned
+    CAUSAL over q ``(0, t)`` x k ``(0, start + t)`` allows key ``j`` for
+    chunk row ``i`` iff ``j <= start + i``, i.e. exactly what token
+    ``start + i`` of a single-shot prefill would see. This one function
+    serves both long-prompt chunking and shared-prefix continuation (a
+    forked sequence's suffix attending to the shared prefix KV it never
+    computed).
+
+    ``start`` is HOST state (the engine's committed length; must equal
+    ``seq_lens[slot]``): the gather width and mask ranges are static per
+    (start, t). Compile-reuse shape: each chunk of ONE prompt is its own
+    geometry (the history grows), which is inherent to the static-mask
+    flex kernel — the bottom-right-aligned CAUSAL bound needs the exact
+    ``start + t`` endpoint, so the width cannot be bucketed without
+    shifting the diagonal. Reuse happens ACROSS requests and steps: the
+    scheduler feeds fixed-size chunks at aligned starts, so a
+    steady-state multi-tenant cadence replays the same (start, t)
+    programs instead of compiling per request.
+    """
+    t = q.shape[0]
+    start = int(start)
+    with named_scope("magi_kvcache_prefill_write"):
+        cache = write_prefill_kv(cache, slot, k, v)
+    kc, vc = gather_kv(cache, slot, max_len=start + t)
+    from ..ops import flex_flash_attn_func
+
+    with named_scope("magi_prefill_attn"):
+        out, lse = flex_flash_attn_func(
+            q,
+            kc,
+            vc,
+            [(0, t)],
+            [(0, start + t)],
+            [int(AttnMaskType.CAUSAL)],
+            scale=scale,
+            softcap=softcap,
+            out_dtype=out_dtype,
+            interpret=interpret,
+        )
+    return out, lse, cache
+
+
 class ServingEngine:
     """Minimal continuous-batching host loop over one paged cache.
 
@@ -198,6 +268,7 @@ class ServingEngine:
         max_pages_per_seq: int | None = None,
         dtype=jnp.bfloat16,
         max_admission_evictions: int = 4,
+        prefix_sharing: bool = True,
     ):
         from .. import env
 
@@ -217,23 +288,48 @@ class ServingEngine:
         self.allocator = PageAllocator(
             num_pages, page_size, max_seqs, max_pages_per_seq
         )
+        # shared-prefix trie (ISSUE 9). Inert until an admission carries
+        # host token ids — tokenless admissions behave exactly as before
+        self.prefix: PrefixCache | None = (
+            PrefixCache(page_size) if prefix_sharing else None
+        )
         self._lengths: dict[int, int] = {}
         self._priorities: dict[int, int] = {}
+        self._tokens: dict[int, tuple[int, ...]] = {}
+        # slot -> (shared FULL prefix pages, their token count): the
+        # cascade grouping key (set on fork, or at commit_prefix)
+        self._slot_prefix: dict[int, tuple[tuple[int, ...], int]] = {}
         self.max_admission_evictions = int(max_admission_evictions)
         self._record_pool()
 
     # -- admission / retirement (host) --
 
-    def admit(self, num_tokens: int, *, priority: int = 0) -> AdmissionResult:
+    def admit(
+        self,
+        num_tokens: int,
+        *,
+        priority: int = 0,
+        tokens: "Sequence[int] | None" = None,
+    ) -> AdmissionResult:
         """Reserve a slot + pages for a sequence of ``num_tokens`` prompt
         tokens (plus later decode growth via :meth:`reserve_growth`).
 
+        ``tokens`` (ISSUE 9): the prompt's host-side token ids. With
+        prefix sharing enabled, the longest already-resident prefix is
+        installed by REFERENCE (``PageAllocator.fork`` — a refcount
+        bump, no copy, ``seq_lens`` pre-set to the match); only the
+        remaining tokens need pages and prefill. The match length comes
+        back as ``AdmissionResult.prefix_len``. Tokenless admissions
+        behave exactly as before.
+
         Returns a typed :class:`AdmissionResult` — NEVER raises on
-        resource pressure (ISSUE 8). When the pool/slots are exhausted,
-        a bounded evict-lowest-priority-then-retry policy frees up to
-        ``max_admission_evictions`` live sequences whose ``priority`` is
-        strictly below the incoming one; if that still doesn't fit, the
-        verdict is backpressure (``magi_admission_rejected{reason=}``).
+        resource pressure (ISSUE 8). Under pressure the policy is: drop
+        least-recently-used UNSHARED prefix-cache pages first (cached
+        KV is a disposable optimization, live sequences are not), then
+        the bounded evict-lowest-priority-then-retry pass over live
+        sequences whose ``priority`` is strictly below the incoming
+        one; if that still doesn't fit, the verdict is backpressure
+        (``magi_admission_rejected{reason=}``).
         """
         need = max(self.allocator.pages_needed(num_tokens), 1)
         if need > self.allocator.max_pages_per_seq:
@@ -241,9 +337,46 @@ class ServingEngine:
             res = AdmissionResult(False, None, "too_long")
             telemetry.record_admission(res)
             return res
+        tokens = tuple(int(t) for t in tokens) if tokens is not None else None
         evicted: list[int] = []
         while True:
-            if self.allocator.can_admit(num_tokens):
+            # re-match every round: a prefix eviction below may have
+            # released pages an earlier match pointed at
+            match = (
+                self.prefix.match(tokens)
+                if self.prefix is not None and tokens is not None
+                else None
+            )
+            if match is not None and match.hit:
+                if self.allocator.can_fork(match.pages, num_tokens):
+                    try:
+                        slot, pages = self.allocator.fork(
+                            match.pages, num_tokens
+                        )
+                    except PageAllocatorError:
+                        res = AdmissionResult(
+                            False, None, "alloc_error", tuple(evicted)
+                        )
+                        telemetry.record_admission(res)
+                        self._record_pool()
+                        return res
+                    try:
+                        self.cache = assign_block_table(
+                            self.cache, slot, pages, keep_len=match.length
+                        )
+                    except Exception:
+                        self.allocator.free(slot)
+                        self._record_pool()
+                        raise
+                    return self._finish_admit(
+                        slot, priority, tokens, evicted,
+                        prefix_len=match.length,
+                        shared_full=(
+                            match.pages[: match.full_pages],
+                            match.full_pages * self.allocator.page_size,
+                        ),
+                    )
+            elif self.allocator.can_admit(num_tokens):
                 try:
                     slot, pages = self.allocator.allocate(num_tokens)
                 except RuntimeError:
@@ -263,11 +396,26 @@ class ServingEngine:
                     self.allocator.free(slot)
                     self._record_pool()
                     raise
-                self._priorities[slot] = int(priority)
-                res = AdmissionResult(True, slot, "ok", tuple(evicted))
-                telemetry.record_admission(res)
-                self._record_pool()
-                return res
+                return self._finish_admit(slot, priority, tokens, evicted)
+            # pressure: cached-but-unreferenced prefix pages go first —
+            # but ONLY when pages are actually the bottleneck. A slot
+            # shortage (or a raced alloc failure) cannot be fixed by
+            # dropping cached KV, and flushing the trie then would
+            # destroy every future shared-prefix hit for nothing.
+            shared = len(match.pages) if match is not None else 0
+            free = self.allocator.num_pages - self.allocator.pages_in_use
+            deficit = need - shared - free
+            if (
+                deficit > 0
+                and self.prefix is not None
+                and self.allocator.active_seqs < self.allocator.max_seqs
+            ):
+                freed = self.prefix.evict(self.allocator, deficit)
+                if freed > 0:
+                    telemetry.record_prefix_eviction(
+                        freed, self.prefix.resident_pages
+                    )
+                    continue
             if len(evicted) >= self.max_admission_evictions:
                 break  # bounded: give up rather than churn the pool
             victim = self._eviction_candidate(int(priority))
@@ -281,6 +429,38 @@ class ServingEngine:
             else "pool_exhausted"
         )
         res = AdmissionResult(False, None, reason, tuple(evicted))
+        telemetry.record_admission(res)
+        self._record_pool()
+        return res
+
+    def _finish_admit(
+        self,
+        slot: int,
+        priority: int,
+        tokens: tuple[int, ...] | None,
+        evicted: list[int],
+        *,
+        prefix_len: int = 0,
+        shared_full: tuple[tuple[int, ...], int] | None = None,
+    ) -> AdmissionResult:
+        """Shared tail of both admission paths: bookkeeping + telemetry."""
+        self._priorities[slot] = int(priority)
+        if tokens is not None:
+            self._tokens[slot] = tokens
+            if self.prefix is not None:
+                # only admissions that actually consulted the trie count
+                # toward the hit/miss series — a disabled prefix cache
+                # must not report a phantom 0% hit rate
+                telemetry.record_prefix_lookup(
+                    hit=prefix_len > 0, matched_tokens=prefix_len
+                )
+        if prefix_len:
+            self._lengths[slot] = prefix_len
+        if shared_full is not None and shared_full[0]:
+            self._slot_prefix[slot] = (tuple(shared_full[0]), shared_full[1])
+        res = AdmissionResult(
+            True, slot, "ok", tuple(evicted), prefix_len=prefix_len
+        )
         telemetry.record_admission(res)
         self._record_pool()
         return res
@@ -306,7 +486,11 @@ class ServingEngine:
         self._record_pool()
 
     def free(self, slot: int) -> None:
-        """Retire a sequence: pages back to the pool, slot reusable.
+        """Retire a sequence: one page reference dropped per page (a
+        prefix page still held by the trie or by sibling forks stays
+        resident — the refcount decrement ISSUE 9 specifies), slot
+        reusable. A double free raises the allocator's typed
+        ``InvalidFreeError``.
 
         Exception-safe ordering: the device-side slot reset is computed
         BEFORE the allocator mutates — if it throws, the allocator still
@@ -317,6 +501,8 @@ class ServingEngine:
         self.cache = fresh
         self._lengths.pop(slot, None)
         self._priorities.pop(slot, None)
+        self._tokens.pop(slot, None)
+        self._slot_prefix.pop(slot, None)
         self._record_pool()
 
     # -- device steps --
@@ -333,39 +519,171 @@ class ServingEngine:
         ):
             self.reserve_growth(slot, total_tokens)
 
+    def _ensure_writable(self, slot: int, start: int) -> None:
+        """Copy-on-write split (ISSUE 9) before a write at position
+        ``start``: when the write lands MID-page (``start % page_size
+        != 0``) and that page is shared (refcount > 1 — a forked partial
+        tail, or the registrant's own tail after the trie pinned it),
+        give the slot a private copy first. Writes that start on a page
+        boundary land on a fresh page from the slot's own reservation
+        and never need a split; full shared prefix pages are therefore
+        never copied.
+
+        Atomicity: ``cow_page`` validates (and can refuse on pool
+        exhaustion) before any bookkeeping moves; the device-side copy
+        and table swap are infallible index ops on the committed ids."""
+        ps = self.allocator.page_size
+        if start <= 0 or start % ps == 0 or start >= self.cache.max_seq_len:
+            return
+        idx = start // ps
+        pages = self.allocator.slot_pages(slot)
+        if idx >= len(pages):
+            return  # page not reserved yet: growth installs a fresh one
+        if self.allocator.page_ref(pages[idx]) <= 1:
+            return  # private already
+        old, new = self.allocator.cow_page(slot, idx)
+        with named_scope("magi_kvcache_cow"):
+            self.cache = swap_block_table_page(
+                copy_page(self.cache, old, new), slot, idx, new
+            )
+        telemetry.record_prefix_cow()
+
     def prefill(self, q, k, v, slot: int, **kw):
-        """Prefill a prompt into ``slot``; returns the prefill out/lse.
+        """Prefill prompt rows into ``slot``; returns the prefill out/lse.
+
+        ISSUE 9 generalizes this to a *continuation-capable, chunked*
+        prefill:
+
+        - a slot with committed tokens (a shared-prefix fork, or a prior
+          chunk) takes the cross path: each chunk's KV is written, then
+          its queries attend the WHOLE gathered history causally
+          (:func:`continue_prefill_into_cache`) — output rows are
+          bit-comparable to the same rows of a single-shot prefill;
+        - prompts longer than ``MAGI_ATTENTION_PREFILL_CHUNK`` are split
+          into chunk-sized steps internally (unset = single shot), so a
+          long prompt never occupies the engine for one giant kernel —
+          the :class:`~magiattention_tpu.serving.scheduler.Scheduler`
+          instead feeds one chunk per scheduler step to interleave with
+          decode;
+        - if the admission carried token ids and this call completes the
+          prompt, the pages are auto-registered as shareable
+          (:meth:`commit_prefix`).
+
+        A ``length=`` padded prompt is only supported on the one-shot
+        path (chunk continuation needs the true rows).
 
         Exception-safe (ISSUE 8 satellite): a failure mid prefill —
         attention crash, cache-write crash, injected ``prefill_error``
         chaos — releases the half-admitted slot entirely (pages back to
         the pool, bookkeeping cleared) before re-raising, so the next
-        admission reuses those pages instead of leaking them. The cache
-        update itself only commits on success (``prefill_into_cache`` is
-        functional)."""
+        admission reuses those pages instead of leaking them."""
+        from .. import env
         from ..resilience import chaos
 
-        length = kw.get("length")
-        wrote = q.shape[0] if length is None else int(length)
-        # reservation growth stays OUTSIDE the fault cleanup: a refused
-        # extension (transient pool exhaustion) mutates nothing —
-        # allocator.extend is check-before-pop — and must leave the
-        # slot's committed KV intact, exactly like the identical error
-        # from decode_step's growth path (resource pressure is an
+        length = kw.pop("length", None)
+        t = q.shape[0]
+        wrote = t if length is None else int(length)
+        start = self._lengths.get(slot, 0)
+        if t == 0 and length is None:
+            # fully-cached prompt (the shared prefix covered every
+            # token): nothing to write or attend — just the hooks
+            toks = self._tokens.get(slot)
+            if toks is not None and start >= len(toks):
+                self.commit_prefix(slot)
+            return (
+                jnp.zeros((0, q.shape[1], q.shape[2]), q.dtype),
+                jnp.zeros((0, q.shape[1]), jnp.float32),
+            )
+        chunk = env.prefill_chunk()
+        # reservation growth and the CoW split stay OUTSIDE the fault
+        # cleanup: a refused extension/split (transient pool exhaustion)
+        # mutates nothing — both are check-before-pop — and must leave
+        # the slot's committed KV intact, exactly like the identical
+        # error from decode_step's growth path (resource pressure is an
         # operating condition, not a reason to destroy the sequence)
-        self._ensure_reserved(slot, self._lengths.get(slot, 0) + wrote)
+        self._ensure_reserved(slot, start + wrote)
+        self._ensure_writable(slot, start)
         try:
             chaos.maybe_fail("prefill_error")
-            out, lse, new_cache = prefill_into_cache(
-                q, k, v, self.cache, slot, **kw
-            )
+            if start == 0 and (chunk is None or t <= chunk):
+                out, lse, new_cache = prefill_into_cache(
+                    q, k, v, self.cache, slot, length=length, **kw
+                )
+                self.cache = new_cache
+            else:
+                assert length is None, (
+                    "chunked/continuation prefill requires unpadded "
+                    "prompts (length=None); pre-slice the valid rows"
+                )
+                out, lse = self._chunked_prefill(
+                    q, k, v, slot, start, chunk, **kw
+                )
         except Exception:
             self._release_after_fault(slot)
             raise
-        self.cache = new_cache
-        self._lengths[slot] = self._lengths.get(slot, 0) + wrote
+        self._lengths[slot] = start + wrote
         telemetry.record_prefill(wrote)
+        toks = self._tokens.get(slot)
+        if toks is not None and self._lengths[slot] >= len(toks):
+            self.commit_prefix(slot)
         return out, lse
+
+    def _chunked_prefill(self, q, k, v, slot, start, chunk, **kw):
+        """Drive ``continue_prefill_into_cache`` chunk by chunk; each
+        chunk's cache write commits before the next chunk attends (the
+        cross path reads it back). A fault mid-loop reaches
+        :meth:`prefill`'s cleanup, which tears the slot down whole."""
+        t = q.shape[0]
+        step = int(chunk) if chunk else t
+        outs, lses = [], []
+        pos = 0
+        while pos < t:
+            n = min(step, t - pos)
+            o, l, new_cache = continue_prefill_into_cache(
+                q[pos : pos + n],
+                k[pos : pos + n],
+                v[pos : pos + n],
+                self.cache,
+                slot,
+                start=start + pos,
+                **kw,
+            )
+            self.cache = new_cache
+            outs.append(o)
+            lses.append(l)
+            pos += n
+        if len(outs) == 1:
+            return outs[0], lses[0]
+        return jnp.concatenate(outs, axis=0), jnp.concatenate(lses, axis=0)
+
+    def commit_prefix(self, slot: int) -> int:
+        """Register the slot's prefilled pages as a shareable prefix
+        (host trie + one allocator reference per newly recorded page).
+        Auto-invoked by :meth:`prefill` when the admission's token ids
+        are fully written; call manually after driving the pure ops
+        yourself. Returns the number of pages newly pinned."""
+        if self.prefix is None:
+            return 0
+        toks = self._tokens.get(slot)
+        n = min(self._lengths.get(slot, 0), len(toks) if toks else 0)
+        if not toks or n == 0:
+            return 0
+        pages = self.allocator.slot_pages(slot)
+        newly = self.prefix.register(toks[:n], pages, self.allocator)
+        full = n // self.allocator.page_size
+        if full and slot not in self._slot_prefix:
+            # fresh registrant: its own leading full pages ARE the trie's
+            # resident copy — the cascade group key. (A forked slot keeps
+            # the key of the prefix it shares with its siblings.)
+            self._slot_prefix[slot] = (
+                tuple(pages[:full]),
+                full * self.allocator.page_size,
+            )
+        telemetry.record_prefix_registered(
+            newly, self.prefix.resident_pages
+        )
+        self._record_pool()
+        return newly
 
     def _release_after_fault(self, slot: int) -> None:
         """Tear a faulted slot all the way down (best-effort, never
@@ -381,33 +699,81 @@ class ServingEngine:
             )
         self._lengths.pop(slot, None)
         self._priorities.pop(slot, None)
+        self._tokens.pop(slot, None)
+        self._slot_prefix.pop(slot, None)
         self._record_pool()
 
-    def decode_step(self, q, k_new, v_new, slots, **kw):
+    def decode_step(self, q, k_new, v_new, slots, *, cascade=None, **kw):
         """One continuous-batching decode step: append each sequence's
         new KV, then attend over the whole history (the new token
         included — standard causal decode). Page reservations grow
-        automatically when a sequence crosses into an unreserved page."""
+        automatically when a sequence crosses into an unreserved page; a
+        sequence appending into a still-shared tail page gets its
+        copy-on-write split here, before the write.
+
+        ``cascade`` (ISSUE 9): ``None`` follows ``MAGI_ATTENTION_CASCADE``
+        (``auto`` = two-level cascade attention whenever >= 2 batch
+        members share a resident full-page prefix), ``True``/``'on'``
+        forces cascade for every prefix-carrying sequence (singleton
+        groups included — the parity-test mode), ``False``/``'off'``
+        forces the flat split-KV path. Parity between the two paths is
+        ``make sched-check``'s acceptance criterion."""
+        from .. import env
+
         batch = DecodeBatch.of(slots)
         slot_list = np.asarray(slots).tolist()
         for s in slot_list:
             self._ensure_reserved(s, self._lengths.get(s, 0) + 1)
-        # resolve the split count ONCE (fingerprint + cache lookup) and
-        # hand the concrete int down — decode is the per-token hot loop
-        kw["num_splits"] = resolve_num_splits(
-            kw.get("num_splits"), self.cache, batch.batch_size, q.shape[1]
-        )
+            self._ensure_writable(s, self._lengths.get(s, 0))
+        if cascade is None:
+            mode = env.cascade_mode()
+        elif isinstance(cascade, str):
+            mode = cascade
+        else:
+            mode = "on" if cascade else "off"
+        groups = []
+        if mode != "off" and self._slot_prefix:
+            groups = plan_cascade_groups(
+                self._slot_prefix,
+                slot_list,
+                min_group=1 if mode == "on" else 2,
+            )
         with named_scope("magi_kvcache_append"):
             self.cache = append_kv(self.cache, batch.slots, k_new, v_new)
         for s in slot_list:
             self._lengths[s] = self._lengths.get(s, 0) + 1
-        out, lse = magi_attn_decode(q, self.cache, batch, **kw)
+        if groups:
+            # per-phase split resolution happens inside the cascade
+            # (prefix tables and suffix tables have their own widths);
+            # the num_splits gauge reports 0 = "per phase"
+            out, lse = cascade_decode_attn(
+                q,
+                self.cache,
+                np.asarray(slot_list),
+                groups,
+                num_splits=kw.get("num_splits"),
+                scale=kw.get("scale"),
+                softcap=kw.get("softcap", 0.0),
+                out_dtype=kw.get("out_dtype"),
+                interpret=kw.get("interpret"),
+            )
+            resolved = 0
+        else:
+            # resolve the split count ONCE (fingerprint + cache lookup)
+            # and hand the concrete int down — decode is the per-token
+            # hot loop
+            kw["num_splits"] = resolved = resolve_num_splits(
+                kw.get("num_splits"), self.cache, batch.batch_size,
+                q.shape[1],
+            )
+            out, lse = magi_attn_decode(q, self.cache, batch, **kw)
         telemetry.record_decode_step(
             batch_size=batch.batch_size,
-            num_splits=kw["num_splits"],
+            num_splits=resolved,
             max_seq_len=max(
                 (self._lengths.get(s, 0) for s in slot_list), default=0
             ),
+            cascade_groups=len(groups),
         )
         return out, lse
 

@@ -39,6 +39,28 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class PageAllocatorError(RuntimeError):
+    """Typed base of every :class:`PageAllocator` failure (ISSUE 9): a
+    caller that wants to treat resource pressure as backpressure catches
+    THIS, not bare RuntimeError — and bookkeeping-corruption bugs get
+    their own subclasses so they can never be mistaken for pressure."""
+
+
+class InvalidFreeError(PageAllocatorError, KeyError):
+    """``free()`` (or a ref release) on a slot/page the allocator does
+    not currently own — a double-free or a never-allocated id. Raised
+    BEFORE any free-list mutation: the historical failure mode here is
+    silent free-list corruption (the same page handed to two sequences),
+    so misuse is loud and state-preserving. Subclasses ``KeyError`` for
+    callers of the pre-ISSUE-9 contract."""
+
+
+class PageShareError(PageAllocatorError):
+    """Refcount misuse on the copy-on-write sharing surface
+    (``retain``/``release_pages``/``cow_page``): the page named is not
+    resident, or a CoW split was requested on an unshared page."""
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass(frozen=True)
 class PagedKVCache:
@@ -225,26 +247,71 @@ def assign_block_table(
     slot: int,
     pages: Sequence[int],
     *,
-    keep_len: bool = False,
+    keep_len: bool | int = False,
 ) -> PagedKVCache:
     """Install a slot's page list (host-side admission; ``pages`` come
-    from :class:`PageAllocator`). Resets the slot's length to 0 unless
-    ``keep_len`` (a growth re-assignment extending a live sequence's
-    reservation keeps its stored tokens)."""
+    from :class:`PageAllocator`).
+
+    ``keep_len`` sets the slot's stored-token count:
+
+    - ``False`` (default): reset to 0 — a fresh admission.
+    - ``True``: keep the current value — a growth re-assignment
+      extending a live sequence's reservation.
+    - an ``int`` N: set to exactly N — the prefix-fork path installs a
+      shared prefix whose first N tokens are ALREADY materialized in the
+      shared pages (``keep_len=0`` is therefore identical to ``False``).
+      N past the installed pages' capacity is REJECTED: a fork claiming
+      tokens beyond its page list would decode block-table padding
+      (page 0 — possibly another live sequence's data) as its own KV.
+    """
     assert len(pages) <= cache.max_pages_per_seq, (
         f"{len(pages)} pages > max_pages_per_seq {cache.max_pages_per_seq}"
     )
     row = np.zeros((cache.max_pages_per_seq,), np.int32)
     row[: len(pages)] = np.asarray(pages, np.int32)
+    if keep_len is True:
+        seq_lens = cache.seq_lens
+    else:
+        n = 0 if keep_len is False else int(keep_len)
+        assert 0 <= n <= len(pages) * cache.page_size, (
+            f"keep_len={n} exceeds the {len(pages)}-page installed "
+            f"capacity ({len(pages) * cache.page_size} tokens)"
+        )
+        seq_lens = cache.seq_lens.at[slot].set(n)
     return PagedKVCache(
         k_pages=cache.k_pages,
         v_pages=cache.v_pages,
         block_tables=cache.block_tables.at[slot].set(jnp.asarray(row)),
-        seq_lens=(
-            cache.seq_lens
-            if keep_len
-            else cache.seq_lens.at[slot].set(0)
+        seq_lens=seq_lens,
+    )
+
+
+def copy_page(cache: PagedKVCache, src_page: int, dst_page: int) -> PagedKVCache:
+    """Device-side page copy (the data half of a copy-on-write split):
+    ``dst_page``'s K/V payload becomes a bit-copy of ``src_page``'s.
+    Functional like every cache update — pair with
+    :func:`swap_block_table_page` to point the writing slot at its
+    private copy."""
+    return PagedKVCache(
+        k_pages=cache.k_pages.at[dst_page].set(cache.k_pages[src_page]),
+        v_pages=cache.v_pages.at[dst_page].set(cache.v_pages[src_page]),
+        block_tables=cache.block_tables,
+        seq_lens=cache.seq_lens,
+    )
+
+
+def swap_block_table_page(
+    cache: PagedKVCache, slot: int, page_idx: int, new_page: int
+) -> PagedKVCache:
+    """Point one block-table entry of ``slot`` at ``new_page`` (the
+    table half of a copy-on-write split; lengths untouched)."""
+    return PagedKVCache(
+        k_pages=cache.k_pages,
+        v_pages=cache.v_pages,
+        block_tables=cache.block_tables.at[slot, page_idx].set(
+            jnp.int32(new_page)
         ),
+        seq_lens=cache.seq_lens,
     )
 
 
@@ -268,6 +335,15 @@ class PageAllocator:
     the device means the jitted decode step never depends on pool state.
     Occupancy numbers feed the ``magi_kvcache_*`` telemetry gauges
     (``telemetry.record_kvcache_state``).
+
+    ISSUE 9 adds **per-page refcounts**: a resident page may be
+    referenced by several sequences (a copy-on-write shared prefix) and
+    by the prefix cache itself, yet it occupies pool capacity exactly
+    once — ``pages_in_use`` counts residency, not references, which is
+    the memory win shared system prompts buy. ``fork`` admits a sequence
+    onto existing shared pages, ``cow_page`` splits one page the moment
+    a writer needs it private, and ``free``/``release_pages`` decrement
+    refs, recycling a page only when its last reference drops.
     """
 
     def __init__(
@@ -284,6 +360,9 @@ class PageAllocator:
         self._free_pages: list[int] = list(range(num_pages - 1, -1, -1))
         self._free_slots: list[int] = list(range(max_seqs - 1, -1, -1))
         self._slot_pages: dict[int, list[int]] = {}
+        # refcount per RESIDENT page (absent key = page is on the free
+        # list); every owner — sequence slot or prefix cache — holds one
+        self._page_refs: dict[int, int] = {}
 
     def pages_needed(self, num_tokens: int) -> int:
         return -(-max(int(num_tokens), 0) // self.page_size)
@@ -312,22 +391,145 @@ class PageAllocator:
         chaos.maybe_fail("alloc_fail")
         need = max(self.pages_needed(num_tokens), 1)
         if chaos.pool_exhausted() or need > len(self._free_pages):
-            raise RuntimeError(
+            raise PageAllocatorError(
                 f"PageAllocator: {need} pages requested, "
                 f"{0 if chaos.pool_exhausted() else len(self._free_pages)}"
                 " free"
             )
         if not self._free_slots:
-            raise RuntimeError("PageAllocator: no free sequence slot")
+            raise PageAllocatorError("PageAllocator: no free sequence slot")
         if need > self.max_pages_per_seq:
-            raise RuntimeError(
+            raise PageAllocatorError(
                 f"PageAllocator: {num_tokens} tokens need {need} pages > "
                 f"max_pages_per_seq {self.max_pages_per_seq}"
             )
         slot = self._free_slots.pop()
-        pages = [self._free_pages.pop() for _ in range(need)]
+        pages = [self._pop_free_page() for _ in range(need)]
         self._slot_pages[slot] = pages
         return slot, list(pages)
+
+    def _pop_free_page(self) -> int:
+        page = self._free_pages.pop()
+        self._page_refs[page] = 1
+        return page
+
+    def _decref(self, page: int) -> bool:
+        """Drop one reference; returns True when the page was recycled
+        to the free list (last reference gone)."""
+        refs = self._page_refs.get(page)
+        if refs is None:
+            raise InvalidFreeError(
+                f"PageAllocator: page {page} is not resident (double "
+                "release or never-allocated id)"
+            )
+        if refs > 1:
+            self._page_refs[page] = refs - 1
+            return False
+        del self._page_refs[page]
+        self._free_pages.append(page)
+        return True
+
+    def page_ref(self, page: int) -> int:
+        """Current reference count of a page (0 if free)."""
+        return self._page_refs.get(page, 0)
+
+    def retain(self, pages: Sequence[int]) -> None:
+        """Add one reference to each resident page (sharing: a prefix
+        fork, or the prefix cache pinning its resident copy). All-or-
+        nothing: validation runs before any count moves."""
+        for p in pages:
+            if p not in self._page_refs:
+                raise PageShareError(
+                    f"PageAllocator: cannot retain non-resident page {p}"
+                )
+        for p in pages:
+            self._page_refs[p] += 1
+
+    def release_pages(self, pages: Sequence[int]) -> int:
+        """Drop one reference per page (the prefix cache's eviction
+        path); returns how many pages actually went back to the free
+        list."""
+        return sum(1 for p in pages if self._decref(p))
+
+    def can_fork(self, shared_pages: Sequence[int], num_tokens: int) -> bool:
+        """Would :meth:`fork` succeed right now?"""
+        from ..resilience import chaos
+
+        if chaos.pool_exhausted():
+            return False
+        need = max(self.pages_needed(num_tokens), len(shared_pages), 1)
+        grow = need - len(shared_pages)
+        return (
+            bool(self._free_slots)
+            and need <= self.max_pages_per_seq
+            and grow <= len(self._free_pages)
+            and all(p in self._page_refs for p in shared_pages)
+        )
+
+    def fork(
+        self, shared_pages: Sequence[int], num_tokens: int
+    ) -> tuple[int, list[int]]:
+        """Admit a sequence whose first ``len(shared_pages)`` pages are
+        an already-resident shared prefix: the shared pages gain one
+        reference each (NO copy), and only the pages covering the
+        remaining tokens are newly popped. Returns (slot, full page
+        list). Atomic like :meth:`allocate` — every check runs before
+        any free-list or refcount mutation."""
+        from ..resilience import chaos
+
+        chaos.maybe_fail("alloc_fail")
+        shared = list(shared_pages)
+        need = max(self.pages_needed(num_tokens), len(shared), 1)
+        if need > self.max_pages_per_seq:
+            raise PageAllocatorError(
+                f"PageAllocator: {num_tokens} tokens need {need} pages > "
+                f"max_pages_per_seq {self.max_pages_per_seq}"
+            )
+        grow = need - len(shared)
+        if chaos.pool_exhausted() or grow > len(self._free_pages):
+            raise PageAllocatorError(
+                f"PageAllocator: fork needs {grow} fresh pages, "
+                f"{0 if chaos.pool_exhausted() else len(self._free_pages)}"
+                " free"
+            )
+        if not self._free_slots:
+            raise PageAllocatorError("PageAllocator: no free sequence slot")
+        for p in shared:
+            if p not in self._page_refs:
+                raise PageShareError(
+                    f"PageAllocator: shared prefix page {p} is not resident"
+                )
+        slot = self._free_slots.pop()
+        for p in shared:
+            self._page_refs[p] += 1
+        pages = shared + [self._pop_free_page() for _ in range(grow)]
+        self._slot_pages[slot] = pages
+        return slot, list(pages)
+
+    def cow_page(self, slot: int, page_idx: int) -> tuple[int, int]:
+        """Copy-on-write split: give ``slot`` a private replacement for
+        the SHARED page at ``page_idx`` of its page list. Returns
+        ``(old_page, new_page)`` — the caller copies the payload
+        (:func:`copy_page`) and swaps the block-table entry
+        (:func:`swap_block_table_page`). The old page keeps its other
+        references; a refused split (pool exhausted) mutates nothing."""
+        pages = self._slot_pages.get(slot)
+        if pages is None:
+            raise InvalidFreeError(f"PageAllocator: slot {slot} not allocated")
+        old = pages[page_idx]
+        if self._page_refs.get(old, 0) < 2:
+            raise PageShareError(
+                f"PageAllocator: page {old} is not shared (ref "
+                f"{self._page_refs.get(old, 0)}) — nothing to split"
+            )
+        if not self._free_pages:
+            raise PageAllocatorError(
+                "PageAllocator: page pool exhausted (CoW split)"
+            )
+        new = self._pop_free_page()
+        self._page_refs[old] -= 1
+        pages[page_idx] = new
+        return old, new
 
     def extend(self, slot: int, total_tokens: int) -> list[int]:
         """Grow a slot's reservation to cover ``total_tokens``; returns the
@@ -338,10 +540,10 @@ class PageAllocator:
 
         pages = self._slot_pages.get(slot)
         if pages is None:
-            raise KeyError(f"PageAllocator: slot {slot} not allocated")
+            raise InvalidFreeError(f"PageAllocator: slot {slot} not allocated")
         need = max(self.pages_needed(total_tokens), 1)
         if need > self.max_pages_per_seq:
-            raise RuntimeError(
+            raise PageAllocatorError(
                 f"PageAllocator: {total_tokens} tokens exceed "
                 f"max_pages_per_seq {self.max_pages_per_seq}"
             )
@@ -349,26 +551,49 @@ class PageAllocator:
         if grow > 0 and (
             chaos.pool_exhausted() or grow > len(self._free_pages)
         ):
-            raise RuntimeError("PageAllocator: page pool exhausted")
+            raise PageAllocatorError("PageAllocator: page pool exhausted")
         for _ in range(max(grow, 0)):
-            pages.append(self._free_pages.pop())
+            pages.append(self._pop_free_page())
         return list(pages)
 
     def free(self, slot: int) -> None:
-        """Return a slot's pages to the pool (block-table reuse tested)."""
-        pages = self._slot_pages.pop(slot, None)
+        """Retire a slot: one reference dropped per page (a page shared
+        with other sequences or the prefix cache stays resident), slot
+        id reusable.
+
+        A double-free — or a never-allocated slot — raises a typed
+        :class:`InvalidFreeError` BEFORE anything mutates (ISSUE 9
+        satellite): the pre-refcount failure mode was handing the same
+        page to two sequences via a corrupted free list."""
+        pages = self._slot_pages.get(slot)
         if pages is None:
-            raise KeyError(f"PageAllocator: slot {slot} not allocated")
-        self._free_pages.extend(reversed(pages))
+            raise InvalidFreeError(
+                f"PageAllocator: slot {slot} not allocated (double free?)"
+            )
+        del self._slot_pages[slot]
+        for p in reversed(pages):
+            self._decref(p)
         self._free_slots.append(slot)
 
     def reserved_pages(self, slot: int) -> int:
         """Pages currently installed for a slot (0 if unallocated)."""
         return len(self._slot_pages.get(slot, ()))
 
+    def slot_pages(self, slot: int) -> list[int]:
+        """The slot's current page list (a copy; host bookkeeping)."""
+        pages = self._slot_pages.get(slot)
+        if pages is None:
+            raise InvalidFreeError(f"PageAllocator: slot {slot} not allocated")
+        return list(pages)
+
     @property
     def pages_in_use(self) -> int:
         return self.num_pages - len(self._free_pages)
+
+    @property
+    def shared_pages(self) -> int:
+        """Resident pages with more than one reference (CoW-shared)."""
+        return sum(1 for r in self._page_refs.values() if r > 1)
 
     @property
     def active_seqs(self) -> int:
@@ -381,5 +606,6 @@ class PageAllocator:
             "pages_in_use": self.pages_in_use,
             "occupancy_ratio": self.pages_in_use / max(self.num_pages, 1),
             "active_seqs": self.active_seqs,
+            "shared_pages": self.shared_pages,
             "page_size": self.page_size,
         }

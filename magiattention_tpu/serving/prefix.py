@@ -1,0 +1,427 @@
+"""Shared-prefix serving: copy-on-write page sharing + cascade attention.
+
+The ISSUE 9 tentpole, after FlashInfer's cascade ("multi-level") design
+(arxiv 2501.01005): a fleet serving millions of users from one system
+prompt should hold ONE resident copy of that prompt's KV, and decode
+should read those hot pages once per group, not once per sequence.
+
+Two cooperating pieces:
+
+- :class:`PrefixCache` — a host-side trie over *page-granular token
+  hashes*. Every registered prompt contributes a chain of full-page
+  nodes (node key = sha256 of the parent key + the page's token ids, so
+  equal chains collide exactly and position-dependently) plus at most
+  one partial-page "tail" per node. Matching a new prompt walks the
+  chain; the matched pages are installed in the new sequence's block
+  table by :meth:`PageAllocator.fork` with a refcount bump — NO copy.
+  The trie itself holds one reference per registered page, so the
+  resident copy survives every fork retiring; under pool pressure
+  :meth:`PrefixCache.evict` releases least-recently-used unreferenced
+  branches (deepest-first, so the trie stays prefix-closed).
+
+- :func:`cascade_decode_attn` — two-level decode: per prefix group the
+  shared full-page prefix partial is computed ONCE as a batched split-KV
+  call over the group's rows of the SHARED block table, the per-sequence
+  unique-suffix partial over each sequence's private pages, and the two
+  merge with ``ops/correction.correct_attn_out_lse`` — the identical
+  (out, lse) algebra the split-KV tree and the CP merge already trust,
+  which is why the parity oracle is simply dense attention over the
+  concatenated KV.
+
+Copy-on-write: sharing is *read* sharing. The one place a shared page
+can be written is the partial tail page (a forked sequence's first
+write, or the registrant's own next decode append, lands mid-page). The
+engine calls ``PageAllocator.cow_page`` + ``kv_cache.copy_page`` right
+before such a write — one page copied, once, per diverging sequence;
+full prefix pages are never written and never copied.
+
+Everything here is host-side planning except :func:`cascade_decode_attn`
+(pure jax over the cache pytree). No jit-visible state: the trie and
+refcounts live beside the :class:`PageAllocator`, exactly like the free
+lists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.correction import correct_attn_out_lse
+from ..utils.instrument import named_scope
+from .decode_attn import decode_partials_for_tables, resolve_num_splits
+from .kv_cache import PagedKVCache, PageAllocator
+
+_ROOT = b"root"
+
+
+def _chain_hash(parent: bytes, page_tokens: Sequence[int]) -> bytes:
+    """Position-dependent content key of one full page of token ids:
+    equal keys <=> equal (prefix chain, page tokens)."""
+    h = hashlib.sha256(parent)
+    h.update(np.asarray(page_tokens, np.int64).tobytes())
+    return h.digest()
+
+
+@dataclasses.dataclass
+class _Tail:
+    """A registered partial last page: ``tokens`` is the page's actual
+    (sub-page) token content; ``page`` holds their KV."""
+
+    page: int
+    tokens: tuple[int, ...]
+
+
+@dataclasses.dataclass
+class _Node:
+    """One full-page trie node (the root has ``page = -1``)."""
+
+    page: int
+    parent: bytes | None
+    depth: int  # full pages from the root, this one included
+    children: set[bytes] = dataclasses.field(default_factory=set)
+    tail: _Tail | None = None
+    last_used: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixMatch:
+    """Result of :meth:`PrefixCache.match`.
+
+    - ``pages``: resident page ids covering the matched prefix, in
+      sequence order (possibly ending with a shared partial tail page).
+    - ``length``: matched token count (``len(full pages) * page_size``
+      plus the tail's token count when the tail matched).
+    - ``full_pages``: how many of ``pages`` are FULL prefix pages — the
+      cascade group boundary (the tail page, if any, belongs to the
+      per-sequence suffix level: it will be CoW-split on first write).
+    """
+
+    pages: tuple[int, ...]
+    length: int
+    full_pages: int
+
+    @property
+    def hit(self) -> bool:
+        return self.length > 0
+
+
+class PrefixCache:
+    """Host-side shared-prefix trie over one :class:`PageAllocator`.
+
+    The cache holds ONE allocator reference per registered page; forks
+    add their own references via ``PageAllocator.fork``. ``pages_in_use``
+    therefore counts every shared page exactly once — the asserted
+    memory win of ``make sched-check``.
+    """
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        self._nodes: dict[bytes, _Node] = {
+            _ROOT: _Node(page=-1, parent=None, depth=0)
+        }
+        self._clock = 0  # logical LRU clock (monotonic per touch)
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def resident_pages(self) -> int:
+        """Pages currently pinned by the trie (full nodes + tails)."""
+        n = sum(1 for k in self._nodes if k != _ROOT)
+        n += sum(1 for node in self._nodes.values() if node.tail is not None)
+        return n
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self._nodes) - 1
+
+    # -- match / register ------------------------------------------------
+
+    def match(self, tokens: Sequence[int]) -> PrefixMatch:
+        """Longest resident prefix of ``tokens``: full-page chain walk,
+        then at most one partial tail whose registered tokens are a
+        prefix of the remainder. Touches the walked nodes' LRU clock."""
+        toks = [int(t) for t in tokens]
+        ps = self.page_size
+        self._clock += 1
+        key, node = _ROOT, self._nodes[_ROOT]
+        pages: list[int] = []
+        length = 0
+        for i in range(len(toks) // ps):
+            nxt = _chain_hash(key, toks[i * ps : (i + 1) * ps])
+            child = self._nodes.get(nxt)
+            if child is None:
+                break
+            key, node = nxt, child
+            node.last_used = self._clock
+            pages.append(node.page)
+            length += ps
+        full = len(pages)
+        tail = node.tail
+        rem = toks[length:]
+        if (
+            tail is not None
+            and 0 < len(tail.tokens) <= len(rem)
+            and tuple(rem[: len(tail.tokens)]) == tail.tokens
+        ):
+            pages.append(tail.page)
+            length += len(tail.tokens)
+        return PrefixMatch(tuple(pages), length, full)
+
+    def register(
+        self,
+        tokens: Sequence[int],
+        slot_pages: Sequence[int],
+        allocator: PageAllocator,
+    ) -> int:
+        """Record a prefilled prompt's pages as shareable: new full-page
+        nodes for every page not already in the trie, plus one tail for
+        the partial last page (first registrant wins an occupied tail
+        slot). Each newly recorded page gains one allocator reference
+        (the trie's resident copy). Returns the number of pages newly
+        pinned."""
+        toks = [int(t) for t in tokens]
+        ps = self.page_size
+        self._clock += 1
+        key, node = _ROOT, self._nodes[_ROOT]
+        newly = 0
+        for i in range(len(toks) // ps):
+            nxt = _chain_hash(key, toks[i * ps : (i + 1) * ps])
+            child = self._nodes.get(nxt)
+            if child is None:
+                page = int(slot_pages[i])
+                allocator.retain([page])
+                child = _Node(page=page, parent=key, depth=node.depth + 1)
+                self._nodes[nxt] = child
+                node.children.add(nxt)
+                newly += 1
+            child.last_used = self._clock
+            key, node = nxt, child
+        rem = toks[(len(toks) // ps) * ps :]
+        if rem and node.tail is None:
+            page = int(slot_pages[len(toks) // ps])
+            allocator.retain([page])
+            node.tail = _Tail(page=page, tokens=tuple(rem))
+            newly += 1
+        return newly
+
+    # -- eviction --------------------------------------------------------
+
+    def evict(self, allocator: PageAllocator, want_pages: int = 1) -> int:
+        """Release least-recently-used UNSHARED trie pages until
+        ``want_pages`` pages went back to the free list (or nothing
+        evictable remains). Only pages whose sole reference is the
+        trie's (``page_ref == 1``) are candidates — a prefix still
+        backing live sequences stays resident — and branches drop
+        leaf-first so the trie remains prefix-closed. Returns pages
+        actually freed."""
+        freed = 0
+        while freed < want_pages:
+            victim_key: bytes | None = None
+            victim_tail: _Node | None = None
+            victim_used = None
+            # tails first: they are leaves by construction
+            for node in self._nodes.values():
+                t = node.tail
+                if t is not None and allocator.page_ref(t.page) == 1:
+                    if victim_used is None or node.last_used < victim_used:
+                        victim_used, victim_tail, victim_key = (
+                            node.last_used, node, None,
+                        )
+            for k, node in self._nodes.items():
+                if (
+                    k != _ROOT
+                    and not node.children
+                    and node.tail is None
+                    and allocator.page_ref(node.page) == 1
+                ):
+                    if victim_used is None or node.last_used < victim_used:
+                        victim_used, victim_tail, victim_key = (
+                            node.last_used, None, k,
+                        )
+            if victim_tail is not None:
+                freed += allocator.release_pages([victim_tail.tail.page])
+                victim_tail.tail = None
+            elif victim_key is not None:
+                node = self._nodes.pop(victim_key)
+                self._nodes[node.parent].children.discard(victim_key)
+                freed += allocator.release_pages([node.page])
+            else:
+                break  # nothing evictable
+        return freed
+
+    def drop_all(self, allocator: PageAllocator) -> int:
+        """Release EVERY trie reference (shutdown / tests). Shared pages
+        stay resident for their sequences; trie-only pages free."""
+        freed = 0
+        for k, node in list(self._nodes.items()):
+            if node.tail is not None:
+                freed += allocator.release_pages([node.tail.page])
+                node.tail = None
+            if k != _ROOT:
+                freed += allocator.release_pages([node.page])
+        self._nodes = {_ROOT: _Node(page=-1, parent=None, depth=0)}
+        return freed
+
+
+# ---------------------------------------------------------------------------
+# cascade attention
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CascadeGroup:
+    """One shared-prefix decode group.
+
+    - ``shared_pages``: the group's FULL prefix pages (every member's
+      block-table row starts with exactly these ids).
+    - ``prefix_len``: tokens they hold (= ``len(shared_pages) * ps``).
+    - ``members``: positions within the decode batch (NOT slot ids).
+    """
+
+    shared_pages: tuple[int, ...]
+    prefix_len: int
+    members: tuple[int, ...]
+
+
+def cascade_decode_attn(
+    q: jax.Array,  # [b, hq, head_dim] one query token per sequence
+    cache: PagedKVCache,
+    slots: np.ndarray,  # [b] host-side cache slots
+    groups: Sequence[CascadeGroup],
+    *,
+    num_splits: int | None = None,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    out_dtype=None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Two-level (cascade) decode over shared prefixes.
+
+    For each :class:`CascadeGroup` the shared-prefix partial runs ONCE,
+    batched over the group on the shared page row; each member's
+    unique-suffix partial runs on its own remaining pages; the two merge
+    through ``correct_attn_out_lse``. Batch positions not covered by any
+    group take the flat split-KV path. Bit-parity with dense attention
+    over the concatenated prefix+suffix KV is the acceptance criterion
+    (``make sched-check``, both backends).
+
+    ``num_splits`` (optional) pins the split count of every phase;
+    ``None`` resolves per phase through the decode autotuner with the
+    cascade ``prefix_groups`` fingerprint axis.
+    """
+    b, hq, d = q.shape
+    slots = np.asarray(slots)
+    assert slots.shape == (b,)
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    out_dtype = jnp.dtype(out_dtype) if out_dtype is not None else q.dtype
+    slots_dev = jnp.asarray(slots, jnp.int32)
+    bt_all = cache.block_tables[slots_dev]  # [b, MPP]
+    sl_all = cache.seq_lens[slots_dev]  # [b]
+    mpp = cache.max_pages_per_seq
+
+    grouped = [i for g in groups for i in g.members]
+    assert len(grouped) == len(set(grouped)), "overlapping cascade groups"
+    rest = [i for i in range(b) if i not in set(grouped)]
+
+    outs = [None] * b
+    lses = [None] * b
+
+    def _scatter(idx, o, l):
+        for j, i in enumerate(idx):
+            outs[i] = o[j]
+            lses[i] = l[j]
+
+    with named_scope("magi_cascade_decode"):
+        for g in groups:
+            idx = list(g.members)
+            n_shared = len(g.shared_pages)
+            assert n_shared > 0 and g.prefix_len == n_shared * cache.page_size
+            qg = q[jnp.asarray(idx, jnp.int32)]
+            # level 1: the shared prefix, once per group — every member
+            # reads the SAME page row, so the row is broadcast, fully
+            # covered (full pages by construction)
+            bt_shared = jnp.broadcast_to(
+                jnp.asarray(g.shared_pages, jnp.int32)[None, :],
+                (len(idx), n_shared),
+            )
+            sl_shared = jnp.full((len(idx),), g.prefix_len, jnp.int32)
+            s_prefix = resolve_num_splits(
+                num_splits, cache, len(idx), hq,
+                mpp=n_shared, prefix_groups=max(len(groups), 1),
+            )
+            with named_scope("magi_cascade_prefix"):
+                o_p, l_p = decode_partials_for_tables(
+                    qg, cache, bt_shared, sl_shared,
+                    num_splits=s_prefix, scale=scale, softcap=softcap,
+                    interpret=interpret,
+                )
+            # level 2: each member's private suffix pages (block-table
+            # positions past the shared prefix; table-relative lengths)
+            idx_dev = jnp.asarray(idx, jnp.int32)
+            suffix_w = mpp - n_shared
+            if suffix_w > 0:
+                bt_suffix = bt_all[idx_dev][:, n_shared:]
+                sl_suffix = jnp.maximum(
+                    sl_all[idx_dev] - g.prefix_len, 0
+                )
+                s_suffix = resolve_num_splits(
+                    num_splits, cache, len(idx), hq, mpp=suffix_w,
+                )
+                with named_scope("magi_cascade_suffix"):
+                    o_s, l_s = decode_partials_for_tables(
+                        qg, cache, bt_suffix, sl_suffix,
+                        num_splits=s_suffix, scale=scale, softcap=softcap,
+                        interpret=interpret,
+                    )
+                o_g, l_g = correct_attn_out_lse(o_p, l_p, o_s, l_s)
+            else:
+                o_g, l_g = o_p, l_p  # sequence IS its prefix (no growth room)
+            _scatter(idx, o_g, l_g)
+
+        if rest:
+            idx_dev = jnp.asarray(rest, jnp.int32)
+            s_flat = resolve_num_splits(num_splits, cache, len(rest), hq)
+            o_r, l_r = decode_partials_for_tables(
+                q[idx_dev], cache, bt_all[idx_dev], sl_all[idx_dev],
+                num_splits=s_flat, scale=scale, softcap=softcap,
+                interpret=interpret,
+            )
+            _scatter(rest, o_r, l_r)
+
+    out = jnp.stack(outs).astype(out_dtype)
+    lse = jnp.stack(lses)
+    return out, lse
+
+
+def plan_cascade_groups(
+    slot_prefixes: dict[int, tuple[tuple[int, ...], int]],
+    batch_slots: Sequence[int],
+    *,
+    min_group: int = 2,
+) -> list[CascadeGroup]:
+    """Group a decode batch by shared full-page prefix.
+
+    ``slot_prefixes`` maps slot -> (shared full pages, prefix token
+    count) — the engine's fork/registration bookkeeping. Batch members
+    whose shared page tuple is identical form one group; groups smaller
+    than ``min_group`` are dropped (a singleton cascade is just a flat
+    decode with an extra merge — ``min_group=1`` forces cascade anyway,
+    which the parity tests use)."""
+    by_key: dict[tuple[tuple[int, ...], int], list[int]] = {}
+    for pos, slot in enumerate(batch_slots):
+        entry = slot_prefixes.get(int(slot))
+        if entry is None or not entry[0]:
+            continue
+        by_key.setdefault((entry[0], entry[1]), []).append(pos)
+    return [
+        CascadeGroup(shared_pages=pages, prefix_len=plen, members=tuple(m))
+        for (pages, plen), m in sorted(by_key.items())
+        if len(m) >= min_group
+    ]

@@ -1,0 +1,384 @@
+"""Chunked-prefill continuous-batching scheduler (ISSUE 9 tentpole).
+
+The missing control layer over :class:`ServingEngine`: without it, a
+long prompt occupies the engine for one giant prefill while every
+decoding user stalls — exactly the head-of-line blocking FlashInfer's
+serving composition (arxiv 2501.01005) schedules away. The
+:class:`Scheduler` runs a step loop under a **token budget**:
+
+1. **Admit** queued requests (priority-desc, FIFO within a priority)
+   through the engine's typed admission — shared prefixes install by
+   reference, backpressure parks the queue head instead of raising.
+2. **Decode first**: if any sequence is decoding, ONE batched decode
+   step runs before any prefill work. This is the anti-starvation
+   invariant ``make sched-check`` asserts: while a long prefill drains
+   chunk by chunk, every step still produces a token for every decoding
+   sequence.
+3. **Prefill chunks** with the remaining budget: the highest-priority
+   prefilling request advances by up to ``MAGI_ATTENTION_PREFILL_CHUNK``
+   tokens per step (the engine's cross path attends each chunk to the
+   already-written cache), so prompt progress and decode progress
+   interleave at token granularity.
+
+Requests carry their attention inputs directly (this repo is the
+attention runtime, not a model): per-token prompt q/k/v, and one q/k/v
+row per decode step — a "model" is simulated by the caller. Completion
+is ``max_new_tokens`` decode steps.
+
+Per-request SLO telemetry lands on the existing metrics registry
+(``magi_request_queue_seconds`` / ``magi_request_ttft_seconds`` /
+``magi_request_token_latency_seconds`` histograms + the ``magi_sched_*``
+step counters/gauges) — the observability ROADMAP item 2 asks for.
+
+Host-side only: the scheduler never traces; the jitted work is the
+engine's pure ops underneath.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry
+from .engine import ServingEngine
+
+QUEUED = "queued"
+PREFILLING = "prefilling"
+DECODING = "decoding"
+FINISHED = "finished"
+REJECTED = "rejected"
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request, attention-level.
+
+    - ``prompt_q/k/v``: ``[P, h, d]`` per-token prompt projections.
+    - ``tokens``: optional host token ids (length P) — enables shared-
+      prefix matching/registration at admission.
+    - ``decode_q/k/v``: ``[G, h, d]`` the projections of each generated
+      step (the caller's stand-in for the model's next-token compute);
+      ``max_new_tokens`` defaults to G.
+    - ``priority``: admission priority (higher wins; the engine may
+      evict strictly-lower-priority residents under pressure).
+    """
+
+    rid: int
+    prompt_q: jax.Array
+    prompt_k: jax.Array
+    prompt_v: jax.Array
+    decode_q: jax.Array
+    decode_k: jax.Array
+    decode_v: jax.Array
+    tokens: Sequence[int] | None = None
+    max_new_tokens: int | None = None
+    priority: int = 0
+
+    @property
+    def prompt_len(self) -> int:
+        return self.prompt_q.shape[0]
+
+    @property
+    def num_new_tokens(self) -> int:
+        if self.max_new_tokens is not None:
+            return int(self.max_new_tokens)
+        return int(self.decode_q.shape[0])
+
+
+@dataclasses.dataclass
+class RequestState:
+    """Scheduler-side lifecycle record of one request."""
+
+    request: Request
+    status: str = QUEUED
+    slot: int | None = None
+    submitted_at: float = 0.0
+    admitted_at: float | None = None
+    first_token_at: float | None = None
+    last_token_at: float | None = None
+    prefill_pos: int = 0  # prompt tokens committed (incl. shared prefix)
+    prefix_len: int = 0  # tokens installed by reference at admission
+    tokens_done: int = 0
+    prefill_out_tail: jax.Array | None = None  # last prompt row's out
+    decode_outs: list = dataclasses.field(default_factory=list)
+
+    @property
+    def rid(self) -> int:
+        return self.request.rid
+
+
+@dataclasses.dataclass(frozen=True)
+class StepReport:
+    """What one :meth:`Scheduler.step` tick actually did (the
+    sched-check starvation assertions read these)."""
+
+    step: int
+    admitted: tuple[int, ...]
+    rejected: tuple[int, ...]
+    decode_ran: bool
+    decode_batch: int
+    prefill_chunks: tuple[tuple[int, int], ...]  # (rid, chunk tokens)
+    tokens_used: int
+    finished: tuple[int, ...]
+
+    @property
+    def idle(self) -> bool:
+        return (
+            not self.decode_ran
+            and not self.prefill_chunks
+            and not self.admitted
+        )
+
+
+class Scheduler:
+    """Token-budget continuous-batching loop over one engine.
+
+    ``token_budget``: attention tokens one step may process (decode
+    counts 1 per sequence, a prefill chunk its row count). ``chunk``
+    overrides ``MAGI_ATTENTION_PREFILL_CHUNK`` (None = env; env unset =
+    whole remaining prompt, bounded by the budget).
+    """
+
+    def __init__(
+        self,
+        engine: ServingEngine,
+        *,
+        token_budget: int = 256,
+        chunk: int | None = None,
+        max_decode_batch: int | None = None,
+        clock=time.perf_counter,
+    ):
+        from .. import env
+
+        self.engine = engine
+        self.token_budget = int(token_budget)
+        self.chunk = int(chunk) if chunk is not None else env.prefill_chunk()
+        self.max_decode_batch = max_decode_batch
+        self._clock = clock
+        self._queue: list[RequestState] = []
+        self._active: dict[int, RequestState] = {}  # rid -> state
+        self._finished: dict[int, RequestState] = {}
+        self._step = 0
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, request: Request) -> RequestState:
+        st = RequestState(request=request, submitted_at=self._clock())
+        self._queue.append(st)
+        return st
+
+    @property
+    def waiting(self) -> int:
+        return len(self._queue)
+
+    @property
+    def num_active(self) -> int:
+        return len(self._active)
+
+    @property
+    def done(self) -> bool:
+        return not self._queue and not self._active
+
+    def result(self, rid: int) -> RequestState:
+        return self._finished[rid]
+
+    # -- the step loop ---------------------------------------------------
+
+    def _admission_order(self) -> list[RequestState]:
+        # stable sort: priority desc, then submission order (FIFO)
+        return sorted(
+            self._queue, key=lambda s: (-s.request.priority, s.submitted_at)
+        )
+
+    def _admit_queued(self) -> tuple[list[int], list[int]]:
+        admitted, rejected = [], []
+        for st in self._admission_order():
+            req = st.request
+            res = self.engine.admit(
+                req.prompt_len,
+                priority=req.priority,
+                tokens=req.tokens,
+            )
+            if not res.admitted:
+                if res.reason == "too_long":
+                    # permanent: no eviction makes it fit — surface it
+                    st.status = REJECTED
+                    self._queue.remove(st)
+                    self._finished[st.rid] = st
+                    rejected.append(st.rid)
+                    continue
+                break  # transient backpressure: keep FIFO order, retry later
+            # an admission may have evicted lower-priority residents
+            for victim_slot in res.evicted:
+                self._handle_eviction(victim_slot)
+            st.slot = res.slot
+            st.prefix_len = res.prefix_len
+            st.prefill_pos = res.prefix_len
+            st.admitted_at = self._clock()
+            # zero-suffix prompts (fully-cached) still take one empty
+            # prefill tick, which runs the registration hook
+            st.status = PREFILLING
+            self._queue.remove(st)
+            self._active[st.rid] = st
+            admitted.append(st.rid)
+            telemetry.record_request_queue_time(
+                st.admitted_at - st.submitted_at
+            )
+        return admitted, rejected
+
+    def _handle_eviction(self, slot: int) -> None:
+        """A live sequence was priority-evicted by the engine: push its
+        request back to the queue for a clean retry (prefix pages it
+        shared are still resident, so the retry re-forks cheaply)."""
+        for rid, st in list(self._active.items()):
+            if st.slot == slot:
+                del self._active[rid]
+                st.slot = None
+                st.status = QUEUED
+                st.prefill_pos = 0
+                st.prefix_len = 0
+                st.tokens_done = 0
+                st.decode_outs.clear()
+                # the restarted generation gets a fresh SLO record: its
+                # TTFT must be measured again and a stale last_token_at
+                # would push one eviction+requeue+re-prefill-sized
+                # outlier into the inter-token latency histogram
+                st.first_token_at = None
+                st.last_token_at = None
+                self._queue.append(st)
+                return
+
+    def _decode_states(self) -> list[RequestState]:
+        return [
+            st for st in self._active.values() if st.status == DECODING
+        ]
+
+    def _run_decode(self, states: list[RequestState]) -> int:
+        if self.max_decode_batch is not None:
+            states = states[: self.max_decode_batch]
+        qs = jnp.stack([st.request.decode_q[st.tokens_done] for st in states])
+        ks = jnp.stack([st.request.decode_k[st.tokens_done] for st in states])
+        vs = jnp.stack([st.request.decode_v[st.tokens_done] for st in states])
+        slots = [st.slot for st in states]
+        out, _lse = self.engine.decode_step(qs, ks, vs, slots)
+        now = self._clock()
+        for j, st in enumerate(states):
+            st.decode_outs.append(out[j])
+            st.tokens_done += 1
+            if st.first_token_at is None:
+                st.first_token_at = now
+                telemetry.record_request_ttft(now - st.submitted_at)
+            else:
+                telemetry.record_request_token_latency(
+                    now - (st.last_token_at or now)
+                )
+            st.last_token_at = now
+            if st.tokens_done >= st.request.num_new_tokens:
+                self._finish(st)
+        return len(states)
+
+    def _finish(self, st: RequestState) -> None:
+        st.status = FINISHED
+        self.engine.free(st.slot)
+        del self._active[st.rid]
+        self._finished[st.rid] = st
+
+    def _prefill_states(self) -> list[RequestState]:
+        sts = [
+            st for st in self._active.values() if st.status == PREFILLING
+        ]
+        return sorted(
+            sts, key=lambda s: (-s.request.priority, s.submitted_at)
+        )
+
+    def _run_prefill_chunk(self, st: RequestState, budget: int) -> int:
+        req = st.request
+        remaining = req.prompt_len - st.prefill_pos
+        cap = self.chunk if self.chunk else remaining
+        n = max(min(cap, remaining, budget), 0)
+        if remaining > 0 and n == 0:
+            return 0  # budget exhausted
+        lo, hi = st.prefill_pos, st.prefill_pos + n
+        out, _lse = self.engine.prefill(
+            req.prompt_q[lo:hi],
+            req.prompt_k[lo:hi],
+            req.prompt_v[lo:hi],
+            st.slot,
+        )
+        st.prefill_pos = hi
+        if n and hi == req.prompt_len:
+            st.prefill_out_tail = out[-1]
+        if st.prefill_pos >= req.prompt_len:
+            st.status = DECODING
+            if req.num_new_tokens == 0:
+                self._finish(st)
+        return n
+
+    def step(self) -> StepReport:
+        """One scheduler tick: admissions, at most ONE decode step, then
+        prefill chunks with whatever budget remains."""
+        self._step += 1
+        budget = self.token_budget
+        admitted, rejected = self._admit_queued()
+        finished_before = set(self._finished)
+
+        decode_ran = False
+        decode_batch = 0
+        decoding = self._decode_states()
+        if decoding:
+            decode_batch = self._run_decode(decoding)
+            decode_ran = True
+            budget -= decode_batch
+
+        chunks: list[tuple[int, int]] = []
+        for st in self._prefill_states():
+            if budget <= 0:
+                break
+            n = self._run_prefill_chunk(st, budget)
+            if n == 0 and st.request.prompt_len - st.prefill_pos > 0:
+                break  # budget can't fit the next chunk's first token
+            budget -= n
+            chunks.append((st.rid, n))
+
+        report = StepReport(
+            step=self._step,
+            admitted=tuple(admitted),
+            rejected=tuple(rejected),
+            decode_ran=decode_ran,
+            decode_batch=decode_batch,
+            prefill_chunks=tuple(chunks),
+            tokens_used=self.token_budget - budget,
+            finished=tuple(set(self._finished) - finished_before),
+        )
+        telemetry.record_sched_step(
+            waiting=self.waiting,
+            active=self.num_active,
+            tokens_used=report.tokens_used,
+            prefill_chunks=len([c for c in chunks if c[1] > 0]),
+            decode_ran=decode_ran,
+        )
+        return report
+
+    def run(self, max_steps: int = 10_000) -> list[StepReport]:
+        """Step until every submitted request finished (or the safety
+        cap trips — an idle step with work still pending means a
+        deadlock and raises)."""
+        reports = []
+        while not self.done:
+            if len(reports) >= max_steps:
+                raise RuntimeError(
+                    f"Scheduler.run: {max_steps} steps without draining "
+                    f"({self.waiting} queued, {self.num_active} active)"
+                )
+            rep = self.step()
+            reports.append(rep)
+            if rep.idle and not self.done and self.num_active == 0:
+                raise RuntimeError(
+                    "Scheduler.run: queue blocked with no active work "
+                    "(pool too small for the queue head?)"
+                )
+        return reports

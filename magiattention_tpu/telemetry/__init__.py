@@ -38,8 +38,11 @@ from .aggregate import (  # noqa: F401
     merge_snapshots,
 )
 from .collectors import (  # noqa: F401
+    REQUIRED_PLAN_CACHE_METRICS,
     REQUIRED_PLAN_METRICS,
+    REQUIRED_PREFIX_METRICS,
     REQUIRED_RESILIENCE_METRICS,
+    REQUIRED_SCHED_METRICS,
     REQUIRED_SERVING_METRICS,
     REQUIRED_TIMELINE_METRICS,
     REQUIRED_VALIDATE_METRICS,
@@ -64,7 +67,15 @@ from .collectors import (  # noqa: F401
     record_overlap_choice,
     record_plan,
     record_prefill,
+    record_prefix_cow,
+    record_prefix_eviction,
+    record_prefix_lookup,
+    record_prefix_registered,
+    record_request_queue_time,
+    record_request_token_latency,
+    record_request_ttft,
     record_runtime_costs,
+    record_sched_step,
     record_tuning_cache_io_error,
     record_validate,
     telemetry_summary,

@@ -39,6 +39,13 @@ M_DISPATCH_BUILDS = "magi_dispatch_meta_builds_total"
 M_GRPCOLL_BUILDS = "magi_group_collective_builds_total"
 M_CACHE_HITS = "magi_runtime_cache_hits_total"
 M_CACHE_MISSES = "magi_runtime_cache_misses_total"
+# plan-LRU visibility (ISSUE 9 satellite, seeds ROADMAP item 5): the
+# canonical names for the keyed interface's plan-cache behavior — every
+# hit is a full host-side solve NOT paid. Same events as the legacy
+# magi_runtime_cache_* counters above (kept for dashboards); the pair
+# is REQUIRED_PLAN_CACHE_METRICS so renames/drops fail the drift guard
+M_PLAN_CACHE_HITS = "magi_plan_cache_hits"
+M_PLAN_CACHE_MISSES = "magi_plan_cache_misses"
 # plan-sanitizer counters (analysis/plan_sanity.py): only ticked while
 # MAGI_ATTENTION_VALIDATE != off AND telemetry is enabled. checks counts
 # every sanitizer invocation (pass or fail); failures counts raised
@@ -123,8 +130,13 @@ M_TL_PRED_ERROR = "magi_overlap_prediction_error_ratio"  # measured/pred
 M_DECODE_STEPS = "magi_decode_steps_total"
 M_DECODE_TOKENS = "magi_decode_tokens_total"  # one per sequence per step
 M_DECODE_BATCH = "magi_decode_batch_size"
+# resolved flat split count of the last decode step; 0 = the step ran
+# cascade attention, which resolves splits per phase (see the cascade
+# gauge below)
 M_DECODE_SPLITS = "magi_decode_num_splits"
 M_DECODE_MAX_SEQ_LEN = "magi_decode_max_seq_len"
+# shared-prefix groups the last decode step's cascade ran (0 = flat)
+M_DECODE_CASCADE_GROUPS = "magi_decode_cascade_groups"
 M_PREFILL_TOKENS = "magi_prefill_tokens_total"
 # kv-cache layer: page-pool occupancy (PageAllocator accounting)
 M_KVCACHE_PAGES_TOTAL = "magi_kvcache_pages_total"
@@ -132,6 +144,33 @@ M_KVCACHE_PAGES_USED = "magi_kvcache_pages_in_use"
 M_KVCACHE_OCCUPANCY = "magi_kvcache_occupancy_ratio"
 M_KVCACHE_ACTIVE_SEQS = "magi_kvcache_active_seqs"
 M_KVCACHE_PAGE_SIZE = "magi_kvcache_page_size"
+# resident pages referenced by more than one owner (CoW sharing)
+M_KVCACHE_SHARED = "magi_kvcache_shared_pages"
+
+# counters + gauges — shared-prefix cache (serving/prefix.py; ISSUE 9).
+# hits/misses count admissions that carried token ids; matched tokens is
+# the prefill compute the trie saved (one count per token NOT recomputed)
+M_PREFIX_HITS = "magi_prefix_cache_hits_total"
+M_PREFIX_MISSES = "magi_prefix_cache_misses_total"
+M_PREFIX_MATCHED_TOKENS = "magi_prefix_matched_tokens_total"
+M_PREFIX_RESIDENT = "magi_prefix_resident_pages"  # gauge: trie-pinned
+M_PREFIX_REGISTERED = "magi_prefix_registered_pages_total"  # newly pinned
+M_PREFIX_COW = "magi_prefix_cow_splits_total"  # pages privatized on write
+M_PREFIX_EVICTED = "magi_prefix_evicted_pages_total"  # LRU pressure drops
+
+# counters + gauges + histograms — chunked-prefill scheduler
+# (serving/scheduler.py; ISSUE 9): per-step interleave accounting and the
+# per-request SLO surface (queue wait, time-to-first-token, per-token
+# decode latency)
+M_SCHED_STEPS = "magi_sched_steps_total"
+M_SCHED_PREFILL_CHUNKS = "magi_sched_prefill_chunks_total"
+M_SCHED_DECODE_STEPS = "magi_sched_decode_steps_total"
+M_SCHED_WAITING = "magi_sched_waiting_requests"  # gauge: queued
+M_SCHED_ACTIVE = "magi_sched_active_requests"  # gauge: prefilling+decoding
+M_SCHED_STEP_TOKENS = "magi_sched_step_tokens"  # gauge: last step's usage
+H_REQ_QUEUE_S = "magi_request_queue_seconds"
+H_REQ_TTFT_S = "magi_request_ttft_seconds"
+H_REQ_TOKLAT_S = "magi_request_token_latency_seconds"
 
 # counters + gauges — resilience layer (resilience/; docs/resilience.md).
 # guard counters ({site=host|merged|stageN|splitN|correction|reduce_lse}):
@@ -182,6 +221,15 @@ REQUIRED_PLAN_METRICS: tuple[str, ...] = (
     H_PLAN_BUILD_S,
 )
 
+# populated by one cold + one warm resolution through the keyed
+# interface (``magi_attn_flex_key``); asserted by make telemetry-check's
+# plan-LRU step (ISSUE 9 satellite — the visibility ROADMAP item 5's
+# plan-reuse work will be measured with)
+REQUIRED_PLAN_CACHE_METRICS: tuple[str, ...] = (
+    M_PLAN_CACHE_HITS,
+    M_PLAN_CACHE_MISSES,
+)
+
 # populated by one profile_plan_timeline run (telemetry/timeline.py);
 # asserted by make telemetry-check's timeline step, documented in
 # docs/observability.md "Measured timelines & overlap audit"
@@ -204,12 +252,43 @@ REQUIRED_SERVING_METRICS: tuple[str, ...] = (
     M_DECODE_BATCH,
     M_DECODE_SPLITS,
     M_DECODE_MAX_SEQ_LEN,
+    M_DECODE_CASCADE_GROUPS,
     M_PREFILL_TOKENS,
     M_KVCACHE_PAGES_TOTAL,
     M_KVCACHE_PAGES_USED,
     M_KVCACHE_OCCUPANCY,
     M_KVCACHE_ACTIVE_SEQS,
     M_KVCACHE_PAGE_SIZE,
+    M_KVCACHE_SHARED,
+)
+
+# populated by one hit + one miss prefix admission, a commit, a CoW
+# split and an LRU eviction; asserted by make telemetry-check's
+# shared-prefix step and exercised end-to-end by make sched-check,
+# documented in docs/observability.md + docs/serving.md
+REQUIRED_PREFIX_METRICS: tuple[str, ...] = (
+    M_PREFIX_HITS,
+    M_PREFIX_MISSES,
+    M_PREFIX_MATCHED_TOKENS,
+    M_PREFIX_RESIDENT,
+    M_PREFIX_REGISTERED,
+    M_PREFIX_COW,
+    M_PREFIX_EVICTED,
+)
+
+# populated by a few Scheduler.step() ticks over a mixed prefill+decode
+# trace; asserted by make telemetry-check's scheduler step and
+# exercised end-to-end by make sched-check
+REQUIRED_SCHED_METRICS: tuple[str, ...] = (
+    M_SCHED_STEPS,
+    M_SCHED_PREFILL_CHUNKS,
+    M_SCHED_DECODE_STEPS,
+    M_SCHED_WAITING,
+    M_SCHED_ACTIVE,
+    M_SCHED_STEP_TOKENS,
+    H_REQ_QUEUE_S,
+    H_REQ_TTFT_S,
+    H_REQ_TOKLAT_S,
 )
 
 
@@ -487,10 +566,15 @@ def record_measured_timeline(tl) -> None:
 
 
 def record_cache_access(hit: bool) -> None:
-    """Keyed-runtime LRU behavior (``api/interface.py``)."""
+    """Keyed-runtime plan-LRU behavior (``api/interface.py``): one tick
+    per ``magi_attn_*_key`` resolution, under both the canonical
+    ``magi_plan_cache_*`` names (ISSUE 9, REQUIRED_PLAN_METRICS) and the
+    legacy ``magi_runtime_cache_*`` spelling."""
     if not _enabled():
         return
-    get_registry().counter_inc(M_CACHE_HITS if hit else M_CACHE_MISSES)
+    reg = get_registry()
+    reg.counter_inc(M_CACHE_HITS if hit else M_CACHE_MISSES)
+    reg.counter_inc(M_PLAN_CACHE_HITS if hit else M_PLAN_CACHE_MISSES)
 
 
 # ---------------------------------------------------------------------------
@@ -625,11 +709,18 @@ def record_tuning_cache_io_error(op: str) -> None:
 
 
 def record_decode_step(
-    *, batch_size: int, num_splits: int, max_seq_len: int
+    *,
+    batch_size: int,
+    num_splits: int,
+    max_seq_len: int,
+    cascade_groups: int = 0,
 ) -> None:
     """One continuous-batching decode step (``serving/engine.py``):
     counts steps/tokens and keeps the latest batch geometry — the
-    resolved split count is what the split-KV kernel actually ran."""
+    resolved split count is what the flat split-KV kernel ran
+    (``num_splits = 0`` means the step ran cascade attention, which
+    resolves splits per phase; ``cascade_groups`` is then the
+    shared-prefix group count)."""
     if not _enabled():
         return
     reg = get_registry()
@@ -638,6 +729,7 @@ def record_decode_step(
     reg.gauge_set(M_DECODE_BATCH, int(batch_size))
     reg.gauge_set(M_DECODE_SPLITS, int(num_splits))
     reg.gauge_set(M_DECODE_MAX_SEQ_LEN, int(max_seq_len))
+    reg.gauge_set(M_DECODE_CASCADE_GROUPS, int(cascade_groups))
 
 
 def record_prefill(num_tokens: int) -> None:
@@ -659,6 +751,98 @@ def record_kvcache_state(occupancy: dict) -> None:
     reg.gauge_set(M_KVCACHE_OCCUPANCY, float(occupancy["occupancy_ratio"]))
     reg.gauge_set(M_KVCACHE_ACTIVE_SEQS, int(occupancy["active_seqs"]))
     reg.gauge_set(M_KVCACHE_PAGE_SIZE, int(occupancy["page_size"]))
+    reg.gauge_set(M_KVCACHE_SHARED, int(occupancy.get("shared_pages", 0)))
+
+
+# ---------------------------------------------------------------------------
+# shared-prefix cache + scheduler (serving/prefix.py, serving/scheduler.py)
+# ---------------------------------------------------------------------------
+
+
+def record_prefix_lookup(*, hit: bool, matched_tokens: int = 0) -> None:
+    """One token-carrying admission consulted the prefix trie
+    (``ServingEngine.admit``); on a hit, ``matched_tokens`` prompt
+    tokens were installed by reference instead of prefilled."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_PREFIX_HITS if hit else M_PREFIX_MISSES)
+    if matched_tokens:
+        reg.counter_inc(M_PREFIX_MATCHED_TOKENS, int(matched_tokens))
+
+
+def record_prefix_registered(newly_pinned: int, resident_pages: int) -> None:
+    """One prompt registered as shareable (``ServingEngine.commit_prefix``):
+    counts the pages newly pinned by the trie and refreshes the resident
+    gauge (registered - evicted = resident, reconcilable offline)."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    if newly_pinned:
+        reg.counter_inc(M_PREFIX_REGISTERED, int(newly_pinned))
+    reg.gauge_set(M_PREFIX_RESIDENT, int(resident_pages))
+
+
+def record_prefix_cow() -> None:
+    """One copy-on-write page split: a sequence needed to write into a
+    still-shared tail page and got its private copy."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_PREFIX_COW)
+
+
+def record_prefix_eviction(pages_freed: int, resident_pages: int) -> None:
+    """Pool pressure dropped LRU unreferenced prefix pages
+    (``PrefixCache.evict`` via admission)."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_PREFIX_EVICTED, int(pages_freed))
+    reg.gauge_set(M_PREFIX_RESIDENT, int(resident_pages))
+
+
+def record_sched_step(
+    *,
+    waiting: int,
+    active: int,
+    tokens_used: int,
+    prefill_chunks: int,
+    decode_ran: bool,
+) -> None:
+    """One ``Scheduler.step`` tick: queue depths and what the token
+    budget actually bought (chunks started, decode step or not)."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_SCHED_STEPS)
+    if prefill_chunks:
+        reg.counter_inc(M_SCHED_PREFILL_CHUNKS, int(prefill_chunks))
+    if decode_ran:
+        reg.counter_inc(M_SCHED_DECODE_STEPS)
+    reg.gauge_set(M_SCHED_WAITING, int(waiting))
+    reg.gauge_set(M_SCHED_ACTIVE, int(active))
+    reg.gauge_set(M_SCHED_STEP_TOKENS, int(tokens_used))
+
+
+def record_request_queue_time(seconds: float) -> None:
+    """Submission -> admission wait of one request (SLO surface)."""
+    if not _enabled():
+        return
+    get_registry().histogram_observe(H_REQ_QUEUE_S, float(seconds))
+
+
+def record_request_ttft(seconds: float) -> None:
+    """Submission -> first decoded token of one request (SLO surface)."""
+    if not _enabled():
+        return
+    get_registry().histogram_observe(H_REQ_TTFT_S, float(seconds))
+
+
+def record_request_token_latency(seconds: float) -> None:
+    """Inter-token decode latency of one generated token (SLO surface)."""
+    if not _enabled():
+        return
+    get_registry().histogram_observe(H_REQ_TOKLAT_S, float(seconds))
 
 
 # ---------------------------------------------------------------------------

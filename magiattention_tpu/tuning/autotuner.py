@@ -296,6 +296,7 @@ def select_decode_splits(
     *,
     head_dim: int = 128,
     dtype: str = "bfloat16",
+    prefix_groups: int = 0,
 ) -> TuningDecision:
     """Resolve the split-KV decode split count (the ``decode``
     fingerprint kind; ISSUE 4).
@@ -321,6 +322,12 @@ def select_decode_splits(
     record computed at a nearby mpp whose ``block_k`` neither divides
     nor even fits the current geometry — the ratio-free split count
     survives the aliasing, and the caller clamps it to a divisor.
+
+    ``prefix_groups`` (ISSUE 9): the cascade prefix-group count of the
+    workload (0 = flat decode). It is a fingerprint axis only — the
+    shared-prefix phase runs the same kernel at the group's batch, but
+    its access pattern (one hot page set for the whole batch) must not
+    share a tuned winner with flat decode at the same geometry.
     """
     from .. import env, telemetry
     from ..utils.cost import TPU_PEAK_SPECS
@@ -328,7 +335,14 @@ def select_decode_splits(
 
     mpp = max(int(max_pages_per_seq), 1)
     fp = make_decode_fingerprint(
-        batch, mpp, page_size, hq, hk, head_dim=head_dim, dtype=dtype
+        batch,
+        mpp,
+        page_size,
+        hq,
+        hk,
+        head_dim=head_dim,
+        dtype=dtype,
+        prefix_groups=prefix_groups,
     )
     cache = get_tuning_cache()
     rec, layer = cache.get(fp)

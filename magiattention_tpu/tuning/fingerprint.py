@@ -149,8 +149,13 @@ class DecodeFingerprint:
     dtype: str
     page_size: int
     max_pages_bucket: int  # log2 bucket of max_pages_per_seq
+    # cascade prefix-group axis (ISSUE 9): 0 = flat decode; otherwise
+    # the log2 bucket of the shared-prefix group count — the cascade
+    # prefix phase reads ONE hot page set for the whole batch, a
+    # different bandwidth profile than flat decode at the same geometry
+    prefix_groups_bucket: int = 0
 
-    DECODE_FINGERPRINT_VERSION = 1
+    DECODE_FINGERPRINT_VERSION = 2
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -171,8 +176,11 @@ def make_decode_fingerprint(
     *,
     head_dim: int = 128,
     dtype: str = "bfloat16",
+    prefix_groups: int = 0,
 ) -> DecodeFingerprint:
-    """Derive the decode-kind fingerprint (host-side integers only)."""
+    """Derive the decode-kind fingerprint (host-side integers only).
+    ``prefix_groups > 0`` marks a cascade shared-prefix phase (v2 axis);
+    its bucket keeps cascade winners disjoint from flat-decode ones."""
     import jax
 
     from .. import env
@@ -189,6 +197,9 @@ def make_decode_fingerprint(
         dtype=str(dtype),
         page_size=int(page_size),
         max_pages_bucket=_log2_bucket(max_pages_per_seq),
+        prefix_groups_bucket=(
+            0 if prefix_groups <= 0 else 1 + _log2_bucket(prefix_groups)
+        ),
     )
 
 

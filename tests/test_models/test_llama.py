@@ -109,9 +109,9 @@ def _tree_close(a, b, rtol=2e-4, atol=2e-5):
     [
         # both oracle-exactness runs are slow-tier since the ISSUE 7
         # compat refactor resurrected this suite in CI (46s + 100s on
-        # this 1-core box vs the 870s tier-1 budget); default-tier
-        # dp/cp+oracle wiring is proven by test_magi_llama_pp_matches_
-        # oracle[axes0] below, which shares the layer stack
+        # this 1-core box vs the 870s tier-1 budget); since the ISSUE 9
+        # re-tier the whole oracle family is --run-slow (see the pp
+        # param note below for what stays default-tier)
         pytest.param({"dp": 2, "cp": 4}, None, marks=pytest.mark.slow),
         pytest.param(
             {"dp": 2, "cp": 2, "tp": 2}, "tp", marks=pytest.mark.slow
@@ -139,7 +139,16 @@ def test_magi_llama_matches_oracle(oracle, axes, tp_axis):
 @pytest.mark.parametrize(
     "axes,tp_axis",
     [
-        ({"pp": 2, "dp": 2, "cp": 2}, None),
+        # ISSUE 9 re-tier: the last default-tier `oracle` consumer moved
+        # to slow (23s call + the 47s oracle fixture it alone kept alive
+        # on this 1-core box, vs the 870s budget). Full-model llama
+        # oracle exactness is now --run-slow entirely; default-tier keeps
+        # the model-wiring smokes below plus the layer-level SPMD
+        # coverage in tests/test_parallel/ (pipeline fwd/bwd, overlap,
+        # kernel-backend parity), which is where a numerics regression
+        # would actually localize.
+        pytest.param({"pp": 2, "dp": 2, "cp": 2}, None,
+                     marks=pytest.mark.slow),
         # the tp variant is slow-tier (16s; budget note above)
         pytest.param(
             {"pp": 2, "dp": 1, "cp": 2, "tp": 2}, "tp",

@@ -30,10 +30,14 @@ TOTAL, CP, CHUNK, D = 512, 2, 64, 32
 
 # the pallas variants differentiate an interpret-mode staged kernel —
 # minutes of compile on CPU, redundant with the jnp-backend coverage of
-# the same guard math (the quarantine is backend-independent jnp code);
-# tier-1 keeps jnp live, --run-slow exercises the kernel backend too
+# the same guard math (the quarantine is backend-independent jnp code).
+# ISSUE 9 re-tier: the jnp vjp+jvp variant joined the slow tier too
+# (61s of grad compiles on this 1-core box vs the 870s budget) — its
+# exact surface (repair-mode vjp finiteness + grad parity on unaffected
+# rows through a quarantined stage) runs in every `make check` via
+# exps/run_resilience_check.py; --run-slow exercises both backends
 BACKENDS = [
-    "jnp",
+    pytest.param("jnp", marks=pytest.mark.slow),
     pytest.param("pallas", marks=pytest.mark.slow),
 ]
 

@@ -225,3 +225,38 @@ def test_decode_batch_is_a_pytree():
     assert len(leaves) == 1 and b.batch_size == 3
     b2 = jax.tree_util.tree_unflatten(treedef, leaves)
     np.testing.assert_array_equal(np.asarray(b2.slots), [2, 0, 1])
+
+
+def test_continuation_prefill_matches_single_shot():
+    """ISSUE 9: prefilling a prompt in TWO engine.prefill calls (the
+    second takes the cross path against the written cache) equals one
+    single-shot prefill, for the continuation rows and all later
+    decode steps."""
+    from magiattention_tpu.ops import flex_flash_attn_func
+
+    rng = np.random.default_rng(77)
+    t0, t1 = 21, 14  # split mid-page (page_size 16)
+    t = t0 + t1
+    q = _rand(rng, t, HQ, D)
+    k = _rand(rng, t, HK, D)
+    v = _rand(rng, t, HK, D)
+
+    eng = _engine()
+    slot = eng.admit(t).slot
+    eng.prefill(q[:t0], k[:t0], v[:t0], slot)
+    out2, _ = eng.prefill(q[t0:], k[t0:], v[t0:], slot)
+    assert int(eng.cache.seq_lens[slot]) == t
+
+    ref_out, _ = flex_flash_attn_func(
+        q, k, v, [(0, t)], [(0, t)], [1]
+    )
+    assert_close(out2, ref_out[t0:], atol=1e-5, rtol=1e-5,
+                 msg="continuation rows")
+    qd = _rand(rng, 1, HQ, D)
+    kd = _rand(rng, 1, HK, D)
+    out_d, _ = eng.decode_step(qd, kd, kd, [slot])
+    eng2 = _engine()
+    slot2 = eng2.admit(t).slot
+    eng2.prefill(q, k, v, slot2)
+    out_d2, _ = eng2.decode_step(qd, kd, kd, [slot2])
+    assert_close(out_d, out_d2, atol=1e-5, rtol=1e-5, msg="decode after")

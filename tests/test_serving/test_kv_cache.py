@@ -174,6 +174,74 @@ def test_jit_retrace_constant_across_growing_lengths():
     assert len(traces) == 1
 
 
+@pytest.mark.parametrize("ps", [8, 16])
+def test_assign_block_table_keep_len_int_semantics(ps):
+    """ISSUE 9 satellite: the prefix-fork path installs pages with
+    ``keep_len=<int>`` — the slot's length is set to exactly that many
+    already-materialized tokens. Exercised at page boundaries, mid-page,
+    and the keep_len=0 truncation corner."""
+    rng = np.random.default_rng(6)
+    cache = _mk(16, ps, mpp=4)
+    pages = [3, 6, 9]
+    k = jnp.asarray(rng.standard_normal((3 * ps, HK, D)), jnp.float32)
+    cache = assign_block_table(cache, 0, pages)
+    cache = write_prefill_kv(cache, 0, k, k)
+    assert int(cache.seq_lens[0]) == 3 * ps
+
+    # exact page boundary: a fork claiming exactly 2 full pages
+    c2 = assign_block_table(cache, 1, pages, keep_len=2 * ps)
+    assert int(c2.seq_lens[1]) == 2 * ps
+    gk, _ = gather_kv(c2, 1)
+    np.testing.assert_array_equal(np.asarray(gk[: 2 * ps]),
+                                  np.asarray(k[: 2 * ps]))
+    assert not np.any(np.asarray(gk[2 * ps:]))  # boundary truncates exactly
+
+    # mid-page: a shared partial tail
+    c3 = assign_block_table(cache, 1, pages, keep_len=2 * ps + 3)
+    assert int(c3.seq_lens[1]) == 2 * ps + 3
+    gk3, _ = gather_kv(c3, 1)
+    np.testing.assert_array_equal(np.asarray(gk3[: 2 * ps + 3]),
+                                  np.asarray(k[: 2 * ps + 3]))
+
+    # keep_len=0 == keep_len=False: full truncation, nothing readable
+    c4 = assign_block_table(cache, 0, pages, keep_len=0)
+    assert int(c4.seq_lens[0]) == 0
+    assert not np.any(np.asarray(gather_kv(c4, 0)[0]))
+    c5 = assign_block_table(cache, 0, pages, keep_len=False)
+    assert int(c5.seq_lens[0]) == 0
+
+    # keep_len=True still preserves the live value
+    c6 = assign_block_table(cache, 0, pages, keep_len=True)
+    assert int(c6.seq_lens[0]) == 3 * ps
+
+    # claiming past the installed pages' capacity is rejected
+    with pytest.raises(AssertionError):
+        assign_block_table(cache, 0, pages[:1], keep_len=ps + 1)
+
+
+def test_allocator_double_free_regression():
+    """ISSUE 9 satellite: free() on an already-freed or never-allocated
+    slot raises the typed InvalidFreeError and leaves the free lists
+    untouched (no page is ever handed out twice afterwards)."""
+    from magiattention_tpu.serving import InvalidFreeError
+
+    alloc = PageAllocator(num_pages=6, page_size=8, max_seqs=3,
+                          max_pages_per_seq=4)
+    s0, p0 = alloc.allocate(16)
+    s1, p1 = alloc.allocate(16)
+    alloc.free(s0)
+    with pytest.raises(InvalidFreeError):
+        alloc.free(s0)
+    with pytest.raises(InvalidFreeError):
+        alloc.free(123)
+    # the pool still hands out each page exactly once
+    s2, p2 = alloc.allocate(32)
+    assert not (set(p2) & set(p1))
+    seen = p1 + p2
+    assert len(seen) == len(set(seen))
+    assert alloc.pages_in_use == len(seen)
+
+
 def test_full_slot_append_is_dropped_not_wrapped():
     """Appending past max_seq_len must not corrupt page 0."""
     ps = 8

@@ -1,0 +1,398 @@
+"""Shared-prefix serving (ISSUE 9): refcounted allocator sharing, the
+token-hash trie, copy-on-write splits, and cascade decode parity.
+
+The contracts:
+
+1. refcounts — a shared page occupies the pool ONCE; forks bump refs,
+   frees decrement, the last reference recycles; misuse (double free,
+   retaining a free page, CoW on an unshared page) raises typed errors
+   before any state mutates.
+2. trie — match returns the longest registered full-page chain (plus a
+   matching partial tail), registration pins pages, LRU eviction only
+   drops pages nobody else references and keeps the trie prefix-closed.
+3. CoW — a write landing mid-page on a shared page privatizes exactly
+   that page; sibling sequences and the trie keep reading the original.
+4. cascade — two-level decode (shared-prefix partial once per group +
+   per-sequence suffix partial, LSE-merged) is bit-comparable to the
+   flat split-KV path and to a dense oracle.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.serving import (
+    InvalidFreeError,
+    PageAllocator,
+    PageShareError,
+    PrefixCache,
+    ServingEngine,
+    plan_cascade_groups,
+)
+from magiattention_tpu.testing import assert_close
+
+D, HK, HQ, PS = 16, 2, 4, 8
+VOCAB = 50
+
+
+@pytest.fixture(autouse=True)
+def _jnp_backend(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+
+
+_rng0 = np.random.default_rng(7)
+EMB_K = _rng0.standard_normal((VOCAB, HK, D)).astype(np.float32)
+EMB_V = _rng0.standard_normal((VOCAB, HK, D)).astype(np.float32)
+
+
+def kv_of(tokens):
+    idx = np.asarray(tokens, np.int64)
+    return jnp.asarray(EMB_K[idx]), jnp.asarray(EMB_V[idx])
+
+
+def dense_ref(q_row, tokens):
+    kf = np.repeat(EMB_K[np.asarray(tokens)].astype(np.float64), HQ // HK, 1)
+    vf = np.repeat(EMB_V[np.asarray(tokens)].astype(np.float64), HQ // HK, 1)
+    z = np.einsum("hd,thd->ht", np.asarray(q_row, np.float64), kf)
+    z /= math.sqrt(D)
+    w = np.exp(z - z.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    return np.einsum("ht,thd->hd", w, vf)
+
+
+def _engine(num_pages=48, mpp=12, max_seqs=6, prefix_sharing=True):
+    return ServingEngine(
+        num_pages=num_pages, num_kv_heads=HK, head_dim=D, page_size=PS,
+        max_seqs=max_seqs, max_pages_per_seq=mpp, dtype=jnp.float32,
+        prefix_sharing=prefix_sharing,
+    )
+
+
+def _admit_prefill(eng, rng, tokens):
+    res = eng.admit(len(tokens), tokens=tokens)
+    assert res.admitted, res
+    suffix = list(tokens[res.prefix_len:])
+    k, v = kv_of(suffix)
+    q = jnp.asarray(rng.standard_normal((len(suffix), HQ, D)), jnp.float32)
+    eng.prefill(q, k, v, res.slot)
+    return res
+
+
+# ---------------------------------------------------------------------------
+# allocator refcounts + typed errors
+# ---------------------------------------------------------------------------
+
+
+def test_fork_shares_pages_and_counts_residency_once():
+    alloc = PageAllocator(num_pages=8, page_size=PS, max_seqs=4,
+                          max_pages_per_seq=8)
+    s0, pages = alloc.allocate(3 * PS)
+    assert alloc.pages_in_use == 3
+    s1, pages1 = alloc.fork(pages[:2], 3 * PS)  # 2 shared + 1 fresh
+    assert pages1[:2] == pages[:2] and pages1[2] not in pages
+    assert alloc.pages_in_use == 4  # shared pages counted ONCE
+    assert alloc.page_ref(pages[0]) == 2
+    assert alloc.shared_pages == 2
+    alloc.free(s0)
+    assert alloc.pages_in_use == 4 - 1  # only s0's private page freed
+    assert alloc.page_ref(pages[0]) == 1
+    alloc.free(s1)
+    assert alloc.pages_in_use == 0
+
+
+def test_double_free_raises_typed_error_and_mutates_nothing():
+    """ISSUE 9 satellite: a double free (or never-allocated slot) must
+    raise InvalidFreeError — not silently push pages onto the free list
+    twice (the same page handed to two sequences)."""
+    alloc = PageAllocator(num_pages=4, page_size=PS, max_seqs=2,
+                          max_pages_per_seq=4)
+    slot, _ = alloc.allocate(2 * PS)
+    alloc.free(slot)
+    free_before = alloc.num_pages - alloc.pages_in_use
+    with pytest.raises(InvalidFreeError):
+        alloc.free(slot)  # double free
+    with pytest.raises(InvalidFreeError):
+        alloc.free(99)  # never allocated
+    # nothing corrupted: free list unchanged, a fresh cycle still works
+    assert alloc.num_pages - alloc.pages_in_use == free_before
+    s2, p2 = alloc.allocate(4 * PS)
+    assert sorted(p2) == list(range(4))  # every page handed out once
+    # typed error is still a KeyError for pre-ISSUE-9 callers
+    assert issubclass(InvalidFreeError, KeyError)
+
+
+def test_share_surface_typed_errors():
+    alloc = PageAllocator(num_pages=4, page_size=PS, max_seqs=2,
+                          max_pages_per_seq=4)
+    slot, pages = alloc.allocate(2 * PS)
+    with pytest.raises(PageShareError):
+        alloc.retain([99])  # not resident
+    with pytest.raises(PageShareError):
+        alloc.cow_page(slot, 0)  # not shared — nothing to split
+    alloc.retain([pages[0]])
+    old, new = alloc.cow_page(slot, 0)
+    assert old == pages[0] and new != old
+    assert alloc.page_ref(old) == 1 and alloc.page_ref(new) == 1
+    assert alloc.slot_pages(slot)[0] == new
+    alloc.release_pages([old])
+    with pytest.raises(InvalidFreeError):
+        alloc.release_pages([old])  # double release
+
+
+def test_fork_is_atomic_on_exhaustion():
+    alloc = PageAllocator(num_pages=3, page_size=PS, max_seqs=4,
+                          max_pages_per_seq=8)
+    _, pages = alloc.allocate(2 * PS)
+    assert not alloc.can_fork(pages, 6 * PS)  # needs 4 fresh, 1 free
+    refs_before = [alloc.page_ref(p) for p in pages]
+    with pytest.raises(Exception):
+        alloc.fork(pages, 6 * PS)
+    assert [alloc.page_ref(p) for p in pages] == refs_before
+    assert alloc.pages_in_use == 2
+
+
+# ---------------------------------------------------------------------------
+# the trie
+# ---------------------------------------------------------------------------
+
+
+def test_trie_match_register_roundtrip():
+    alloc = PageAllocator(num_pages=16, page_size=PS, max_seqs=4,
+                          max_pages_per_seq=8)
+    trie = PrefixCache(PS)
+    toks = list(range(2 * PS + 3))  # 2 full pages + 3-token tail
+    slot, pages = alloc.allocate(len(toks))
+    assert not trie.match(toks).hit
+    assert trie.register(toks, pages, alloc) == 3  # 2 full + tail
+    assert trie.resident_pages == 3
+    m = trie.match(toks)
+    assert m.hit and m.length == len(toks) and m.full_pages == 2
+    assert list(m.pages) == pages[:3]
+    # shorter prompt: full pages only, the tail outruns it
+    m2 = trie.match(toks[: 2 * PS + 1])
+    assert m2.length == 2 * PS and m2.full_pages == 2
+    # diverging second page: only the first page matches
+    bad = toks[:PS] + [49] * PS + toks[2 * PS:]
+    m3 = trie.match(bad)
+    assert m3.length == PS and m3.full_pages == 1
+    # registration pinned refs: freeing the slot keeps the pages
+    alloc.free(slot)
+    assert alloc.pages_in_use == 3
+
+
+def test_trie_eviction_is_lru_and_ref_safe():
+    alloc = PageAllocator(num_pages=16, page_size=PS, max_seqs=4,
+                          max_pages_per_seq=8)
+    trie = PrefixCache(PS)
+    s_a, pg_a = alloc.allocate(2 * PS)
+    trie.register(list(range(2 * PS)), pg_a, alloc)
+    s_b, pg_b = alloc.allocate(2 * PS)
+    trie.register(list(range(100, 100 + 2 * PS)), pg_b, alloc)
+    # branch A is still referenced by its slot -> never evicted;
+    # branch B's slot freed -> its pages drop to trie-only refs
+    alloc.free(s_b)
+    trie.match(list(range(2 * PS)))  # touch A: B is older AND unshared
+    freed = trie.evict(alloc, 10)
+    assert freed == 2  # both B pages dropped, A kept (slot ref)
+    assert trie.match(list(range(100, 100 + 2 * PS))).length == 0
+    assert trie.match(list(range(2 * PS))).length == 2 * PS
+    alloc.free(s_a)
+    assert trie.evict(alloc, 10) == 2
+    assert alloc.pages_in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# engine fork + CoW + memory
+# ---------------------------------------------------------------------------
+
+
+def test_engine_fork_memory_and_isolation():
+    """N users sharing an aligned P-token prefix hold pages_needed(P) +
+    sum pages_needed(suffix_i) pages — and each user's data stays its
+    own after the shared pages diverge."""
+    rng = np.random.default_rng(11)
+    eng = _engine()
+    prefix = list(rng.integers(0, VOCAB, 2 * PS))  # aligned
+    prompts = [prefix] + [
+        prefix + list(rng.integers(0, VOCAB, 5 + i)) for i in range(3)
+    ]
+    results = [_admit_prefill(eng, rng, p) for p in prompts]
+    for r in results[1:]:
+        assert r.prefix_len == len(prefix)
+    expect = 2 + sum(math.ceil((len(p) - 2 * PS) / PS) for p in prompts)
+    assert eng.allocator.pages_in_use == expect
+    # decode isolation: each sequence sees ITS stream only
+    qd = jnp.asarray(rng.standard_normal((4, HQ, D)), jnp.float32)
+    new_toks = [1, 2, 3, 4]
+    kn, vn = kv_of(new_toks)
+    out, _ = eng.decode_step(qd, kn, vn, [r.slot for r in results])
+    for j, p in enumerate(prompts):
+        assert_close(
+            out[j], dense_ref(qd[j], p + [new_toks[j]]).astype(np.float32),
+            atol=1e-5, rtol=1e-5, msg=f"user {j}",
+        )
+
+
+def test_cow_split_on_shared_tail_write():
+    """A fork sharing an unaligned prefix's tail page must privatize it
+    on its first suffix write; the registrant's copy and the trie's
+    resident copy stay intact."""
+    rng = np.random.default_rng(12)
+    eng = _engine()
+    sysp = list(rng.integers(0, VOCAB, PS + 3))  # 1 full page + 3 tail
+    r0 = _admit_prefill(eng, rng, sysp)  # registers incl. tail
+    tail_page = eng.allocator.slot_pages(r0.slot)[1]
+    assert eng.allocator.page_ref(tail_page) == 2  # slot + trie
+    r1 = _admit_prefill(eng, rng, sysp + [9, 8, 7])  # tail share -> CoW
+    assert r1.prefix_len == len(sysp)
+    p1 = eng.allocator.slot_pages(r1.slot)
+    assert p1[0] == eng.allocator.slot_pages(r0.slot)[0]  # full page shared
+    assert p1[1] != tail_page  # tail privatized
+    # the original tail page still holds ONLY the prefix tail (r0 can
+    # decode against it unchanged)
+    qd = jnp.asarray(rng.standard_normal((2, HQ, D)), jnp.float32)
+    kn, vn = kv_of([5, 6])
+    out, _ = eng.decode_step(qd, kn, vn, [r0.slot, r1.slot])
+    assert_close(out[0], dense_ref(qd[0], sysp + [5]).astype(np.float32),
+                 atol=1e-5, rtol=1e-5, msg="registrant")
+    assert_close(out[1],
+                 dense_ref(qd[1], sysp + [9, 8, 7, 6]).astype(np.float32),
+                 atol=1e-5, rtol=1e-5, msg="fork")
+
+
+def test_freed_forks_leave_one_resident_copy_then_evictable():
+    rng = np.random.default_rng(13)
+    eng = _engine()
+    prefix = list(rng.integers(0, VOCAB, 2 * PS))
+    results = [
+        _admit_prefill(eng, rng, prefix + list(rng.integers(0, VOCAB, 4)))
+        for _ in range(3)
+    ]
+    for r in results:
+        eng.free(r.slot)
+    # only the trie's resident copies remain
+    resident = eng.prefix.resident_pages
+    assert eng.allocator.pages_in_use == resident
+    # evict drops everything nobody references
+    assert eng.prefix.evict(eng.allocator, 100) == resident
+    assert eng.allocator.pages_in_use == 0
+
+
+def test_slot_bottleneck_does_not_flush_prefix_cache():
+    """A slot shortage cannot be fixed by dropping cached KV: the
+    pressure loop must leave the trie alone when pages are plentiful
+    and the bottleneck is max_seqs (review regression)."""
+    rng = np.random.default_rng(17)
+    eng = _engine(num_pages=32, mpp=8, max_seqs=2)
+    r0 = _admit_prefill(eng, rng, list(rng.integers(0, VOCAB, 2 * PS)))
+    r1 = _admit_prefill(eng, rng, list(rng.integers(0, VOCAB, PS)))
+    resident = eng.prefix.resident_pages
+    assert resident > 0
+    res = eng.admit(PS)  # both slots taken, plenty of pages free
+    assert not res.admitted and res.reason == "no_free_slot"
+    assert eng.prefix.resident_pages == resident  # trie untouched
+    eng.free(r0.slot)
+    eng.free(r1.slot)
+
+
+def test_admission_pressure_evicts_prefix_pages_before_sequences():
+    rng = np.random.default_rng(14)
+    eng = _engine(num_pages=6, mpp=6, max_seqs=4)
+    r0 = _admit_prefill(eng, rng, list(rng.integers(0, VOCAB, 2 * PS)))
+    eng.free(r0.slot)  # 2 pages now trie-only
+    assert eng.allocator.pages_in_use == 2
+    res = eng.admit(5 * PS)  # needs 5, only 4 free -> must evict trie
+    assert res.admitted and not res.evicted  # NO live sequence was evicted
+    assert eng.prefix.resident_pages < 2
+
+
+# ---------------------------------------------------------------------------
+# cascade grouping + parity
+# ---------------------------------------------------------------------------
+
+
+def test_plan_cascade_groups():
+    prefixes = {
+        0: ((4, 5), 2 * PS),
+        1: ((4, 5), 2 * PS),
+        2: ((7,), PS),
+        3: ((4, 5), 2 * PS),
+    }
+    groups = plan_cascade_groups(prefixes, [0, 1, 2, 3, 9])
+    assert len(groups) == 1
+    g = groups[0]
+    assert g.shared_pages == (4, 5) and g.members == (0, 1, 3)
+    assert g.prefix_len == 2 * PS
+    # min_group=1 keeps singletons (parity-test mode)
+    groups_all = plan_cascade_groups(prefixes, [0, 1, 2, 3, 9], min_group=1)
+    assert len(groups_all) == 2
+
+
+@pytest.mark.parametrize("splits", [None, 2])
+def test_cascade_equals_flat_and_dense(splits):
+    rng = np.random.default_rng(15)
+    eng = _engine()
+    prefix = list(rng.integers(0, VOCAB, 3 * PS))
+    prompts = [prefix] + [
+        prefix + list(rng.integers(0, VOCAB, 3 + 2 * i)) for i in range(2)
+    ]
+    results = [_admit_prefill(eng, rng, p) for p in prompts]
+    slots = [r.slot for r in results]
+    qd = jnp.asarray(rng.standard_normal((3, HQ, D)), jnp.float32)
+    new_toks = [10, 11, 12]
+    kn, vn = kv_of(new_toks)
+    before = [eng._lengths[s] for s in slots]
+    out_c, lse_c = eng.decode_step(
+        qd, kn, vn, slots, cascade=True, num_splits=splits
+    )
+    # rewind the append and run the flat path on the identical state
+    for s, b in zip(slots, before):
+        eng._lengths[s] = b
+    eng.cache = type(eng.cache)(
+        eng.cache.k_pages, eng.cache.v_pages, eng.cache.block_tables,
+        eng.cache.seq_lens.at[jnp.asarray(slots)].set(
+            jnp.asarray(before, jnp.int32)
+        ),
+    )
+    out_f, lse_f = eng.decode_step(
+        qd, kn, vn, slots, cascade=False, num_splits=splits
+    )
+    assert_close(out_c, out_f, atol=1e-5, rtol=1e-5, msg="cascade vs flat")
+    assert_close(lse_c, lse_f, atol=1e-5, rtol=1e-5, msg="lse")
+    for j, p in enumerate(prompts):
+        assert_close(
+            out_c[j],
+            dense_ref(qd[j], p + [new_toks[j]]).astype(np.float32),
+            atol=1e-5, rtol=1e-5, msg=f"vs dense user {j}",
+        )
+
+
+def test_cascade_auto_engages_only_with_real_groups():
+    from magiattention_tpu import telemetry
+
+    rng = np.random.default_rng(16)
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        eng = _engine()
+        prefix = list(rng.integers(0, VOCAB, 2 * PS))
+        ra = _admit_prefill(eng, rng, prefix)
+        rb = _admit_prefill(eng, rng, prefix + [1, 2])
+        # lone un-prefixed sequence: auto must stay flat
+        rc = _admit_prefill(eng, rng, list(rng.integers(0, VOCAB, 5)))
+        q1 = jnp.asarray(rng.standard_normal((1, HQ, D)), jnp.float32)
+        kn, vn = kv_of([3])
+        eng.decode_step(q1, kn, vn, [rc.slot])
+        snap = telemetry.snapshot()
+        assert snap["gauges"]["magi_decode_cascade_groups"] == 0
+        # the two prefix-sharers together: auto engages
+        q2 = jnp.asarray(rng.standard_normal((2, HQ, D)), jnp.float32)
+        kn2, vn2 = kv_of([4, 5])
+        eng.decode_step(q2, kn2, vn2, [ra.slot, rb.slot])
+        snap = telemetry.snapshot()
+        assert snap["gauges"]["magi_decode_cascade_groups"] == 1
+        assert snap["gauges"]["magi_decode_num_splits"] == 0  # per-phase
+    finally:
+        telemetry.set_enabled(None)
