@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze resilience-check check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze resilience-check roofline-check roofline-report check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -121,8 +121,23 @@ analyze:
 resilience-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_resilience_check.py
 
+# roofline/occupancy gate (ISSUE 10, CPU): REQUIRED_ROOFLINE_METRICS on
+# a real cp=2 profile, occupancy map == brute-force block scan on random
+# slice lists, per-hop magi_hop_ms gauges on a cp=4 hops-impl profile
+# summing to ~the cast time, and --self-test proof that a planted
+# dead-block-heavy plan is attributed to dead steps
+# (exps/run_roofline_check.py exits non-zero on any violation)
+roofline-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_roofline_check.py --self-test
+
+# mask-aware roofline report + occupancy JSON artifact for the 16k
+# varlen block-causal headline (docs/observability.md "Roofline &
+# occupancy"); host-side only
+roofline-report:
+	JAX_PLATFORMS=cpu $(PY) exps/run_roofline_report.py
+
 # the default check flow: syntax, static analysis, telemetry catalog +
 # timeline/aggregate semantics, autotuner rung expectations, perf gate,
 # serving parity, shared-prefix/scheduler gate, group-collective
-# parity/volume, resilience gate — all CPU-safe
-check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check
+# parity/volume, resilience gate, roofline/occupancy gate — all CPU-safe
+check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check

@@ -40,6 +40,24 @@ _KEYS = ("metric", "value", "unit", "vs_baseline")
 # rung can never diverge from the shape the kernel actually ran.
 _HEADLINE_T, _HEADLINE_HQ, _HEADLINE_HK, _HEADLINE_D = 65536, 8, 8, 128
 _HEADLINE_DTYPE = "bfloat16"
+# the heterogeneous-mask headline (BASELINE config 2's kernel half): ONE
+# spec shared by the extras measurement, the mask-density context, and
+# the roofline probe, so the recorded density/efficiency can never
+# describe a different workload than the metric they annotate
+_VARLEN_T = 16384
+_VARLEN_METRIC = "flex_attn_fwd_tflops_16k_varlen_block_causal_bf16"
+
+
+def _varlen_slices():
+    """(q_ranges, k_ranges, attn_type_map) of the 16k varlen headline."""
+    from magiattention_tpu.testing.workloads import varlen_block_causal
+
+    sl = varlen_block_causal(_VARLEN_T)
+    return (
+        [(int(a), int(b)) for a, b, *_ in sl],
+        [(int(s[2]), int(s[3])) for s in sl],
+        [int(s[4]) for s in sl],
+    )
 
 sys.path.insert(0, _HERE)
 
@@ -144,6 +162,52 @@ def _bench_autotune_rung() -> "str | None":
         return None
 
 
+def _bench_mask_profile(metrics: dict) -> "tuple[dict, dict]":
+    """Per-metric (mask_density, roofline_efficiency) context maps for
+    the benched workloads (ISSUE 10): density = true entries / dense S²
+    (exact host-side counting, ``tuning/cost_model.exact_mask_area``),
+    efficiency = measured TF/s / the generation's peak. Recorded next to
+    ``autotune_rung`` so the perf gate can attribute a TF/s delta to a
+    rung vs a density (workload) change. Never fatal — empty maps on any
+    error."""
+    densities: dict = {}
+    efficiencies: dict = {}
+    try:
+        from magiattention_tpu.telemetry.roofline import resolve_peak_tflops
+        from magiattention_tpu.tuning.cost_model import exact_mask_area
+
+        def causal_density(t):
+            return (t + 1) / (2 * t)
+
+        varlen_density = None
+        for name, value in metrics.items():
+            if not (
+                name.startswith("flex_attn_")
+                and "tflops" in name
+                and isinstance(value, (int, float))
+            ):
+                continue
+            if "64k_causal" in name:
+                densities[name] = round(causal_density(65536), 6)
+            elif "128k_causal" in name:
+                densities[name] = round(causal_density(131072), 6)
+            elif "16k_varlen_block_causal" in name:
+                if varlen_density is None:
+                    qr, kr, ts = _varlen_slices()
+                    varlen_density = exact_mask_area(qr, kr, ts) / float(
+                        _VARLEN_T * _VARLEN_T
+                    )
+                densities[name] = round(varlen_density, 6)
+            else:
+                continue
+            efficiencies[name] = round(
+                float(value) / resolve_peak_tflops(), 4
+            )
+    except Exception as e:
+        print(f"mask-profile context failed: {e!r}", file=sys.stderr)
+    return densities, efficiencies
+
+
 def _append_history(meta: dict, extras: dict) -> None:
     """Append the cached run to BENCH_HISTORY.jsonl — the machine-readable
     perf trajectory exps/run_perf_gate.py gates on. Never fatal."""
@@ -152,6 +216,7 @@ def _append_history(meta: dict, extras: dict) -> None:
 
         metrics = {meta["metric"]: meta["value"]}
         metrics.update(extras or {})
+        densities, efficiencies = _bench_mask_profile(metrics)
         baseline.append_history(
             _HISTORY,
             baseline.make_history_entry(
@@ -161,6 +226,8 @@ def _append_history(meta: dict, extras: dict) -> None:
                 device=meta.get("device"),
                 vs_baseline=meta.get("vs_baseline"),
                 autotune_rung=_bench_autotune_rung(),
+                mask_density=densities,
+                roofline_efficiency=efficiencies,
             ),
         )
         print(f"bench history appended -> {_HISTORY}", file=sys.stderr)
@@ -215,6 +282,7 @@ def _telemetry_block() -> None:
             plan, num_heads_q=hq, num_heads_kv=hkv, head_dim=d,
             bytes_per_elt=2, generation=gen,
         )
+        _roofline_block()  # before the snapshot: gauges ride the archive
         snap = telemetry.snapshot()
         payload = {
             "provenance": (
@@ -242,6 +310,57 @@ def _telemetry_block() -> None:
             telemetry.set_enabled(None)
         except Exception:
             pass
+
+
+def _roofline_block() -> None:
+    """Roofline section of the bench summary (ISSUE 10): mask-aware
+    achieved-vs-peak on the heterogeneous 16k varlen headline — exact
+    host-side FLOPs/occupancy counting at the rung the autotuner picks,
+    with the measured TF/s pulled from the newest history entry (this
+    subprocess is CPU-pinned; the measurement is the chip's own). Prints
+    the ``roofline probe:`` line and records the ``magi_roofline_*``
+    gauges into the archived snapshot. Never fatal."""
+    try:
+        from magiattention_tpu import telemetry
+        from magiattention_tpu.telemetry import baseline
+
+        # NOTE: this subprocess runs CONCURRENTLY with the measurement
+        # child, which appends to history only after it finishes — so
+        # "newest" here is usually the PREVIOUS round's number. That is
+        # the probe's contract (latest committed measurement), and the
+        # printed line says so.
+        measured, _ = baseline.newest_metric_value(
+            baseline.load_history(_HISTORY), _VARLEN_METRIC
+        )
+        qr, kr, ts = _varlen_slices()
+        rep = telemetry.profile_roofline(
+            qr,
+            kr,
+            ts,
+            num_heads_q=_HEADLINE_HQ,
+            num_heads_kv=_HEADLINE_HK,
+            head_dim=_HEADLINE_D,
+            dtype=_HEADLINE_DTYPE,
+            workload="16k_varlen_block_causal",
+            measured_tflops=measured,
+        )
+        f = rep.gap_fractions()
+        head = (
+            f"achieved {rep.efficiency:.1%} of {rep.peak_tflops:g} TF/s "
+            f"peak ({rep.measured_tflops:.2f} TF/s, newest committed "
+            "history — may lag this run)"
+            if measured is not None
+            else "no measured TF/s in history; modeled gap"
+        )
+        print(
+            f"roofline probe: 16k varlen: {head}, "
+            f"dead-step {f['dead_steps']:.1%}, "
+            f"dominant waste {rep.dominant_waste}, "
+            f"density {rep.mask_density:.4f}",
+            file=sys.stderr,
+        )
+    except Exception as e:
+        print(f"roofline probe failed: {e!r}", file=sys.stderr)
 
 
 def _decode_summary_line() -> None:
@@ -583,23 +702,16 @@ def _measure_extras(dt_fwd_64k: float) -> dict:
         file=sys.stderr,
     )
 
-    # 2. 16k varlen block-causal fwd (BASELINE config 2's kernel shape)
-    from magiattention_tpu.testing.workloads import varlen_block_causal
-
-    t = 16384
-    slices = varlen_block_causal(t)
-    qr = [(int(s[0]), int(s[1])) for s in slices]
-    kr = [(int(s[2]), int(s[3])) for s in slices]
-    ts = [int(s[4]) for s in slices]
+    # 2. 16k varlen block-causal fwd (the shared _VARLEN_* headline spec)
+    t = _VARLEN_T
+    qr, kr, ts = _varlen_slices()
     # exact area via the mask oracle (host-side, cheap at 16k)
     from magiattention_tpu.testing.ref_attn import make_attn_mask_from_ranges
 
     mask = make_attn_mask_from_ranges(qr, kr, ts, t, t)
     area = int(np.asarray(mask).sum())
     tf_varlen = fwd_tf(t, qr, kr, ts, area, n=10)
-    extras["flex_attn_fwd_tflops_16k_varlen_block_causal_bf16"] = round(
-        tf_varlen, 3
-    )
+    extras[_VARLEN_METRIC] = round(tf_varlen, 3)
     print(f"extras: 16k varlen fwd {tf_varlen:.1f} TF/s", file=sys.stderr)
 
     # 3. 128k causal fwd (BASELINE config 3's single-chip kernel half)

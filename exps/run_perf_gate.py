@@ -92,7 +92,11 @@ def run_gate(history_path, expectations_path, tolerance, inject=0.0):
         metrics = {k: v * (1.0 - inject) for k, v in metrics.items()}
         print(f"(injected {inject:.0%} regression into every metric)")
     results = baseline.check_gate(metrics, expectations, tolerance)
-    flags = baseline.rung_changes(history)
+    # rung + mask-density flags: both re-price what a TF/s delta means
+    # (tuning story / workload story), neither is fatal by itself
+    flags = baseline.rung_changes(history) + baseline.density_changes(
+        history
+    )
     print(baseline.gate_report(results, flags))
     return 1 if any(r.failed for r in results) else 0
 

@@ -1,0 +1,332 @@
+"""Roofline/occupancy drift guard (``make roofline-check``) — ISSUE 10.
+
+Four assertions on the mask-aware roofline profiler, all CPU-safe (the
+8-virtual-device mesh + jnp kernel backend):
+
+1. **Catalog**: a real cp=2 profile (plan built, full pipelined path
+   measured via ``profile_plan_timeline``, fed to ``analyze_workload`` +
+   ``record_roofline``) must populate every
+   ``telemetry.REQUIRED_ROOFLINE_METRICS`` name the docs promise.
+2. **Occupancy exactness**: ``block_occupancy_map`` must equal a
+   brute-force dense-mask block scan on random slice lists (random
+   lengths, types, blockings) — the per-q-block active-k-block lists are
+   the future block-sparse kernel's input and must be trusted.
+3. **Per-hop attribution**: a cp=4 profile with the hop-scheduled
+   collective impl pinned must record one ``magi_hop_ms{hop=,axis=}``
+   gauge per timed hop, and the per-hop sum must land within a generous
+   factor of the whole-cast measurement (each hop program re-pays
+   dispatch overhead, so the sum legitimately exceeds the fused cast —
+   the tolerance bounds both directions).
+4. **--self-test**: a planted dead-block-heavy plan (one q-block row
+   attending everything, every other row one tile) must be attributed
+   to dead steps as the dominant waste term — proof the decomposition
+   can actually point at the right culprit.
+
+Exit codes: 0 = pass, 1 = drift/violation.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+
+# the hop-sum-vs-cast tolerance band: per-hop programs re-pay the fixed
+# dispatch/sync floor the fused cast pays once, so the sum runs high;
+# far outside this band the per-hop numbers are not measuring the cast
+HOP_SUM_RATIO_LO = 0.2
+HOP_SUM_RATIO_HI = 8.0
+
+
+def _series(snap: dict, name: str) -> dict:
+    return {
+        k: v
+        for sec in snap.values()
+        for k, v in sec.items()
+        if k == name or k.startswith(name + "{")
+    }
+
+
+def _has_series(snap: dict, name: str) -> bool:
+    return bool(_series(snap, name))
+
+
+def _build_plan(total, cp, degree, impl=None):
+    from magiattention_tpu import env
+    from magiattention_tpu.common import AttnMaskType, AttnRanges
+    from magiattention_tpu.meta import make_dispatch_meta_from_qk_ranges
+    from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+    from magiattention_tpu.parallel import build_dist_attn_plan
+
+    chunk = total // (env.min_chunks_per_rank() * cp)
+    qr = AttnRanges.from_ranges([(0, total)])
+    kr = AttnRanges.from_ranges([(0, total)])
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, [AttnMaskType.CAUSAL], total, total,
+        chunk_size=chunk, cp_size=cp,
+    )
+    oc = (
+        OverlapConfig(degree=degree, min_stage_rows=64)
+        if degree
+        else OverlapConfig(degree=0)
+    )
+    prev = os.environ.get("MAGI_ATTENTION_GROUP_COLL_IMPL")
+    if impl is not None:
+        os.environ["MAGI_ATTENTION_GROUP_COLL_IMPL"] = impl
+    try:
+        plan = build_dist_attn_plan(
+            mq, bucket, block_q=64, block_k=64, overlap_config=oc
+        )
+    finally:
+        if impl is not None:
+            if prev is None:
+                os.environ.pop("MAGI_ATTENTION_GROUP_COLL_IMPL", None)
+            else:
+                os.environ["MAGI_ATTENTION_GROUP_COLL_IMPL"] = prev
+    return plan
+
+
+def check_catalog() -> int:
+    """A real cp=2 profile must populate REQUIRED_ROOFLINE_METRICS."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from magiattention_tpu import telemetry
+    from magiattention_tpu.parallel import make_attn_params
+
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    total, cp = 2048, 2
+    plan = _build_plan(total, cp, degree=0)
+    mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
+    params = make_attn_params(plan, 64, out_dtype="float32")
+    tl = telemetry.profile_plan_timeline(
+        plan, mesh, params, num_heads=(4, 2), head_dim=64, reps=1, inner=1
+    )
+    rep = telemetry.analyze_workload(
+        [(0, total)], [(0, total)], [1],
+        num_heads_q=4, num_heads_kv=2, head_dim=64,
+        block_q=64, block_k=64, head_block=4,
+        workload="cp2_check",
+        measured_ms=tl.measured_total_ms,
+    )
+    telemetry.record_roofline(rep)
+    snap = telemetry.snapshot()
+    missing = [
+        m for m in telemetry.REQUIRED_ROOFLINE_METRICS
+        if not _has_series(snap, m)
+    ]
+    if missing:
+        print(
+            "FAIL: documented roofline metrics missing after a real cp=2 "
+            f"profile (catalog drift): {missing}"
+        )
+        return 1
+    if not (0.0 < rep.mask_density <= 1.0):
+        print(f"FAIL: cp=2 mask density out of (0, 1]: {rep.mask_density}")
+        return 1
+    summary = telemetry.telemetry_summary(snap)
+    if "roofline probe" not in summary:
+        print(f"FAIL: telemetry_summary lacks the roofline line:\n{summary}")
+        return 1
+    print(
+        f"catalog OK: {len(telemetry.REQUIRED_ROOFLINE_METRICS)} roofline "
+        f"metrics present; cp=2 efficiency {rep.efficiency:.2%} "
+        f"(CPU backend — the machinery, not a chip number)"
+    )
+    return 0
+
+
+def check_occupancy(seeds=range(6)) -> int:
+    """block_occupancy_map == brute-force dense block scan."""
+    import numpy as np
+
+    from magiattention_tpu.telemetry.occupancy import block_occupancy_map
+    from magiattention_tpu.testing.ref_attn import make_attn_mask_from_ranges
+
+    for seed in seeds:
+        rng = np.random.default_rng(seed)
+        total = int(rng.choice([192, 256, 384, 512]))
+        n = int(rng.integers(1, 8))
+        qr, kr, ts = [], [], []
+        for _ in range(n):
+            a, b = sorted(rng.integers(0, total, 2).tolist())
+            c, d = sorted(rng.integers(0, total, 2).tolist())
+            if a == b or c == d:
+                continue
+            qr.append((a, b))
+            kr.append((c, d))
+            ts.append(int(rng.choice([0, 1, 2])))
+        if not qr:
+            continue
+        bq = int(rng.choice([16, 32, 64]))
+        bk = int(rng.choice([16, 32, 64]))
+        m = block_occupancy_map(qr, kr, ts, bq, bk)
+        mask = np.asarray(
+            make_attn_mask_from_ranges(qr, kr, ts, total, total)
+        )
+        extent_q = max(b for _, b in qr)
+        extent_k = max(d for _, d in kr)
+        nq = max(-(-extent_q // bq), 1)
+        nk = max(-(-extent_k // bk), 1)
+        brute = tuple(
+            tuple(
+                j
+                for j in range(nk)
+                if mask[i * bq : (i + 1) * bq, j * bk : (j + 1) * bk].any()
+            )
+            for i in range(nq)
+        )
+        if (m.num_q_blocks, m.num_k_blocks) != (nq, nk) or m.active != brute:
+            print(
+                f"FAIL: occupancy map != brute-force block scan "
+                f"(seed {seed}, blocks {bq}x{bk}):\n"
+                f"  map   {m.active}\n  brute {brute}"
+            )
+            return 1
+        # the JSON artifact must round-trip into the same lists
+        from magiattention_tpu.telemetry.occupancy import BlockOccupancyMap
+
+        if BlockOccupancyMap.from_json(m.as_json()).active != m.active:
+            print(f"FAIL: occupancy JSON round-trip drift (seed {seed})")
+            return 1
+    print(f"occupancy OK: map == brute-force scan on {len(list(seeds))} "
+          "random slice lists (+ JSON round-trip)")
+    return 0
+
+
+def check_hops() -> int:
+    """cp=4 hops-impl profile: magi_hop_ms per hop, sum ~ the cast."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from magiattention_tpu import telemetry
+    from magiattention_tpu.parallel import make_attn_params
+
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    total, cp = 2048, 4
+    plan = _build_plan(total, cp, degree=0, impl="hops")
+    comm = plan.merged_comm
+    if comm.impl != "hops" or not comm.hops:
+        print(f"FAIL: pinned hops impl did not build hops: {comm.impl}")
+        return 1
+    mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
+    params = make_attn_params(plan, 64, out_dtype="float32")
+    tl = telemetry.profile_plan_timeline(
+        plan, mesh, params, num_heads=(4, 2), head_dim=64, reps=2, inner=1
+    )
+    if len(tl.hops) != len(comm.hops):
+        print(
+            f"FAIL: {len(comm.hops)} hops planned but {len(tl.hops)} timed"
+        )
+        return 1
+    snap = telemetry.snapshot()
+    gauges = _series(snap, "magi_hop_ms")
+    if len(gauges) != len(comm.hops):
+        print(
+            f"FAIL: expected {len(comm.hops)} magi_hop_ms series, got "
+            f"{sorted(gauges)}"
+        )
+        return 1
+    bad = [k for k in gauges if "hop=" not in k or "axis=" not in k]
+    if bad:
+        print(f"FAIL: magi_hop_ms series missing hop=/axis= labels: {bad}")
+        return 1
+    cast_ms = tl.stages[0].comm_ms
+    hop_sum = sum(h.ms for h in tl.hops)
+    ratio = hop_sum / max(cast_ms, 1e-9)
+    if not (HOP_SUM_RATIO_LO <= ratio <= HOP_SUM_RATIO_HI):
+        print(
+            f"FAIL: per-hop sum {hop_sum:.3f} ms vs cast {cast_ms:.3f} ms "
+            f"(ratio {ratio:.2f} outside [{HOP_SUM_RATIO_LO}, "
+            f"{HOP_SUM_RATIO_HI}]) — the hop programs are not measuring "
+            "the cast"
+        )
+        return 1
+    print(
+        f"hops OK: {len(tl.hops)} magi_hop_ms gauges on the cp=4 "
+        f"hops-impl profile; per-hop sum {hop_sum:.3f} ms vs whole cast "
+        f"{cast_ms:.3f} ms (ratio {ratio:.2f}, within tolerance)"
+    )
+    return 0
+
+
+def self_test() -> int:
+    """The decomposition must flag a planted dead-block-heavy plan."""
+    from magiattention_tpu.telemetry.roofline import analyze_workload
+
+    total, blk = 4096, 128
+    # q-block 0 attends EVERYTHING (sets steps = 32); every other
+    # q-block attends exactly its own tile -> 31 rows of 1 entry under a
+    # static 32-step extent: 961 of 1024 grid slots are clamped dead
+    qr = [(0, blk)] + [(i * blk, (i + 1) * blk) for i in range(1, 32)]
+    kr = [(0, total)] + [(i * blk, (i + 1) * blk) for i in range(1, 32)]
+    ts = [0] * 32
+    rep = analyze_workload(
+        qr, kr, ts,
+        num_heads_q=8, num_heads_kv=8, head_dim=128,
+        block_q=blk, block_k=blk, head_block=8,
+        generation="v5e", backend="tpu", workload="dead_block_plant",
+    )
+    f = rep.gap_fractions()
+    if rep.dead_slots == 0:
+        print(f"FAIL: planted plan has no dead slots: {rep}")
+        return 1
+    if rep.dominant_waste != "dead_steps":
+        print(
+            "FAIL: dead-block-heavy plant not attributed to dead steps "
+            f"(dominant {rep.dominant_waste}, fractions {f})"
+        )
+        return 1
+    # aligned full-mask tiles: the other two terms must be ~zero here
+    if f["partial_tile"] > 0.05 or f["masked_overcompute"] > 0.05:
+        print(f"FAIL: tile-aligned plant shows tile waste: {f}")
+        return 1
+    print(
+        f"self-test OK: planted plan ({rep.dead_slots} dead slots) "
+        f"attributed to dead steps ({f['dead_steps']:.1%} of the gap)"
+    )
+    print(rep.report())
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--self-test",
+        action="store_true",
+        help="additionally assert the waste decomposition flags a "
+        "planted dead-block-heavy plan",
+    )
+    args = p.parse_args()
+    from magiattention_tpu import telemetry
+
+    try:
+        for step in (check_catalog, check_occupancy, check_hops):
+            rc = step()
+            if rc:
+                return rc
+        if args.self_test:
+            rc = self_test()
+            if rc:
+                return rc
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+    print("roofline-check OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

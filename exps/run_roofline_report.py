@@ -1,0 +1,147 @@
+"""Mask-aware roofline report for one workload (ISSUE 10 acceptance).
+
+Default: the 16k varlen block-causal headline — the workload stuck at
+8.4 TF/s while dense paths run 101-113 (ROADMAP item 1). The report:
+
+- resolves the rung the autotuner actually picks for the workload
+  (``auto_block_config`` — pricing what executes, not a hypothetical),
+- pulls the newest measured TF/s for the workload's metric from
+  ``BENCH_HISTORY.jsonl`` (override with ``--measured-tflops``),
+- prints the mask-aware roofline decomposition (achieved fraction of
+  peak, gap attribution, dominant waste term) and the block-occupancy
+  ASCII heatmap,
+- dumps the occupancy JSON artifact — per-q-block active-k-block lists
+  in exactly the shape a splash-style block-sparse grid consumes
+  (default ``exps/data/occupancy_<workload>_<total>.json``).
+
+Host-side only (exact numpy counting; no devices, tunnel-wedge-safe).
+
+Usage:
+  python exps/run_roofline_report.py
+  python exps/run_roofline_report.py --total 16384 \
+      --workload varlen_block_causal --measured-tflops 8.44
+Exit codes: 0 = report produced (and self-consistent), 1 = error.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DATA = os.path.join(_ROOT, "exps", "data")
+
+# workload -> the BENCH_HISTORY metric whose TF/s measures it
+_METRIC_FOR = {
+    ("varlen_block_causal", 16384):
+        "flex_attn_fwd_tflops_16k_varlen_block_causal_bf16",
+    ("dense_causal", 65536): "flex_attn_fwd_tflops_64k_causal_bf16",
+    ("dense_causal", 131072): "flex_attn_fwd_tflops_128k_causal_bf16",
+}
+
+
+def _newest_measurement(metric: str):
+    from magiattention_tpu.telemetry import baseline
+
+    return baseline.newest_metric_value(
+        baseline.load_history(
+            os.path.join(_ROOT, baseline.HISTORY_FILENAME)
+        ),
+        metric,
+    )
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--total", type=int, default=16384)
+    p.add_argument(
+        "--workload", default="varlen_block_causal",
+        help="a magiattention_tpu.testing.workloads builder name",
+    )
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument(
+        "--measured-tflops", type=float, default=None,
+        help="override the TF/s pulled from BENCH_HISTORY.jsonl",
+    )
+    p.add_argument(
+        "--generation", default=None,
+        help="peak-table key (default MAGI_ATTENTION_TPU_GENERATION)",
+    )
+    p.add_argument(
+        "--occupancy-out", default=None,
+        help="occupancy JSON path (default exps/data/occupancy_*.json)",
+    )
+    args = p.parse_args()
+
+    from magiattention_tpu.telemetry.occupancy import block_occupancy_map
+    from magiattention_tpu.telemetry.roofline import profile_roofline
+    from magiattention_tpu.testing import workloads
+
+    builder = getattr(workloads, args.workload, None)
+    if builder is None:
+        print(f"unknown workload {args.workload!r}; see testing/workloads.py")
+        return 1
+    slices = builder(args.total)
+    qr = [(int(a), int(b)) for a, b, *_ in slices]
+    kr = [(int(s[2]), int(s[3])) for s in slices]
+    ts = [int(s[4]) for s in slices]
+
+    measured, provenance = args.measured_tflops, "--measured-tflops"
+    if measured is None:
+        metric = _METRIC_FOR.get((args.workload, args.total))
+        if metric is not None:
+            measured, provenance = _newest_measurement(metric)
+    rep = profile_roofline(
+        qr, kr, ts,
+        num_heads_q=args.heads,
+        num_heads_kv=args.kv_heads,
+        head_dim=args.head_dim,
+        dtype=args.dtype,
+        generation=args.generation,
+        workload=f"{args.workload}_{args.total}",
+        measured_tflops=measured,
+        record=False,  # standalone report: no registry side effects
+    )
+    print(rep.report())
+    if measured is not None:
+        print(f"  (measured TF/s source: {provenance})")
+        # self-consistency: the achieved fraction IS measured/peak under
+        # the mask-FLOPs convention — drift here means the accounting broke
+        if abs(rep.efficiency - measured / rep.peak_tflops) > 1e-9:
+            print("FAIL: efficiency != measured/peak — accounting drift")
+            return 1
+    print()
+
+    occ = block_occupancy_map(qr, kr, ts, rep.block_q, rep.block_k)
+    print(occ.ascii_heatmap())
+    out = args.occupancy_out or os.path.join(
+        _DATA, f"occupancy_{args.workload}_{args.total}.json"
+    )
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    occ.dump(out)
+    # prove the artifact loads back as per-q-block active-k-block lists
+    with open(out) as f:
+        loaded = json.load(f)
+    lists = loaded["active_k_blocks"]
+    assert len(lists) == occ.num_q_blocks and all(
+        isinstance(row, list) for row in lists
+    )
+    print(
+        f"\noccupancy artifact -> {out} "
+        f"({occ.num_q_blocks} q-blocks, {occ.active_blocks_total} active "
+        f"tiles, block density {occ.block_density:.4f}; the block-sparse "
+        "grid input of ROADMAP item 1)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -334,6 +334,25 @@ def tpu_generation() -> str:
     return _env_str("MAGI_ATTENTION_TPU_GENERATION", "v5e")
 
 
+def peak_tflops_override() -> float | None:
+    """Explicit roofline peak rate (TF/s) for the mask-aware roofline
+    profiler (``telemetry/roofline.py``), or None to resolve through the
+    per-backend/per-generation peak table. Set it on hardware the table
+    doesn't know (or to re-anchor the efficiency denominator, e.g. to a
+    measured dense-kernel ceiling instead of the datasheet peak). Pure
+    observability — never influences planning, so NOT part of
+    :func:`flags_fingerprint`."""
+    v = os.environ.get("MAGI_ATTENTION_PEAK_TFLOPS")
+    if v is None or not v.strip():
+        return None
+    f = float(v)
+    if f <= 0:
+        raise ValueError(
+            f"MAGI_ATTENTION_PEAK_TFLOPS={v!r} must be a positive TF/s rate"
+        )
+    return f
+
+
 def group_coll_impl() -> str:
     """Group-collective realization (``comm/group_collective.py``):
     'a2a' = one globally-padded ``lax.all_to_all`` per cast (legacy),

@@ -42,6 +42,7 @@ from .collectors import (  # noqa: F401
     REQUIRED_PLAN_METRICS,
     REQUIRED_PREFIX_METRICS,
     REQUIRED_RESILIENCE_METRICS,
+    REQUIRED_ROOFLINE_METRICS,
     REQUIRED_SCHED_METRICS,
     REQUIRED_SERVING_METRICS,
     REQUIRED_TIMELINE_METRICS,
@@ -71,6 +72,7 @@ from .collectors import (  # noqa: F401
     record_prefix_eviction,
     record_prefix_lookup,
     record_prefix_registered,
+    record_roofline,
     record_request_queue_time,
     record_request_token_latency,
     record_request_ttft,
@@ -87,7 +89,18 @@ from .events import (  # noqa: F401
     span,
     trace_metadata_events,
 )
+from .occupancy import (  # noqa: F401
+    BlockOccupancyMap,
+    block_occupancy_map,
+)
+from .roofline import (  # noqa: F401
+    RooflineReport,
+    analyze_workload,
+    profile_roofline,
+    resolve_peak_tflops,
+)
 from .timeline import (  # noqa: F401
+    HopTiming,
     MeasuredTimeline,
     StageTiming,
     profile_key_timeline,
@@ -146,16 +159,22 @@ def dump_events(path: str) -> str:
 
 
 __all__ = [
+    "BlockOccupancyMap",
     "EventBuffer",
+    "HopTiming",
     "MeasuredTimeline",
     "MetricsRegistry",
     "REQUIRED_PLAN_METRICS",
     "REQUIRED_RESILIENCE_METRICS",
+    "REQUIRED_ROOFLINE_METRICS",
     "REQUIRED_SERVING_METRICS",
     "REQUIRED_TIMELINE_METRICS",
     "REQUIRED_VALIDATE_METRICS",
+    "RooflineReport",
     "StageTiming",
     "aggregate_across_mesh",
+    "analyze_workload",
+    "block_occupancy_map",
     "configure_logging",
     "dump_events",
     "dump_metrics",
@@ -167,6 +186,7 @@ __all__ = [
     "merge_snapshots",
     "profile_key_timeline",
     "profile_plan_timeline",
+    "profile_roofline",
     "record_admission",
     "record_autotune_cache",
     "record_autotune_decision",
@@ -189,7 +209,9 @@ __all__ = [
     "record_kvcache_state",
     "record_plan",
     "record_prefill",
+    "record_roofline",
     "record_runtime_costs",
+    "resolve_peak_tflops",
     "record_tuning_cache_io_error",
     "record_validate",
     "reset",

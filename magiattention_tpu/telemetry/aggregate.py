@@ -231,7 +231,9 @@ def merge_chrome_traces(
     ``thread_name`` metadata per thread. Accepts either the
     ``{"traceEvents": [...]}`` payload ``dump_events`` writes or a bare
     event list. Rank-local metadata events are dropped and re-emitted
-    against the remapped pids.
+    against the remapped pids — with the rank's own ``thread_name``
+    labels preserved, so named synthetic tracks (the per-hop comm spans)
+    stay one distinctly-named track per rank x hop after the merge.
     """
     from .events import trace_metadata_events
 
@@ -240,9 +242,16 @@ def merge_chrome_traces(
         events = tr.get("traceEvents", []) if isinstance(tr, dict) else tr
         label = labels[i] if labels is not None else f"rank {i}"
         body = []
+        tnames: dict[int, str] = {}
         for ev in events:
             if ev.get("ph") == "M":
-                continue  # re-derived below against the remapped pid
+                # harvest the rank-local track names; everything else is
+                # re-derived below against the remapped pid
+                if ev.get("name") == "thread_name":
+                    name = (ev.get("args") or {}).get("name")
+                    if name:
+                        tnames[ev.get("tid", 0)] = name
+                continue
             e = dict(ev)
             e["pid"] = i
             body.append(e)
@@ -255,6 +264,10 @@ def merge_chrome_traces(
                 "args": {"sort_index": i},
             }
         )
-        merged.extend(trace_metadata_events(body, process_name=label))
+        merged.extend(
+            trace_metadata_events(
+                body, process_name=label, thread_names=tnames
+            )
+        )
         merged.extend(body)
     return {"traceEvents": merged, "displayTimeUnit": "ms"}

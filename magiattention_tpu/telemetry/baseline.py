@@ -95,9 +95,16 @@ def make_history_entry(
     device: str | None = None,
     vs_baseline: float | None = None,
     autotune_rung: str | None = None,
+    mask_density: dict | None = None,
+    roofline_efficiency: dict | None = None,
 ) -> dict:
     """Canonical history-entry schema (one place, so bench.py and the
-    seeding path can never drift)."""
+    seeding path can never drift).
+
+    ``mask_density`` / ``roofline_efficiency`` are per-metric context
+    maps (``{metric_name: value}``) recorded NEXT TO the metrics, like
+    ``autotune_rung`` — context for attributing a TF/s delta (workload
+    density changed vs kernel regressed), never gated themselves."""
     entry: dict = {
         "source": source,
         "metrics": {
@@ -114,6 +121,14 @@ def make_history_entry(
         entry["vs_baseline"] = vs_baseline
     if autotune_rung is not None:
         entry["autotune_rung"] = autotune_rung
+    if mask_density:
+        entry["mask_density"] = {
+            k: float(v) for k, v in sorted(mask_density.items())
+        }
+    if roofline_efficiency:
+        entry["roofline_efficiency"] = {
+            k: float(v) for k, v in sorted(roofline_efficiency.items())
+        }
     return entry
 
 
@@ -123,6 +138,21 @@ def newest_metrics(history: list[dict]) -> dict[str, float]:
     stand in for a metric the newest run didn't measure (that case is
     the gate's ``missing`` verdict, a warning, not a silent pass)."""
     return dict(history[-1].get("metrics", {})) if history else {}
+
+
+def newest_metric_value(
+    history: list[dict], name: str
+) -> "tuple[float, str] | tuple[None, None]":
+    """(value, source) of the newest entry recording metric ``name`` —
+    the ONE history-schema lookup shared by the bench's roofline probe
+    and ``exps/run_roofline_report.py`` (unlike :func:`newest_metrics`,
+    this walks back past newer entries that didn't measure it: a probe
+    wants the latest available number, the gate wants the newest run)."""
+    for entry in reversed(history):
+        v = entry.get("metrics", {}).get(name)
+        if isinstance(v, (int, float)):
+            return float(v), str(entry.get("source", "?"))
+    return None, None
 
 
 def rung_changes(history: list[dict]) -> list[str]:
@@ -142,6 +172,42 @@ def rung_changes(history: list[dict]) -> list[str]:
                 f"(between {prev[0]} and {src})"
             )
         prev = (src, rung)
+    return flags
+
+
+# density is a pure function of the workload definition, so any drift
+# beyond float noise means the benched mask itself changed shape
+_DENSITY_CHANGE_RTOL = 0.01
+
+
+def density_changes(history: list[dict]) -> list[str]:
+    """Human-readable flags for mask-density changes between consecutive
+    runs that recorded one, per metric. Density re-defines what a TF/s
+    number means (the convention divides by TRUE mask FLOPs): a TF/s
+    delta WITH a density change is a workload story, not a kernel
+    regression — the gate surfaces the pair, never fails on it."""
+    flags: list[str] = []
+    prev: dict[str, tuple[str, float]] = {}  # metric -> (source, density)
+    for entry in history:
+        dens = entry.get("mask_density")
+        if not isinstance(dens, dict):
+            continue
+        src = str(entry.get("source", "?"))
+        for name, value in dens.items():
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                continue
+            old = prev.get(name)
+            if old is not None and abs(value - old[1]) > (
+                _DENSITY_CHANGE_RTOL * max(abs(old[1]), 1e-12)
+            ):
+                flags.append(
+                    f"mask density of {name} changed {old[1]:g} -> "
+                    f"{value:g} (between {old[0]} and {src}) — a TF/s "
+                    "delta here is a workload story, not a regression"
+                )
+            prev[name] = (src, value)
     return flags
 
 

@@ -125,6 +125,31 @@ M_TL_EFFICIENCY = "magi_overlap_measured_efficiency"
 M_TL_PREDICTED_MS = "magi_overlap_predicted_total_ms"  # solver's model
 M_TL_PRED_ERROR = "magi_overlap_prediction_error_ratio"  # measured/pred
 
+# gauges — mask-aware roofline profiler (telemetry/roofline.py; see
+# docs/observability.md "Roofline & occupancy"): true-vs-scheduled FLOPs
+# accounting and the waste decomposition of the measured-vs-peak gap.
+# Measured TF/s are on the mask-FLOPs convention; the peak comes from
+# the per-backend/per-generation table (MAGI_ATTENTION_PEAK_TFLOPS
+# overrides)
+M_ROOF_PEAK = "magi_roofline_peak_tflops"
+M_ROOF_ACHIEVED = "magi_roofline_achieved_tflops"
+M_ROOF_EFFICIENCY = "magi_roofline_efficiency"  # achieved / peak [0, ~1]
+M_ROOF_MASK_FLOPS = "magi_roofline_mask_flops"  # true (A)
+M_ROOF_SCHED_FLOPS = "magi_roofline_scheduled_flops"  # tile-granular (B)
+M_ROOF_DENSITY = "magi_roofline_mask_density"  # A / dense Sq*Sk
+# gap attribution fractions (of measured - ideal; modeled when no
+# measurement): dead grid slots, block-quantization padding, in-tile
+# masked-entry overcompute — plus the live-step fee and the honest
+# unattributed residual in the snapshot via the same labels
+M_ROOF_DEAD_FRAC = "magi_roofline_dead_step_fraction"
+M_ROOF_PARTIAL_FRAC = "magi_roofline_partial_tile_fraction"
+M_ROOF_MASKED_FRAC = "magi_roofline_masked_overcompute_fraction"
+# per-hop comm attribution (telemetry/timeline.py): wall ms of each hop
+# of a hop-scheduled group cast, timed as its own jitted program —
+# {hop=<shift|inter|intra>, axis=<mesh axis>, stage=} so the DCN-aware
+# two-axis pricing (ROADMAP item 3) lands against measured hop costs
+M_HOP_MS = "magi_hop_ms"
+
 # counters + gauges — serving subsystem (serving/; see docs/serving.md).
 # decode layer: per continuous-batching step
 M_DECODE_STEPS = "magi_decode_steps_total"
@@ -241,6 +266,22 @@ REQUIRED_TIMELINE_METRICS: tuple[str, ...] = (
     M_TL_EFFICIENCY,
     M_TL_PREDICTED_MS,
     M_TL_PRED_ERROR,
+)
+
+# populated by one record_roofline with a measured rate (a real profile
+# through profile_roofline / the plan-timeline driver); asserted by
+# make roofline-check (exps/run_roofline_check.py), documented in
+# docs/observability.md "Roofline & occupancy"
+REQUIRED_ROOFLINE_METRICS: tuple[str, ...] = (
+    M_ROOF_PEAK,
+    M_ROOF_ACHIEVED,
+    M_ROOF_EFFICIENCY,
+    M_ROOF_MASK_FLOPS,
+    M_ROOF_SCHED_FLOPS,
+    M_ROOF_DENSITY,
+    M_ROOF_DEAD_FRAC,
+    M_ROOF_PARTIAL_FRAC,
+    M_ROOF_MASKED_FRAC,
 )
 
 # populated by one prefill + one ServingEngine decode step; asserted by
@@ -526,17 +567,62 @@ def record_runtime_costs(
     )
 
 
+def record_roofline(report) -> None:
+    """One mask-aware roofline analysis (``telemetry/roofline.py``
+    :class:`RooflineReport`): the true/scheduled FLOPs accounting, the
+    achieved fraction of peak (when a measurement exists), and the gap
+    attribution fractions — labeled with the workload name so sweeps
+    keep one series per workload."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    w = report.workload
+    reg.gauge_set(M_ROOF_PEAK, report.peak_tflops, workload=w)
+    reg.gauge_set(M_ROOF_MASK_FLOPS, report.mask_flops, workload=w)
+    reg.gauge_set(M_ROOF_SCHED_FLOPS, report.scheduled_flops, workload=w)
+    reg.gauge_set(M_ROOF_DENSITY, report.mask_density, workload=w)
+    f = report.gap_fractions()
+    reg.gauge_set(M_ROOF_DEAD_FRAC, f["dead_steps"], workload=w)
+    reg.gauge_set(M_ROOF_PARTIAL_FRAC, f["partial_tile"], workload=w)
+    reg.gauge_set(M_ROOF_MASKED_FRAC, f["masked_overcompute"], workload=w)
+    if report.measured_tflops is not None:
+        reg.gauge_set(M_ROOF_ACHIEVED, report.measured_tflops, workload=w)
+        reg.gauge_set(M_ROOF_EFFICIENCY, report.efficiency, workload=w)
+    else:
+        # a measurement-less re-record must not leave an earlier run's
+        # achieved/efficiency paired with this run's fresh fractions
+        reg.clear_series(M_ROOF_ACHIEVED, workload=w)
+        reg.clear_series(M_ROOF_EFFICIENCY, workload=w)
+    _marker_event(
+        "roofline",
+        {
+            "workload": w,
+            "rung": f"{report.block_q}x{report.block_k}x{report.head_block}",
+            "mask_density": report.mask_density,
+            "measured_tflops": report.measured_tflops,
+            "efficiency": report.efficiency,
+            "dominant_waste": report.dominant_waste,
+        },
+    )
+
+
 def record_measured_timeline(tl) -> None:
     """One measured stage timeline (``telemetry/timeline.py``): per-stage
     comm/calc wall time next to the solver's prediction, the pipelined
-    vs serial totals, and the achieved overlap efficiency. Stage-labeled
-    families are cleared first — a re-profile at a different degree must
-    not leave stale stage series behind."""
+    vs serial totals, and the achieved overlap efficiency — plus, for
+    hop-scheduled casts, the per-hop ``magi_hop_ms`` attribution.
+    Stage-labeled families are cleared first — a re-profile at a
+    different degree must not leave stale stage series behind."""
     if not _enabled():
         return
     reg = get_registry()
     reg.clear_metric(M_TL_COMM_MS)
     reg.clear_metric(M_TL_CALC_MS)
+    reg.clear_metric(M_HOP_MS)
+    for ht in getattr(tl, "hops", ()):
+        reg.gauge_set(
+            M_HOP_MS, ht.ms, hop=ht.hop, axis=ht.axis, stage=ht.stage
+        )
     for st in tl.stages:
         if st.stage != "host":  # the host stage has no cast by definition
             reg.gauge_set(M_TL_COMM_MS, st.comm_ms, stage=st.stage)
@@ -914,6 +1000,27 @@ def telemetry_summary(snapshot: dict | None = None) -> str:
             f"predicted {fmt(g.get(M_AUTOTUNE_PREDICTED_MS))} ms  "
             f"cache hits/misses: {fmt(hits)}/"
             f"{fmt(c.get(M_AUTOTUNE_CACHE_MISSES, 0))}"
+        )
+    # one line per profiled workload: achieved % of peak + the dead-step
+    # share of the gap (the satellite's headline pair). Keyed on the
+    # peak gauge, which record_roofline ALWAYS sets — a static analysis
+    # (no measurement, so no efficiency gauge) still gets its line
+    roof_keys = [k for k in g if k.startswith(M_ROOF_PEAK + "{")]
+    if g.get(M_ROOF_PEAK) is not None:
+        roof_keys.append(M_ROOF_PEAK)
+    for key in sorted(roof_keys):
+        labels = key[len(M_ROOF_PEAK):]
+        eff = g.get(M_ROOF_EFFICIENCY + labels)
+        achieved = (
+            f"achieved {eff:.1%} of" if eff is not None else "modeled vs"
+        )
+        lines.append(
+            f"  roofline probe{labels or ''}: {achieved} "
+            f"{fmt(g.get(key))} TF/s peak "
+            f"({fmt(g.get(M_ROOF_ACHIEVED + labels))} TF/s), "
+            f"dead-step fraction "
+            f"{fmt(g.get(M_ROOF_DEAD_FRAC + labels))}, "
+            f"density {fmt(g.get(M_ROOF_DENSITY + labels))}"
         )
     if g.get(M_TL_MEASURED_TOTAL_MS) is not None:
         lines.append(

@@ -27,11 +27,31 @@ from collections import deque
 
 
 class EventBuffer:
-    """Ring buffer of span events (host wall-clock, microsecond stamps)."""
+    """Ring buffer of span events (host wall-clock, microsecond stamps).
+
+    Spans land on the recording thread's track by default; ``track=``
+    puts a span on a named synthetic track instead (a small stable tid +
+    a ``thread_name`` metadata event at dump time) — how the per-hop
+    comm timeline gets one Perfetto track per hop instead of burying
+    every measurement on the host thread."""
 
     def __init__(self, maxlen: int = 4096):
         self._lock = threading.Lock()
         self._events: deque[dict] = deque(maxlen=maxlen)
+        # track name -> synthetic tid (small ints, far below real thread
+        # idents, assigned in first-use order — deterministic per run)
+        self._tracks: dict[str, int] = {}
+
+    def _track_tid(self, track: str) -> int:
+        tid = self._tracks.get(track)
+        if tid is None:
+            tid = self._tracks[track] = len(self._tracks) + 1
+        return tid
+
+    def track_names(self) -> dict[int, str]:
+        """Synthetic-track names by tid (for dump-time metadata)."""
+        with self._lock:
+            return {tid: name for name, tid in self._tracks.items()}
 
     def record(
         self,
@@ -39,18 +59,25 @@ class EventBuffer:
         start_s: float,
         duration_s: float,
         attrs: dict | None = None,
+        *,
+        track: str | None = None,
     ) -> None:
-        ev = {
-            "name": name,
-            "ph": "X",  # Chrome trace "complete" event
-            "ts": start_s * 1e6,  # trace format wants microseconds
-            "dur": duration_s * 1e6,
-            "pid": os.getpid(),
-            "tid": threading.get_ident(),
-        }
-        if attrs:
-            ev["args"] = dict(attrs)
         with self._lock:
+            tid = (
+                self._track_tid(track)
+                if track is not None
+                else threading.get_ident()
+            )
+            ev = {
+                "name": name,
+                "ph": "X",  # Chrome trace "complete" event
+                "ts": start_s * 1e6,  # trace format wants microseconds
+                "dur": duration_s * 1e6,
+                "pid": os.getpid(),
+                "tid": tid,
+            }
+            if attrs:
+                ev["args"] = dict(attrs)
             self._events.append(ev)
 
     def events(self) -> list[dict]:
@@ -73,7 +100,10 @@ class EventBuffer:
         a raw number."""
         events = self.events()
         payload = {
-            "traceEvents": trace_metadata_events(events) + events,
+            "traceEvents": trace_metadata_events(
+                events, thread_names=self.track_names()
+            )
+            + events,
             "displayTimeUnit": "ms",
         }
         with open(path, "w") as f:
@@ -85,12 +115,16 @@ class EventBuffer:
 def trace_metadata_events(
     events: list[dict],
     process_name: str | None = None,
+    thread_names: dict[int, str] | None = None,
 ) -> list[dict]:
     """Chrome-trace metadata (phase ``M``) naming every pid/tid seen in
     ``events``: one ``process_name`` per distinct pid, one ``thread_name``
     per distinct (pid, tid). Perfetto then labels the tracks instead of
-    showing raw ids. The cross-rank merge (``telemetry/aggregate.py``)
-    reuses this with a per-rank ``process_name``."""
+    showing raw ids. ``thread_names`` maps tids of synthetic tracks
+    (per-hop comm spans) to their names; unlisted tids keep the generic
+    host-thread label. The cross-rank merge (``telemetry/aggregate.py``)
+    reuses this with a per-rank ``process_name`` and the rank-local
+    thread names it harvested."""
     pids: dict[int, set] = {}
     for ev in events:
         if ev.get("ph") == "M":
@@ -111,13 +145,14 @@ def trace_metadata_events(
             }
         )
         for tid in sorted(pids[pid]):
+            name = (thread_names or {}).get(tid) or f"host thread {tid}"
             meta.append(
                 {
                     "name": "thread_name",
                     "ph": "M",
                     "pid": pid,
                     "tid": tid,
-                    "args": {"name": f"host thread {tid}"},
+                    "args": {"name": name},
                 }
             )
     return meta
@@ -149,13 +184,16 @@ def record_event(
     start_s: float,
     duration_s: float,
     attrs: dict | None = None,
+    *,
+    track: str | None = None,
 ) -> None:
-    """Append one completed span (no-op while telemetry is disabled)."""
+    """Append one completed span (no-op while telemetry is disabled).
+    ``track`` routes it onto a named synthetic Chrome-trace track."""
     from . import enabled
 
     if not enabled():
         return
-    get_event_buffer().record(name, start_s, duration_s, attrs)
+    get_event_buffer().record(name, start_s, duration_s, attrs, track=track)
 
 
 @contextlib.contextmanager

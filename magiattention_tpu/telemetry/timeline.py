@@ -56,6 +56,22 @@ class StageTiming:
 
 
 @dataclasses.dataclass(frozen=True)
+class HopTiming:
+    """Measured cost of ONE hop of a hop-scheduled group cast (or one
+    level of a hierarchical cast), timed as its own jitted program.
+    ``hop`` is the ppermute shift as a string (``"0"`` = the local-copy
+    self hop) or the level name (``"inter"``/``"intra"``) on
+    hierarchical metas; ``axis`` is the mesh axis the hop rides — the
+    label the DCN-aware two-axis pricing (ROADMAP item 3) keys on."""
+
+    stage: str  # "merged" or the remote stage index as a string
+    axis: str  # mesh axis name ("cp"; "dcn"/"ici" on hier meshes)
+    hop: str
+    rows: int  # padded payload rows per rank this hop ships
+    ms: float
+
+
+@dataclasses.dataclass(frozen=True)
 class MeasuredTimeline:
     """One profiled plan: per-stage measurements plus the aggregate
     pipelined/serial/predicted comparison."""
@@ -69,6 +85,9 @@ class MeasuredTimeline:
     overlap_efficiency: float  # hidden / hideable, clamped to [0, 1]
     predicted_total_ms: float | None  # simulate_overlap_timeline model
     prediction_error_ratio: float | None  # measured_total / predicted
+    # per-hop attribution of hop-scheduled / hierarchical casts (empty
+    # for pure a2a plans): each hop timed as its own program
+    hops: tuple[HopTiming, ...] = ()
 
     def report(self) -> str:
         """Human-readable predicted-vs-measured table (the overlap
@@ -109,6 +128,26 @@ class MeasuredTimeline:
                 f"{self.prediction_error_ratio:.2f}x "
                 "(>1: hardware slower than the model priced)"
             )
+        if self.hops:
+            lines.append("  per-hop cast attribution:")
+            by_stage: dict[str, float] = {}
+            for h in self.hops:
+                lines.append(
+                    f"    stage {h.stage:<7} axis={h.axis} hop {h.hop}: "
+                    f"{h.ms:.3f} ms ({h.rows} rows/rank)"
+                )
+                by_stage[h.stage] = by_stage.get(h.stage, 0.0) + h.ms
+            cast_by_stage = {
+                st.stage: st.comm_ms for st in self.stages if st.comm_ms
+            }
+            for stage, total in by_stage.items():
+                cast = cast_by_stage.get(stage)
+                if cast:
+                    lines.append(
+                        f"    stage {stage:<7} hop sum {total:.3f} ms vs "
+                        f"whole cast {cast:.3f} ms (per-hop programs "
+                        "re-pay dispatch overhead)"
+                    )
         return "\n".join(lines)
 
 
@@ -214,7 +253,7 @@ def profile_plan_timeline(
 
     from .. import env
     from ..benchmarking.bench import do_bench
-    from ..comm.group_collective import group_cast_m
+    from ..comm.group_collective import group_cast, group_cast_m, hop_cast
     from ..comm.hier import group_cast_hier
     from ..ops.correction import correct_attn_out_lse
     from ..parallel.dist_attn import (
@@ -327,6 +366,116 @@ def profile_plan_timeline(
     def t_ms(fn, *args):
         return do_bench(fn, *args, **bench_kw).median_ms
 
+    # ---- per-hop comm attribution ----------------------------------------
+    # Each hop of a hop-scheduled cast (and each level of a hierarchical
+    # one) re-traced as its OWN jitted program and timed with the same
+    # do_bench discipline, so the stage cast time decomposes per hop /
+    # per axis — spans land on per-hop Chrome-trace tracks and the
+    # magi_hop_ms{hop=,axis=,stage=} gauges carry the numbers the
+    # DCN-aware hop pricing (ROADMAP item 3) will calibrate against.
+    import time as _time
+
+    from .events import record_event
+
+    hop_timings: list[HopTiming] = []
+
+    # each probe is hop_cast itself with a ONE-hop list — the exact body
+    # (recv layout, named scope, chaos straggler branch) the real cast
+    # runs, so a slow or chaos-straggled hop shows up in ITS gauge
+    def _one_hop_fn(comm, h):
+        def body(k_, v_, sidx, rpos, _h=h):
+            return hop_cast(
+                jnp.stack([k_, v_], axis=1),
+                [_h],
+                (sidx, rpos),
+                comm.max_recv,
+                axis_name=axis_name,
+                world=comm.cp_size,
+            )
+
+        return smap(4, body)
+
+    def _one_intra_hop_fn(comm, h, intra_name):
+        def body(gw_, sidx, rpos, _h=h):
+            return hop_cast(
+                gw_,
+                [_h],
+                (sidx, rpos),
+                comm.max_recv,
+                axis_name=intra_name,
+                world=comm.n_intra,
+            )
+
+        return smap(3, body)
+
+    def time_hops(comm, stage_label):
+        # (hop label, axis label, rows/rank, fn, args) per timed program
+        pieces = []
+        if plan.hier is not None:
+            inter_name, intra_name = axis_name
+            arrays = comm.cast_device_arrays()
+            inter_args = put(arrays[:3])
+
+            def inter_body(k_, v_, sidx, rsel, rval):
+                return group_cast(
+                    jnp.stack([k_, v_], axis=1), sidx, rsel, rval,
+                    axis_name=inter_name,
+                )
+
+            inter_fn = smap(5, inter_body)
+            gw = inter_fn(k, v, *inter_args)
+            pieces.append(
+                ("inter", inter_name,
+                 comm.n_inter * int(comm.inter_send_idx.shape[2]),
+                 inter_fn, (k, v) + inter_args)
+            )
+            if comm.impl == "hops":
+                for j, h in enumerate(comm.intra_hops):
+                    hop_args = put(arrays[3 + 2 * j : 5 + 2 * j])
+                    pieces.append(
+                        (str(h.shift), intra_name, h.size,
+                         _one_intra_hop_fn(comm, h, intra_name),
+                         (gw,) + hop_args)
+                    )
+            else:
+                intra_args = put(arrays[3:6])
+
+                def intra_body(gw_, sidx, rsel, rval):
+                    return group_cast(
+                        gw_, sidx, rsel, rval, axis_name=intra_name
+                    )
+
+                pieces.append(
+                    ("intra", intra_name,
+                     comm.n_intra * int(comm.intra_send_idx.shape[2]),
+                     smap(4, intra_body), (gw,) + intra_args)
+                )
+        elif comm.impl == "hops":
+            for h in comm.hops:
+                hop_args = put((h.send_idx, h.recv_pos))
+                pieces.append(
+                    (str(h.shift), str(axis_name), h.size,
+                     _one_hop_fn(comm, h), (k, v) + hop_args)
+                )
+        for hop_label, ax, rows, fn, args in pieces:
+            t0 = _time.perf_counter()
+            ms = t_ms(fn, *args)
+            if record:  # record=False must leave the ring buffer alone
+                record_event(
+                    "hop_cast",
+                    t0,
+                    ms * 1e-3,
+                    {"stage": stage_label, "hop": hop_label, "axis": ax,
+                     "rows_per_rank": rows, "ms": ms},
+                    track=f"hop {hop_label} ({ax})",
+                )
+            hop_timings.append(
+                HopTiming(
+                    stage=stage_label, axis=ax, hop=hop_label,
+                    rows=rows, ms=ms,
+                )
+            )
+
     predicted = _predicted_costs(
         plan,
         num_heads_q=hq,
@@ -373,6 +522,7 @@ def profile_plan_timeline(
         calc_fn = smap(4 + 9, merged_body, n_out=2)
         recv = cast_fn(k, v, *comm_args)
         comm_ms = t_ms(cast_fn, k, v, *comm_args)
+        time_hops(plan.merged_comm, "merged")
         calc_ms = t_ms(calc_fn, q, k, v, recv, *tabs)
         stages.append(
             StageTiming(
@@ -430,6 +580,7 @@ def profile_plan_timeline(
             calc_fn = smap(4 + 9, stage_body, n_out=2)
             recv = cast_fn(k, v, *comm_args)
             comm_ms = t_ms(cast_fn, k, v, *comm_args)
+            time_hops(sp.comm, str(i))
             calc_ms = t_ms(calc_fn, q, acc_out, acc_lse, recv, *tabs)
             acc_out, acc_lse = calc_fn(q, acc_out, acc_lse, recv, *tabs)
             stages.append(
@@ -478,6 +629,7 @@ def profile_plan_timeline(
             if predicted_total_ms
             else None
         ),
+        hops=tuple(hop_timings),
     )
     if record:
         from .collectors import record_measured_timeline

@@ -143,6 +143,28 @@ _ENTRY_MEMO: dict = {}
 _ENTRY_MEMO_CAP = 4096
 
 
+def slice_block_k_spans(
+    q0: int, q1: int, k0: int, k1: int, mt: int, block_q: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Per-q-block attended k-intervals of ONE slice: (q_block_idx,
+    row_lo, row_hi, k_lo, k_hi) vectors, mask-type-aware — the same
+    affine spans ``block_meta._slice_k_span`` emits. Blocks whose span is
+    empty have ``k_hi <= k_lo``. THE single counting primitive shared by
+    the autotuner's entry estimator and the roofline/occupancy profiler
+    (``telemetry/roofline.py``, ``telemetry/occupancy.py``) so the two
+    can never disagree about what the kernel schedules."""
+    idx = np.arange(q0 // block_q, _cdiv(q1, block_q), dtype=np.int64)
+    lo = np.maximum(q0, idx * block_q)  # first row (inclusive)
+    hi = np.minimum(q1, (idx + 1) * block_q)  # last row (exclusive)
+    k_lo = np.full(idx.shape, k0, dtype=np.int64)
+    k_hi = np.full(idx.shape, k1, dtype=np.int64)
+    if mt & 1:  # causal: k - ke <= q - qe
+        k_hi = np.minimum(k_hi, k1 - q1 + hi)
+    if mt & 2:  # inv-causal: k - ks >= q - qs
+        k_lo = np.maximum(k_lo, k0 + (lo - q0))
+    return idx, lo, hi, k_lo, k_hi
+
+
 def _estimate_entries_impl(
     q: np.ndarray, k: np.ndarray, t: np.ndarray, block_q: int, block_k: int
 ) -> tuple[int, int, int]:
@@ -152,15 +174,9 @@ def _estimate_entries_impl(
     for (q0, q1), (k0, k1), mt in zip(q.tolist(), k.tolist(), t.tolist()):
         if q1 <= q0 or k1 <= k0:
             continue
-        idx = np.arange(q0 // block_q, _cdiv(q1, block_q), dtype=np.int64)
-        lo = np.maximum(q0, idx * block_q)  # first row (inclusive)
-        hi = np.minimum(q1, (idx + 1) * block_q)  # last row (exclusive)
-        k_lo = np.full(idx.shape, k0, dtype=np.int64)
-        k_hi = np.full(idx.shape, k1, dtype=np.int64)
-        if mt & 1:  # causal: k - ke <= q - qe
-            k_hi = np.minimum(k_hi, k1 - q1 + hi)
-        if mt & 2:  # inv-causal: k - ks >= q - qs
-            k_lo = np.maximum(k_lo, k0 + (lo - q0))
+        idx, _, _, k_lo, k_hi = slice_block_k_spans(
+            q0, q1, k0, k1, mt, block_q
+        )
         covered = k_hi > k_lo
         nkb = np.where(
             covered,
@@ -172,6 +188,37 @@ def _estimate_entries_impl(
     entries = int(per_block.sum()) + dummies
     steps = max(int(per_block.max()) if per_block.size else 0, 1)
     return entries, steps, nq
+
+
+def exact_mask_area(q_ranges, k_ranges, attn_type_map) -> int:
+    """EXACT valid-entry count of the mask (row-wise, vectorized numpy —
+    O(total q rows) per slice, host planning scale). This is the area the
+    true-FLOPs side of the roofline divides by; memoized on the canonical
+    slice digest like the entry counts (the profiler and the bench
+    density field hit the same workloads repeatedly).
+
+    Summed PER SLICE — the kernel's own work convention (every slice's
+    entries run through the softmax; the runtime rejects masks whose
+    slices overlap in (q, k) coverage, see MAGI_ATTENTION_SANITY_CHECK),
+    matching how plan ``total_area`` counts."""
+    q, k, t = _normalize_slices(q_ranges, k_ranges, attn_type_map)
+    key = ("area", slices_digest(q, k, t))
+    hit = _ENTRY_MEMO.get(key)
+    if hit is None:
+        total = 0
+        for (q0, q1), (k0, k1), mt in zip(q.tolist(), k.tolist(), t.tolist()):
+            rows = np.arange(q0, q1, dtype=np.int64)
+            r_lo = np.full(rows.shape, k0, dtype=np.int64)
+            r_hi = np.full(rows.shape, k1, dtype=np.int64)
+            if mt & 1:  # causal: k - ke <= q - qe  (row-exact: hi row+1)
+                r_hi = np.minimum(r_hi, k1 - q1 + rows + 1)
+            if mt & 2:  # inv-causal: k - ks >= q - qs
+                r_lo = np.maximum(r_lo, k0 + (rows - q0))
+            total += int(np.maximum(r_hi - r_lo, 0).sum())
+        if len(_ENTRY_MEMO) >= _ENTRY_MEMO_CAP:
+            _ENTRY_MEMO.clear()
+        _ENTRY_MEMO[key] = hit = total
+    return hit
 
 
 def smem_feasible(

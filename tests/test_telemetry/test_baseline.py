@@ -327,3 +327,58 @@ def test_repo_seeded_gate_passes():
         pytest.skip("no committed bench history in this checkout")
     p = _run_gate("--self-test")
     assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_history_entry_carries_mask_density_and_efficiency():
+    """ISSUE 10 satellite: mask density + roofline efficiency ride every
+    entry as per-metric CONTEXT (like autotune_rung) — next to, never
+    inside, the gated metrics."""
+    entry = baseline.make_history_entry(
+        source="t",
+        metrics={"flex_attn_fwd_tflops_x": 10.0},
+        mask_density={"flex_attn_fwd_tflops_x": 0.07},
+        roofline_efficiency={"flex_attn_fwd_tflops_x": 0.051},
+    )
+    assert entry["mask_density"] == {"flex_attn_fwd_tflops_x": 0.07}
+    assert entry["roofline_efficiency"] == {"flex_attn_fwd_tflops_x": 0.051}
+    assert "mask_density" not in entry["metrics"]
+    # omitted/empty maps leave the entry schema unchanged
+    bare = baseline.make_history_entry(source="t", metrics={}, mask_density={})
+    assert "mask_density" not in bare and "roofline_efficiency" not in bare
+
+
+def test_density_changes_flags_workload_story():
+    hist = [
+        {"source": "r1", "metrics": {"m": 10.0},
+         "mask_density": {"m": 0.070, "n": 0.5}},
+        {"source": "r2", "metrics": {"m": 10.1},
+         "mask_density": {"m": 0.0701}},  # within float-noise rtol
+        {"source": "r3", "metrics": {"m": 4.0},
+         "mask_density": {"m": 0.21, "n": 0.5}},  # the workload changed
+    ]
+    flags = baseline.density_changes(hist)
+    assert len(flags) == 1
+    assert "mask density of m changed" in flags[0]
+    assert "workload story" in flags[0]
+    # entries without the field (older history) never flag or crash
+    assert baseline.density_changes([{"metrics": {}}, hist[0]]) == []
+
+
+def test_density_changes_skips_malformed_values():
+    hist = [
+        {"source": "a", "mask_density": {"m": "not-a-number"}},
+        {"source": "b", "mask_density": {"m": 0.3}},
+        {"source": "c", "mask_density": {"m": 0.6}},
+    ]
+    flags = baseline.density_changes(hist)
+    assert len(flags) == 1 and "0.3 -> 0.6" in flags[0]
+
+
+def test_newest_metric_value_walks_past_entries_without_it():
+    hist = [
+        {"source": "old", "metrics": {"m": 7.0}},
+        {"source": "newer", "metrics": {"other": 1.0}},
+    ]
+    assert baseline.newest_metric_value(hist, "m") == (7.0, "old")
+    assert baseline.newest_metric_value(hist, "other") == (1.0, "newer")
+    assert baseline.newest_metric_value(hist, "absent") == (None, None)

@@ -1,0 +1,227 @@
+"""Per-hop comm attribution (telemetry/timeline.py + events/aggregate):
+each hop of a hop-scheduled cast timed as its own program, gauged as
+magi_hop_ms{hop=,axis=,stage=}, stamped on its own Chrome-trace track —
+and the multi-rank merge keeping one distinctly-named track per
+rank x hop. Runs the jnp kernel backend on the virtual CPU mesh."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.common import AttnMaskType, AttnRanges
+from magiattention_tpu.meta import make_dispatch_meta_from_qk_ranges
+from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+from magiattention_tpu.parallel import build_dist_attn_plan, make_attn_params
+from magiattention_tpu.telemetry.events import trace_metadata_events
+from magiattention_tpu.telemetry.registry import estimate_percentiles
+
+
+@pytest.fixture(autouse=True)
+def _env(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    monkeypatch.setenv("MAGI_ATTENTION_GROUP_COLL_IMPL", "hops")
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    yield
+    telemetry.set_enabled(None)
+    telemetry.reset()
+
+
+def _hops_plan(total=1024, cp=2):
+    chunk = total // (4 * cp)
+    qr = AttnRanges.from_ranges([(0, total)])
+    kr = AttnRanges.from_ranges([(0, total)])
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, [AttnMaskType.CAUSAL], total, total,
+        chunk_size=chunk, cp_size=cp,
+    )
+    return build_dist_attn_plan(
+        mq, bucket, block_q=64, block_k=64,
+        overlap_config=OverlapConfig(degree=0),
+    )
+
+
+@pytest.fixture(scope="module")
+def profiled():
+    """One profiled hops-impl plan shared by the assertions below (the
+    profile itself is the expensive part)."""
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    import os
+
+    prev = os.environ.get("MAGI_ATTENTION_GROUP_COLL_IMPL")
+    prev_backend = os.environ.get("MAGI_ATTENTION_KERNEL_BACKEND")
+    os.environ["MAGI_ATTENTION_GROUP_COLL_IMPL"] = "hops"
+    os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] = "jnp"
+    try:
+        plan = _hops_plan()
+        assert plan.merged_comm.impl == "hops" and plan.merged_comm.hops
+        mesh = Mesh(np.array(jax.devices()[:2]), ("cp",))
+        params = make_attn_params(plan, 64, out_dtype="float32")
+        tl = telemetry.profile_plan_timeline(
+            plan, mesh, params, num_heads=(4, 2), head_dim=64,
+            reps=1, inner=1,
+        )
+        snap = telemetry.snapshot()
+        events = telemetry.get_event_buffer()
+        trace = {
+            "traceEvents": trace_metadata_events(
+                events.events(), thread_names=events.track_names()
+            )
+            + events.events()
+        }
+        yield plan, tl, snap, trace
+    finally:
+        for var, old in (
+            ("MAGI_ATTENTION_GROUP_COLL_IMPL", prev),
+            ("MAGI_ATTENTION_KERNEL_BACKEND", prev_backend),
+        ):
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
+def test_hops_timed_and_gauged(profiled):
+    plan, tl, snap, _ = profiled
+    comm = plan.merged_comm
+    assert len(tl.hops) == len(comm.hops)
+    by_hop = {h.hop: h for h in tl.hops}
+    for hp in comm.hops:
+        ht = by_hop[str(hp.shift)]
+        assert ht.axis == "cp" and ht.stage == "merged"
+        assert ht.rows == hp.size and ht.ms > 0
+    gauges = {
+        k: v for k, v in snap["gauges"].items()
+        if k.startswith("magi_hop_ms{")
+    }
+    assert len(gauges) == len(comm.hops)
+    for key in gauges:
+        assert "hop=" in key and "axis=cp" in key and "stage=merged" in key
+    # per-hop sum lands in the same regime as the fused cast (each hop
+    # program re-pays dispatch overhead, so a generous band)
+    cast_ms = tl.stages[0].comm_ms
+    ratio = sum(h.ms for h in tl.hops) / max(cast_ms, 1e-9)
+    assert 0.1 <= ratio <= 20.0, (ratio, cast_ms, tl.hops)
+
+
+def test_report_carries_hop_lines(profiled):
+    _, tl, _, _ = profiled
+    text = tl.report()
+    assert "per-hop cast attribution:" in text
+    for h in tl.hops:
+        assert f"hop {h.hop}:" in text
+    assert "hop sum" in text
+
+
+def test_hop_spans_get_distinct_tracks(profiled):
+    _, tl, _, trace = profiled
+    tnames = [
+        e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "thread_name"
+    ]
+    hop_tracks = [n for n in tnames if n.startswith("hop ")]
+    assert sorted(hop_tracks) == sorted(
+        {f"hop {h.hop} ({h.axis})" for h in tl.hops}
+    )
+    # distinct synthetic tids per track
+    tids = {
+        e["tid"]
+        for e in trace["traceEvents"]
+        if e.get("ph") == "X" and e["name"] == "hop_cast"
+    }
+    assert len(tids) == len(hop_tracks)
+
+
+def test_merge_keeps_one_track_per_rank_and_hop(profiled):
+    _, tl, _, trace = profiled
+    tr = json.loads(json.dumps(trace))  # simulate two archived ranks
+    merged = telemetry.merge_chrome_traces([tr, tr], labels=["r0", "r1"])
+    named = [
+        (e["pid"], e["args"]["name"])
+        for e in merged["traceEvents"]
+        if e.get("ph") == "M"
+        and e["name"] == "thread_name"
+        and e["args"]["name"].startswith("hop ")
+    ]
+    # one distinctly-named hop track per rank x hop, no collisions
+    assert len(named) == len(set(named)) == 2 * len(tl.hops)
+    assert {pid for pid, _ in named} == {0, 1}
+    for h in tl.hops:
+        assert sum(
+            1 for _, n in named if n == f"hop {h.hop} ({h.axis})"
+        ) == 2
+
+
+def test_estimate_percentiles_survives_single_event_histograms():
+    """A one-sample histogram (a single timed hop observed once) must
+    report that sample for every percentile, not interpolate into a
+    bucket edge or divide by zero."""
+    bounds = (1e-5, 1e-4, 1e-3, 1e-2)
+    counts = [0, 0, 1, 0, 0]
+    p50, p95, p99 = estimate_percentiles(bounds, counts, 1, 3e-4, 3e-4)
+    assert p50 == p95 == p99 == pytest.approx(3e-4)
+    # and an empty histogram stays None, never a crash
+    assert estimate_percentiles(bounds, [0] * 5, 0, float("inf"),
+                                float("-inf")) == [None, None, None]
+
+
+def test_a2a_plan_has_no_hop_timings(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_GROUP_COLL_IMPL", "a2a")
+    plan = _hops_plan(total=512, cp=2)
+    assert plan.merged_comm.impl == "a2a"
+    mesh = Mesh(np.array(jax.devices()[:2]), ("cp",))
+    params = make_attn_params(plan, 32, out_dtype="float32")
+    tl = telemetry.profile_plan_timeline(
+        plan, mesh, params, num_heads=(2, 2), head_dim=32,
+        reps=1, inner=1,
+    )
+    assert tl.hops == ()
+    assert not any(
+        k.startswith("magi_hop_ms")
+        for k in telemetry.snapshot()["gauges"]
+    )
+
+
+def test_hier_levels_labeled_inter_and_intra(monkeypatch):
+    """Hierarchical meshes: the inter a2a level and each intra hop get
+    their own timing, labeled with the axis they ride (the label the
+    DCN-aware two-axis pricing keys on)."""
+    total, cp = 1024, 4
+    qr = AttnRanges.from_ranges([(0, total)])
+    kr = AttnRanges.from_ranges([(0, total)])
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, [AttnMaskType.CAUSAL], total, total,
+        chunk_size=64, cp_size=cp,
+    )
+    plan = build_dist_attn_plan(
+        mq, bucket, block_q=64, block_k=64,
+        overlap_config=OverlapConfig(degree=0), cp_mesh_shape=(2, 2),
+    )
+    assert plan.hier == (2, 2) and plan.merged_comm.impl == "hops"
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dcn", "ici"))
+    params = make_attn_params(plan, 64, out_dtype="float32")
+    tl = telemetry.profile_plan_timeline(
+        plan, mesh, params, axis_name=("dcn", "ici"),
+        num_heads=(4, 2), head_dim=64, reps=1, inner=1,
+    )
+    by_axis = {}
+    for h in tl.hops:
+        by_axis.setdefault(h.axis, []).append(h.hop)
+    assert by_axis["dcn"] == ["inter"]
+    assert sorted(by_axis["ici"]) == sorted(
+        str(h.shift) for h in plan.merged_comm.intra_hops
+    )
+    gauges = [
+        k for k in telemetry.snapshot()["gauges"]
+        if k.startswith("magi_hop_ms{")
+    ]
+    assert any("axis=dcn,hop=inter" in k for k in gauges)
+    assert any("axis=ici" in k for k in gauges)

@@ -1,0 +1,124 @@
+"""Block-occupancy maps (telemetry/occupancy.py): the per-q-block
+active-k-block lists must equal a brute-force dense-mask block scan
+(they are the future block-sparse kernel's input), export losslessly as
+JSON, and memoize on the canonical slice digest."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.telemetry.occupancy import (
+    BlockOccupancyMap,
+    block_occupancy_map,
+)
+from magiattention_tpu.testing.ref_attn import make_attn_mask_from_ranges
+from magiattention_tpu.testing.workloads import varlen_block_causal
+
+
+def _brute_force(qr, kr, ts, total, bq, bk):
+    mask = np.asarray(make_attn_mask_from_ranges(qr, kr, ts, total, total))
+    extent_q = max(b for _, b in qr)
+    extent_k = max(d for _, d in kr)
+    nq = max(-(-extent_q // bq), 1)
+    nk = max(-(-extent_k // bk), 1)
+    return tuple(
+        tuple(
+            j
+            for j in range(nk)
+            if mask[i * bq : (i + 1) * bq, j * bk : (j + 1) * bk].any()
+        )
+        for i in range(nq)
+    )
+
+
+def _random_slices(seed, total):
+    rng = np.random.default_rng(seed)
+    qr, kr, ts = [], [], []
+    for _ in range(int(rng.integers(1, 7))):
+        a, b = sorted(rng.integers(0, total, 2).tolist())
+        c, d = sorted(rng.integers(0, total, 2).tolist())
+        if a < b and c < d:
+            qr.append((a, b))
+            kr.append((c, d))
+            ts.append(int(rng.choice([0, 1, 2])))
+    return qr, kr, ts
+
+
+@pytest.mark.parametrize("seed", [0, 3, 11])
+@pytest.mark.parametrize("bq,bk", [(32, 32), (64, 16)])
+def test_matches_brute_force_block_scan(seed, bq, bk):
+    total = 256
+    qr, kr, ts = _random_slices(seed, total)
+    if not qr:
+        pytest.skip("degenerate draw")
+    m = block_occupancy_map(qr, kr, ts, bq, bk)
+    assert m.active == _brute_force(qr, kr, ts, total, bq, bk)
+
+
+def test_varlen_block_causal_structure():
+    total, bq, bk = 512, 64, 64
+    sl = varlen_block_causal(total, n_docs=4)
+    qr = [(a, b) for a, b, *_ in sl]
+    kr = [(s[2], s[3]) for s in sl]
+    ts = [s[4] for s in sl]
+    m = block_occupancy_map(qr, kr, ts, bq, bk)
+    assert m.active == _brute_force(qr, kr, ts, total, bq, bk)
+    # block-diagonal-ish: never denser than dense causal
+    assert 0.0 < m.block_density <= 0.75
+    counts = m.row_counts()
+    assert counts.sum() == m.active_blocks_total
+    hist = m.density_histogram()
+    assert sum(hist["counts"]) == m.num_q_blocks
+
+
+def test_memoized_on_slice_digest():
+    qr, kr, ts = [(0, 128)], [(0, 128)], [1]
+    a = block_occupancy_map(qr, kr, ts, 32, 32)
+    b = block_occupancy_map(list(qr), list(kr), list(ts), 32, 32)
+    assert a is b  # digest-keyed memo hit, not a recompute
+    c = block_occupancy_map(qr, kr, ts, 32, 16)
+    assert c is not a
+
+
+def test_json_round_trip_and_dump(tmp_path):
+    qr, kr, ts = [(0, 100), (100, 180)], [(0, 100), (40, 180)], [1, 0]
+    m = block_occupancy_map(qr, kr, ts, 32, 32)
+    payload = m.as_json()
+    # the artifact shape the block-sparse grid consumes
+    assert isinstance(payload["active_k_blocks"], list)
+    assert len(payload["active_k_blocks"]) == m.num_q_blocks
+    assert BlockOccupancyMap.from_json(payload).active == m.active
+    path = m.dump(str(tmp_path / "occ.json"))
+    assert BlockOccupancyMap.load(path).active == m.active
+
+
+def test_dead_q_blocks_and_widened_k_grid():
+    # q rows 64..128 attend nothing -> one dead q-block
+    m = block_occupancy_map([(0, 64)], [(0, 64)], [0], 64, 64,
+                            num_k_blocks=4)
+    assert m.num_k_blocks == 4
+    assert m.active == ((0,),)
+    m2 = block_occupancy_map([(64, 128)], [(64, 128)], [0], 64, 64)
+    assert m2.num_q_blocks == 2 and m2.dead_q_blocks == 1
+    assert m2.active[0] == ()
+
+
+def test_ascii_heatmap_renders():
+    sl = varlen_block_causal(512, n_docs=4)
+    m = block_occupancy_map(
+        [(a, b) for a, b, *_ in sl],
+        [(s[2], s[3]) for s in sl],
+        [s[4] for s in sl],
+        64,
+        64,
+    )
+    art = m.ascii_heatmap(max_rows=8, max_cols=16)
+    lines = art.splitlines()
+    assert "block occupancy" in lines[0]
+    assert len(lines) == 1 + min(m.num_q_blocks, 8)
+    assert all(ln.startswith("  |") and ln.endswith("|") for ln in lines[1:])
+
+
+def test_narrow_num_k_blocks_rejected():
+    with pytest.raises(ValueError, match="narrower"):
+        block_occupancy_map([(0, 256)], [(0, 256)], [0], 64, 64,
+                            num_k_blocks=2)
