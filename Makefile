@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze resilience-check roofline-check roofline-report check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze resilience-check roofline-check roofline-report trace-check check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -130,6 +130,18 @@ resilience-check:
 roofline-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_roofline_check.py --self-test
 
+# request-tracing & exposition gate (ISSUE 11, CPU): a multi-tenant
+# scheduler trace must reconstruct to complete, monotonically ordered
+# per-request span trees whose derived stats reconcile EXACTLY with the
+# SLO histograms, export as a valid one-track-per-request Chrome trace
+# + JSONL, mark ring-truncated traces partial (dropped-span counter),
+# dump the flight recorder (incl. the faulting tick) on an injected
+# MAGI_ATTENTION_CHAOS prefill fault, and render a Prometheus exposition
+# that parses and covers every REQUIRED_* metric catalog
+# (exps/run_trace_check.py exits non-zero on any violation)
+trace-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_trace_check.py
+
 # mask-aware roofline report + occupancy JSON artifact for the 16k
 # varlen block-causal headline (docs/observability.md "Roofline &
 # occupancy"); host-side only
@@ -139,5 +151,6 @@ roofline-report:
 # the default check flow: syntax, static analysis, telemetry catalog +
 # timeline/aggregate semantics, autotuner rung expectations, perf gate,
 # serving parity, shared-prefix/scheduler gate, group-collective
-# parity/volume, resilience gate, roofline/occupancy gate — all CPU-safe
-check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check
+# parity/volume, resilience gate, roofline/occupancy gate, request
+# tracing/exposition gate — all CPU-safe
+check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check

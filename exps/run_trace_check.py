@@ -1,0 +1,502 @@
+"""Request-tracing & exposition drift guard (``make trace-check``) — CPU.
+
+The ISSUE 11 acceptance surface, device-free, on a multi-tenant
+scheduler trace (shared system prompt + chunked long prompt + a
+priority eviction):
+
+1. **complete span trees**: every submitted request reconstructs to a
+   complete (non-partial) span tree with gap-free, monotonically
+   ordered spans — zero ring drops at the default ring size;
+2. **no drift**: the per-request derived stats (queue wait, TTFT,
+   inter-token samples) reconcile EXACTLY with the SLO histogram
+   aggregates — the span helpers and the histograms are fed the same
+   floats;
+3. **valid exports**: the one-track-per-request Chrome trace and the
+   JSONL export round-trip through json;
+4. **truncation is detectable**: a deliberately tiny ring drops spans,
+   ticks ``magi_trace_events_dropped_total``, and the reconstructed
+   tree is marked partial instead of complete;
+5. **chaos-triggered flight dump**: an injected ``MAGI_ATTENTION_CHAOS``
+   prefill fault mid-trace arms the flight recorder; the scheduler's
+   tick loop records the aborted tick and the dump written to
+   ``MAGI_ATTENTION_TRACE_DIR`` contains it;
+6. **exposition**: ``render_prometheus`` output parses under a strict
+   line grammar, covers every ``REQUIRED_*`` metric catalog, is served
+   verbatim by the scrape thread, and ``snapshot_delta`` turns counters
+   into rates.
+
+Exits non-zero on any violation.
+"""
+
+import json
+import math
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] = "jnp"
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from magiattention_tpu import telemetry  # noqa: E402
+from magiattention_tpu.serving import (  # noqa: E402
+    Request,
+    Scheduler,
+    ServingEngine,
+)
+from magiattention_tpu.telemetry import exposition, trace  # noqa: E402
+from magiattention_tpu.telemetry.events import EventBuffer  # noqa: E402
+
+HQ, HK, D, PS = 4, 2, 16, 8
+VOCAB = 89
+
+_rng = np.random.default_rng(0)
+EMB_K = _rng.standard_normal((VOCAB, HK, D)).astype(np.float32)
+EMB_V = _rng.standard_normal((VOCAB, HK, D)).astype(np.float32)
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _req(rng, rid, tokens, gen, priority=0, with_tokens=True):
+    idx = np.asarray(tokens, np.int64)
+    return Request(
+        rid=rid,
+        prompt_q=jnp.asarray(
+            rng.standard_normal((len(tokens), HQ, D)), jnp.float32
+        ),
+        prompt_k=jnp.asarray(EMB_K[idx]),
+        prompt_v=jnp.asarray(EMB_V[idx]),
+        decode_q=jnp.asarray(rng.standard_normal((gen, HQ, D)), jnp.float32),
+        decode_k=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        decode_v=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        tokens=list(tokens) if with_tokens else None,
+        priority=priority,
+    )
+
+
+def run_multi_tenant_trace() -> tuple[int, dict]:
+    """Drive the multi-tenant scenario; returns (rc, traces)."""
+    rng = np.random.default_rng(1)
+    eng = ServingEngine(
+        num_pages=96, num_kv_heads=HK, head_dim=D, page_size=PS,
+        max_seqs=8, max_pages_per_seq=16, dtype=jnp.float32,
+    )
+    sched = Scheduler(eng, token_budget=24, chunk=PS)
+    sysp = [int(t) for t in rng.integers(0, VOCAB, 2 * PS)]
+    submitted = []
+    # tenant 0 registers the system prompt; tenants 1-2 fork it
+    submitted.append(sched.submit(_req(rng, 0, sysp, gen=4)))
+    for _ in range(4):
+        sched.step()
+    for i in (1, 2):
+        toks = sysp + [int(t) for t in rng.integers(0, VOCAB, 5)]
+        submitted.append(sched.submit(_req(rng, i, toks, gen=3)))
+    # a long prompt that must drain in chunks under the budget
+    submitted.append(
+        sched.submit(
+            _req(rng, 3, [int(t) for t in rng.integers(0, VOCAB, 5 * PS)],
+                 gen=2, with_tokens=False)
+        )
+    )
+    sched.run()
+    # a priority eviction: one resident slot, low prio then high prio
+    eng2 = ServingEngine(
+        num_pages=16, num_kv_heads=HK, head_dim=D, page_size=PS,
+        max_seqs=1, max_pages_per_seq=8, dtype=jnp.float32,
+        prefix_sharing=False,
+    )
+    sched2 = Scheduler(eng2, token_budget=32, chunk=None)
+    submitted.append(
+        sched2.submit(
+            _req(rng, 10, list(rng.integers(0, VOCAB, 2 * PS)), gen=3,
+                 priority=0, with_tokens=False)
+        )
+    )
+    sched2.step()
+    sched2.step()  # rid 10 decodes its first token
+    submitted.append(
+        sched2.submit(
+            _req(rng, 11, list(rng.integers(0, VOCAB, 2 * PS)), gen=1,
+                 priority=5, with_tokens=False)
+        )
+    )
+    sched2.run()
+
+    buf = telemetry.get_event_buffer()
+    if buf.dropped:
+        return fail(
+            f"default ring dropped {buf.dropped} spans on the check "
+            "trace — ring too small for the acceptance scenario"
+        ), {}
+    traces = telemetry.export_request_traces()
+    by_rid = {tr.rid: tr for tr in traces.values()}
+    want_rids = {st.rid for st in submitted}
+    if set(by_rid) != want_rids:
+        return fail(
+            f"expected traces for rids {sorted(want_rids)}, got "
+            f"{sorted(by_rid)}"
+        ), {}
+    for tr in traces.values():
+        if tr.partial or not tr.complete:
+            return fail(
+                f"trace {tr.trace_id} (rid {tr.rid}) partial={tr.partial} "
+                f"complete={tr.complete} — expected a complete tree"
+            ), {}
+        seqs = [s["seq"] for s in tr.spans]
+        if seqs != list(range(len(seqs))):
+            return fail(f"rid {tr.rid}: seq gap {seqs}"), {}
+        ts = [s["ts"] for s in tr.spans]
+        if any(b < a - 1e-9 for a, b in zip(ts, ts[1:])):
+            return fail(f"rid {tr.rid}: span timestamps not monotonic"), {}
+        if tr.spans[0]["kind"] != "submit":
+            return fail(f"rid {tr.rid}: tree does not start at submit"), {}
+    # workload-shape spot checks: the scenario really exercised the paths
+    if by_rid[3].stats["prefill_chunks"] < 3:
+        return fail(
+            f"long prompt ran {by_rid[3].stats['prefill_chunks']} chunks — "
+            "chunking did not engage"
+        ), {}
+    if by_rid[10].stats["evictions"] != 1:
+        return fail("rid 10 was not priority-evicted"), {}
+    if by_rid[1].stats["prefix_hit_tokens"] != 2 * PS:
+        return fail(
+            f"rid 1 prefix_hit_tokens {by_rid[1].stats['prefix_hit_tokens']}"
+            f" != {2 * PS}"
+        ), {}
+    print(
+        f"trace-check: {len(traces)} complete span trees "
+        f"({sum(len(t.spans) for t in traces.values())} spans, 0 dropped), "
+        "monotonic ordering, eviction/requeue + chunked prefill + prefix "
+        "fork all traced"
+    )
+    return 0, traces
+
+
+def check_stats_match_histograms(traces: dict) -> int:
+    snap = telemetry.snapshot()
+    h = snap["histograms"]
+    sums = {"queue": 0.0, "ttft": [], "lat": []}
+    nq = 0
+    for tr in traces.values():
+        qs = tr.stats["queue_samples"]
+        nq += len(qs)
+        sums["queue"] += sum(qs)
+        for s in tr.spans:
+            if s["attrs"].get("ttft_s") is not None:
+                sums["ttft"].append(s["attrs"]["ttft_s"])
+        sums["lat"].extend(tr.stats["token_latency_samples"])
+    checks = (
+        ("magi_request_queue_seconds", nq, sums["queue"]),
+        ("magi_request_ttft_seconds", len(sums["ttft"]), sum(sums["ttft"])),
+        (
+            "magi_request_token_latency_seconds",
+            len(sums["lat"]),
+            sum(sums["lat"]),
+        ),
+    )
+    for name, count, total in checks:
+        hh = h.get(name)
+        if hh is None:
+            return fail(f"histogram {name} missing")
+        if hh["count"] != count:
+            return fail(
+                f"{name}: histogram count {hh['count']} != trace-derived "
+                f"{count} — the two views drifted"
+            )
+        if not math.isclose(hh["sum"], total, rel_tol=1e-9, abs_tol=1e-12):
+            return fail(
+                f"{name}: histogram sum {hh['sum']} != trace-derived "
+                f"{total}"
+            )
+    print(
+        "trace-check: per-request derived stats reconcile exactly with "
+        f"the SLO histograms ({nq} queue / {len(sums['ttft'])} ttft / "
+        f"{len(sums['lat'])} inter-token samples)"
+    )
+    return 0
+
+
+def check_exports(traces: dict, tmpdir: str) -> int:
+    chrome = telemetry.request_traces_to_chrome(traces)
+    blob = json.loads(json.dumps(chrome))
+    evs = blob["traceEvents"]
+    spans = [e for e in evs if e.get("ph") == "X"]
+    procs = [
+        e for e in evs
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    ]
+    if len(procs) != len(traces):
+        return fail(
+            f"chrome export: {len(procs)} request tracks for "
+            f"{len(traces)} traces"
+        )
+    if {e["pid"] for e in spans} != set(range(len(traces))):
+        return fail("chrome export: spans not laid one track per request")
+    if not all("ts" in e and "dur" in e for e in spans):
+        return fail("chrome export: span events missing ts/dur")
+    jpath = telemetry.dump_request_traces_jsonl(
+        os.path.join(tmpdir, "traces.jsonl")
+    )
+    rows = [json.loads(line) for line in open(jpath)]
+    if [r["rid"] for r in rows] != sorted(r["rid"] for r in rows):
+        return fail("jsonl export not rid-ordered")
+    if len(rows) != len(traces):
+        return fail("jsonl export row count mismatch")
+    print(
+        f"trace-check: Chrome export valid ({len(spans)} spans on "
+        f"{len(traces)} request tracks), JSONL round-trips"
+    )
+    return 0
+
+
+def check_ring_truncation_detectable() -> int:
+    before = telemetry.get_registry().counter_value(
+        "magi_trace_events_dropped_total"
+    )
+    buf = EventBuffer(maxlen=4)
+    for i in range(9):
+        buf.record(
+            "req:decode_step", float(i), 0.0,
+            {"trace_id": "trunc-0", "kind": "decode_step", "seq": i,
+             "rid": 0},
+        )
+    if buf.dropped != 5:
+        return fail(f"tiny ring dropped {buf.dropped}, expected 5")
+    after = telemetry.get_registry().counter_value(
+        "magi_trace_events_dropped_total"
+    )
+    if after - before != 5:
+        return fail(
+            "magi_trace_events_dropped_total did not tick with the drops"
+        )
+    trs = telemetry.export_request_traces(buf.events(), dropped=buf.dropped)
+    tr = trs["trunc-0"]
+    if not tr.partial or tr.complete:
+        return fail(
+            "truncated trace not marked partial "
+            f"(partial={tr.partial}, complete={tr.complete})"
+        )
+    print(
+        "trace-check: ring truncation detectable — dropped-span counter "
+        "ticks and the reconstructed tree is marked partial"
+    )
+    return 0
+
+
+def check_chaos_flight_dump(tmpdir: str) -> int:
+    os.environ["MAGI_ATTENTION_TRACE_DIR"] = tmpdir
+    fr = trace.reset_flight_recorder()
+    rng = np.random.default_rng(2)
+    eng = ServingEngine(
+        num_pages=32, num_kv_heads=HK, head_dim=D, page_size=PS,
+        max_seqs=4, max_pages_per_seq=8, dtype=jnp.float32,
+        prefix_sharing=False,
+    )
+    sched = Scheduler(eng, token_budget=32, chunk=None)
+    sched.submit(
+        _req(rng, 0, list(rng.integers(0, VOCAB, PS)), gen=2,
+             with_tokens=False)
+    )
+    sched.step()  # healthy tick lands in the ring
+    from magiattention_tpu.resilience.chaos import (
+        ChaosInjectedError,
+        reset_chaos,
+    )
+
+    os.environ["MAGI_ATTENTION_CHAOS"] = "prefill_error:times=1"
+    reset_chaos()
+    sched.submit(
+        _req(rng, 1, list(rng.integers(0, VOCAB, PS)), gen=1,
+             with_tokens=False)
+    )
+    faulted = False
+    try:
+        sched.run()
+    except ChaosInjectedError:
+        faulted = True
+    finally:
+        os.environ.pop("MAGI_ATTENTION_CHAOS", None)
+        reset_chaos()
+    if not faulted:
+        return fail("injected prefill chaos did not surface")
+    if not fr.dump_paths:
+        return fail("chaos fault did not produce a flight-recorder dump")
+    payload = json.load(open(fr.dump_paths[-1]))
+    if payload["trigger"]["trigger"] != "engine_fault":
+        return fail(
+            f"dump trigger {payload['trigger']['trigger']!r} != engine_fault"
+        )
+    ticks = payload["ticks"]
+    if not ticks or "aborted" not in ticks[-1]:
+        return fail("flight dump does not contain the faulting tick")
+    if "ChaosInjectedError" not in ticks[-1]["aborted"]:
+        return fail(
+            f"faulting tick records {ticks[-1]['aborted']!r}, expected the "
+            "chaos error"
+        )
+    if not any("aborted" not in t for t in ticks):
+        return fail("flight dump carries no healthy pre-fault ticks")
+    snap = telemetry.snapshot()
+    dumped = [
+        k for k in snap["counters"]
+        if k.startswith("magi_flight_recorder_dumps_total")
+    ]
+    if not dumped:
+        return fail("magi_flight_recorder_dumps_total did not tick")
+    print(
+        "trace-check: chaos-injected prefill fault -> flight-recorder "
+        f"dump with the faulting tick ({len(ticks)} ticks, "
+        f"{len(payload['admissions'])} admission records)"
+    )
+    return 0
+
+
+def _metric_present(parsed: dict, name: str) -> bool:
+    return any(
+        k == name
+        or k.startswith(name + "{")
+        or k.startswith(name + "_bucket")
+        or k in (name + "_sum", name + "_count")
+        or k.startswith(name + "_sum{")
+        or k.startswith(name + "_count{")
+        for k in parsed
+    )
+
+
+def check_prometheus_exposition() -> int:
+    from magiattention_tpu.telemetry import collectors
+
+    catalogs = {
+        n: tuple(getattr(telemetry, n))
+        for n in dir(telemetry)
+        if n.startswith("REQUIRED_")
+    }
+    reg = telemetry.get_registry()
+    snap = telemetry.snapshot()
+    present = set()
+    for sec in snap.values():
+        for k in sec:
+            present.add(k.split("{", 1)[0])
+    # the serving/sched/prefix/trace catalogs came from the real trace;
+    # the plan/timeline/roofline/resilience/validate catalogs belong to
+    # layers this serving check does not run (telemetry-check covers
+    # their live population) — synthesize representative series so the
+    # RENDERER is proven over the full documented name space
+    synthesized = 0
+    for names in catalogs.values():
+        for name in names:
+            if name in present:
+                continue
+            if name.endswith("_seconds"):
+                reg.histogram_observe(name, 0.01)
+            elif name.endswith("_total") or "violations" in name:
+                reg.counter_inc(name, 1, synthetic="1")
+            else:
+                reg.gauge_set(name, 1.0, synthetic="1")
+            synthesized += 1
+    text = exposition.render_prometheus()
+    try:
+        parsed = exposition.parse_prometheus_text(text)
+    except ValueError as e:
+        return fail(f"render_prometheus output does not parse: {e}")
+    missing = [
+        name
+        for names in catalogs.values()
+        for name in names
+        if not _metric_present(parsed, name)
+    ]
+    if missing:
+        return fail(f"exposition missing catalog metrics: {missing}")
+    # every registry series must survive the render->parse round trip
+    for sec in ("counters", "gauges"):
+        for k in telemetry.snapshot()[sec]:
+            if k.split("{", 1)[0].endswith("_seconds"):
+                continue
+            if not _metric_present(parsed, k.split("{", 1)[0]):
+                return fail(f"series {k} lost in exposition")
+    # live scrape serves the same text
+    srv = exposition.MetricsServer(0, host="127.0.0.1").start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+        ).read().decode()
+        scraped = exposition.parse_prometheus_text(body)
+        if [m for m in parsed if m not in scraped]:
+            return fail("scrape endpoint served fewer series than render")
+    finally:
+        srv.stop()
+    # delta: counters become rates between scrapes
+    prev = telemetry.snapshot()
+    reg.counter_inc("magi_decode_tokens_total", 40)
+    delta = exposition.snapshot_delta(prev, telemetry.snapshot(), seconds=8.0)
+    if delta["counters"].get("magi_decode_tokens_total") != 40:
+        return fail("snapshot_delta counter increment wrong")
+    if delta["counters_per_s"]["magi_decode_tokens_total"] != 5.0:
+        return fail("snapshot_delta rate wrong")
+    ncat = sum(len(v) for v in catalogs.values())
+    print(
+        f"trace-check: prometheus exposition parses, covers all "
+        f"{len(catalogs)} REQUIRED_* catalogs ({ncat} metrics, "
+        f"{synthesized} synthesized for renderer coverage), scrape "
+        "endpoint matches, counters->rates via snapshot_delta"
+    )
+    assert collectors  # imported for the catalog module, keep ruff quiet
+    return 0
+
+
+def main() -> int:
+    env_backup = {
+        k: os.environ.get(k)
+        for k in (
+            "MAGI_ATTENTION_CHAOS",
+            "MAGI_ATTENTION_TRACE_DIR",
+            "MAGI_ATTENTION_PREFILL_CHUNK",
+        )
+    }
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    trace.reset_flight_recorder()
+    try:
+        with tempfile.TemporaryDirectory(prefix="magi_trace_check_") as td:
+            rc, traces = run_multi_tenant_trace()
+            if rc:
+                return rc
+            for check in (
+                lambda: check_stats_match_histograms(traces),
+                lambda: check_exports(traces, td),
+                check_ring_truncation_detectable,
+                lambda: check_chaos_flight_dump(td),
+                check_prometheus_exposition,
+            ):
+                rc = check()
+                if rc:
+                    return rc
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+        trace.reset_flight_recorder()
+        for kk, vv in env_backup.items():
+            if vv is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = vv
+    print(
+        "trace-check OK: complete per-request span trees, trace==histogram "
+        "reconciliation, valid Chrome/JSONL exports, detectable ring "
+        "truncation, chaos-triggered flight dump, full-catalog prometheus "
+        "exposition"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

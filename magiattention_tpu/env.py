@@ -163,6 +163,43 @@ def trace_dir() -> str:
     return _env_str("MAGI_ATTENTION_TRACE_DIR", "./magi_attention_trace")
 
 
+def metrics_port() -> int:
+    """TCP port of the live Prometheus exposition endpoint
+    (``telemetry/exposition.py``): ``0`` (the default) keeps the HTTP
+    thread off entirely; a positive port starts one stdlib
+    ``http.server`` thread per process serving ``GET /metrics`` in
+    Prometheus text format (plus ``/metrics.json`` and ``/healthz``) the
+    first time a :class:`ServingEngine` is built (or on an explicit
+    ``telemetry.start_metrics_server()``). Pure observability — never
+    influences planning, so NOT part of :func:`flags_fingerprint`."""
+    v = _env_int("MAGI_ATTENTION_METRICS_PORT", 0)
+    if v < 0 or v > 65535:
+        raise ValueError(
+            f"MAGI_ATTENTION_METRICS_PORT={v} must be 0 (off) or a valid "
+            "TCP port"
+        )
+    return v
+
+
+def flight_recorder_depth() -> int:
+    """Tick capacity of the serving flight recorder
+    (``telemetry/trace.py``): the last N scheduler ticks (StepReport +
+    queue depth + budget utilization) and admission decisions kept in a
+    bounded host ring, auto-dumped to ``MAGI_ATTENTION_TRACE_DIR`` when
+    a resilience signal fires (NumericalGuardError, degradation path,
+    admission-rejection storm, engine fault). ``0`` disables recording
+    entirely. Always-on by default — the per-tick cost is one small dict
+    append, negligible next to a scheduler tick's device work. Pure
+    observability, NOT part of :func:`flags_fingerprint`."""
+    v = _env_int("MAGI_ATTENTION_FLIGHT_RECORDER_DEPTH", 64)
+    if v < 0:
+        raise ValueError(
+            f"MAGI_ATTENTION_FLIGHT_RECORDER_DEPTH={v} must be >= 0 "
+            "(0 disables the recorder)"
+        )
+    return v
+
+
 def perf_gate_tolerance() -> float:
     """Fractional TF/s regression the perf gate tolerates before failing
     (``exps/run_perf_gate.py`` / ``make perf-gate``): a run below

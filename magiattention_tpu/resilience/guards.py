@@ -166,6 +166,11 @@ def _report(code, *, sites, mode: str, under_jit: bool):
         else:
             telemetry.record_guard_violation(s)
     if mode == "check":
+        # a tripped guard is a post-mortem moment (ISSUE 11): dump the
+        # serving flight recorder's recent ticks, if any were recorded
+        from ..telemetry.trace import get_flight_recorder
+
+        get_flight_recorder().trigger("numerical_guard", sites=list(bad))
         if under_jit:
             # inside someone else's jit the callback cannot unwind the
             # python stack cleanly — surface loudly instead of raising

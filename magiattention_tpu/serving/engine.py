@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import exposition, trace
 from ..common.enum import AttnMaskType
 from ..utils.instrument import named_scope
 from .decode_attn import decode_attn_paged, resolve_num_splits
@@ -300,6 +301,15 @@ class ServingEngine:
         # cascade grouping key (set on fork, or at commit_prefix)
         self._slot_prefix: dict[int, tuple[tuple[int, ...], int]] = {}
         self.max_admission_evictions = int(max_admission_evictions)
+        # what the last decode_step resolved (split count, cascade
+        # grouping): the scheduler reads this to tag per-request
+        # decode_step trace spans (ISSUE 11) — plain host state, not
+        # gated on telemetry
+        self.last_decode_info: dict = {}
+        self._flight = trace.get_flight_recorder()
+        # live exposition (ISSUE 11): one scrape thread per process when
+        # MAGI_ATTENTION_METRICS_PORT is set; no-op (None) by default
+        exposition.ensure_metrics_server()
         self._record_pool()
 
     # -- admission / retirement (host) --
@@ -335,7 +345,7 @@ class ServingEngine:
         if need > self.allocator.max_pages_per_seq:
             # no amount of evicting makes an over-long sequence fit
             res = AdmissionResult(False, None, "too_long")
-            telemetry.record_admission(res)
+            self._note_admission(res)
             return res
         tokens = tuple(int(t) for t in tokens) if tokens is not None else None
         evicted: list[int] = []
@@ -357,7 +367,7 @@ class ServingEngine:
                         res = AdmissionResult(
                             False, None, "alloc_error", tuple(evicted)
                         )
-                        telemetry.record_admission(res)
+                        self._note_admission(res)
                         self._record_pool()
                         return res
                     try:
@@ -385,7 +395,7 @@ class ServingEngine:
                     res = AdmissionResult(
                         False, None, "alloc_error", tuple(evicted)
                     )
-                    telemetry.record_admission(res)
+                    self._note_admission(res)
                     self._record_pool()
                     return res
                 try:
@@ -429,9 +439,17 @@ class ServingEngine:
             else "pool_exhausted"
         )
         res = AdmissionResult(False, None, reason, tuple(evicted))
-        telemetry.record_admission(res)
+        self._note_admission(res)
         self._record_pool()
         return res
+
+    def _note_admission(self, res: AdmissionResult) -> None:
+        """Shared admission telemetry: registry counters (gated on the
+        telemetry flag) + the always-on flight recorder's rejection-storm
+        detector (ISSUE 11 — a run of consecutive rejections arms a
+        post-mortem dump)."""
+        telemetry.record_admission(res)
+        self._flight.note_admission(res.admitted, res.reason)
 
     def _finish_admit(
         self,
@@ -461,7 +479,7 @@ class ServingEngine:
         res = AdmissionResult(
             True, slot, "ok", tuple(evicted), prefix_len=prefix_len
         )
-        telemetry.record_admission(res)
+        self._note_admission(res)
         self._record_pool()
         return res
 
@@ -688,7 +706,11 @@ class ServingEngine:
     def _release_after_fault(self, slot: int) -> None:
         """Tear a faulted slot all the way down (best-effort, never
         raises over the original fault): allocator pages returned, slot
-        length zeroed, bookkeeping dropped."""
+        length zeroed, bookkeeping dropped. Arms a flight-recorder dump
+        (deferred, ISSUE 11): when a scheduler drives this engine, its
+        tick loop records the aborted tick and flushes — the post-mortem
+        contains the tick the fault killed."""
+        self._flight.trigger("engine_fault", immediate=False, slot=slot)
         try:
             self.free(slot)
         except Exception:
@@ -767,6 +789,18 @@ class ServingEngine:
                 q.shape[1],
             )
             out, lse = magi_attn_decode(q, self.cache, batch, **kw)
+        # per-step resolution facts for the request tracer (ISSUE 11):
+        # the scheduler tags each member's decode_step span with them
+        self.last_decode_info = {
+            "batch": batch.batch_size,
+            "num_splits": resolved,
+            "cascade_groups": len(groups),
+            "cascade_group_of": {
+                int(slot_list[pos]): gi
+                for gi, g in enumerate(groups)
+                for pos in g.members
+            },
+        }
         telemetry.record_decode_step(
             batch_size=batch.batch_size,
             num_splits=resolved,

@@ -30,6 +30,18 @@ Per-request SLO telemetry lands on the existing metrics registry
 ``magi_request_token_latency_seconds`` histograms + the ``magi_sched_*``
 step counters/gauges) — the observability ROADMAP item 2 asks for.
 
+Request-lifecycle tracing (ISSUE 11): every request gets a trace id at
+submission and the scheduler emits typed lifecycle spans (submit /
+admitted / prefill_chunk / decode_step / evicted / requeued / finished
+...) through ``telemetry/trace.py`` into the span ring — the SLO
+histogram samples are emitted by the same helpers, so the per-request
+trace and the aggregate histograms are computed from one number.
+``telemetry.export_request_traces()`` reconstructs the span trees;
+every tick also lands in the always-on flight recorder, which
+auto-dumps on resilience signals (a tick that aborts on an engine
+fault is recorded before the dump flushes, so the post-mortem contains
+the faulting tick).
+
 Host-side only: the scheduler never traces; the jitted work is the
 engine's pure ops underneath.
 """
@@ -44,6 +56,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry
+from ..telemetry import trace as reqtrace
 from .engine import ServingEngine
 
 QUEUED = "queued"
@@ -65,6 +78,10 @@ class Request:
       ``max_new_tokens`` defaults to G.
     - ``priority``: admission priority (higher wins; the engine may
       evict strictly-lower-priority residents under pressure).
+    - ``trace_id``: request-lifecycle trace id (ISSUE 11); None (the
+      default) lets :meth:`Scheduler.submit` assign a process-unique
+      one. Every lifecycle span the serving stack emits for this
+      request is tagged with it.
     """
 
     rid: int
@@ -77,6 +94,7 @@ class Request:
     tokens: Sequence[int] | None = None
     max_new_tokens: int | None = None
     priority: int = 0
+    trace_id: str | None = None
 
     @property
     def prompt_len(self) -> int:
@@ -97,12 +115,22 @@ class RequestState:
     status: str = QUEUED
     slot: int | None = None
     submitted_at: float = 0.0
+    # the SLO clock origin: == submitted_at normally, reset to the
+    # requeue instant after a priority eviction — a restarted
+    # generation's queue wait and TTFT are measured from requeue (the
+    # ISSUE 9 clock-reset hardening, made explicit and trace-asserted
+    # in ISSUE 11). submitted_at itself is NOT reset: it keeps the
+    # original FIFO seniority in the admission order.
+    slo_start: float = 0.0
     admitted_at: float | None = None
     first_token_at: float | None = None
     last_token_at: float | None = None
     prefill_pos: int = 0  # prompt tokens committed (incl. shared prefix)
     prefix_len: int = 0  # tokens installed by reference at admission
     tokens_done: int = 0
+    prefill_chunk_idx: int = 0  # chunks run so far (trace span index)
+    evictions: int = 0  # priority evictions suffered
+    trace_id: str = ""
     prefill_out_tail: jax.Array | None = None  # last prompt row's out
     decode_outs: list = dataclasses.field(default_factory=list)
 
@@ -124,6 +152,12 @@ class StepReport:
     prefill_chunks: tuple[tuple[int, int], ...]  # (rid, chunk tokens)
     tokens_used: int
     finished: tuple[int, ...]
+    # ISSUE 11 satellite: saturation at tick granularity — the queue
+    # depth when the tick started (before admissions) and the fraction
+    # of the token budget it spent; also exported as the
+    # magi_sched_queue_depth / magi_sched_budget_utilization gauges
+    queue_depth: int = 0
+    budget_utilization: float = 0.0
 
     @property
     def idle(self) -> bool:
@@ -163,12 +197,30 @@ class Scheduler:
         self._active: dict[int, RequestState] = {}  # rid -> state
         self._finished: dict[int, RequestState] = {}
         self._step = 0
+        self._flight = reqtrace.get_flight_recorder()
 
     # -- submission ------------------------------------------------------
 
     def submit(self, request: Request) -> RequestState:
-        st = RequestState(request=request, submitted_at=self._clock())
+        now = self._clock()
+        st = RequestState(
+            request=request,
+            submitted_at=now,
+            slo_start=now,
+            trace_id=(
+                request.trace_id
+                if request.trace_id is not None
+                else reqtrace.new_trace_id(request.rid)
+            ),
+        )
         self._queue.append(st)
+        reqtrace.span_submit(
+            st.trace_id,
+            st.rid,
+            prompt_len=request.prompt_len,
+            max_new_tokens=request.num_new_tokens,
+            priority=request.priority,
+        )
         return st
 
     @property
@@ -198,11 +250,12 @@ class Scheduler:
         admitted, rejected = [], []
         for st in self._admission_order():
             req = st.request
-            res = self.engine.admit(
-                req.prompt_len,
-                priority=req.priority,
-                tokens=req.tokens,
-            )
+            with reqtrace.request_context(st.trace_id, st.rid):
+                res = self.engine.admit(
+                    req.prompt_len,
+                    priority=req.priority,
+                    tokens=req.tokens,
+                )
             if not res.admitted:
                 if res.reason == "too_long":
                     # permanent: no eviction makes it fit — surface it
@@ -210,7 +263,13 @@ class Scheduler:
                     self._queue.remove(st)
                     self._finished[st.rid] = st
                     rejected.append(st.rid)
+                    reqtrace.span_rejected(
+                        st.trace_id, st.rid, reason=res.reason
+                    )
                     continue
+                reqtrace.span_backpressure(
+                    st.trace_id, st.rid, reason=res.reason
+                )
                 break  # transient backpressure: keep FIFO order, retry later
             # an admission may have evicted lower-priority residents
             for victim_slot in res.evicted:
@@ -225,8 +284,19 @@ class Scheduler:
             self._queue.remove(st)
             self._active[st.rid] = st
             admitted.append(st.rid)
-            telemetry.record_request_queue_time(
-                st.admitted_at - st.submitted_at
+            # span + SLO histogram from the same float (cannot drift);
+            # queue wait measured from the SLO clock origin, which a
+            # requeue resets
+            reqtrace.span_admitted(
+                st.trace_id,
+                st.rid,
+                slot=res.slot,
+                prefix_len=res.prefix_len,
+                shared_pages=res.prefix_len // max(
+                    self.engine.allocator.page_size, 1
+                ),
+                evicted=len(res.evicted),
+                queue_s=st.admitted_at - st.slo_start,
             )
         return admitted, rejected
 
@@ -236,20 +306,29 @@ class Scheduler:
         shared are still resident, so the retry re-forks cheaply)."""
         for rid, st in list(self._active.items()):
             if st.slot == slot:
+                reqtrace.span_evicted(st.trace_id, st.rid, slot=slot)
                 del self._active[rid]
                 st.slot = None
                 st.status = QUEUED
                 st.prefill_pos = 0
                 st.prefix_len = 0
                 st.tokens_done = 0
+                st.prefill_chunk_idx = 0
+                st.evictions += 1
                 st.decode_outs.clear()
                 # the restarted generation gets a fresh SLO record: its
                 # TTFT must be measured again and a stale last_token_at
                 # would push one eviction+requeue+re-prefill-sized
-                # outlier into the inter-token latency histogram
+                # outlier into the inter-token latency histogram. The
+                # SLO clock restarts at the requeue instant — TTFT and
+                # queue wait of the retry measure the retry, not the
+                # whole first life (trace-asserted end to end by
+                # tests/test_serving/test_scheduler.py and trace-check)
                 st.first_token_at = None
                 st.last_token_at = None
+                st.slo_start = self._clock()
                 self._queue.append(st)
+                reqtrace.span_requeued(st.trace_id, st.rid)
                 return
 
     def _decode_states(self) -> list[RequestState]:
@@ -264,19 +343,39 @@ class Scheduler:
         ks = jnp.stack([st.request.decode_k[st.tokens_done] for st in states])
         vs = jnp.stack([st.request.decode_v[st.tokens_done] for st in states])
         slots = [st.slot for st in states]
+        t0 = time.perf_counter()
         out, _lse = self.engine.decode_step(qs, ks, vs, slots)
+        dur = time.perf_counter() - t0
+        # what the engine's step actually resolved (split count /
+        # cascade grouping): per-request decode spans carry it
+        info = getattr(self.engine, "last_decode_info", None) or {}
+        group_of = info.get("cascade_group_of", {})
         now = self._clock()
         for j, st in enumerate(states):
             st.decode_outs.append(out[j])
             st.tokens_done += 1
+            ttft_s = token_latency_s = None
             if st.first_token_at is None:
                 st.first_token_at = now
-                telemetry.record_request_ttft(now - st.submitted_at)
+                # from the SLO clock origin: the submit instant, or the
+                # requeue instant after a priority eviction
+                ttft_s = now - st.slo_start
             else:
-                telemetry.record_request_token_latency(
-                    now - (st.last_token_at or now)
-                )
+                token_latency_s = now - (st.last_token_at or now)
             st.last_token_at = now
+            # span + histograms from the same floats (cannot drift)
+            reqtrace.span_decode_step(
+                st.trace_id,
+                st.rid,
+                token_idx=st.tokens_done - 1,
+                batch=len(states),
+                num_splits=int(info.get("num_splits", 0)),
+                cascade_group=group_of.get(st.slot),
+                start_s=t0,
+                duration_s=dur,
+                ttft_s=ttft_s,
+                token_latency_s=token_latency_s,
+            )
             if st.tokens_done >= st.request.num_new_tokens:
                 self._finish(st)
         return len(states)
@@ -286,6 +385,17 @@ class Scheduler:
         self.engine.free(st.slot)
         del self._active[st.rid]
         self._finished[st.rid] = st
+        now = self._clock()
+        reqtrace.span_finished(
+            st.trace_id,
+            st.rid,
+            tokens=st.tokens_done,
+            prefill_chunks=st.prefill_chunk_idx,
+            prefix_len=st.prefix_len,
+            evictions=st.evictions,
+            e2e_s=now - st.submitted_at,
+            slo_window_s=now - st.slo_start,
+        )
 
     def _prefill_states(self) -> list[RequestState]:
         sts = [
@@ -303,12 +413,24 @@ class Scheduler:
         if remaining > 0 and n == 0:
             return 0  # budget exhausted
         lo, hi = st.prefill_pos, st.prefill_pos + n
-        out, _lse = self.engine.prefill(
-            req.prompt_q[lo:hi],
-            req.prompt_k[lo:hi],
-            req.prompt_v[lo:hi],
-            st.slot,
+        t0 = time.perf_counter()
+        with reqtrace.request_context(st.trace_id, st.rid):
+            out, _lse = self.engine.prefill(
+                req.prompt_q[lo:hi],
+                req.prompt_k[lo:hi],
+                req.prompt_v[lo:hi],
+                st.slot,
+            )
+        reqtrace.span_prefill_chunk(
+            st.trace_id,
+            st.rid,
+            tokens=n,
+            chunk_idx=st.prefill_chunk_idx,
+            start=lo,
+            start_s=t0,
+            duration_s=time.perf_counter() - t0,
         )
+        st.prefill_chunk_idx += 1
         st.prefill_pos = hi
         if n and hi == req.prompt_len:
             st.prefill_out_tail = out[-1]
@@ -320,8 +442,61 @@ class Scheduler:
 
     def step(self) -> StepReport:
         """One scheduler tick: admissions, at most ONE decode step, then
-        prefill chunks with whatever budget remains."""
+        prefill chunks with whatever budget remains. Every tick lands in
+        the flight recorder; a tick aborted by an engine fault is
+        recorded (with the error) before the armed post-mortem dump
+        flushes, so the dump contains the faulting tick."""
         self._step += 1
+        tick_start = time.perf_counter()  # flight-recorder arm window
+        queue_depth = self.waiting  # at tick START, before admissions
+        try:
+            report = self._step_body(queue_depth)
+        except Exception as e:  # noqa: BLE001 — recorded, then re-raised
+            self._flight.record_tick(
+                {
+                    "step": self._step,
+                    "aborted": repr(e),
+                    "queue_depth": queue_depth,
+                    "active": self.num_active,
+                    "budget": self.token_budget,
+                },
+                start_t=tick_start,
+            )
+            self._flight.flush()
+            raise
+        telemetry.record_sched_step(
+            waiting=self.waiting,
+            active=self.num_active,
+            tokens_used=report.tokens_used,
+            prefill_chunks=len(
+                [c for c in report.prefill_chunks if c[1] > 0]
+            ),
+            decode_ran=report.decode_ran,
+            budget_utilization=report.budget_utilization,
+            queue_depth=report.queue_depth,
+        )
+        self._flight.record_tick(
+            {
+                "step": report.step,
+                "admitted": list(report.admitted),
+                "rejected": list(report.rejected),
+                "decode_ran": report.decode_ran,
+                "decode_batch": report.decode_batch,
+                "prefill_chunks": [list(c) for c in report.prefill_chunks],
+                "tokens_used": report.tokens_used,
+                "budget": self.token_budget,
+                "budget_utilization": report.budget_utilization,
+                "queue_depth": report.queue_depth,
+                "waiting": self.waiting,
+                "active": self.num_active,
+                "finished": list(report.finished),
+            },
+            start_t=tick_start,
+        )
+        self._flight.flush()
+        return report
+
+    def _step_body(self, queue_depth: int) -> StepReport:
         budget = self.token_budget
         admitted, rejected = self._admit_queued()
         finished_before = set(self._finished)
@@ -344,24 +519,19 @@ class Scheduler:
             budget -= n
             chunks.append((st.rid, n))
 
-        report = StepReport(
+        tokens_used = self.token_budget - budget
+        return StepReport(
             step=self._step,
             admitted=tuple(admitted),
             rejected=tuple(rejected),
             decode_ran=decode_ran,
             decode_batch=decode_batch,
             prefill_chunks=tuple(chunks),
-            tokens_used=self.token_budget - budget,
+            tokens_used=tokens_used,
             finished=tuple(set(self._finished) - finished_before),
+            queue_depth=queue_depth,
+            budget_utilization=tokens_used / max(self.token_budget, 1),
         )
-        telemetry.record_sched_step(
-            waiting=self.waiting,
-            active=self.num_active,
-            tokens_used=report.tokens_used,
-            prefill_chunks=len([c for c in chunks if c[1] > 0]),
-            decode_ran=decode_ran,
-        )
-        return report
 
     def run(self, max_steps: int = 10_000) -> list[StepReport]:
         """Step until every submitted request finished (or the safety

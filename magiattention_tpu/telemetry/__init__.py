@@ -46,6 +46,7 @@ from .collectors import (  # noqa: F401
     REQUIRED_SCHED_METRICS,
     REQUIRED_SERVING_METRICS,
     REQUIRED_TIMELINE_METRICS,
+    REQUIRED_TRACE_METRICS,
     REQUIRED_VALIDATE_METRICS,
     record_admission,
     record_autotune_cache,
@@ -88,6 +89,28 @@ from .events import (  # noqa: F401
     record_event,
     span,
     trace_metadata_events,
+)
+from .exposition import (  # noqa: F401
+    MetricsServer,
+    ensure_metrics_server,
+    parse_prometheus_text,
+    render_prometheus,
+    snapshot_delta,
+    start_metrics_server,
+    stop_metrics_server,
+)
+from .trace import (  # noqa: F401
+    FlightRecorder,
+    RequestTrace,
+    dump_request_traces,
+    dump_request_traces_jsonl,
+    export_request_traces,
+    get_flight_recorder,
+    record_request_span,
+    request_context,
+    request_traces_to_chrome,
+    reset_flight_recorder,
+    reset_request_traces,
 )
 from .occupancy import (  # noqa: F401
     BlockOccupancyMap,
@@ -143,9 +166,11 @@ def snapshot() -> dict:
 
 
 def reset() -> None:
-    """Clear the global registry AND the span ring buffer."""
+    """Clear the global registry, the span ring buffer, and the
+    per-request trace sequence counters."""
     get_registry().reset()
     get_event_buffer().clear()
+    reset_request_traces()
 
 
 def dump_metrics(path: str) -> str:
@@ -161,15 +186,19 @@ def dump_events(path: str) -> str:
 __all__ = [
     "BlockOccupancyMap",
     "EventBuffer",
+    "FlightRecorder",
     "HopTiming",
     "MeasuredTimeline",
     "MetricsRegistry",
+    "MetricsServer",
     "REQUIRED_PLAN_METRICS",
     "REQUIRED_RESILIENCE_METRICS",
     "REQUIRED_ROOFLINE_METRICS",
     "REQUIRED_SERVING_METRICS",
     "REQUIRED_TIMELINE_METRICS",
+    "REQUIRED_TRACE_METRICS",
     "REQUIRED_VALIDATE_METRICS",
+    "RequestTrace",
     "RooflineReport",
     "StageTiming",
     "aggregate_across_mesh",
@@ -178,12 +207,18 @@ __all__ = [
     "configure_logging",
     "dump_events",
     "dump_metrics",
+    "dump_request_traces",
+    "dump_request_traces_jsonl",
     "enabled",
+    "ensure_metrics_server",
+    "export_request_traces",
     "get_event_buffer",
+    "get_flight_recorder",
     "get_logger",
     "get_registry",
     "merge_chrome_traces",
     "merge_snapshots",
+    "parse_prometheus_text",
     "profile_key_timeline",
     "profile_plan_timeline",
     "profile_roofline",
@@ -210,7 +245,13 @@ __all__ = [
     "record_plan",
     "record_prefill",
     "record_roofline",
+    "record_request_span",
     "record_runtime_costs",
+    "render_prometheus",
+    "request_context",
+    "request_traces_to_chrome",
+    "reset_flight_recorder",
+    "reset_request_traces",
     "resolve_peak_tflops",
     "record_tuning_cache_io_error",
     "record_validate",
@@ -218,7 +259,10 @@ __all__ = [
     "series_key",
     "set_enabled",
     "snapshot",
+    "snapshot_delta",
     "span",
+    "start_metrics_server",
+    "stop_metrics_server",
     "telemetry_summary",
     "trace_metadata_events",
 ]

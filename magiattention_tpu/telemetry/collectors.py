@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import time
 
+from .events import DROPPED_COUNTER as M_TRACE_DROPPED
 from .registry import get_registry
 
 
@@ -193,9 +194,23 @@ M_SCHED_DECODE_STEPS = "magi_sched_decode_steps_total"
 M_SCHED_WAITING = "magi_sched_waiting_requests"  # gauge: queued
 M_SCHED_ACTIVE = "magi_sched_active_requests"  # gauge: prefilling+decoding
 M_SCHED_STEP_TOKENS = "magi_sched_step_tokens"  # gauge: last step's usage
+# per-tick saturation surface (ISSUE 11 satellite): the fraction of the
+# token budget the last tick actually spent, and the queue depth at tick
+# START (before admissions) — scheduler saturation visible from a
+# scrape, no trace replay needed
+M_SCHED_BUDGET_UTIL = "magi_sched_budget_utilization"
+M_SCHED_QUEUE_DEPTH = "magi_sched_queue_depth"
 H_REQ_QUEUE_S = "magi_request_queue_seconds"
 H_REQ_TTFT_S = "magi_request_ttft_seconds"
 H_REQ_TOKLAT_S = "magi_request_token_latency_seconds"
+
+# counters — request-lifecycle tracing (telemetry/trace.py; ISSUE 11).
+# traces started (one per Scheduler.submit); ring spans dropped
+# (M_TRACE_DROPPED, defined next to the ring in events.py — nonzero
+# means reconstructed span trees are partial); flight-recorder
+# post-mortem dumps written ({trigger=})
+M_REQ_TRACES = "magi_request_traces_total"
+M_FLIGHT_DUMPS = "magi_flight_recorder_dumps_total"
 
 # counters + gauges — resilience layer (resilience/; docs/resilience.md).
 # guard counters ({site=host|merged|stageN|splitN|correction|reduce_lse}):
@@ -327,9 +342,21 @@ REQUIRED_SCHED_METRICS: tuple[str, ...] = (
     M_SCHED_WAITING,
     M_SCHED_ACTIVE,
     M_SCHED_STEP_TOKENS,
+    M_SCHED_BUDGET_UTIL,
+    M_SCHED_QUEUE_DEPTH,
     H_REQ_QUEUE_S,
     H_REQ_TTFT_S,
     H_REQ_TOKLAT_S,
+)
+
+# populated by a traced scheduler run that overflows a (deliberately
+# tiny) span ring and fires one flight-recorder dump; asserted by
+# make trace-check (exps/run_trace_check.py), documented in
+# docs/observability.md "Request tracing & exposition"
+REQUIRED_TRACE_METRICS: tuple[str, ...] = (
+    M_REQ_TRACES,
+    M_TRACE_DROPPED,
+    M_FLIGHT_DUMPS,
 )
 
 
@@ -773,9 +800,16 @@ def record_admission(result) -> None:
 def record_degraded_path(reason: str) -> None:
     """A degradation path engaged (plan-build -> dense degree-0 plan,
     hops build -> a2a impl): gauge value 1 labeled with the reason, plus
-    a marker event so traces show WHEN it happened."""
+    a marker event so traces show WHEN it happened. Also arms/writes a
+    flight-recorder dump (outside the telemetry gate — the recorder is
+    always-on) and, when a request context is live, a ``degraded`` span
+    on that request's trace."""
+    from .trace import SPAN_DEGRADED, get_flight_recorder, span_for_current
+
+    get_flight_recorder().trigger("degraded_path", reason=reason)
     if not _enabled():
         return
+    span_for_current(SPAN_DEGRADED, reason=reason)
     get_registry().gauge_set(M_DEGRADED_PATH, 1, reason=reason)
     _marker_event("degraded_path", {"reason": reason})
 
@@ -871,9 +905,14 @@ def record_prefix_registered(newly_pinned: int, resident_pages: int) -> None:
 
 def record_prefix_cow() -> None:
     """One copy-on-write page split: a sequence needed to write into a
-    still-shared tail page and got its private copy."""
+    still-shared tail page and got its private copy. When a request
+    context is live (the scheduler wraps engine calls), the split also
+    lands as a ``cow`` span on that request's trace."""
     if not _enabled():
         return
+    from .trace import SPAN_COW, span_for_current
+
+    span_for_current(SPAN_COW)
     get_registry().counter_inc(M_PREFIX_COW)
 
 
@@ -894,9 +933,13 @@ def record_sched_step(
     tokens_used: int,
     prefill_chunks: int,
     decode_ran: bool,
+    budget_utilization: float | None = None,
+    queue_depth: int | None = None,
 ) -> None:
     """One ``Scheduler.step`` tick: queue depths and what the token
-    budget actually bought (chunks started, decode step or not)."""
+    budget actually bought (chunks started, decode step or not), plus
+    the tick's budget utilization and start-of-tick queue depth (ISSUE
+    11 satellite — saturation without trace replay)."""
     if not _enabled():
         return
     reg = get_registry()
@@ -908,6 +951,25 @@ def record_sched_step(
     reg.gauge_set(M_SCHED_WAITING, int(waiting))
     reg.gauge_set(M_SCHED_ACTIVE, int(active))
     reg.gauge_set(M_SCHED_STEP_TOKENS, int(tokens_used))
+    if budget_utilization is not None:
+        reg.gauge_set(M_SCHED_BUDGET_UTIL, float(budget_utilization))
+    if queue_depth is not None:
+        reg.gauge_set(M_SCHED_QUEUE_DEPTH, int(queue_depth))
+
+
+def record_request_traced() -> None:
+    """One request entered the traced lifecycle (``trace.span_submit``)."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_REQ_TRACES)
+
+
+def record_flight_dump(trigger: str) -> None:
+    """One flight-recorder post-mortem dump was written ({trigger=})."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_FLIGHT_DUMPS, trigger=trigger)
+    _marker_event("flight_recorder_dump", {"trigger": trigger})
 
 
 def record_request_queue_time(seconds: float) -> None:

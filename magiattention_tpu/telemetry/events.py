@@ -25,6 +25,12 @@ import threading
 import time
 from collections import deque
 
+# registry counter ticked when the ring evicts a span to admit a new one
+# (collectors re-exports it as M_TRACE_DROPPED; the trace-check CI
+# asserts it): silent truncation would make a reconstructed request
+# trace look complete when it is not
+DROPPED_COUNTER = "magi_trace_events_dropped_total"
+
 
 class EventBuffer:
     """Ring buffer of span events (host wall-clock, microsecond stamps).
@@ -41,6 +47,18 @@ class EventBuffer:
         # track name -> synthetic tid (small ints, far below real thread
         # idents, assigned in first-use order — deterministic per run)
         self._tracks: dict[str, int] = {}
+        # spans silently evicted by the ring (oldest-first): surfaced as
+        # a counter + one-time warning so a truncated trace is
+        # detectable, and read by export_request_traces to mark
+        # reconstructed span trees partial instead of complete
+        self._dropped = 0
+        self._drop_warned = False
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted from the ring since construction/clear."""
+        with self._lock:
+            return self._dropped
 
     def _track_tid(self, track: str) -> int:
         tid = self._tracks.get(track)
@@ -78,7 +96,30 @@ class EventBuffer:
             }
             if attrs:
                 ev["args"] = dict(attrs)
+            full = (
+                self._events.maxlen is not None
+                and len(self._events) >= self._events.maxlen
+            )
+            if full:
+                self._dropped += 1
+            warn_first_drop = full and not self._drop_warned
+            if warn_first_drop:
+                self._drop_warned = True
             self._events.append(ev)
+        if full:
+            from .registry import get_registry
+
+            get_registry().counter_inc(DROPPED_COUNTER)
+        if warn_first_drop:
+            from .logger import get_logger
+
+            get_logger("telemetry").warning(
+                "span-event ring full (maxlen=%d): oldest spans are being "
+                "dropped — request traces reconstructed from this buffer "
+                "will be marked partial. Raise "
+                "MAGI_ATTENTION_TELEMETRY_RING_SIZE to keep more.",
+                self._events.maxlen,
+            )
 
     def events(self) -> list[dict]:
         with self._lock:
@@ -91,6 +132,8 @@ class EventBuffer:
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._dropped = 0
+            self._drop_warned = False
 
     def dump(self, path: str) -> str:
         """Write the buffered spans as Chrome trace-event JSON; returns

@@ -166,6 +166,100 @@ def test_scheduler_slo_telemetry():
         telemetry.set_enabled(None)
 
 
+def test_step_report_saturation_fields_and_gauges():
+    """ISSUE 11 satellite: StepReport carries start-of-tick queue depth
+    and budget utilization, exported as magi_sched_* gauges."""
+    rng = np.random.default_rng(6)
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        eng = _engine()
+        budget = 16
+        sched = Scheduler(eng, token_budget=budget, chunk=PS)
+        for i in range(2):
+            sched.submit(_req(rng, i, prompt_len=2 * PS, gen=2))
+        first = sched.step()
+        assert first.queue_depth == 2  # before this tick's admissions
+        assert first.budget_utilization == first.tokens_used / budget
+        assert 0.0 < first.budget_utilization <= 1.0
+        sched.run()
+        snap = telemetry.snapshot()
+        assert "magi_sched_budget_utilization" in snap["gauges"]
+        assert "magi_sched_queue_depth" in snap["gauges"]
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
+def test_evicted_requeued_slo_clocks_measured_from_requeue():
+    """ISSUE 11 satellite: the PR 9 clock-reset hardening, asserted end
+    to end with the per-request trace as the oracle — an evicted-and-
+    requeued request's TTFT is measured from REQUEUE (not original
+    submission), and the inter-token histogram carries no
+    eviction-sized outlier."""
+    rng = np.random.default_rng(7)
+    clock = iter(float(i) for i in range(10_000))
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        # ONE resident at a time: a higher-priority arrival must evict
+        eng = _engine(num_pages=16, max_seqs=1, max_pages_per_seq=8)
+        sched = Scheduler(
+            eng, token_budget=32, chunk=None,
+            clock=lambda: next(clock),
+        )
+        sched.submit(_req(rng, 0, prompt_len=2 * PS, gen=4, priority=0))
+        sched.step()  # admit + prefill r0
+        sched.step()  # r0 decodes its first token (life-1 TTFT)
+        sched.submit(_req(rng, 1, prompt_len=2 * PS, gen=1, priority=5))
+        reports = sched.run()
+        assert any(r.rejected == () and 1 in r.admitted for r in reports)
+        st0 = sched.result(0)
+        assert len(st0.decode_outs) == 4
+        traces = telemetry.export_request_traces()
+        tr0 = next(t for t in traces.values() if t.rid == 0)
+        kinds = [s["kind"] for s in tr0.spans]
+        assert "evicted" in kinds and "requeued" in kinds
+        assert kinds.index("requeued") == kinds.index("evicted") + 1
+        assert tr0.stats["evictions"] == 1
+        assert tr0.complete and not tr0.partial
+        # the trace is the oracle: r0's life-2 TTFT attr is measured
+        # from the requeue instant (slo_start), NOT from submission
+        assert st0.slo_start > st0.submitted_at  # clock was reset
+        life2_ttft = tr0.stats["ttft_s"]  # last recorded TTFT sample
+        assert life2_ttft == st0.first_token_at - st0.slo_start
+        assert life2_ttft < st0.first_token_at - st0.submitted_at
+        # no eviction-sized outlier: every inter-token sample is far
+        # below the span of r0's first life (submit -> requeue) — the
+        # gap a stale last_token_at would have leaked into the histogram
+        snap = telemetry.snapshot()
+        h = snap["histograms"]["magi_request_token_latency_seconds"]
+        eviction_sized = st0.slo_start - st0.submitted_at
+        assert eviction_sized >= 4.0  # the fake clock makes it large
+        assert h["max"] < eviction_sized
+        # and the histogram reconciles exactly with the trace samples
+        all_lat = [
+            s
+            for t in traces.values()
+            for s in t.stats["token_latency_samples"]
+        ]
+        assert h["count"] == len(all_lat)
+        assert h["sum"] == sum(all_lat)
+        assert h["max"] == max(all_lat)
+        ttfts = [
+            s["attrs"]["ttft_s"]
+            for t in traces.values()
+            for s in t.spans
+            if s["attrs"].get("ttft_s") is not None
+        ]
+        ht = snap["histograms"]["magi_request_ttft_seconds"]
+        assert ht["count"] == len(ttfts) == 3  # r0 life1, r1, r0 life2
+        assert ht["sum"] == sum(ttfts)
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
 def test_scheduler_shared_prefix_trace_saves_prefill_work():
     """Multi-tenant trace: after tenant 0 registers the system prompt,
     every later tenant's prefill only covers its suffix."""

@@ -1,0 +1,188 @@
+"""Prometheus exposition (ISSUE 11): text rendering, parse round-trip,
+snapshot delta/rates, and the stdlib scrape server."""
+
+import json
+import urllib.request
+
+import pytest
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.telemetry import exposition
+from magiattention_tpu.telemetry.registry import MetricsRegistry
+
+
+def _sample_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter_inc("magi_plan_builds_total", 3)
+    reg.counter_inc("magi_guard_violations", 2, site="stage1")
+    reg.gauge_set("magi_sched_queue_depth", 5)
+    reg.gauge_set("magi_comm_impl_choice", 1, impl="hops", reason="auto_volume")
+    reg.histogram_observe("magi_request_ttft_seconds", 0.05)
+    reg.histogram_observe("magi_request_ttft_seconds", 0.5)
+    return reg
+
+
+def test_render_parses_and_round_trips_every_series():
+    snap = _sample_registry().snapshot()
+    text = exposition.render_prometheus(snap)
+    parsed = exposition.parse_prometheus_text(text)
+    assert parsed["magi_plan_builds_total"] == 3
+    assert parsed["magi_guard_violations{site=stage1}"] == 2
+    assert parsed["magi_sched_queue_depth"] == 5
+    assert parsed["magi_comm_impl_choice{impl=hops,reason=auto_volume}"] == 1
+    # histogram triple with cumulative buckets
+    assert parsed["magi_request_ttft_seconds_count"] == 2
+    assert parsed["magi_request_ttft_seconds_sum"] == pytest.approx(0.55)
+    assert parsed["magi_request_ttft_seconds_bucket{le=+Inf}"] == 2
+    assert parsed["magi_request_ttft_seconds_bucket{le=0.1}"] == 1
+    assert parsed["magi_request_ttft_seconds_bucket{le=1}"] == 2
+    # TYPE lines present and well-formed
+    assert "# TYPE magi_plan_builds_total counter" in text
+    assert "# TYPE magi_sched_queue_depth gauge" in text
+    assert "# TYPE magi_request_ttft_seconds histogram" in text
+
+
+def test_bucket_counts_are_cumulative_and_monotone():
+    snap = _sample_registry().snapshot()
+    parsed = exposition.parse_prometheus_text(
+        exposition.render_prometheus(snap)
+    )
+    buckets = sorted(
+        (float(k.split("le=")[1].rstrip("}")) if "Inf" not in k else
+         float("inf"), v)
+        for k, v in parsed.items()
+        if k.startswith("magi_request_ttft_seconds_bucket")
+    )
+    values = [v for _, v in buckets]
+    assert values == sorted(values)
+    assert values[-1] == 2
+
+
+def test_label_value_escaping_round_trips():
+    reg = MetricsRegistry()
+    reg.gauge_set("magi_test_gauge", 1, note='we "quote" and \\slash')
+    text = exposition.render_prometheus(reg.snapshot())
+    parsed = exposition.parse_prometheus_text(text)
+    assert parsed['magi_test_gauge{note=we "quote" and \\slash}'] == 1
+
+
+def test_label_backslash_n_round_trips():
+    """Regression: a literal backslash followed by 'n' (r'C:\\new') must
+    survive render->parse — sequential unescape replacements used to
+    decode the pair as a newline."""
+    reg = MetricsRegistry()
+    reg.gauge_set("magi_test_gauge", 1, path="C:\\new", nl="a\nb")
+    text = exposition.render_prometheus(reg.snapshot())
+    parsed = exposition.parse_prometheus_text(text)
+    assert parsed["magi_test_gauge{nl=a\nb,path=C:\\new}"] == 1
+
+
+def test_parse_rejects_malformed_lines():
+    with pytest.raises(ValueError):
+        exposition.parse_prometheus_text("not a metric line at all {{{")
+
+
+def test_empty_snapshot_renders_empty():
+    assert exposition.render_prometheus({}) == ""
+    assert exposition.parse_prometheus_text("") == {}
+
+
+def test_snapshot_delta_counters_become_rates():
+    reg = MetricsRegistry()
+    reg.counter_inc("magi_decode_tokens_total", 10)
+    prev = reg.snapshot()
+    reg.counter_inc("magi_decode_tokens_total", 30)
+    reg.gauge_set("magi_sched_queue_depth", 7)
+    curr = reg.snapshot()
+    d = exposition.snapshot_delta(prev, curr, seconds=15.0)
+    assert d["counters"]["magi_decode_tokens_total"] == 30
+    assert d["counters_per_s"]["magi_decode_tokens_total"] == pytest.approx(
+        2.0
+    )
+    assert d["gauges"]["magi_sched_queue_depth"] == 7
+    assert d["window_seconds"] == 15.0
+
+
+def test_snapshot_delta_counter_reset_reports_current():
+    prev = {"counters": {"magi_decode_tokens_total": 100}}
+    curr = {"counters": {"magi_decode_tokens_total": 4}}
+    d = exposition.snapshot_delta(prev, curr)
+    assert d["counters"]["magi_decode_tokens_total"] == 4
+
+
+def test_snapshot_delta_histograms_difference_bucketwise():
+    reg = MetricsRegistry()
+    reg.histogram_observe("h", 0.05)
+    prev = reg.snapshot()
+    reg.histogram_observe("h", 0.05)
+    reg.histogram_observe("h", 5.0)
+    curr = reg.snapshot()
+    d = exposition.snapshot_delta(prev, curr)
+    dh = d["histograms"]["h"]
+    assert dh["count"] == 2
+    assert dh["sum"] == pytest.approx(5.05)
+    assert sum(dh["bucket_counts"]) == 2
+    assert dh["mean"] == pytest.approx(2.525)
+    assert dh["p50"] is not None
+
+
+def test_snapshot_delta_without_prev_is_identity_on_counters():
+    reg = MetricsRegistry()
+    reg.counter_inc("c", 5)
+    d = exposition.snapshot_delta(None, reg.snapshot())
+    assert d["counters"]["c"] == 5
+    assert "counters_per_s" not in d
+
+
+# ---------------------------------------------------------------------------
+# the scrape server
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_server_serves_live_registry():
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    srv = None
+    try:
+        telemetry.get_registry().counter_inc("magi_decode_steps_total", 4)
+        srv = exposition.MetricsServer(0, host="127.0.0.1").start()
+        base = f"http://127.0.0.1:{srv.port}"
+        body = urllib.request.urlopen(base + "/metrics").read().decode()
+        parsed = exposition.parse_prometheus_text(body)
+        assert parsed["magi_decode_steps_total"] == 4
+        snap = json.loads(
+            urllib.request.urlopen(base + "/metrics.json").read()
+        )
+        assert snap["counters"]["magi_decode_steps_total"] == 4
+        assert (
+            urllib.request.urlopen(base + "/healthz").read() == b"ok\n"
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope")
+    finally:
+        if srv is not None:
+            srv.stop()
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
+def test_ensure_metrics_server_off_by_default(monkeypatch):
+    monkeypatch.delenv("MAGI_ATTENTION_METRICS_PORT", raising=False)
+    assert exposition.ensure_metrics_server() is None
+
+
+def test_start_metrics_server_requires_port(monkeypatch):
+    monkeypatch.delenv("MAGI_ATTENTION_METRICS_PORT", raising=False)
+    exposition.stop_metrics_server()
+    with pytest.raises(ValueError):
+        exposition.start_metrics_server()
+
+
+def test_metrics_port_env_validation(monkeypatch):
+    from magiattention_tpu import env
+
+    monkeypatch.setenv("MAGI_ATTENTION_METRICS_PORT", "70000")
+    with pytest.raises(ValueError):
+        env.metrics_port()
+    monkeypatch.setenv("MAGI_ATTENTION_METRICS_PORT", "0")
+    assert env.metrics_port() == 0

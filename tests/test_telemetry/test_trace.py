@@ -1,0 +1,305 @@
+"""Request-lifecycle tracing (ISSUE 11): span emission, span-tree
+reconstruction, partial marking, Chrome/JSONL export, flight recorder."""
+
+import json
+import os
+
+import pytest
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.telemetry import trace
+from magiattention_tpu.telemetry.events import EventBuffer
+
+
+@pytest.fixture()
+def live_telemetry():
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    yield
+    telemetry.set_enabled(None)
+    telemetry.reset()
+
+
+def _emit_full_lifecycle(rid=3, tokens=2):
+    tid = trace.new_trace_id(rid)
+    trace.span_submit(tid, rid, prompt_len=16, max_new_tokens=tokens)
+    trace.span_admitted(
+        tid, rid, slot=0, prefix_len=8, shared_pages=1, evicted=0,
+        queue_s=0.25,
+    )
+    trace.span_prefill_chunk(
+        tid, rid, tokens=8, chunk_idx=0, start=8, start_s=1.0,
+        duration_s=0.5,
+    )
+    for i in range(tokens):
+        trace.span_decode_step(
+            tid, rid, token_idx=i, batch=1, num_splits=2,
+            cascade_group=None, start_s=2.0 + i, duration_s=0.1,
+            ttft_s=0.75 if i == 0 else None,
+            token_latency_s=None if i == 0 else 0.125,
+        )
+    trace.span_finished(tid, rid, tokens=tokens)
+    return tid
+
+
+def test_export_reconstructs_complete_tree(live_telemetry):
+    tid = _emit_full_lifecycle(rid=3, tokens=2)
+    traces = telemetry.export_request_traces()
+    tr = traces[tid]
+    assert tr.rid == 3
+    assert tr.complete and not tr.partial
+    kinds = [s["kind"] for s in tr.spans]
+    assert kinds == [
+        "submit", "admitted", "prefill_chunk", "decode_step",
+        "decode_step", "finished",
+    ]
+    assert [s["seq"] for s in tr.spans] == list(range(6))
+    assert tr.stats["queue_s"] == 0.25
+    assert tr.stats["ttft_s"] == 0.75
+    assert tr.stats["tokens"] == 2
+    assert tr.stats["prefill_chunks"] == 1
+    assert tr.stats["prefill_tokens"] == 8
+    assert tr.stats["prefix_hit_tokens"] == 8
+    assert tr.stats["token_latency_samples"] == [0.125]
+    assert tr.stats["tokens_per_s"] == pytest.approx(8.0)
+
+
+def test_span_helpers_feed_slo_histograms_from_same_floats(live_telemetry):
+    """The no-drift property: histogram samples == trace-attr samples."""
+    _emit_full_lifecycle(rid=1, tokens=3)
+    _emit_full_lifecycle(rid=2, tokens=2)
+    snap = telemetry.snapshot()
+    traces = telemetry.export_request_traces()
+    ttfts, toklats, queues = [], [], []
+    for tr in traces.values():
+        if tr.stats["ttft_s"] is not None:
+            ttfts.append(tr.stats["ttft_s"])
+        toklats.extend(tr.stats["token_latency_samples"])
+        queues.extend(tr.stats["queue_samples"])
+    h = snap["histograms"]
+    assert h["magi_request_ttft_seconds"]["count"] == len(ttfts)
+    assert h["magi_request_ttft_seconds"]["sum"] == pytest.approx(sum(ttfts))
+    assert h["magi_request_token_latency_seconds"]["count"] == len(toklats)
+    assert h["magi_request_token_latency_seconds"]["sum"] == pytest.approx(
+        sum(toklats)
+    )
+    assert h["magi_request_queue_seconds"]["count"] == len(queues)
+    assert snap["counters"]["magi_request_traces_total"] == 2
+
+
+def test_truncated_trace_marked_partial_not_complete(live_telemetry):
+    tid = _emit_full_lifecycle(rid=5, tokens=2)
+    events = telemetry.get_event_buffer().events()
+    # simulate ring eviction of the oldest spans
+    truncated = events[2:]
+    traces = telemetry.export_request_traces(truncated, dropped=2)
+    tr = traces[tid]
+    assert tr.partial
+    assert not tr.complete
+    assert [s["seq"] for s in tr.spans] == [2, 3, 4, 5]
+
+
+def test_ring_drop_counter_and_partial_end_to_end(live_telemetry):
+    """A too-small ring drops oldest spans: the magi_trace_events_dropped
+    counter ticks and reconstruction flags the trace partial."""
+    buf = EventBuffer(maxlen=3)
+    for i in range(5):
+        buf.record(
+            "req:decode_step",
+            float(i),
+            0.0,
+            {"trace_id": "t-0", "kind": "decode_step", "seq": i, "rid": 0},
+        )
+    assert buf.dropped == 2
+    assert len(buf) == 3
+    snap = telemetry.snapshot()
+    assert snap["counters"]["magi_trace_events_dropped_total"] == 2
+    traces = telemetry.export_request_traces(
+        buf.events(), dropped=buf.dropped
+    )
+    assert traces["t-0"].partial
+    buf.clear()
+    assert buf.dropped == 0
+
+
+def test_chrome_export_one_track_per_request(live_telemetry):
+    t1 = _emit_full_lifecycle(rid=1, tokens=1)
+    t2 = _emit_full_lifecycle(rid=2, tokens=1)
+    payload = telemetry.request_traces_to_chrome()
+    evs = payload["traceEvents"]
+    spans = [e for e in evs if e.get("ph") == "X"]
+    # rid 1 -> pid 0, rid 2 -> pid 1 (rid-ordered)
+    assert {e["pid"] for e in spans} == {0, 1}
+    procs = {
+        e["pid"]: e["args"]["name"]
+        for e in evs
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert t1 in procs[0] and "request 1" in procs[0]
+    assert t2 in procs[1] and "request 2" in procs[1]
+
+
+def test_jsonl_dump_round_trips(live_telemetry, tmp_path):
+    _emit_full_lifecycle(rid=1, tokens=1)
+    _emit_full_lifecycle(rid=2, tokens=2)
+    path = telemetry.dump_request_traces_jsonl(str(tmp_path / "t.jsonl"))
+    rows = [json.loads(line) for line in open(path)]
+    assert [r["rid"] for r in rows] == [1, 2]
+    assert all(r["complete"] for r in rows)
+    assert rows[1]["stats"]["tokens"] == 2
+    cpath = telemetry.dump_request_traces(str(tmp_path / "t.json"))
+    assert json.load(open(cpath))["traceEvents"]
+
+
+def test_request_context_tags_engine_side_spans(live_telemetry):
+    tid = trace.new_trace_id(9)
+    assert trace.current_trace() is None
+    with trace.request_context(tid, 9):
+        assert trace.current_trace() == (tid, 9)
+        trace.span_for_current(trace.SPAN_COW, page=4)
+    trace.span_for_current(trace.SPAN_COW)  # no context: dropped
+    traces = telemetry.export_request_traces()
+    assert [s["kind"] for s in traces[tid].spans] == ["cow"]
+    assert traces[tid].spans[0]["attrs"]["page"] == 4
+    assert len(traces) == 1
+
+
+def test_disabled_telemetry_emits_nothing():
+    telemetry.set_enabled(False)
+    try:
+        _emit_full_lifecycle(rid=7)
+        assert len(telemetry.get_event_buffer()) == 0
+        assert telemetry.snapshot()["histograms"] == {}
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def flight_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_TRACE_DIR", str(tmp_path))
+    return tmp_path
+
+
+def test_flight_recorder_immediate_dump_contains_ring(flight_dir):
+    fr = trace.FlightRecorder(depth=4)
+    for i in range(6):
+        fr.record_tick({"step": i, "tokens_used": 10 * i})
+    path = fr.trigger("numerical_guard", sites=["stage1"])
+    assert path is not None and os.path.exists(path)
+    payload = json.load(open(path))
+    assert payload["trigger"]["trigger"] == "numerical_guard"
+    assert payload["trigger"]["context"]["sites"] == ["stage1"]
+    # bounded ring: only the last `depth` ticks survive
+    assert [t["step"] for t in payload["ticks"]] == [2, 3, 4, 5]
+    assert payload["ticks_dropped"] >= 1
+
+
+def test_flight_recorder_deferred_dump_includes_faulting_tick(flight_dir):
+    fr = trace.FlightRecorder(depth=8)
+    fr.record_tick({"step": 1})
+    fr.trigger("engine_fault", immediate=False, slot=3)
+    # nothing written yet: the dump waits for the tick-loop flush
+    assert fr.dump_paths == []
+    fr.record_tick({"step": 2, "aborted": "ChaosInjectedError(...)"})
+    path = fr.flush()
+    assert path is not None
+    payload = json.load(open(path))
+    assert payload["trigger"]["trigger"] == "engine_fault"
+    assert payload["ticks"][-1]["aborted"].startswith("ChaosInjectedError")
+
+
+def test_flight_recorder_empty_ring_never_writes(flight_dir):
+    fr = trace.FlightRecorder(depth=8)
+    assert fr.trigger("degraded_path", reason="x") is None
+    assert fr.flush() is None
+    assert list(flight_dir.iterdir()) == []
+
+
+def test_flight_recorder_rejection_storm_arms_dump(flight_dir):
+    fr = trace.FlightRecorder(depth=8, storm_threshold=3)
+    fr.record_tick({"step": 1})
+    fr.note_admission(True)
+    for _ in range(2):
+        fr.note_admission(False, "pool_exhausted")
+    assert fr.flush() is None  # below threshold
+    fr.note_admission(False, "pool_exhausted")  # third consecutive
+    path = fr.flush()
+    assert path is not None
+    payload = json.load(open(path))
+    assert payload["trigger"]["trigger"] == "admission_rejection_storm"
+    assert len(payload["admissions"]) == 4
+
+
+def test_flight_recorder_depth_zero_disables(flight_dir):
+    fr = trace.FlightRecorder(depth=0)
+    fr.record_tick({"step": 1})
+    fr.note_admission(False, "pool_exhausted")
+    assert fr.trigger("numerical_guard") is None
+    assert list(flight_dir.iterdir()) == []
+
+
+def test_flight_recorder_slow_tick_arm_survives_ttl(flight_dir):
+    """An arm that fired DURING a tick is flushed however long the tick
+    took (first-call jit compiles run for minutes): the tick's start
+    stamp, not wall-clock TTL, decides staleness."""
+    import time as _time
+
+    fr = trace.FlightRecorder(depth=4)
+    fr.ARM_TTL_S = 0.05
+    tick_start = _time.perf_counter()
+    fr.trigger("admission_rejection_storm", immediate=False)
+    _time.sleep(0.06)  # the "tick" outlives the TTL
+    fr.record_tick({"step": 1}, start_t=tick_start)
+    path = fr.flush()
+    assert path is not None
+    payload = json.load(open(path))
+    assert payload["trigger"]["trigger"] == "admission_rejection_storm"
+
+
+def test_flight_recorder_orphan_arm_expires(flight_dir):
+    """An arm predating the recorded tick (engine fault outside any
+    scheduler) still expires: it must not attach itself to a later,
+    unrelated scheduler run."""
+    import time as _time
+
+    fr = trace.FlightRecorder(depth=4)
+    fr.ARM_TTL_S = 0.05
+    fr.record_tick({"step": 0})
+    fr.trigger("engine_fault", immediate=False, slot=1)
+    _time.sleep(0.06)
+    fr.record_tick({"step": 1}, start_t=_time.perf_counter())
+    assert fr.flush() is None
+    assert fr.dump_paths == []
+
+
+def test_flight_recorder_stale_arm_does_not_swallow_live_signal(flight_dir):
+    """A stale deferred arm must not make a later immediate trigger's
+    dump vanish: the live signal replaces it and dumps under its own
+    name."""
+    import time as _time
+
+    fr = trace.FlightRecorder(depth=4)
+    fr.ARM_TTL_S = 0.05
+    fr.record_tick({"step": 0})
+    fr.trigger("engine_fault", immediate=False, slot=1)  # never flushed
+    _time.sleep(0.06)
+    path = fr.trigger("numerical_guard", sites=["host"])
+    assert path is not None
+    payload = json.load(open(path))
+    assert payload["trigger"]["trigger"] == "numerical_guard"
+    assert payload["trigger"]["context"]["sites"] == ["host"]
+
+
+def test_flight_recorder_dump_cap(flight_dir):
+    fr = trace.FlightRecorder(depth=4, max_dumps=2)
+    fr.record_tick({"step": 1})
+    assert fr.trigger("a") is not None
+    assert fr.trigger("b") is not None
+    assert fr.trigger("c") is None  # capped
+    assert len(list(flight_dir.iterdir())) == 2
