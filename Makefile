@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze resilience-check roofline-check roofline-report trace-check check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze resilience-check roofline-check roofline-report trace-check distserve-check check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -142,6 +142,18 @@ roofline-check:
 trace-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_trace_check.py
 
+# disaggregated-serving gate (ISSUE 12, the ROADMAP item-2 gate; CPU,
+# 8 emulated chips): KV-head-sharded TP decode bitwise-matches the
+# single-chip reference, prefill->decode page streams round-trip
+# exactly (digest + gathered-KV equality), aggregate decode tokens/s
+# scales with decode chip count at flat p99 token latency (logical tick
+# clock; trace written to exps/data/distserve_scaling.json), and a
+# chaos-injected decode-chip fault ends in trace-verified
+# requeue+replay with a flight-recorder post-mortem — never a hang
+# (exps/run_distserve_check.py exits non-zero on any violation)
+distserve-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_distserve_check.py
+
 # mask-aware roofline report + occupancy JSON artifact for the 16k
 # varlen block-causal headline (docs/observability.md "Roofline &
 # occupancy"); host-side only
@@ -152,5 +164,5 @@ roofline-report:
 # timeline/aggregate semantics, autotuner rung expectations, perf gate,
 # serving parity, shared-prefix/scheduler gate, group-collective
 # parity/volume, resilience gate, roofline/occupancy gate, request
-# tracing/exposition gate — all CPU-safe
-check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check
+# tracing/exposition gate, disaggregated-serving gate — all CPU-safe
+check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check distserve-check

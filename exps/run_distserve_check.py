@@ -1,0 +1,432 @@
+"""Disaggregated-serving drift guard (``make distserve-check``) — CPU.
+
+The ISSUE 12 acceptance surface (the ROADMAP item-2 gate), on emulated
+multi-chip meshes (``xla_force_host_platform_device_count=8``):
+
+1. **TP decode parity, bitwise**: KV-head-sharded decode over the
+   sharded page pool (``tp_decode_attn``, tp in {1, 2, 4}) equals the
+   single-chip split-KV reference bit for bit — per-head math and the
+   LSE merge are untouched by the sharding.
+2. **Page-stream integrity**: the prefill -> decode page transfer
+   round-trips exactly — payload digest equality on every stream
+   (``verify_streams``) plus gathered-KV bit equality against the
+   prefill tier's committed pages.
+3. **The scaling trace**: one fixed multi-tenant workload driven
+   through the ``TieredScheduler`` on 1, 2 and 4 decode replicas with a
+   LOGICAL tick clock — aggregate decode tokens per tick must INCREASE
+   with the chip count while the p99 per-token latency stays FLAT (one
+   tick per token for every decoding request, regardless of fleet
+   width). The trace is written to ``exps/data/distserve_scaling.json``.
+4. **Fault -> requeue+replay, trace-verified**: a chaos-injected
+   ``decode_fault`` (one decode chip dies mid-step) must end with every
+   request finished, the victims' traces showing evicted{reason=
+   decode_fault} -> requeued -> a SECOND pages_streamed/tier_migrated,
+   a flight-recorder post-mortem on disk, and every
+   ``REQUIRED_DISTSERVE_METRICS`` name populated — never a hang.
+
+Exits non-zero on any violation.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] = "jnp"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from magiattention_tpu import telemetry  # noqa: E402
+from magiattention_tpu.resilience import chaos  # noqa: E402
+from magiattention_tpu.serving import (  # noqa: E402
+    Request,
+    TieredEngine,
+    TieredScheduler,
+    assign_block_table,
+    decode_attn_paged,
+    gather_kv,
+    make_paged_kv_cache,
+    shard_kv_cache,
+    tp_decode_attn,
+    write_prefill_kv,
+)
+
+HQ, HK, D = 8, 4, 32
+VOCAB = 97
+ARTIFACT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "data",
+    "distserve_scaling.json",
+)
+
+_rng = np.random.default_rng(0)
+EMB_K = _rng.standard_normal((VOCAB, HK, D)).astype(np.float32)
+EMB_V = _rng.standard_normal((VOCAB, HK, D)).astype(np.float32)
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def kv_of(tokens):
+    idx = np.asarray(tokens, np.int64)
+    return jnp.asarray(EMB_K[idx]), jnp.asarray(EMB_V[idx])
+
+
+def mk_request(rng, rid, tokens, gen):
+    k, v = kv_of(tokens)
+    return Request(
+        rid=rid,
+        prompt_q=jnp.asarray(
+            rng.standard_normal((len(tokens), HQ, D)), jnp.float32
+        ),
+        prompt_k=k,
+        prompt_v=v,
+        decode_q=jnp.asarray(rng.standard_normal((gen, HQ, D)), jnp.float32),
+        decode_k=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        decode_v=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        tokens=list(tokens),
+    )
+
+
+class TickClock:
+    """Logical scheduler clock: one unit per tick, so SLO samples are
+    deterministic tick counts instead of wall-noise — the only honest
+    latency unit on an emulated (time-shared CPU) mesh."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def check_tp_parity() -> int:
+    rng = np.random.default_rng(1)
+    lengths = [53, 17, 40, 9]
+    mpp, ps = 8, 8
+    cache = make_paged_kv_cache(
+        len(lengths) * mpp + 2, ps, HK, D, max_seqs=len(lengths),
+        max_pages_per_seq=mpp, dtype=jnp.float32,
+    )
+    nxt = 1
+    for slot, t in enumerate(lengths):
+        pages = list(range(nxt, nxt + mpp))
+        nxt += mpp
+        cache = assign_block_table(cache, slot, pages)
+        k = jnp.asarray(rng.standard_normal((t, HK, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((t, HK, D)), jnp.float32)
+        cache = write_prefill_kv(cache, slot, k, v)
+    q = jnp.asarray(
+        rng.standard_normal((len(lengths), HQ, D)), jnp.float32
+    )
+    slots = jnp.arange(len(lengths), dtype=jnp.int32)
+    ref_out, ref_lse = decode_attn_paged(q, cache, slots, num_splits=2)
+    for tp in (1, 2, 4):
+        mesh = Mesh(np.asarray(jax.devices()[:tp]), ("tp",))
+        sc = shard_kv_cache(cache, mesh)
+        if tp > 1 and len(sc.k_pages.devices()) != tp:
+            return fail(f"tp={tp}: pool not device-sharded across the mesh")
+        out, lse = tp_decode_attn(q, sc, slots, mesh=mesh, num_splits=2)
+        if not np.array_equal(np.asarray(out), np.asarray(ref_out)):
+            return fail(f"tp={tp} decode out != single-chip (bitwise)")
+        if not np.array_equal(np.asarray(lse), np.asarray(ref_lse)):
+            return fail(f"tp={tp} decode lse != single-chip (bitwise)")
+    print(
+        "distserve-check: TP decode bitwise-matches the single-chip "
+        "reference for tp in {1, 2, 4} over the KV-head-sharded pool"
+    )
+    return 0
+
+
+def check_stream_integrity() -> int:
+    rng = np.random.default_rng(2)
+    telemetry.set_enabled(True)
+    eng = TieredEngine(
+        num_pages=64, num_kv_heads=HK, head_dim=D, page_size=8,
+        max_seqs=8, max_pages_per_seq=8, dtype=jnp.float32,
+        mesh_spec={"prefill": 1, "decode_dp": 2, "decode_tp": 2},
+        verify_streams=True,
+    )
+    for n_tok in (24, 21, 9):  # aligned, unaligned, sub-page
+        toks = list(rng.integers(0, VOCAB, n_tok))
+        res = eng.admit(len(toks), tokens=toks)
+        if not res.admitted:
+            return fail(f"admission refused for {n_tok}-token prompt")
+        k, v = kv_of(toks)
+        q = jnp.asarray(
+            rng.standard_normal((len(toks), HQ, D)), jnp.float32
+        )
+        # keep a contiguous copy of what prefill will commit: the
+        # stream retires the prefill slot, so compare against this
+        eng.prefill(q, k, v, res.slot)
+        reports = eng.take_stream_reports()
+        if len(reports) != 1:
+            return fail(f"expected 1 stream, saw {len(reports)}")
+        rep = reports[0]
+        if rep.digest_ok is not True:
+            return fail(
+                f"stream digest mismatch for {n_tok}-token prompt "
+                f"(digest_ok={rep.digest_ok})"
+            )
+        rec = eng._seq[res.slot]
+        replica = eng.replicas[rec["replica"]]
+        dk, dv = gather_kv(
+            replica.engine.cache, rec["dslot"], max_len=n_tok
+        )
+        if not (
+            np.array_equal(np.asarray(dk), np.asarray(k))
+            and np.array_equal(np.asarray(dv), np.asarray(v))
+        ):
+            return fail(
+                f"decode-tier gathered KV != prefill KV ({n_tok} tokens)"
+            )
+    print(
+        "distserve-check: page streams round-trip exactly (digest + "
+        "gathered-KV bit equality) for aligned/unaligned/sub-page prompts"
+    )
+    return 0
+
+
+def check_scaling_trace() -> int:
+    rng = np.random.default_rng(3)
+    n_req, gen, prompt = 8, 8, 8
+    prompts = [list(rng.integers(0, VOCAB, prompt)) for _ in range(n_req)]
+    rows = []
+    for dp in (1, 2, 4):
+        telemetry.set_enabled(True)
+        telemetry.reset()
+        telemetry.reset_request_traces()
+        clock = TickClock()
+        eng = TieredEngine(
+            # 2 slots per replica: each chip decodes at most 2 requests
+            # concurrently, so fleet width is what scales throughput
+            num_pages=16, num_kv_heads=HK, head_dim=D, page_size=8,
+            max_seqs=2, max_pages_per_seq=4, dtype=jnp.float32,
+            mesh_spec={"prefill": 1, "decode_dp": dp, "decode_tp": 1},
+        )
+        sched = TieredScheduler(
+            eng, prefill_budget=64, decode_budget=64, clock=clock
+        )
+        rng_i = np.random.default_rng(4)
+        for i, toks in enumerate(prompts):
+            sched.submit(mk_request(rng_i, i, toks, gen))
+        reports = []
+        while not sched.done:
+            if len(reports) > 500:
+                return fail(f"dp={dp}: scheduler did not drain")
+            reports.append(sched.step())
+            clock.t += 1.0
+        total = sum(r.decode_batch for r in reports)
+        if total != n_req * gen:
+            return fail(
+                f"dp={dp}: {total} decode tokens, expected {n_req * gen}"
+            )
+        traces = telemetry.export_request_traces()
+        latencies = [
+            s
+            for tr in traces.values()
+            for s in tr.stats["token_latency_samples"]
+        ]
+        p99 = float(np.percentile(latencies, 99)) if latencies else 0.0
+        rows.append(
+            {
+                "decode_chips": dp,
+                "ticks": len(reports),
+                "decode_tokens": total,
+                "tokens_per_tick": total / len(reports),
+                "p99_token_latency_ticks": p99,
+                "streams": int(
+                    telemetry.snapshot()["counters"].get(
+                        "magi_page_streams_total", 0
+                    )
+                ),
+            }
+        )
+    print("distserve-check scaling trace (logical tick clock):")
+    print(f"  {'chips':>5} {'ticks':>6} {'tok/tick':>9} {'p99 (ticks)':>12}")
+    for r in rows:
+        print(
+            f"  {r['decode_chips']:>5} {r['ticks']:>6} "
+            f"{r['tokens_per_tick']:>9.2f} "
+            f"{r['p99_token_latency_ticks']:>12.2f}"
+        )
+    for a, b in zip(rows, rows[1:]):
+        if not b["tokens_per_tick"] > a["tokens_per_tick"] * 1.2:
+            return fail(
+                f"aggregate decode tokens/tick did not scale: "
+                f"{a['decode_chips']} chips -> {a['tokens_per_tick']:.2f}, "
+                f"{b['decode_chips']} chips -> {b['tokens_per_tick']:.2f}"
+            )
+    p99s = [r["p99_token_latency_ticks"] for r in rows]
+    if max(p99s) - min(p99s) > 1e-9:
+        return fail(
+            f"p99 token latency not flat across fleet widths: {p99s}"
+        )
+    os.makedirs(os.path.dirname(ARTIFACT), exist_ok=True)
+    with open(ARTIFACT, "w") as f:
+        json.dump(
+            {
+                "workload": {
+                    "requests": n_req, "prompt_tokens": prompt,
+                    "decode_tokens": gen,
+                    "slots_per_replica": 2,
+                },
+                "clock": "logical ticks (one per scheduler step)",
+                "rows": rows,
+            },
+            f, indent=1,
+        )
+        f.write("\n")
+    print(
+        f"distserve-check: decode tokens/tick scaled "
+        f"{rows[0]['tokens_per_tick']:.2f} -> {rows[-1]['tokens_per_tick']:.2f} "
+        f"over 1 -> {rows[-1]['decode_chips']} decode chips at flat p99 "
+        f"{p99s[0]:.2f} ticks; trace -> {os.path.relpath(ARTIFACT)}"
+    )
+    return 0
+
+
+def check_fault_requeue_replay() -> int:
+    rng = np.random.default_rng(5)
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    telemetry.reset_request_traces()
+    tmp = tempfile.mkdtemp(prefix="magi_distserve_")
+    os.environ["MAGI_ATTENTION_TRACE_DIR"] = tmp
+    os.environ["MAGI_ATTENTION_CHAOS"] = "decode_fault:times=1"
+    chaos.reset_chaos()
+    telemetry.reset_flight_recorder()
+    try:
+        eng = TieredEngine(
+            num_pages=64, num_kv_heads=HK, head_dim=D, page_size=8,
+            max_seqs=8, max_pages_per_seq=8, dtype=jnp.float32,
+            mesh_spec={"prefill": 1, "decode_dp": 2, "decode_tp": 1},
+            verify_streams=True,
+        )
+        sched = TieredScheduler(eng, prefill_budget=64, decode_budget=16)
+        gen = 4
+        for i in range(4):
+            sched.submit(
+                mk_request(rng, i, list(rng.integers(0, VOCAB, 12)), gen)
+            )
+        reports = sched.run(max_steps=100)  # a hang raises here
+        for i in range(4):
+            st = sched.result(i)
+            if st.status != "finished" or len(st.decode_outs) != gen:
+                return fail(
+                    f"request {i} did not replay to completion "
+                    f"({st.status}, {len(st.decode_outs)}/{gen} tokens)"
+                )
+        traces = telemetry.export_request_traces()
+        replayed = []
+        for tr in traces.values():
+            kinds = [s["kind"] for s in tr.spans]
+            if kinds.count("pages_streamed") == 2:
+                ev = next(s for s in tr.spans if s["kind"] == "evicted")
+                if ev["attrs"].get("reason") != "decode_fault":
+                    return fail(
+                        f"evicted span lacks the fault reason: {ev['attrs']}"
+                    )
+                rq = kinds.index("requeued")
+                if "tier_migrated" not in kinds[rq:]:
+                    return fail(
+                        "no tier_migrated after requeue — replay not traced"
+                    )
+                replayed.append(tr)
+        if not replayed:
+            return fail(
+                "no trace shows the second page stream (replay missing)"
+            )
+        flight = telemetry.get_flight_recorder()
+        if not flight.dump_paths:
+            return fail("decode fault did not dump the flight recorder")
+        with open(flight.dump_paths[-1]) as f:
+            dump = json.load(f)
+        if dump["trigger"]["trigger"] != "decode_tier_fault":
+            return fail(
+                f"flight dump trigger is {dump['trigger']['trigger']}"
+            )
+        snap = telemetry.snapshot()
+        present = set()
+        for sec in snap.values():
+            for k in sec:
+                present.add(k.split("{", 1)[0])
+        missing = [
+            m for m in telemetry.REQUIRED_DISTSERVE_METRICS
+            if m not in present
+        ]
+        if missing:
+            return fail(f"distserve metric catalog missing: {missing}")
+        print(
+            f"distserve-check: decode-chip fault absorbed in "
+            f"{len(reports)} ticks — {len(replayed)} request(s) "
+            "requeued+replayed (trace-verified second stream), flight "
+            f"post-mortem at {flight.dump_paths[-1]}, all "
+            f"{len(telemetry.REQUIRED_DISTSERVE_METRICS)} catalog "
+            "metrics live"
+        )
+        return 0
+    finally:
+        os.environ.pop("MAGI_ATTENTION_CHAOS", None)
+        chaos.reset_chaos()
+
+
+def main() -> int:
+    env_backup = {
+        k: os.environ.get(k)
+        for k in (
+            "MAGI_ATTENTION_KERNEL_BACKEND",
+            "MAGI_ATTENTION_CHAOS",
+            "MAGI_ATTENTION_TRACE_DIR",
+            "MAGI_ATTENTION_SERVING_MESH",
+        )
+    }
+    # every flight dump (the scaling trace's deliberate backpressure
+    # waves arm rejection-storm dumps) lands in a temp dir, not the repo
+    os.environ["MAGI_ATTENTION_TRACE_DIR"] = tempfile.mkdtemp(
+        prefix="magi_distserve_"
+    )
+    telemetry.reset_flight_recorder()
+    try:
+        for check in (
+            check_tp_parity,
+            check_stream_integrity,
+            check_scaling_trace,
+            check_fault_requeue_replay,
+        ):
+            rc = check()
+            if rc:
+                return rc
+    finally:
+        telemetry.set_enabled(None)
+        for kk, vv in env_backup.items():
+            if vv is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = vv
+    print(
+        "distserve-check OK: bitwise TP decode, exact page-stream "
+        "round-trip, decode tokens/s scaling with chip count at flat "
+        "p99, fault -> requeue+replay (never a hang)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

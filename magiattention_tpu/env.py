@@ -344,6 +344,82 @@ def cascade_mode() -> str:
     return v
 
 
+SERVING_TIERS = ("prefill", "decode")
+
+
+def serving_mesh() -> dict | None:
+    """Disaggregated-serving mesh spec (``serving/distributed.py``),
+    validated here: ``MAGI_ATTENTION_SERVING_MESH`` names how many chips
+    each serving tier owns, e.g. ``"prefill=1,decode=4"`` (four
+    single-chip decode replicas) or ``"prefill=2,decode=2x2"`` (decode =
+    2 data-parallel replicas x TP degree 2 — ``DxT`` chips). Unset/''
+    (the default) returns ``None`` = single-chip serving, the
+    :class:`~magiattention_tpu.serving.engine.ServingEngine` path.
+
+    Returns ``{"prefill": P, "decode_dp": D, "decode_tp": T}``. Chip
+    availability (P + D*T <= len(jax.devices())) is checked where the
+    tiers are built, not here — env parsing stays jax-free. Serving-host
+    topology only (never changes a plan or a distributed runtime key),
+    so NOT part of :func:`flags_fingerprint`."""
+    v = _env_str("MAGI_ATTENTION_SERVING_MESH", "").strip().lower()
+    if not v:
+        return None
+    out = {"prefill": 1, "decode_dp": 1, "decode_tp": 1}
+    seen = set()
+    for item in v.split(","):
+        tier, eq, count = item.partition("=")
+        tier = tier.strip()
+        if not eq or tier not in SERVING_TIERS:
+            raise ValueError(
+                f"MAGI_ATTENTION_SERVING_MESH: bad clause {item!r} "
+                f"(want tier=count with tier in {SERVING_TIERS})"
+            )
+        if tier in seen:
+            raise ValueError(
+                f"MAGI_ATTENTION_SERVING_MESH: duplicate tier {tier!r}"
+            )
+        seen.add(tier)
+        count = count.strip()
+        try:
+            if tier == "decode" and "x" in count:
+                dp, _, tp = count.partition("x")
+                out["decode_dp"], out["decode_tp"] = int(dp), int(tp)
+            elif tier == "decode":
+                out["decode_dp"] = int(count)
+            else:
+                out[tier] = int(count)
+        except ValueError:
+            raise ValueError(
+                f"MAGI_ATTENTION_SERVING_MESH: {item!r} count must be an "
+                "integer (decode also takes DxT for dp x tp)"
+            ) from None
+    if out["prefill"] < 1 or out["decode_dp"] < 1 or out["decode_tp"] < 1:
+        raise ValueError(
+            f"MAGI_ATTENTION_SERVING_MESH={v!r}: every tier count must be "
+            ">= 1"
+        )
+    return out
+
+
+def tier_token_budget(tier: str) -> int:
+    """Per-tier token budget of one :class:`~magiattention_tpu.serving.
+    distributed.TieredScheduler` tick (``MAGI_ATTENTION_TIER_BUDGET_PREFILL``
+    / ``_DECODE``): the tiers run on DIFFERENT chips, so each gets its own
+    budget instead of sharing the single-chip ``token_budget``. Decode
+    counts one token per decoding sequence per tick; prefill counts chunk
+    rows. Explicit constructor arguments win. Serving-host behavior only,
+    so NOT part of :func:`flags_fingerprint`."""
+    if tier not in SERVING_TIERS:
+        raise ValueError(f"tier_token_budget: unknown tier {tier!r}")
+    v = _env_int(f"MAGI_ATTENTION_TIER_BUDGET_{tier.upper()}", 256)
+    if v < 1:
+        raise ValueError(
+            f"MAGI_ATTENTION_TIER_BUDGET_{tier.upper()}={v} must be a "
+            "positive token count"
+        )
+    return v
+
+
 def decode_splits() -> int | None:
     """Split-KV decode split count (``serving/decode_attn.py``): an
     integer pins the number of KV splits per sequence; 'auto' (default)

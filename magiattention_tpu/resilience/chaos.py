@@ -79,6 +79,10 @@ _KINDS: dict[str, set[str]] = {
     "pool_exhaust": set(),
     "alloc_fail": {"times"},
     "prefill_error": {"times"},
+    # a decode-tier chip dies mid decode step (serving/distributed.py):
+    # the TieredEngine fails the replica, the TieredScheduler requeues
+    # its requests for replay through the prefill tier — never a hang
+    "decode_fault": {"times"},
     "plan_error": {"times"},
     "hops_build_error": {"times"},
     "cache_io_error": {"op", "times"},

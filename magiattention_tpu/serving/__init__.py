@@ -29,6 +29,16 @@ See ``docs/serving.md`` for the architecture walkthrough.
 """
 
 from .cp_decode import cp_decode_attn, cp_merge_partials  # noqa: F401
+from .distributed import (  # noqa: F401
+    DecodeReplica,
+    DecodeTierFault,
+    PendingStream,
+    StreamReport,
+    TieredEngine,
+    TieredScheduler,
+    pages_digest,
+    tp_decode_attn,
+)
 from .decode_attn import (  # noqa: F401
     decode_attn_paged,
     decode_partials_for_tables,
@@ -53,8 +63,10 @@ from .kv_cache import (  # noqa: F401
     assign_block_table,
     copy_page,
     gather_kv,
+    kv_head_sharding,
     make_paged_kv_cache,
     reset_slot,
+    shard_kv_cache,
     swap_block_table_page,
     write_prefill_kv,
 )
@@ -71,11 +83,14 @@ __all__ = [
     "AdmissionResult",
     "CascadeGroup",
     "DecodeBatch",
+    "DecodeReplica",
+    "DecodeTierFault",
     "InvalidFreeError",
     "PageAllocator",
     "PageAllocatorError",
     "PagedKVCache",
     "PageShareError",
+    "PendingStream",
     "PrefixCache",
     "PrefixMatch",
     "Request",
@@ -83,6 +98,9 @@ __all__ = [
     "Scheduler",
     "ServingEngine",
     "StepReport",
+    "StreamReport",
+    "TieredEngine",
+    "TieredScheduler",
     "append_kv",
     "assign_block_table",
     "cascade_decode_attn",
@@ -93,13 +111,17 @@ __all__ = [
     "decode_attn_paged",
     "decode_partials_for_tables",
     "gather_kv",
+    "kv_head_sharding",
     "magi_attn_decode",
     "make_paged_kv_cache",
     "merge_split_partials",
+    "pages_digest",
     "plan_cascade_groups",
     "prefill_into_cache",
     "reset_slot",
     "resolve_num_splits",
+    "shard_kv_cache",
     "swap_block_table_page",
+    "tp_decode_attn",
     "write_prefill_kv",
 ]

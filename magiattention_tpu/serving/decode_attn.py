@@ -383,7 +383,20 @@ def decode_partials_for_tables(
     convention (rows with ``seq_lens == 0`` are ``(0, -inf)``).
     """
     b, hq, d = q.shape
-    assert d == cache.head_dim and hq % cache.num_kv_heads == 0
+    if d != cache.head_dim or hq % cache.num_kv_heads:
+        # a hard ValueError (not a bare assert): the usual way to get
+        # here is a missharded TP call — q heads and KV heads split by
+        # DIFFERENT factors — and inside shard_map an assert surfaces
+        # as an opaque tracer failure with no shapes attached
+        raise ValueError(
+            f"decode_partials_for_tables: q {tuple(q.shape)} is "
+            f"incompatible with the cache's [pages={cache.num_pages}, "
+            f"page_size={cache.page_size}, kv_heads={cache.num_kv_heads}"
+            f", head_dim={cache.head_dim}] layout: need head_dim "
+            f"{d} == {cache.head_dim} and hq {hq} divisible by kv_heads "
+            f"{cache.num_kv_heads} (a KV-head-sharded call must shard "
+            "q heads and KV heads by the same factor)"
+        )
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if interpret is None:
@@ -433,8 +446,21 @@ def decode_attn_paged(
     tokens (append the step's own KV first for standard causal decode).
     """
     b, hq, d = q.shape
-    assert d == cache.head_dim, (q.shape, cache.head_dim)
-    assert hq % cache.num_kv_heads == 0
+    if d != cache.head_dim or hq % cache.num_kv_heads:
+        # ValueError with the full shape context (was a bare assert):
+        # under a sharded TP decode call a mismatch here means the mesh
+        # split q heads and KV heads by different factors, and the
+        # tracer-level assert it used to raise carried no actionable
+        # shapes
+        raise ValueError(
+            f"decode_attn_paged: q {tuple(q.shape)} is incompatible "
+            f"with the cache's [pages={cache.num_pages}, page_size="
+            f"{cache.page_size}, kv_heads={cache.num_kv_heads}, "
+            f"head_dim={cache.head_dim}] layout: need head_dim {d} == "
+            f"{cache.head_dim} and hq {hq} divisible by kv_heads "
+            f"{cache.num_kv_heads} (a KV-head-sharded call must shard "
+            "q heads and KV heads by the same factor)"
+        )
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if interpret is None:

@@ -270,6 +270,7 @@ class ServingEngine:
         dtype=jnp.bfloat16,
         max_admission_evictions: int = 4,
         prefix_sharing: bool = True,
+        decode_attn_fn=None,
     ):
         from .. import env
 
@@ -301,6 +302,13 @@ class ServingEngine:
         # cascade grouping key (set on fork, or at commit_prefix)
         self._slot_prefix: dict[int, tuple[tuple[int, ...], int]] = {}
         self.max_admission_evictions = int(max_admission_evictions)
+        # ISSUE 12: a pluggable attention realization for decode_step —
+        # ``(q, cache, slots, **kw) -> (out, lse)``. A decode-tier
+        # replica substitutes the KV-head-sharded TP decode
+        # (serving/distributed.tp_decode_attn) here while keeping every
+        # host concern (reservation growth, CoW, append, telemetry)
+        # from THIS engine. None = the standard flat/cascade paths.
+        self._decode_attn_fn = decode_attn_fn
         # what the last decode_step resolved (split count, cascade
         # grouping): the scheduler reads this to tag per-request
         # decode_step trace spans (ISSUE 11) — plain host state, not
@@ -754,7 +762,7 @@ class ServingEngine:
         else:
             mode = "on" if cascade else "off"
         groups = []
-        if mode != "off" and self._slot_prefix:
+        if mode != "off" and self._slot_prefix and self._decode_attn_fn is None:
             groups = plan_cascade_groups(
                 self._slot_prefix,
                 slot_list,
@@ -779,6 +787,13 @@ class ServingEngine:
                 out_dtype=kw.get("out_dtype"),
                 interpret=kw.get("interpret"),
             )
+            resolved = 0
+        elif self._decode_attn_fn is not None:
+            # substituted realization (TP decode over the sharded pool):
+            # split resolution happens inside the substitute, so the
+            # num_splits gauge reads 0 = "externally resolved", like the
+            # cascade per-phase convention
+            out, lse = self._decode_attn_fn(q, self.cache, batch.slots, **kw)
             resolved = 0
         else:
             # resolve the split count ONCE (fingerprint + cache lookup)

@@ -327,6 +327,57 @@ def reset_slot(cache: PagedKVCache, slot: int) -> PagedKVCache:
     )
 
 
+# ---------------------------------------------------------------------------
+# device-sharded storage (ISSUE 12): the pool's arrays live on a mesh,
+# the allocator below stays host-side — one logical free list over
+# device-sharded pages
+# ---------------------------------------------------------------------------
+
+
+def kv_head_sharding(mesh, axis_name: str = "tp"):
+    """The TP decode layout for the page pools (after FlashInfer's /
+    SNIPPETS' ``sharded_paged_attention``): pages split across
+    ``axis_name`` on the **KV-head axis** — every chip holds every page,
+    but only its head slice, so a decode step reads its local heads with
+    zero collectives (softmax is per-head; no LSE ever crosses the
+    axis)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(None, None, axis_name, None))
+
+
+def shard_kv_cache(
+    cache: PagedKVCache, mesh, axis_name: str = "tp"
+) -> PagedKVCache:
+    """Pin a cache's device storage to ``mesh``: ``k_pages``/``v_pages``
+    sharded on the KV-head axis (:func:`kv_head_sharding`), block tables
+    and ``seq_lens`` replicated (they are host-written control state
+    every shard needs whole). The :class:`PageAllocator` is untouched —
+    allocation stays ONE host-side logical free list regardless of how
+    many chips store the pages, which is the disaggregated-serving
+    contract (ISSUE 12): admission decisions are global, storage is not.
+
+    A one-device mesh degenerates to plain placement (how the tiered
+    engine pins each tier's pool to its own mesh slice)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    tp = int(mesh.shape.get(axis_name, 1)) if axis_name else 1
+    if tp > 1 and cache.num_kv_heads % tp:
+        raise ValueError(
+            f"shard_kv_cache: kv_heads {cache.num_kv_heads} not divisible "
+            f"by the {axis_name}={tp} mesh axis — the KV-head-sharded "
+            "layout needs equal head slices per chip"
+        )
+    pages = kv_head_sharding(mesh, axis_name)
+    host = NamedSharding(mesh, PartitionSpec())
+    return PagedKVCache(
+        k_pages=jax.device_put(cache.k_pages, pages),
+        v_pages=jax.device_put(cache.v_pages, pages),
+        block_tables=jax.device_put(cache.block_tables, host),
+        seq_lens=jax.device_put(cache.seq_lens, host),
+    )
+
+
 class PageAllocator:
     """Host-side page bookkeeping: free list, slot ownership, occupancy.
 

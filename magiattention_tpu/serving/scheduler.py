@@ -177,6 +177,14 @@ class Scheduler:
     whole remaining prompt, bounded by the budget).
     """
 
+    # tier labels threaded into spans and the SLO histograms: None on
+    # this single-chip scheduler (the historical unlabeled series, so
+    # trace-check's exact reconciliation is untouched); the
+    # TieredScheduler (serving/distributed.py, ISSUE 12) overrides both
+    # so every sample lands on a per-tier series too
+    _prefill_tier: str | None = None
+    _decode_tier: str | None = None
+
     def __init__(
         self,
         engine: ServingEngine,
@@ -256,6 +264,13 @@ class Scheduler:
                     priority=req.priority,
                     tokens=req.tokens,
                 )
+            # an admission ATTEMPT may have evicted lower-priority
+            # residents even when it ultimately failed (the engine's
+            # bounded evict-then-retry can give up after evicting) —
+            # requeue victims unconditionally, or they dangle in
+            # _active with slots the engine already released
+            for victim_slot in res.evicted:
+                self._handle_eviction(victim_slot)
             if not res.admitted:
                 if res.reason == "too_long":
                     # permanent: no eviction makes it fit — surface it
@@ -271,9 +286,6 @@ class Scheduler:
                     st.trace_id, st.rid, reason=res.reason
                 )
                 break  # transient backpressure: keep FIFO order, retry later
-            # an admission may have evicted lower-priority residents
-            for victim_slot in res.evicted:
-                self._handle_eviction(victim_slot)
             st.slot = res.slot
             st.prefix_len = res.prefix_len
             st.prefill_pos = res.prefix_len
@@ -297,6 +309,7 @@ class Scheduler:
                 ),
                 evicted=len(res.evicted),
                 queue_s=st.admitted_at - st.slo_start,
+                tier=self._prefill_tier,
             )
         return admitted, rejected
 
@@ -304,32 +317,44 @@ class Scheduler:
         """A live sequence was priority-evicted by the engine: push its
         request back to the queue for a clean retry (prefix pages it
         shared are still resident, so the retry re-forks cheaply)."""
-        for rid, st in list(self._active.items()):
+        for st in list(self._active.values()):
             if st.slot == slot:
-                reqtrace.span_evicted(st.trace_id, st.rid, slot=slot)
-                del self._active[rid]
-                st.slot = None
-                st.status = QUEUED
-                st.prefill_pos = 0
-                st.prefix_len = 0
-                st.tokens_done = 0
-                st.prefill_chunk_idx = 0
-                st.evictions += 1
-                st.decode_outs.clear()
-                # the restarted generation gets a fresh SLO record: its
-                # TTFT must be measured again and a stale last_token_at
-                # would push one eviction+requeue+re-prefill-sized
-                # outlier into the inter-token latency histogram. The
-                # SLO clock restarts at the requeue instant — TTFT and
-                # queue wait of the retry measure the retry, not the
-                # whole first life (trace-asserted end to end by
-                # tests/test_serving/test_scheduler.py and trace-check)
-                st.first_token_at = None
-                st.last_token_at = None
-                st.slo_start = self._clock()
-                self._queue.append(st)
-                reqtrace.span_requeued(st.trace_id, st.rid)
+                self._requeue(st)
                 return
+
+    def _requeue(
+        self, st: RequestState, *, tier: str | None = None,
+        reason: str | None = None,
+    ) -> None:
+        """Push one in-flight request back to the queue for a clean
+        retry — the shared tail of a priority eviction and (ISSUE 12) a
+        decode-tier fault. Prefix pages it shared are still resident,
+        so the retry re-forks/re-streams cheaply."""
+        reqtrace.span_evicted(
+            st.trace_id, st.rid, slot=st.slot, tier=tier, reason=reason
+        )
+        self._active.pop(st.rid, None)
+        st.slot = None
+        st.status = QUEUED
+        st.prefill_pos = 0
+        st.prefix_len = 0
+        st.tokens_done = 0
+        st.prefill_chunk_idx = 0
+        st.evictions += 1
+        st.decode_outs.clear()
+        # the restarted generation gets a fresh SLO record: its
+        # TTFT must be measured again and a stale last_token_at
+        # would push one eviction+requeue+re-prefill-sized
+        # outlier into the inter-token latency histogram. The
+        # SLO clock restarts at the requeue instant — TTFT and
+        # queue wait of the retry measure the retry, not the
+        # whole first life (trace-asserted end to end by
+        # tests/test_serving/test_scheduler.py and trace-check)
+        st.first_token_at = None
+        st.last_token_at = None
+        st.slo_start = self._clock()
+        self._queue.append(st)
+        reqtrace.span_requeued(st.trace_id, st.rid)
 
     def _decode_states(self) -> list[RequestState]:
         return [
@@ -339,6 +364,16 @@ class Scheduler:
     def _run_decode(self, states: list[RequestState]) -> int:
         if self.max_decode_batch is not None:
             states = states[: self.max_decode_batch]
+        return self._decode_group(states)
+
+    def _decode_group(
+        self, states: list[RequestState], *, replica: int | None = None
+    ) -> int:
+        """One batched decode step over ``states`` + the per-request
+        span/SLO bookkeeping. The single-chip scheduler calls it once
+        per tick with every decoding state; the TieredScheduler calls
+        it once per decode replica (``replica`` labels the spans) so a
+        replica fault is isolated to its own group."""
         qs = jnp.stack([st.request.decode_q[st.tokens_done] for st in states])
         ks = jnp.stack([st.request.decode_k[st.tokens_done] for st in states])
         vs = jnp.stack([st.request.decode_v[st.tokens_done] for st in states])
@@ -375,6 +410,8 @@ class Scheduler:
                 duration_s=dur,
                 ttft_s=ttft_s,
                 token_latency_s=token_latency_s,
+                tier=self._decode_tier,
+                replica=replica,
             )
             if st.tokens_done >= st.request.num_new_tokens:
                 self._finish(st)
@@ -429,6 +466,7 @@ class Scheduler:
             start=lo,
             start_s=t0,
             duration_s=time.perf_counter() - t0,
+            tier=self._prefill_tier,
         )
         st.prefill_chunk_idx += 1
         st.prefill_pos = hi
@@ -509,15 +547,7 @@ class Scheduler:
             decode_ran = True
             budget -= decode_batch
 
-        chunks: list[tuple[int, int]] = []
-        for st in self._prefill_states():
-            if budget <= 0:
-                break
-            n = self._run_prefill_chunk(st, budget)
-            if n == 0 and st.request.prompt_len - st.prefill_pos > 0:
-                break  # budget can't fit the next chunk's first token
-            budget -= n
-            chunks.append((st.rid, n))
+        chunks, budget = self._run_prefill_loop(budget)
 
         tokens_used = self.token_budget - budget
         return StepReport(
@@ -532,6 +562,25 @@ class Scheduler:
             queue_depth=queue_depth,
             budget_utilization=tokens_used / max(self.token_budget, 1),
         )
+
+    def _run_prefill_loop(
+        self, budget: int
+    ) -> tuple[list[tuple[int, int]], int]:
+        """Advance prefilling requests (priority order, at most one
+        chunk each) until the chunk budget is spent; returns the
+        started ``(rid, tokens)`` chunks and the budget left. Shared
+        with the TieredScheduler, whose prefill tier spends its own
+        budget."""
+        chunks: list[tuple[int, int]] = []
+        for st in self._prefill_states():
+            if budget <= 0:
+                break
+            n = self._run_prefill_chunk(st, budget)
+            if n == 0 and st.request.prompt_len - st.prefill_pos > 0:
+                break  # budget can't fit the next chunk's first token
+            budget -= n
+            chunks.append((st.rid, n))
+        return chunks, budget
 
     def run(self, max_steps: int = 10_000) -> list[StepReport]:
         """Step until every submitted request finished (or the safety

@@ -38,6 +38,7 @@ from .aggregate import (  # noqa: F401
     merge_snapshots,
 )
 from .collectors import (  # noqa: F401
+    REQUIRED_DISTSERVE_METRICS,
     REQUIRED_PLAN_CACHE_METRICS,
     REQUIRED_PLAN_METRICS,
     REQUIRED_PREFIX_METRICS,
@@ -67,6 +68,7 @@ from .collectors import (  # noqa: F401
     record_kvcache_state,
     record_measured_timeline,
     record_overlap_choice,
+    record_page_stream,
     record_plan,
     record_prefill,
     record_prefix_cow,
@@ -79,6 +81,9 @@ from .collectors import (  # noqa: F401
     record_request_ttft,
     record_runtime_costs,
     record_sched_step,
+    record_stream_queue_depth,
+    record_tier_fault,
+    record_tier_state,
     record_tuning_cache_io_error,
     record_validate,
     telemetry_summary,

@@ -204,6 +204,22 @@ H_REQ_QUEUE_S = "magi_request_queue_seconds"
 H_REQ_TTFT_S = "magi_request_ttft_seconds"
 H_REQ_TOKLAT_S = "magi_request_token_latency_seconds"
 
+# counters + gauges — disaggregated serving (serving/distributed.py;
+# ISSUE 12). The page-transfer queue moves committed prefill pages to a
+# decode replica's pool: streams/pages/bytes count the wire traffic of
+# the prefill->decode hand-off, queue depth is the streams parked
+# waiting for decode-tier capacity (sustained nonzero = the decode tier
+# is the bottleneck). Tier gauges ({tier=prefill|decode, replica=})
+# give per-chip occupancy; faults count decode-replica failures the
+# requeue+replay path absorbed
+M_PAGE_STREAMS = "magi_page_streams_total"
+M_STREAM_PAGES = "magi_page_stream_pages_total"
+M_STREAM_BYTES = "magi_page_stream_bytes_total"
+M_STREAM_QUEUE = "magi_page_stream_queue_depth"  # gauge
+M_TIER_FAULTS = "magi_tier_faults_total"  # {tier=, replica=}
+M_TIER_PAGES_USED = "magi_tier_pages_in_use"  # {tier=, replica=}
+M_TIER_ACTIVE = "magi_tier_active_requests"  # {tier=}
+
 # counters — request-lifecycle tracing (telemetry/trace.py; ISSUE 11).
 # traces started (one per Scheduler.submit); ring spans dropped
 # (M_TRACE_DROPPED, defined next to the ring in events.py — nonzero
@@ -347,6 +363,21 @@ REQUIRED_SCHED_METRICS: tuple[str, ...] = (
     H_REQ_QUEUE_S,
     H_REQ_TTFT_S,
     H_REQ_TOKLAT_S,
+)
+
+# populated by one TieredEngine/TieredScheduler run that streams at
+# least one committed prompt prefill->decode and absorbs one injected
+# decode-replica fault; asserted by make distserve-check
+# (exps/run_distserve_check.py), documented in docs/serving.md
+# "Disaggregated serving" + docs/observability.md
+REQUIRED_DISTSERVE_METRICS: tuple[str, ...] = (
+    M_PAGE_STREAMS,
+    M_STREAM_PAGES,
+    M_STREAM_BYTES,
+    M_STREAM_QUEUE,
+    M_TIER_FAULTS,
+    M_TIER_PAGES_USED,
+    M_TIER_ACTIVE,
 )
 
 # populated by a traced scheduler run that overflows a (deliberately
@@ -972,25 +1003,93 @@ def record_flight_dump(trigger: str) -> None:
     _marker_event("flight_recorder_dump", {"trigger": trigger})
 
 
-def record_request_queue_time(seconds: float) -> None:
+def _slo_observe(name: str, seconds: float, tier: str | None) -> None:
+    """``tier=`` threading for the SLO histograms (ISSUE 12): every
+    sample lands on the unlabeled historical series — the fleet-wide
+    aggregate existing dashboards and the trace-check reconciliation
+    scrape, which must not go blank when a deployment switches to
+    tiered serving — and a tiered sample ADDITIONALLY lands on a
+    ``tier=``-labeled series so each tier's p99 is scrapeable on its
+    own."""
+    reg = get_registry()
+    reg.histogram_observe(name, seconds)
+    if tier is not None:
+        reg.histogram_observe(name, seconds, tier=tier)
+
+
+def record_request_queue_time(seconds: float, *, tier: str | None = None) -> None:
     """Submission -> admission wait of one request (SLO surface)."""
     if not _enabled():
         return
-    get_registry().histogram_observe(H_REQ_QUEUE_S, float(seconds))
+    _slo_observe(H_REQ_QUEUE_S, float(seconds), tier)
 
 
-def record_request_ttft(seconds: float) -> None:
+def record_request_ttft(seconds: float, *, tier: str | None = None) -> None:
     """Submission -> first decoded token of one request (SLO surface)."""
     if not _enabled():
         return
-    get_registry().histogram_observe(H_REQ_TTFT_S, float(seconds))
+    _slo_observe(H_REQ_TTFT_S, float(seconds), tier)
 
 
-def record_request_token_latency(seconds: float) -> None:
+def record_request_token_latency(
+    seconds: float, *, tier: str | None = None
+) -> None:
     """Inter-token decode latency of one generated token (SLO surface)."""
     if not _enabled():
         return
-    get_registry().histogram_observe(H_REQ_TOKLAT_S, float(seconds))
+    _slo_observe(H_REQ_TOKLAT_S, float(seconds), tier)
+
+
+# ---------------------------------------------------------------------------
+# disaggregated serving (serving/distributed.py; ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+def record_page_stream(
+    *, pages: int, nbytes: int, queue_depth: int
+) -> None:
+    """One committed prompt's pages streamed prefill -> decode tier
+    (``PageTransferQueue.pump``): the wire traffic of the
+    disaggregation hand-off, plus the post-pump queue depth."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_PAGE_STREAMS)
+    reg.counter_inc(M_STREAM_PAGES, int(pages))
+    reg.counter_inc(M_STREAM_BYTES, int(nbytes))
+    reg.gauge_set(M_STREAM_QUEUE, int(queue_depth))
+
+
+def record_stream_queue_depth(depth: int) -> None:
+    """Streams parked waiting for decode-tier capacity (a stream that
+    could not place this tick). Sustained nonzero = decode tier is the
+    fleet bottleneck — admission backpressure follows."""
+    if not _enabled():
+        return
+    get_registry().gauge_set(M_STREAM_QUEUE, int(depth))
+
+
+def record_tier_fault(tier: str, replica: int) -> None:
+    """One tier chip/replica failed (chaos-injected or organic) and was
+    absorbed by the requeue+replay path."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_TIER_FAULTS, tier=tier, replica=replica)
+
+
+def record_tier_state(
+    tier: str, *, pages_in_use: int, active: int, replica: int | None = None
+) -> None:
+    """One tier member's pool occupancy + live-request count (after an
+    admission / stream / free)."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    labels = {"tier": tier}
+    if replica is not None:
+        labels["replica"] = replica
+    reg.gauge_set(M_TIER_PAGES_USED, int(pages_in_use), **labels)
+    reg.gauge_set(M_TIER_ACTIVE, int(active), tier=tier)
 
 
 # ---------------------------------------------------------------------------

@@ -63,6 +63,11 @@ SPAN_REQUEUED = "requeued"  # back in the queue after eviction
 SPAN_DEGRADED = "degraded"  # a degradation path engaged {reason}
 SPAN_COW = "cow"  # a copy-on-write page split served this request
 SPAN_FINISHED = "finished"  # terminal: all tokens produced
+# disaggregated serving (ISSUE 12, serving/distributed.py): where in the
+# tiered mesh a request's life happened
+SPAN_TIER_ASSIGNED = "tier_assigned"  # admitted onto a tier {tier}
+SPAN_PAGES_STREAMED = "pages_streamed"  # prefill->decode page transfer
+SPAN_TIER_MIGRATED = "tier_migrated"  # now served by {to_tier, replica}
 
 # terminal kinds release the per-trace sequence counter
 _TERMINAL_KINDS = (SPAN_FINISHED, SPAN_REJECTED)
@@ -180,14 +185,16 @@ def span_submit(
 def span_admitted(
     trace_id: str, rid: int, *, slot: int, prefix_len: int,
     shared_pages: int, evicted: int, queue_s: float,
+    tier: str | None = None,
 ) -> None:
     from .collectors import record_request_queue_time
 
     record_request_span(
         trace_id, SPAN_ADMITTED, rid=rid, slot=slot, prefix_len=prefix_len,
         shared_pages=shared_pages, evicted=evicted, queue_s=queue_s,
+        tier=tier,
     )
-    record_request_queue_time(queue_s)
+    record_request_queue_time(queue_s, tier=tier)
 
 
 def span_backpressure(trace_id: str, rid: int, *, reason: str) -> None:
@@ -200,12 +207,12 @@ def span_rejected(trace_id: str, rid: int, *, reason: str) -> None:
 
 def span_prefill_chunk(
     trace_id: str, rid: int, *, tokens: int, chunk_idx: int, start: int,
-    start_s: float, duration_s: float,
+    start_s: float, duration_s: float, tier: str | None = None,
 ) -> None:
     record_request_span(
         trace_id, SPAN_PREFILL_CHUNK, rid=rid, tokens=tokens,
         chunk_idx=chunk_idx, start=start, start_s=start_s,
-        duration_s=duration_s,
+        duration_s=duration_s, tier=tier,
     )
 
 
@@ -213,7 +220,8 @@ def span_decode_step(
     trace_id: str, rid: int, *, token_idx: int, batch: int,
     num_splits: int, cascade_group: int | None, start_s: float,
     duration_s: float, ttft_s: float | None = None,
-    token_latency_s: float | None = None,
+    token_latency_s: float | None = None, tier: str | None = None,
+    replica: int | None = None,
 ) -> None:
     from .collectors import (
         record_request_token_latency,
@@ -224,16 +232,55 @@ def span_decode_step(
         trace_id, SPAN_DECODE_STEP, rid=rid, token_idx=token_idx,
         batch=batch, num_splits=num_splits, cascade_group=cascade_group,
         start_s=start_s, duration_s=duration_s, ttft_s=ttft_s,
-        token_latency_s=token_latency_s,
+        token_latency_s=token_latency_s, tier=tier, replica=replica,
     )
     if ttft_s is not None:
-        record_request_ttft(ttft_s)
+        record_request_ttft(ttft_s, tier=tier)
     if token_latency_s is not None:
-        record_request_token_latency(token_latency_s)
+        record_request_token_latency(token_latency_s, tier=tier)
 
 
-def span_evicted(trace_id: str, rid: int, *, slot: int) -> None:
-    record_request_span(trace_id, SPAN_EVICTED, rid=rid, slot=slot)
+def span_evicted(
+    trace_id: str, rid: int, *, slot: int, tier: str | None = None,
+    reason: str | None = None,
+) -> None:
+    record_request_span(
+        trace_id, SPAN_EVICTED, rid=rid, slot=slot, tier=tier,
+        reason=reason,
+    )
+
+
+# -- disaggregated-serving lifecycle (ISSUE 12) -----------------------------
+
+
+def span_tier_assigned(
+    trace_id: str, rid: int, *, tier: str, slot: int,
+) -> None:
+    record_request_span(
+        trace_id, SPAN_TIER_ASSIGNED, rid=rid, tier=tier, slot=slot
+    )
+
+
+def span_pages_streamed(
+    trace_id: str, rid: int, *, pages: int, tokens: int, nbytes: int,
+    replica: int, digest_ok: bool | None = None,
+    start_s: float | None = None, duration_s: float = 0.0,
+) -> None:
+    record_request_span(
+        trace_id, SPAN_PAGES_STREAMED, rid=rid, pages=pages,
+        tokens=tokens, nbytes=nbytes, replica=replica,
+        digest_ok=digest_ok, start_s=start_s, duration_s=duration_s,
+    )
+
+
+def span_tier_migrated(
+    trace_id: str, rid: int, *, from_tier: str, to_tier: str,
+    replica: int | None = None, reason: str = "commit",
+) -> None:
+    record_request_span(
+        trace_id, SPAN_TIER_MIGRATED, rid=rid, from_tier=from_tier,
+        to_tier=to_tier, replica=replica, reason=reason,
+    )
 
 
 def span_requeued(trace_id: str, rid: int) -> None:

@@ -212,3 +212,31 @@ def test_merge_split_partials_associativity():
     assert np.all(np.isfinite(np.asarray(to)))
     np.testing.assert_allclose(np.asarray(to), np.asarray(fo), atol=1e-5)
     np.testing.assert_allclose(np.asarray(tl), np.asarray(fl), atol=1e-5)
+
+
+@pytest.mark.parametrize("entry", ["paged", "tables"])
+def test_shape_misconfiguration_raises_value_error(entry, monkeypatch):
+    """ISSUE 12 satellite: a missharded call (q heads and KV heads split
+    by different factors — head_dim or GQA divisibility broken) raises a
+    ``ValueError`` naming the offending shapes, not a bare tracer
+    assert."""
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    rng = np.random.default_rng(31)
+    cache, _, _ = _build_cache(rng, [16], 16, 4, hk=2)
+    from magiattention_tpu.serving import decode_partials_for_tables
+
+    def call(q):
+        if entry == "paged":
+            return decode_attn_paged(q, cache, jnp.array([0]))
+        return decode_partials_for_tables(
+            q, cache, cache.block_tables[:1], cache.seq_lens[:1]
+        )
+
+    # hq = 3 is not a multiple of kv_heads = 2 (the sharded-by-different-
+    # factors failure); head_dim mismatch is the other misconfiguration
+    bad_heads = jnp.asarray(rng.standard_normal((1, 3, D)), jnp.float32)
+    with pytest.raises(ValueError, match="kv_heads"):
+        call(bad_heads)
+    bad_dim = jnp.asarray(rng.standard_normal((1, 4, D + 8)), jnp.float32)
+    with pytest.raises(ValueError, match="head_dim"):
+        call(bad_dim)

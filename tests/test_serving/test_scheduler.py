@@ -292,3 +292,37 @@ def test_scheduler_shared_prefix_trace_saves_prefill_work():
             assert sched.result(i).prefix_len == 2 * PS
     finally:
         telemetry.set_enabled(None)
+
+
+def test_failed_admission_with_evictions_requeues_victims():
+    """ISSUE 12 review regression: an admission ATTEMPT that evicts
+    lower-priority residents and then still fails (bounded
+    evict-then-retry gave up — ``AdmissionResult(admitted=False,
+    evicted=(victim,...))``) must requeue the victims exactly like a
+    successful one. They used to dangle in the active set with slots
+    the engine had already released: the victim never decoded again and
+    the run died in the idle-deadlock guard."""
+    rng = np.random.default_rng(13)
+    # 8-page pool: r0 (pri 5) + r1 (pri 0) take 3 pages each, leaving 2
+    eng = _engine(num_pages=8, max_seqs=4, max_pages_per_seq=8)
+    sched = Scheduler(eng, token_budget=64, chunk=None)
+    sched.submit(_req(rng, 0, prompt_len=3 * PS, gen=6, priority=5))
+    sched.submit(_req(rng, 1, prompt_len=3 * PS, gen=4, priority=0))
+    sched.step()  # admit + prefill both
+    sched.step()  # both decoding
+    assert {st.rid for st in sched._active.values()} == {0, 1}
+    # r2 (pri 3) needs 6 pages: free 2, +3 from evicting r1 (pri 0 < 3)
+    # is still short, and r0 (pri 5) is not evictable -> the attempt
+    # fails AFTER evicting r1
+    sched.submit(_req(rng, 2, prompt_len=6 * PS, gen=2, priority=3))
+    sched.step()
+    st1 = next(st for st in sched._queue if st.rid == 1)
+    from magiattention_tpu.serving.scheduler import QUEUED
+
+    assert st1.status == QUEUED and st1.slot is None
+    assert 1 not in sched._active
+    assert st1.evictions == 1
+    # and the fleet drains cleanly: r0 finishes -> r2 fits -> r1 retries
+    sched.run()
+    for rid in (0, 1, 2):
+        assert sched.result(rid).status == "finished"
