@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze resilience-check roofline-check roofline-report trace-check distserve-check check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze spmd-audit lifecycle-check resilience-check roofline-check roofline-report trace-check distserve-check check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -101,13 +101,34 @@ decode-bench:
 comm-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_comm_check.py
 
-# static-analysis gate (ISSUE 7, jax-CPU only, ~15s): AST compat/idiom
-# lint (MAGI001-004 + allowlist), jaxpr trace audit (collective census vs
-# CommMeta across plans x cp x dtypes, upcast census, retrace guard),
-# plan-sanitizer self-check, and --self-test proof that each pass can
-# fail on a seeded violation (docs/static_analysis.md)
+# static-analysis gate (ISSUEs 7 + 13, jax-CPU only, ~50s): AST
+# compat/idiom lint (MAGI001-005 + allowlist), jaxpr trace audit
+# (collective census vs CommMeta across plans x cp x dtypes, upcast
+# census, retrace guard, tp-decode/cascade zero-collective + dtype
+# contract, hier per-level census), plan-sanitizer self-check, the SPMD
+# collective-consistency audit (pass 4) and the serving lifecycle model
+# check (pass 5), plus --self-test proof that each pass can fail on a
+# seeded violation — incl. both replanted historical lifecycle bugs
+# (docs/static_analysis.md)
 analyze:
 	JAX_PLATFORMS=cpu $(PY) exps/run_static_analysis.py --self-test
+
+# pass 4 standalone (ISSUE 13): per-rank collective signatures of every
+# production collective path (flat + hier group cast/reduce, dist_attn
+# calc+grad, cp/tp decode, degradation/chaos variants) must be
+# identical across ranks, hop pairing well-formed; --self-test plants a
+# rank-gated extra ppermute and a one-sided perm
+spmd-audit:
+	JAX_PLATFORMS=cpu $(PY) exps/run_static_analysis.py --only spmd --self-test
+
+# pass 5 standalone (ISSUE 13): exhaustive bounded serving-state
+# interleavings over the REAL host objects (allocator/trie/engine/
+# scheduler/tiered) on a stubbed device layer — >= 10k canonical states
+# with zero invariant violations; --self-test replants the PR 9
+# double-free and PR 12 dangling-victim bugs and requires <= 8-event
+# minimal counterexamples
+lifecycle-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_static_analysis.py --only lifecycle --self-test
 
 # resilience gate (ISSUE 8, CPU, ~4 min): every chaos injector is
 # caught by its matching guard or degradation path (zero silent

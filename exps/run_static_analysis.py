@@ -1,10 +1,11 @@
-"""Static-analysis gate (``make analyze``) — ISSUE 7.
+"""Static-analysis gate (``make analyze``) — ISSUEs 7 + 13.
 
-Runs the three passes of ``magiattention_tpu/analysis/`` over the tree,
+Runs the five passes of ``magiattention_tpu/analysis/`` over the tree,
 CPU-only (virtual 8-device mesh, jnp kernel backend — everything is AST
-walking or abstract tracing; nothing executes on a device):
+walking, abstract tracing, or host-only model checking; nothing
+executes on a device):
 
-1. **Lint** (``analysis/lint.py``): MAGI001..MAGI004 over the package
+1. **Lint** (``analysis/lint.py``): MAGI001..MAGI005 over the package
    (+ MAGI001 over tests/exps/examples), filtered through
    ``exps/data/analysis_allowlist.json``. Stale allowlist entries (the
    violation they covered is gone) fail the gate too — the allowlist
@@ -14,18 +15,34 @@ walking or abstract tracing; nothing executes on a device):
    local plans and cp=1; ppermutes == active hops; a2a counts), group
    cast/reduce census for both impls, decode census, bf16->f32 upcast
    census vs ``exps/data/trace_audit_expectations.json``, retrace
-   guard, and the ISSUE 8 guard census (``MAGI_ATTENTION_GUARD=off``
-   traces zero ``is_finite`` guard ops; ``check`` traces detection for
-   real with unchanged output avals).
+   guard, the ISSUE 8 guard census, and the ISSUE 13 serving surfaces:
+   ``tp_decode_attn`` / cascade decode (zero collectives + dtype
+   contract + upcast census) and the hierarchical cast's per-level
+   census.
 3. **Plan sanitizer self-check** (``analysis/plan_sanity.py``):
    canonical plans validate clean, and a battery of deliberately
    mutated plans/metas each FAIL (OOB ranges, non-permutation recv
    layout, scheduled < true rows, stage-area corruption).
+4. **SPMD collective-consistency audit** (``analysis/spmd_audit.py``,
+   ISSUE 13): per-rank collective signatures of every production
+   collective path — flat + hierarchical group cast/reduce, dist_attn
+   calc+grad, cp_decode, tp_decode, degradation/chaos variants — must
+   be identical across ranks (divergence = a pod-scale hang), with hop
+   pairing well-formed on every traced ppermute.
+5. **Serving lifecycle model check** (``analysis/lifecycle.py``,
+   ISSUE 13): exhaustive bounded event interleavings over the REAL
+   host serving objects (PageAllocator / PrefixCache / ServingEngine /
+   Scheduler / TieredEngine) on a stubbed device layer, asserting
+   refcount/lifecycle/stream-queue invariants at every canonical
+   state.
 
-``--self-test`` additionally proves each pass can fail by seeding one
-violation per pass (mirroring ``run_perf_gate.py --self-test``).
-``--update`` re-records the upcast census expectations after an
-intentional kernel/dtype change.
+``--self-test`` additionally proves each pass can fail by seeding
+violations per pass (incl. the two replanted historical lifecycle
+bugs, found with minimal counterexample traces). ``--update``
+re-records the upcast census expectations after an intentional
+kernel/dtype change. ``--only PASS`` (lint|audit|sanity|spmd|
+lifecycle; repeatable) restricts the run — the ``make spmd-audit`` /
+``make lifecycle-check`` entry points.
 
 Exit codes: 0 = clean, 1 = violations/drift.
 """
@@ -128,6 +145,18 @@ def run_trace_audit(update: bool) -> tuple[list[str], dict]:
     e, census = ta.audit_dtypes(expectations)
     errors += e
     report["upcasts"] = census
+
+    # ISSUE 13 satellite: the post-PR-6 serving surfaces (tp decode,
+    # cascade decode — zero collectives, dtype contract, upcast census)
+    # and the hierarchical cast's per-level census
+    e, serving_census = ta.audit_serving_traces(expectations)
+    errors += e
+    report["serving_upcasts"] = serving_census
+
+    e, r = ta.audit_hier_cast_levels()
+    errors += e
+    report.update(r)
+
     if update:
         payload = {
             "_comment": (
@@ -139,6 +168,9 @@ def run_trace_audit(update: bool) -> tuple[list[str], dict]:
             "_backend": os.environ.get("MAGI_ATTENTION_KERNEL_BACKEND"),
         }
         payload.update({k: dict(sorted(v.items())) for k, v in census.items()})
+        payload.update(
+            {k: dict(sorted(v.items())) for k, v in serving_census.items()}
+        )
         with open(EXPECTATIONS, "w") as f:
             json.dump(payload, f, indent=2, sort_keys=True)
             f.write("\n")
@@ -302,15 +334,55 @@ def run_plan_sanity() -> list[str]:
 
 
 # ---------------------------------------------------------------------------
+# pass 4: SPMD collective-consistency audit (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def run_spmd_audit() -> list[str]:
+    from magiattention_tpu.analysis import spmd_audit as sa
+
+    errors, _report = sa.run_full_audit()
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# pass 5: serving lifecycle model check (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def run_lifecycle() -> tuple[list[str], dict]:
+    from magiattention_tpu.analysis import lifecycle as lc
+
+    errors, report = lc.run_lifecycle_check()
+    total = sum(r["states"] for r in report.values())
+    report["_total_states"] = total
+    # acceptance floor (ISSUE 13): the clean tree must actually cover
+    # a substantial interleaving space, not a vacuous handful of states
+    if not errors and total < 10_000:
+        errors.append(
+            f"lifecycle checker explored only {total} canonical states "
+            "(< 10000) — the model matrix lost its depth/width"
+        )
+    return errors, report
+
+
+# ---------------------------------------------------------------------------
 # --self-test: every pass must be able to fail
 # ---------------------------------------------------------------------------
 
 
-def run_self_test() -> list[str]:
-    import jax
-    import jax.numpy as jnp
+def run_self_test(selected=("lint", "audit", "sanity")) -> list[str]:
+    errors: list[str] = []
+    if "lint" in selected:
+        errors += _self_test_lint()
+    if "audit" in selected:
+        errors += _self_test_audit()
+    if "sanity" in selected:
+        errors += _self_test_sanity()
+    return errors
 
-    from magiattention_tpu.analysis import trace_audit as ta
+
+def _self_test_lint() -> list[str]:
     from magiattention_tpu.analysis.lint import lint_source
 
     errors: list[str] = []
@@ -333,11 +405,30 @@ def run_self_test() -> list[str]:
             "def f(x):\n"
             "    return jax.lax.psum(x, 'cp')\n"
         ),
+        "MAGI005": (
+            "import jax\n"
+            "def f(x):\n"
+            "    r = jax.lax.axis_index('cp')\n"
+            "    if r == 0:\n"
+            "        x = jax.lax.ppermute(x, 'cp', [(0, 1)])\n"
+            "    return x\n"
+        ),
     }
     for rule, src in fixtures.items():
         found = lint_source(src, "magiattention_tpu/ops/planted.py")
         if not any(v.rule == rule for v in found):
             errors.append(f"self-test: planted {rule} violation NOT flagged")
+    # the serving device_put extension of MAGI004 (ISSUE 13)
+    found = lint_source(
+        "import jax\n"
+        "def stream(x):\n"
+        "    return jax.device_put(x, None)\n",
+        "magiattention_tpu/serving/planted.py",
+    )
+    if not any(v.rule == "MAGI004" for v in found):
+        errors.append(
+            "self-test: planted unscoped serving device_put NOT flagged"
+        )
     # the pragma must suppress
     found = lint_source(
         "from jax import shard_map  # magi-allow: MAGI001\n",
@@ -345,6 +436,16 @@ def run_self_test() -> list[str]:
     )
     if found:
         errors.append("self-test: magi-allow pragma did not suppress")
+    return errors
+
+
+def _self_test_audit() -> list[str]:
+    import jax
+    import jax.numpy as jnp
+
+    from magiattention_tpu.analysis import trace_audit as ta
+
+    errors: list[str] = []
 
     # pass 2a: an extra planted ppermute must break the census
     def planted_cast(x):
@@ -401,7 +502,10 @@ def run_self_test() -> list[str]:
             "self-test: retrace counter failed to count a re-traced "
             f"closure (traces={counter.traces})"
         )
+    return errors
 
+
+def _self_test_sanity() -> list[str]:
     # pass 3 failure injection is exercised by run_plan_sanity itself
     # (every _mutations() fixture must fail); re-assert one here so the
     # self-test is self-contained
@@ -410,6 +514,7 @@ def run_self_test() -> list[str]:
         validate_slices,
     )
 
+    errors: list[str] = []
     try:
         validate_slices([(0, 128, 0, 64, 1)], 64, 64)
         errors.append("self-test: planted OOB slice PASSED the sanitizer")
@@ -421,11 +526,15 @@ def run_self_test() -> list[str]:
 # ---------------------------------------------------------------------------
 
 
+PASSES = ("lint", "audit", "sanity", "spmd", "lifecycle")
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
         "--self-test", action="store_true",
-        help="additionally prove each pass can fail on a seeded violation",
+        help="additionally prove each selected pass can fail on a "
+        "seeded violation",
     )
     parser.add_argument(
         "--update", action="store_true",
@@ -433,48 +542,98 @@ def main() -> int:
     )
     parser.add_argument(
         "--skip-audit", action="store_true",
-        help="skip pass 2 (the jax trace audit); lint + plan sanitizer "
-        "still run. Incompatible with --self-test, which proves the "
-        "audit pass can fail.",
+        help="skip pass 2 (the jax trace audit); every other selected "
+        "pass still runs",
+    )
+    parser.add_argument(
+        "--only", action="append", choices=PASSES, default=None,
+        help="run only the named pass(es); repeatable "
+        "(make spmd-audit / make lifecycle-check use this)",
     )
     args = parser.parse_args()
-    if args.skip_audit and args.self_test:
-        parser.error("--self-test needs the trace audit; drop --skip-audit")
+    selected = tuple(args.only) if args.only else PASSES
+    if args.skip_audit:
+        # self-tests are per-pass: dropping the audit pass drops its
+        # self-test too, so the combination is fine
+        selected = tuple(p for p in selected if p != "audit")
+    if not selected:
+        parser.error(
+            "the flag combination selects no pass at all — a vacuous "
+            "PASSED would be a lie (did you mean to drop --skip-audit?)"
+        )
+    if args.update and "audit" not in selected:
+        parser.error(
+            "--update re-records the trace-audit expectations, but the "
+            "audit pass is not selected — nothing would be recorded"
+        )
     _setup_cpu_mesh_env()
 
     failures: list[str] = []
     t0 = time.perf_counter()
-    lint_errors = run_lint()
-    failures += lint_errors
-    print(
-        f"[pass 1] lint: {len(lint_errors)} violation(s) "
-        f"({time.perf_counter() - t0:.1f}s)"
-    )
 
-    if not args.skip_audit:
-        t1 = time.perf_counter()
+    if "lint" in selected:
+        t = time.perf_counter()
+        lint_errors = run_lint()
+        failures += lint_errors
+        print(
+            f"[pass 1] lint: {len(lint_errors)} violation(s) "
+            f"({time.perf_counter() - t:.1f}s)"
+        )
+
+    if "audit" in selected:
+        t = time.perf_counter()
         audit_errors, _report = run_trace_audit(args.update)
         failures += audit_errors
         print(
             f"[pass 2] trace audit: {len(audit_errors)} violation(s) "
-            f"({time.perf_counter() - t1:.1f}s)"
+            f"({time.perf_counter() - t:.1f}s)"
         )
 
-    t2 = time.perf_counter()
-    sanity_errors = run_plan_sanity()
-    failures += sanity_errors
-    print(
-        f"[pass 3] plan sanitizer: {len(sanity_errors)} violation(s) "
-        f"({time.perf_counter() - t2:.1f}s)"
-    )
+    if "sanity" in selected:
+        t = time.perf_counter()
+        sanity_errors = run_plan_sanity()
+        failures += sanity_errors
+        print(
+            f"[pass 3] plan sanitizer: {len(sanity_errors)} violation(s) "
+            f"({time.perf_counter() - t:.1f}s)"
+        )
+
+    if "spmd" in selected:
+        t = time.perf_counter()
+        spmd_errors = run_spmd_audit()
+        failures += spmd_errors
+        print(
+            f"[pass 4] spmd audit: {len(spmd_errors)} violation(s) "
+            f"({time.perf_counter() - t:.1f}s)"
+        )
+
+    if "lifecycle" in selected:
+        t = time.perf_counter()
+        lc_errors, lc_report = run_lifecycle()
+        failures += lc_errors
+        print(
+            f"[pass 5] lifecycle: {len(lc_errors)} violation(s), "
+            f"{lc_report.get('_total_states', 0)} canonical states "
+            f"({time.perf_counter() - t:.1f}s)"
+        )
 
     if args.self_test:
-        t3 = time.perf_counter()
-        st_errors = run_self_test()
+        t = time.perf_counter()
+        st_errors: list[str] = []
+        if {"lint", "audit", "sanity"} & set(selected):
+            st_errors += run_self_test(selected)
+        if "spmd" in selected:
+            from magiattention_tpu.analysis import spmd_audit as sa
+
+            st_errors += sa.self_test()
+        if "lifecycle" in selected:
+            from magiattention_tpu.analysis import lifecycle as lc
+
+            st_errors += lc.run_mutation_self_test()
         failures += st_errors
         print(
             f"[self-test] {len(st_errors)} failure(s) "
-            f"({time.perf_counter() - t3:.1f}s)"
+            f"({time.perf_counter() - t:.1f}s)"
         )
 
     for f in failures:

@@ -463,6 +463,38 @@ def main() -> int:
         )
         return 1
 
+    # 10. analysis catalog (ISSUE 13): one smoke interleaving-checker
+    # exploration (clean: states > 0, counterexamples == 0) plus one
+    # mutated exploration (the replanted PR 9 double-free: the
+    # counterexample counter must move) populate the
+    # REQUIRED_ANALYSIS_METRICS catalog through the real explore() path
+    from magiattention_tpu.analysis import lifecycle as lc
+
+    telemetry.reset()
+    with lc.stubbed_device_layer():
+        res_clean = lc.explore(lc.EngineModel(), max_depth=3)
+        with lc.planted_double_free():
+            res_bad = lc.explore(lc.EngineModel(), max_depth=6)
+    snap = telemetry.snapshot()
+    missing = [
+        m for m in telemetry.REQUIRED_ANALYSIS_METRICS
+        if not has_series(snap, m)
+    ]
+    if missing:
+        print(
+            "FAIL: documented analysis metrics missing after "
+            f"interleaving-checker runs (catalog drift): {missing}"
+        )
+        return 1
+    states = snap["counters"].get("magi_analysis_states_explored", 0)
+    cex = snap["counters"].get("magi_analysis_counterexamples", 0)
+    if states < res_clean.states or not res_bad.counterexamples or cex < 1:
+        print(
+            "FAIL: analysis counters did not track the explorations "
+            f"(states={states}, counterexamples={cex})"
+        )
+        return 1
+
     telemetry.set_enabled(None)
     print(
         f"telemetry-check OK: {len(telemetry.REQUIRED_PLAN_METRICS)} plan "

@@ -1,11 +1,11 @@
-"""Static-analysis subsystem (ISSUE 7): the machine-checked invariants
-the architecture rests on.
+"""Static-analysis subsystem (ISSUEs 7 + 13): the machine-checked
+invariants the architecture rests on.
 
-Three passes, one CLI (``exps/run_static_analysis.py`` / ``make
+Five passes, one CLI (``exps/run_static_analysis.py`` / ``make
 analyze``):
 
 - :mod:`.lint` — AST compat/idiom linter over the package source
-  (MAGI001..MAGI004 rule codes, JSON allowlist + inline pragma).
+  (MAGI001..MAGI005 rule codes, JSON allowlist + inline pragma).
 - :mod:`.trace_audit` — jaxpr trace auditor: abstract-evals the real
   entry points over a plan x cp x dtype matrix and asserts the traced
   collective census against the plan's CommMeta, audits bf16->f32
@@ -14,10 +14,20 @@ analyze``):
 - :mod:`.plan_sanity` — structural sanitizer for AttnSlices /
   DistAttnPlan / GroupCollectiveMeta, callable at plan-build time behind
   ``MAGI_ATTENTION_VALIDATE=off|plan|trace``.
+- :mod:`.spmd_audit` — SPMD collective-consistency auditor (ISSUE 13):
+  per-rank collective signatures of every production collective path
+  must be identical across ranks (divergence = a pod-scale hang), with
+  hop-pairing well-formedness on every traced ``ppermute``.
+- :mod:`.lifecycle` — serving-state interleaving checker (ISSUE 13):
+  an explicit-state model checker driving the real host objects
+  (PageAllocator / PrefixCache / ServingEngine / Scheduler /
+  TieredEngine) over a stubbed device layer through bounded event
+  interleavings, asserting refcount/lifecycle/stream-queue invariants
+  at every canonical state.
 
 Everything here is host-side tooling: importing this package never
-touches jax except inside trace-audit entry points that explicitly
-trace.
+touches jax except inside trace-audit/spmd-audit entry points that
+explicitly trace.
 """
 
 from .lint import (  # noqa: F401

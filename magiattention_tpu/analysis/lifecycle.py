@@ -1,0 +1,1574 @@
+"""Pass 5 — serving-state interleaving checker (ISSUE 13 tentpole).
+
+Every review round since PR 9 found the same class of bug in the
+serving host state machines: a refcount decremented twice on one path
+and never on another, an eviction victim left dangling in the
+scheduler's active table, a page simultaneously on the free list and in
+a live block table. These are *interleaving* bugs — each individual
+transition looks right; only a particular order of admissions,
+prefills, evictions and faults exposes the corruption. This pass
+converts that bug class into a CI gate: an explicit-state model checker
+that drives the REAL host objects — :class:`~..serving.kv_cache
+.PageAllocator`, :class:`~..serving.prefix.PrefixCache`,
+:class:`~..serving.engine.ServingEngine`, the
+:class:`~..serving.scheduler.Scheduler` and the ISSUE-12
+:class:`~..serving.distributed.TieredEngine`/``TieredScheduler`` — over
+a **stubbed device layer**, through exhaustively enumerated bounded
+event interleavings, asserting global invariants at every reached
+state.
+
+Design:
+
+- **Stubbed device layer** (:func:`stubbed_device_layer`). All host
+  bookkeeping is real; only the device work (cache tensors, attention
+  kernels, cross-tier ``device_put``) is replaced with shape-tracking
+  stubs whose *length semantics* mirror the real functional cache ops
+  (``seq_lens`` saturation, ``keep_len`` validation). Events therefore
+  cost microseconds, the state space is enumerable, and a host-logic
+  bug cannot hide behind a mocked-away assertion.
+
+- **Exhaustive bounded exploration** (:func:`explore`). Breadth-first
+  over event sequences up to ``max_depth``, deduplicating on a
+  **canonical state hash** — page and trie identities are renamed to
+  first-use order so states equivalent up to allocator id choice
+  collapse — with each node rebuilt by replaying its event path
+  against a fresh system (the transitions themselves are always the
+  real code). Breadth-first order makes the first counterexample a
+  MINIMAL event trace.
+
+- **Invariant catalog** (checked at every state): refcount
+  conservation (every resident page's refcount equals its sequence
+  owners plus its trie residency, exactly); no page simultaneously
+  free and referenced; free list duplicate-free and page-count
+  conservation; every sequence id in exactly one lifecycle state
+  (engine bookkeeping dicts carry no dangling entries, scheduler
+  actives own live slots, tier records match tier allocators);
+  host/device length-mirror agreement; stream-queue conservation
+  (parked stream <=> ``stream_queued`` stage, queue under its bound);
+  per-tier budget >= 0; and quiescence => all pages free.
+
+- **Mutation self-tests.** The two historical bugs are replantable as
+  context managers — :func:`planted_double_free` (PR 9's pre-refcount
+  ``PageAllocator.free``) and :func:`planted_dangling_eviction`
+  (PR 12's pre-fix scheduler that dropped a FAILED admission's eviction
+  victims) — and the checker must find each with a <= 8-event
+  counterexample (``tests/test_analysis/test_lifecycle.py`` and
+  ``run_static_analysis.py --self-test`` both assert it).
+
+Run via ``make lifecycle-check`` / ``make analyze``. Telemetry:
+``magi_analysis_states_explored`` / ``magi_analysis_counterexamples``
+(the ``REQUIRED_ANALYSIS_METRICS`` catalog).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import tempfile
+from typing import Callable, Sequence
+
+import numpy as np
+
+from .trace_audit import _pinned_env
+
+# ---------------------------------------------------------------------------
+# the stubbed device layer
+# ---------------------------------------------------------------------------
+
+
+class _StubDtype:
+    itemsize = 2
+    name = "bfloat16"
+
+    def __str__(self) -> str:  # pragma: no cover - debug repr
+        return "bfloat16"
+
+
+_DT = _StubDtype()
+
+
+class _StubArray:
+    """Shape-tracking stand-in for a device array: indexing/slicing keep
+    the shape algebra the host code reads, nothing holds data."""
+
+    __slots__ = ("shape",)
+    dtype = _DT
+
+    def __init__(self, shape=()):
+        self.shape = tuple(int(s) for s in shape)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def __len__(self) -> int:
+        return self.shape[0] if self.shape else 0
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            n = len(range(*key.indices(self.shape[0] if self.shape else 0)))
+            return _StubArray((n,) + self.shape[1:])
+        if isinstance(key, (int, np.integer)):
+            return _StubArray(self.shape[1:])
+        try:
+            n = len(key)
+        except TypeError:
+            return _StubArray(self.shape)
+        return _StubArray((n,) + self.shape[1:])
+
+    @property
+    def at(self):
+        return _StubAt(self)
+
+    def astype(self, _dt):
+        return self
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _StubArray(shape)
+
+
+class _StubAt:
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
+
+    def __getitem__(self, _key):
+        return _StubUpdate(self.arr)
+
+
+class _StubUpdate:
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
+
+    def set(self, *_a, **_k):
+        return self.arr
+
+    def add(self, *_a, **_k):
+        return self.arr
+
+
+class _StubJnp:
+    """The jnp surface the serving host loops touch."""
+
+    float32 = "float32"
+    int32 = "int32"
+    int64 = "int64"
+    bfloat16 = "bfloat16"
+
+    @staticmethod
+    def asarray(x, _dtype=None):
+        if isinstance(x, _StubArray):
+            return x
+        return np.asarray(x)
+
+    @staticmethod
+    def zeros(shape, _dtype=None):
+        if isinstance(shape, (int, np.integer)):
+            shape = (shape,)
+        return _StubArray(shape)
+
+    @staticmethod
+    def stack(xs, axis=0):
+        first = xs[0]
+        shape = tuple(getattr(first, "shape", ()))
+        return _StubArray((len(xs),) + shape)
+
+    @staticmethod
+    def concatenate(xs, axis=0):
+        n = sum(getattr(x, "shape", (0,))[0] for x in xs)
+        rest = tuple(getattr(xs[0], "shape", (0,))[1:])
+        return _StubArray((n,) + rest)
+
+
+class _StubJax:
+    @staticmethod
+    def device_put(x, _sharding=None):
+        return x
+
+
+class _StubMesh:
+    def __init__(self, devices, axis_names):
+        self.devices = devices
+        self.axis_names = tuple(axis_names)
+        n = len(devices)
+        self.shape = {self.axis_names[0]: n}
+
+
+@dataclasses.dataclass(frozen=True)
+class _StubCache:
+    """Host mirror of :class:`~..serving.kv_cache.PagedKVCache`: the
+    page payloads are shape-only stubs, but ``block_tables``/``seq_lens``
+    are REAL host values updated with the real ops' length semantics —
+    so the checker can assert the host/device length mirror."""
+
+    k_pages: _StubArray
+    v_pages: _StubArray
+    block_tables: tuple  # [max_seqs] rows of page-id tuples
+    seq_lens: tuple  # [max_seqs] ints
+
+    @property
+    def num_pages(self) -> int:
+        return self.k_pages.shape[0]
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[1]
+
+    @property
+    def num_kv_heads(self) -> int:
+        return self.k_pages.shape[2]
+
+    @property
+    def head_dim(self) -> int:
+        return self.k_pages.shape[3]
+
+    @property
+    def max_seqs(self) -> int:
+        return len(self.block_tables)
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        return len(self.block_tables[0])
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+
+def _stub_make_cache(
+    num_pages,
+    page_size,
+    num_kv_heads,
+    head_dim,
+    *,
+    max_seqs,
+    max_pages_per_seq=None,
+    dtype=None,
+):
+    assert page_size % 8 == 0, page_size  # the real op's tiling contract
+    mpp = max_pages_per_seq if max_pages_per_seq is not None else num_pages
+    shape = (num_pages, page_size, num_kv_heads, head_dim)
+    return _StubCache(
+        k_pages=_StubArray(shape),
+        v_pages=_StubArray(shape),
+        block_tables=tuple((0,) * mpp for _ in range(max_seqs)),
+        seq_lens=(0,) * max_seqs,
+    )
+
+
+def _set(t: tuple, i: int, v):
+    return t[:i] + (v,) + t[i + 1 :]
+
+
+def _stub_assign_block_table(cache, slot, pages, *, keep_len=False):
+    # mirrors the real op's validation exactly — a fork claiming tokens
+    # past its installed pages must be REJECTED here too
+    assert len(pages) <= cache.max_pages_per_seq, (
+        f"{len(pages)} pages > max_pages_per_seq {cache.max_pages_per_seq}"
+    )
+    row = tuple(int(p) for p in pages) + (0,) * (
+        cache.max_pages_per_seq - len(pages)
+    )
+    if keep_len is True:
+        seq = cache.seq_lens
+    else:
+        n = 0 if keep_len is False else int(keep_len)
+        assert 0 <= n <= len(pages) * cache.page_size, (
+            f"keep_len={n} exceeds the {len(pages)}-page installed capacity"
+        )
+        seq = _set(cache.seq_lens, int(slot), n)
+    return dataclasses.replace(
+        cache, block_tables=_set(cache.block_tables, int(slot), row),
+        seq_lens=seq,
+    )
+
+
+def _stub_reset_slot(cache, slot):
+    return dataclasses.replace(
+        cache, seq_lens=_set(cache.seq_lens, int(slot), 0)
+    )
+
+
+def _stub_copy_page(cache, _src, _dst):
+    return cache
+
+
+def _stub_swap_block_table_page(cache, slot, page_idx, new_page):
+    row = _set(cache.block_tables[int(slot)], int(page_idx), int(new_page))
+    return dataclasses.replace(
+        cache, block_tables=_set(cache.block_tables, int(slot), row)
+    )
+
+
+def _stub_append_kv(cache, slots, _k, _v):
+    seq = list(cache.seq_lens)
+    for s in np.asarray(slots).tolist():
+        if seq[s] < cache.max_seq_len:  # the real op's saturation
+            seq[s] += 1
+    return dataclasses.replace(cache, seq_lens=tuple(seq))
+
+
+def _stub_write(cache, slot, t, length):
+    start = cache.seq_lens[int(slot)]
+    wrote = max(min(t if length is None else int(length),
+                    cache.max_seq_len - start), 0)
+    return dataclasses.replace(
+        cache, seq_lens=_set(cache.seq_lens, int(slot), start + wrote)
+    )
+
+
+def _stub_prefill_into_cache(q, k, v, cache, slot, *, length=None, **_kw):
+    t = q.shape[0]
+    out = _StubArray((t,) + tuple(q.shape[1:]))
+    lse = _StubArray((t, q.shape[1]))
+    return out, lse, _stub_write(cache, slot, t, length)
+
+
+def _stub_continue_prefill_into_cache(
+    q, k, v, cache, slot, *, start, **_kw
+):
+    t = q.shape[0]
+    out = _StubArray((t,) + tuple(q.shape[1:]))
+    lse = _StubArray((t, q.shape[1]))
+    return out, lse, _stub_write(cache, slot, t, None)
+
+
+def _stub_magi_attn_decode(q, _cache, _batch, **_kw):
+    return _StubArray(q.shape), _StubArray(q.shape[:2])
+
+
+def _stub_cascade_decode_attn(q, _cache, _slots, _groups, **_kw):
+    return _StubArray(q.shape), _StubArray(q.shape[:2])
+
+
+def _stub_resolve_num_splits(*_a, **_k):
+    return 1
+
+
+class _StubDecodeBatch:
+    def __init__(self, slots):
+        self.slots = np.asarray(slots, np.int64)
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.slots.shape[0])
+
+    @staticmethod
+    def of(slots) -> "_StubDecodeBatch":
+        return _StubDecodeBatch(slots)
+
+
+@contextlib.contextmanager
+def _null_scope(_name):
+    yield
+
+
+@contextlib.contextmanager
+def stubbed_device_layer():
+    """Patch the serving modules' device surface with host-only stubs
+    (and quiet the resilience/serving loggers, pin the flight-recorder
+    dump dir to a tempdir). Every host object constructed inside runs
+    its REAL bookkeeping over the stub cache."""
+    import logging
+
+    from ..serving import distributed as dist_mod
+    from ..serving import engine as eng_mod
+    from ..serving import scheduler as sched_mod
+    from ..telemetry import trace as trace_mod
+
+    patches = [
+        (eng_mod, "jnp", _StubJnp),
+        (eng_mod, "make_paged_kv_cache", _stub_make_cache),
+        (eng_mod, "prefill_into_cache", _stub_prefill_into_cache),
+        (eng_mod, "continue_prefill_into_cache",
+         _stub_continue_prefill_into_cache),
+        (eng_mod, "append_kv", _stub_append_kv),
+        (eng_mod, "assign_block_table", _stub_assign_block_table),
+        (eng_mod, "copy_page", _stub_copy_page),
+        (eng_mod, "swap_block_table_page", _stub_swap_block_table_page),
+        (eng_mod, "reset_slot", _stub_reset_slot),
+        (eng_mod, "gather_kv", lambda c, s, max_len=None: (
+            _StubArray((max_len or c.max_seq_len, c.num_kv_heads,
+                        c.head_dim)),) * 2),
+        (eng_mod, "magi_attn_decode", _stub_magi_attn_decode),
+        (eng_mod, "cascade_decode_attn", _stub_cascade_decode_attn),
+        (eng_mod, "resolve_num_splits", _stub_resolve_num_splits),
+        (eng_mod, "DecodeBatch", _StubDecodeBatch),
+        (eng_mod, "named_scope", _null_scope),
+        (dist_mod, "jax", _StubJax),
+        (dist_mod, "jnp", _StubJnp),
+        (dist_mod, "Mesh", _StubMesh),
+        (dist_mod, "PagedKVCache", _StubCache),
+        (dist_mod, "shard_kv_cache",
+         lambda cache, mesh, axis_name="tp": cache),
+        (dist_mod, "kv_head_sharding", lambda mesh, axis_name="tp": None),
+        (dist_mod, "assign_block_table", _stub_assign_block_table),
+        (dist_mod, "named_scope", _null_scope),
+        (sched_mod, "jnp", _StubJnp),
+    ]
+    from ..telemetry.logger import get_logger
+
+    saved = [(m, n, getattr(m, n)) for m, n, _ in patches]
+    loggers = [
+        get_logger(n) for n in ("serving", "resilience", "telemetry")
+    ]
+    levels = [lg.level for lg in loggers]
+    with tempfile.TemporaryDirectory() as tmp, _pinned_env(
+        "MAGI_ATTENTION_TRACE_DIR", tmp
+    ):
+        trace_mod.reset_flight_recorder()
+        for m, n, v in patches:
+            setattr(m, n, v)
+        for lg in loggers:
+            lg.setLevel(logging.ERROR)
+        try:
+            yield
+        finally:
+            for m, n, v in saved:
+                setattr(m, n, v)
+            for lg, lv in zip(loggers, levels):
+                lg.setLevel(lv)
+            trace_mod.reset_flight_recorder()
+
+
+# ---------------------------------------------------------------------------
+# invariants
+# ---------------------------------------------------------------------------
+
+
+def _trie_page_counts(prefix) -> dict[int, int]:
+    """Pages the trie currently pins, with multiplicity (one reference
+    per full node + one per tail)."""
+    counts: dict[int, int] = {}
+    if prefix is None:
+        return counts
+    for node in prefix._nodes.values():
+        if node.page >= 0:
+            counts[node.page] = counts.get(node.page, 0) + 1
+        if node.tail is not None:
+            counts[node.tail.page] = counts.get(node.tail.page, 0) + 1
+    return counts
+
+
+def allocator_invariants(alloc, prefix=None, label="") -> list[str]:
+    """The page-accounting core: conservation, free/referenced
+    disjointness, exact refcount bookkeeping."""
+    errs: list[str] = []
+    tag = f"[{label}] " if label else ""
+    free = list(alloc._free_pages)
+    refs = dict(alloc._page_refs)
+    if len(set(free)) != len(free):
+        errs.append(f"{tag}free list holds a page twice: {sorted(free)}")
+    both = set(free) & set(refs)
+    if both:
+        errs.append(
+            f"{tag}page(s) {sorted(both)} simultaneously free and "
+            "referenced"
+        )
+    if len(set(free)) + len(refs) != alloc.num_pages:
+        errs.append(
+            f"{tag}page conservation broken: {len(set(free))} free + "
+            f"{len(refs)} resident != {alloc.num_pages} total"
+        )
+    oob = [p for p in list(free) + list(refs) if not 0 <= p < alloc.num_pages]
+    if oob:
+        errs.append(f"{tag}out-of-range page id(s) {sorted(set(oob))}")
+    # refcount conservation: sum of owners == tracked refs, per page
+    owners: dict[int, int] = {}
+    for slot, pages in alloc._slot_pages.items():
+        for p in pages:
+            owners[p] = owners.get(p, 0) + 1
+    for p, n in _trie_page_counts(prefix).items():
+        owners[p] = owners.get(p, 0) + n
+    for p in set(owners) | set(refs):
+        if refs.get(p, 0) != owners.get(p, 0):
+            errs.append(
+                f"{tag}refcount conservation broken on page {p}: "
+                f"tracked refs {refs.get(p, 0)} != "
+                f"{owners.get(p, 0)} owners (slots + trie residents)"
+            )
+    # slot accounting
+    free_slots = list(alloc._free_slots)
+    live_slots = set(alloc._slot_pages)
+    if len(set(free_slots)) != len(free_slots):
+        errs.append(f"{tag}free slot list holds a slot twice")
+    if set(free_slots) & live_slots:
+        errs.append(
+            f"{tag}slot(s) {sorted(set(free_slots) & live_slots)} "
+            "simultaneously free and allocated"
+        )
+    if len(set(free_slots)) + len(live_slots) != alloc.max_seqs:
+        errs.append(
+            f"{tag}slot conservation broken: {len(set(free_slots))} free "
+            f"+ {len(live_slots)} live != {alloc.max_seqs}"
+        )
+    return errs
+
+
+def engine_invariants(engine, label="") -> list[str]:
+    """ServingEngine bookkeeping: no dangling per-slot dicts, the
+    host/device length mirror agrees, lengths within reservations."""
+    errs = allocator_invariants(
+        engine.allocator, getattr(engine, "prefix", None), label
+    )
+    tag = f"[{label}] " if label else ""
+    live = set(engine.allocator._slot_pages)
+    for name in ("_lengths", "_priorities", "_tokens", "_slot_prefix"):
+        stale = set(getattr(engine, name)) - live
+        if stale:
+            errs.append(
+                f"{tag}{name} holds entries for retired slot(s) "
+                f"{sorted(stale)} — a freed sequence left bookkeeping "
+                "behind"
+            )
+    cache = engine.cache
+    if isinstance(cache, _StubCache):
+        ps = engine.allocator.page_size
+        for slot in live:
+            dev = cache.seq_lens[slot]
+            host = engine._lengths.get(slot, 0)
+            if dev != host:
+                errs.append(
+                    f"{tag}slot {slot}: host length mirror {host} != "
+                    f"device seq_lens {dev}"
+                )
+            cap = len(engine.allocator._slot_pages[slot]) * ps
+            if dev > cap:
+                errs.append(
+                    f"{tag}slot {slot}: {dev} tokens stored beyond the "
+                    f"{cap}-token reservation — writes landed on pages "
+                    "owned by other sequences"
+                )
+        for slot in range(cache.max_seqs):
+            if slot not in live and cache.seq_lens[slot] != 0:
+                errs.append(
+                    f"{tag}retired slot {slot} still stores "
+                    f"{cache.seq_lens[slot]} tokens"
+                )
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# canonical-state hashing
+# ---------------------------------------------------------------------------
+
+
+class _Renamer:
+    """First-use canonical renaming of opaque ids (pages, sids)."""
+
+    def __init__(self):
+        self.map: dict = {}
+
+    def __call__(self, x):
+        return self.map.setdefault(x, len(self.map))
+
+
+def canon_allocator(alloc, prefix, ren: _Renamer):
+    slots = tuple(
+        (slot, tuple(ren(p) for p in pages))
+        for slot, pages in sorted(alloc._slot_pages.items())
+    )
+    trie = ()
+    if prefix is not None:
+        clocks = sorted(
+            {n.last_used for n in prefix._nodes.values()}
+        )
+        rank = {c: i for i, c in enumerate(clocks)}
+        trie = tuple(
+            sorted(
+                (
+                    key.hex() if isinstance(key, bytes) else str(key),
+                    ren(node.page) if node.page >= 0 else -1,
+                    node.depth,
+                    rank[node.last_used],
+                    (
+                        (node.tail.tokens, ren(node.tail.page))
+                        if node.tail is not None
+                        else None
+                    ),
+                )
+                for key, node in prefix._nodes.items()
+            )
+        )
+    free = tuple(ren(p) for p in reversed(alloc._free_pages))  # pop order
+    refs = tuple(sorted((ren(p), r) for p, r in alloc._page_refs.items()))
+    free_slots = tuple(reversed(alloc._free_slots))
+    return (slots, trie, free, refs, free_slots)
+
+
+def canon_engine(engine, ren: _Renamer):
+    live = set(engine.allocator._slot_pages)
+    cache = engine.cache
+    tables = tuple(
+        (s, tuple(ren(p) for p in cache.block_tables[s][
+            : len(engine.allocator._slot_pages[s])]))
+        for s in sorted(live)
+    ) if isinstance(cache, _StubCache) else ()
+    return (
+        canon_allocator(engine.allocator, getattr(engine, "prefix", None),
+                        ren),
+        tuple(sorted(engine._lengths.items())),
+        tuple(sorted(engine._priorities.items())),
+        tuple(sorted(engine._tokens.items())),
+        tuple(
+            sorted(
+                (s, tuple(ren(p) for p in pages), n)
+                for s, (pages, n) in engine._slot_prefix.items()
+            )
+        ),
+        tuple(cache.seq_lens) if isinstance(cache, _StubCache) else (),
+        tables,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the explorer
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Counterexample:
+    model: str
+    trace: tuple[str, ...]
+    violations: tuple[str, ...]
+
+    def render(self) -> str:
+        steps = "\n".join(
+            f"    {i + 1}. {ev}" for i, ev in enumerate(self.trace)
+        ) or "    (initial state)"
+        viol = "\n".join(f"    !! {v}" for v in self.violations)
+        return (
+            f"counterexample [{self.model}] — minimal trace "
+            f"({len(self.trace)} event(s)):\n{steps}\n{viol}"
+        )
+
+
+@dataclasses.dataclass
+class ExploreResult:
+    model: str
+    states: int
+    transitions: int
+    max_depth: int
+    counterexamples: list[Counterexample]
+    truncated: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return not self.counterexamples
+
+
+def explore(
+    model,
+    *,
+    max_depth: int = 6,
+    max_states: int = 200_000,
+    stop_on_violation: bool = True,
+) -> ExploreResult:
+    """Breadth-first exhaustive exploration of ``model`` up to
+    ``max_depth`` events, deduplicated on the model's canonical state.
+
+    ``model`` provides ``name``, ``initial() -> sys``,
+    ``events(sys) -> [label]``, ``apply(sys, label)``,
+    ``canon(sys) -> hashable`` and ``check(sys) -> [violation]``.
+    States are rebuilt by REPLAYING event paths against a fresh
+    ``initial()`` — transitions always execute the real code, and
+    breadth-first order makes the first counterexample minimal."""
+    from .. import telemetry
+
+    def build(path):
+        sys = model.initial()
+        for label in path:
+            model.apply(sys, label)
+        return sys
+
+    result = ExploreResult(
+        model=model.name, states=0, transitions=0, max_depth=max_depth,
+        counterexamples=[],
+    )
+
+    init = build(())
+    seen = {model.canon(init)}
+    result.states = 1
+    v0 = model.check(init)
+    if v0:
+        result.counterexamples.append(
+            Counterexample(model.name, (), tuple(v0))
+        )
+        if stop_on_violation:
+            telemetry.record_analysis_run(result.states, 1)
+            return result
+    # each frontier entry carries its enabled events, computed when the
+    # state was first built — expanding a node then needs no parent
+    # replay, halving the replay work of the whole exploration
+    frontier: list[tuple[tuple[str, ...], list[str]]] = [
+        ((), model.events(init))
+    ]
+    depth = 0
+    while frontier and depth < max_depth and not result.truncated:
+        depth += 1
+        nxt: list[tuple[tuple[str, ...], list[str]]] = []
+        for path, labels in frontier:
+            for label in labels:
+                child_path = path + (label,)
+                child = build(child_path)
+                result.transitions += 1
+                c = model.canon(child)
+                if c in seen:
+                    continue
+                seen.add(c)
+                result.states += 1
+                violations = model.check(child)
+                if violations:
+                    result.counterexamples.append(
+                        Counterexample(
+                            model.name, child_path, tuple(violations)
+                        )
+                    )
+                    if stop_on_violation:
+                        telemetry.record_analysis_run(
+                            result.states, len(result.counterexamples)
+                        )
+                        return result
+                nxt.append((child_path, model.events(child)))
+                if result.states >= max_states:
+                    result.truncated = True
+                    break
+            if result.truncated:
+                break
+        frontier = nxt
+    telemetry.record_analysis_run(
+        result.states, len(result.counterexamples)
+    )
+    return result
+
+
+# ---------------------------------------------------------------------------
+# model 1: the single-chip engine (allocator + prefix trie + engine)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _Profile:
+    """One request shape the models drive (tokens enable the prefix
+    trie; None = tokenless admission)."""
+
+    name: str
+    tokens: tuple[int, ...] | None
+    prompt_len: int
+    gen: int
+    priority: int = 0
+
+
+def _default_profiles(page_size: int) -> tuple[_Profile, ...]:
+    ps = page_size
+    base = tuple(range(100, 100 + ps + 3))  # 1 full page + a partial tail
+    return (
+        _Profile("A", base, len(base), gen=1),
+        # B shares A's full page AND its partial tail prefix, then
+        # diverges -> fork + CoW-split surface
+        _Profile("B", base + (7, 8), len(base) + 2, gen=1),
+        # C: tokenless, higher priority -> the eviction surface
+        _Profile("C", None, 2 * ps, gen=1, priority=2),
+    )
+
+
+class EngineModel:
+    """ServingEngine + PageAllocator + PrefixCache under the event
+    alphabet admit / admit-fault / prefill-chunk / decode / free /
+    evict-prefix / drop-prefix."""
+
+    name = "engine"
+
+    def __init__(
+        self,
+        *,
+        num_pages: int = 5,
+        page_size: int = 8,
+        max_seqs: int = 2,
+        max_pages_per_seq: int = 4,
+        chunk: int | None = None,
+        profiles: Sequence[_Profile] | None = None,
+        max_admission_evictions: int = 1,
+    ):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_seqs = max_seqs
+        self.max_pages_per_seq = max_pages_per_seq
+        self.chunk = chunk if chunk is not None else page_size
+        self.profiles = tuple(
+            profiles if profiles is not None
+            else _default_profiles(page_size)
+        )
+        self.max_admission_evictions = max_admission_evictions
+
+    # -- system construction / events ------------------------------------
+
+    def initial(self):
+        from ..serving.engine import ServingEngine
+
+        engine = ServingEngine(
+            num_pages=self.num_pages,
+            num_kv_heads=2,
+            head_dim=4,
+            page_size=self.page_size,
+            max_seqs=self.max_seqs,
+            max_pages_per_seq=self.max_pages_per_seq,
+            max_admission_evictions=self.max_admission_evictions,
+        )
+        # model-side request ledger: name -> dict(status, slot, pos, done)
+        reqs = {
+            p.name: {"status": "idle", "slot": None, "pos": 0, "done": 0}
+            for p in self.profiles
+        }
+        return {"engine": engine, "reqs": reqs}
+
+    def _profile(self, name: str) -> _Profile:
+        return next(p for p in self.profiles if p.name == name)
+
+    def events(self, sys) -> list[str]:
+        engine, reqs = sys["engine"], sys["reqs"]
+        out: list[str] = []
+        for p in self.profiles:
+            r = reqs[p.name]
+            if r["status"] == "idle":
+                out.append(f"admit:{p.name}")
+                out.append(f"admit_fault:{p.name}")
+            elif r["status"] == "active":
+                if r["pos"] < p.prompt_len:
+                    out.append(f"prefill:{p.name}")
+                out.append(f"free:{p.name}")
+        decoding = [
+            p.name
+            for p in self.profiles
+            if reqs[p.name]["status"] == "active"
+            and reqs[p.name]["pos"] >= p.prompt_len
+        ]
+        for nm in decoding:  # single-sequence steps
+            out.append(f"decode:{nm}")
+        if len(decoding) > 1:  # and the batched step (cascade surface)
+            out.append("decode:" + "+".join(decoding))
+        if engine.prefix is not None and engine.prefix.num_nodes:
+            out.append("evict_prefix")
+            out.append("drop_prefix")
+        return out
+
+    def apply(self, sys, label: str) -> None:
+        from ..serving.kv_cache import PageAllocatorError
+
+        engine, reqs = sys["engine"], sys["reqs"]
+        kind, _, arg = label.partition(":")
+        if kind in ("admit", "admit_fault"):
+            p = self._profile(arg)
+            ctx = (
+                _pinned_chaos("alloc_fail:times=1")
+                if kind == "admit_fault"
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                res = engine.admit(
+                    p.prompt_len, priority=p.priority, tokens=p.tokens
+                )
+            for victim in res.evicted:
+                for q in self.profiles:
+                    r = reqs[q.name]
+                    if r["status"] == "active" and r["slot"] == victim:
+                        r.update(status="idle", slot=None, pos=0, done=0)
+            if res.admitted:
+                reqs[arg].update(
+                    status="active", slot=res.slot, pos=res.prefix_len,
+                    done=0,
+                )
+        elif kind == "prefill":
+            p = self._profile(arg)
+            r = reqs[arg]
+            n = min(self.chunk, p.prompt_len - r["pos"])
+            q = _StubArray((n, 2, 4))
+            try:
+                engine.prefill(q, q, q, r["slot"])
+            except PageAllocatorError:
+                return  # transient pressure: state must be untouched
+            r["pos"] += n
+        elif kind == "decode":
+            names = arg.split("+")
+            slots = [reqs[nm]["slot"] for nm in names]
+            b = len(slots)
+            q = _StubArray((b, 2, 4))
+            try:
+                engine.decode_step(q, q, q, slots)
+            except PageAllocatorError:
+                return
+            for nm in names:
+                reqs[nm]["done"] += 1
+        elif kind == "free":
+            r = reqs[arg]
+            engine.free(r["slot"])
+            r.update(status="idle", slot=None, pos=0, done=0)
+        elif kind == "evict_prefix":
+            engine.prefix.evict(engine.allocator, 1)
+        elif kind == "drop_prefix":
+            engine.prefix.drop_all(engine.allocator)
+        else:  # pragma: no cover - unknown label is a harness bug
+            raise AssertionError(f"unknown event {label!r}")
+
+    # -- canon / invariants ----------------------------------------------
+
+    def canon(self, sys):
+        ren = _Renamer()
+        reqs = tuple(
+            (nm, r["status"], r["slot"], r["pos"], r["done"])
+            for nm, r in sorted(sys["reqs"].items())
+        )
+        return (canon_engine(sys["engine"], ren), reqs)
+
+    def check(self, sys) -> list[str]:
+        engine, reqs = sys["engine"], sys["reqs"]
+        errs = engine_invariants(engine, self.name)
+        # every sequence in exactly one lifecycle state: the model's
+        # active set and the allocator's live slots must be a bijection
+        active_slots = [
+            r["slot"] for r in reqs.values() if r["status"] == "active"
+        ]
+        live = set(engine.allocator._slot_pages)
+        if len(set(active_slots)) != len(active_slots):
+            errs.append(
+                f"[{self.name}] two live requests share slot(s) "
+                f"{sorted(s for s in active_slots if active_slots.count(s) > 1)}"
+            )
+        dangling = [s for s in active_slots if s not in live]
+        if dangling:
+            errs.append(
+                f"[{self.name}] active request(s) hold retired slot(s) "
+                f"{sorted(dangling)} — evicted without requeue"
+            )
+        orphaned = live - set(active_slots)
+        if orphaned:
+            errs.append(
+                f"[{self.name}] allocated slot(s) {sorted(orphaned)} "
+                "belong to no live request — leaked reservations"
+            )
+        # quiescence: nothing live and nothing cached => empty pool
+        if not live and (
+            engine.prefix is None or engine.prefix.resident_pages == 0
+        ):
+            if engine.allocator.pages_in_use:
+                errs.append(
+                    f"[{self.name}] quiescent state leaks "
+                    f"{engine.allocator.pages_in_use} page(s)"
+                )
+        return errs
+
+
+@contextlib.contextmanager
+def _pinned_chaos(spec: str):
+    from ..resilience import chaos
+
+    with _pinned_env("MAGI_ATTENTION_CHAOS", spec):
+        chaos.reset_chaos()
+        try:
+            yield
+        finally:
+            chaos.reset_chaos()
+
+
+# ---------------------------------------------------------------------------
+# model 2: scheduler over one engine (the PR 12 dangling-victim surface)
+# ---------------------------------------------------------------------------
+
+
+class SchedulerModel:
+    """Scheduler + ServingEngine: events submit / tick. The tick runs
+    the real admission (priority eviction included), decode-first step
+    and prefill-chunk loop; invariants cross-check the scheduler's
+    request table against the engine's allocator."""
+
+    name = "scheduler"
+
+    def __init__(
+        self,
+        *,
+        num_pages: int = 4,
+        page_size: int = 8,
+        max_seqs: int = 3,
+        max_pages_per_seq: int = 4,
+        token_budget: int = 24,
+        chunk: int = 8,
+        profiles: Sequence[_Profile] | None = None,
+        max_admission_evictions: int = 1,
+    ):
+        ps = page_size
+        self.cfg = dict(
+            num_pages=num_pages, page_size=page_size, max_seqs=max_seqs,
+            max_pages_per_seq=max_pages_per_seq,
+            max_admission_evictions=max_admission_evictions,
+        )
+        self.token_budget = token_budget
+        self.chunk = chunk
+        self.profiles = tuple(
+            profiles
+            if profiles is not None
+            else (
+                _Profile("A", None, ps, gen=1, priority=0),
+                _Profile("B", None, ps, gen=1, priority=0),
+                # C needs the whole pool (gen=0 keeps it inside one
+                # sequence's capacity); with the eviction budget at 1
+                # its admission attempt can evict a victim yet still fail
+                _Profile("C", None, 4 * ps, gen=0, priority=2),
+            )
+        )
+
+    def initial(self):
+        from ..serving.engine import ServingEngine
+        from ..serving.scheduler import Scheduler
+
+        engine = ServingEngine(num_kv_heads=2, head_dim=4, **self.cfg)
+        clock = _CountingClock()
+        sched = Scheduler(
+            engine, token_budget=self.token_budget, chunk=self.chunk,
+            clock=clock,
+        )
+        return {"sched": sched, "engine": engine, "submitted": set()}
+
+    def events(self, sys) -> list[str]:
+        out = []
+        for i, p in enumerate(self.profiles):
+            if p.name not in sys["submitted"]:
+                out.append(f"submit:{p.name}")
+        if not sys["sched"].done:
+            out.append("tick")
+        return out
+
+    def apply(self, sys, label: str) -> None:
+        from ..serving.scheduler import Request
+
+        kind, _, arg = label.partition(":")
+        if kind == "submit":
+            p = next(q for q in self.profiles if q.name == arg)
+            rid = list(self.profiles).index(p)
+            h, d = 2, 4
+            req = Request(
+                rid=rid,
+                prompt_q=_StubArray((p.prompt_len, h, d)),
+                prompt_k=_StubArray((p.prompt_len, h, d)),
+                prompt_v=_StubArray((p.prompt_len, h, d)),
+                decode_q=_StubArray((p.gen, h, d)),
+                decode_k=_StubArray((p.gen, h, d)),
+                decode_v=_StubArray((p.gen, h, d)),
+                tokens=p.tokens,
+                max_new_tokens=p.gen,
+                priority=p.priority,
+                trace_id=f"lc-{p.name}",
+            )
+            sys["sched"].submit(req)
+            sys["submitted"].add(p.name)
+        elif kind == "tick":
+            sys["sched"].step()
+        else:  # pragma: no cover
+            raise AssertionError(f"unknown event {label!r}")
+
+    def canon(self, sys):
+        sched = sys["sched"]
+        ren = _Renamer()
+        queue = tuple(st.rid for st in sched._queue)
+        active = tuple(
+            sorted(
+                (st.rid, st.status, st.slot, st.prefill_pos,
+                 st.tokens_done, st.evictions)
+                for st in sched._active.values()
+            )
+        )
+        finished = tuple(sorted(
+            (rid, st.status) for rid, st in sched._finished.items()
+        ))
+        return (
+            canon_engine(sys["engine"], ren),
+            queue,
+            active,
+            finished,
+            tuple(sorted(sys["submitted"])),
+        )
+
+    def check(self, sys) -> list[str]:
+        sched, engine = sys["sched"], sys["engine"]
+        errs = engine_invariants(engine, self.name)
+        live = set(engine.allocator._slot_pages)
+        seen_rids: set[int] = set()
+        for st in sched._active.values():
+            seen_rids.add(st.rid)
+            if st.slot not in live:
+                errs.append(
+                    f"[{self.name}] active request {st.rid} holds "
+                    f"retired slot {st.slot} — an eviction victim was "
+                    "never requeued (it will never be stepped again)"
+                )
+        for st in sched._queue:
+            if st.rid in seen_rids:
+                errs.append(
+                    f"[{self.name}] request {st.rid} is queued AND "
+                    "active"
+                )
+            if st.slot is not None:
+                errs.append(
+                    f"[{self.name}] queued request {st.rid} still holds "
+                    f"slot {st.slot}"
+                )
+        for rid in sched._finished:
+            if rid in seen_rids:
+                errs.append(
+                    f"[{self.name}] request {rid} is finished AND active"
+                )
+        active_slots = [st.slot for st in sched._active.values()]
+        orphaned = live - set(active_slots)
+        if orphaned:
+            errs.append(
+                f"[{self.name}] allocated slot(s) {sorted(orphaned)} "
+                "belong to no scheduled request"
+            )
+        if sched.done:
+            if engine.allocator.active_seqs:
+                errs.append(
+                    f"[{self.name}] scheduler drained but "
+                    f"{engine.allocator.active_seqs} sequence(s) remain "
+                    "allocated"
+                )
+            if engine.prefix is not None and (
+                engine.prefix.resident_pages == 0
+                and engine.allocator.pages_in_use
+            ):
+                errs.append(
+                    f"[{self.name}] quiescent pool leaks "
+                    f"{engine.allocator.pages_in_use} page(s)"
+                )
+        return errs
+
+
+class _CountingClock:
+    """Deterministic monotonic clock for replayed scheduler runs."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        self.t += 1.0
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# model 3: the tiered (disaggregated) engine + scheduler
+# ---------------------------------------------------------------------------
+
+
+class TieredModel(SchedulerModel):
+    """TieredScheduler over a TieredEngine (1 prefill chip + dp decode
+    replicas): adds the page-stream and decode-fault events to the
+    scheduler alphabet, and checks the sid<->tier-slot bijection plus
+    stream-queue conservation on top of the per-tier allocator
+    invariants."""
+
+    name = "tiered"
+
+    def __init__(
+        self,
+        *,
+        num_pages: int = 4,
+        page_size: int = 8,
+        max_seqs: int = 2,
+        max_pages_per_seq: int = 4,
+        dp: int = 2,
+        prefill_budget: int = 16,
+        decode_budget: int = 8,
+        chunk: int = 8,
+        profiles: Sequence[_Profile] | None = None,
+        stream_queue_max: int = 2,
+    ):
+        ps = page_size
+        self.cfg = dict(
+            num_pages=num_pages, page_size=page_size, max_seqs=max_seqs,
+            max_pages_per_seq=max_pages_per_seq,
+        )
+        self.dp = dp
+        self.prefill_budget = prefill_budget
+        self.decode_budget = decode_budget
+        self.chunk = chunk
+        self.stream_queue_max = stream_queue_max
+        self.profiles = tuple(
+            profiles
+            if profiles is not None
+            else (
+                _Profile("A", None, ps, gen=2, priority=0),
+                _Profile("B", None, 2 * ps, gen=1, priority=1),
+            )
+        )
+
+    def initial(self):
+        from ..serving.distributed import TieredEngine, TieredScheduler
+
+        engine = TieredEngine(
+            num_kv_heads=2,
+            head_dim=4,
+            mesh_spec={
+                "prefill": 1, "decode_dp": self.dp, "decode_tp": 1,
+            },
+            devices=list(range(1 + self.dp)),
+            stream_queue_max=self.stream_queue_max,
+            **self.cfg,
+        )
+        sched = TieredScheduler(
+            engine,
+            prefill_budget=self.prefill_budget,
+            decode_budget=self.decode_budget,
+            chunk=self.chunk,
+            clock=_CountingClock(),
+        )
+        return {"sched": sched, "engine": engine, "submitted": set()}
+
+    def events(self, sys) -> list[str]:
+        out = super().events(sys)
+        sched = sys["sched"]
+        decoding = [
+            st for st in sched._active.values()
+            if st.status == "decoding" and sys["engine"].placed(st.slot)
+        ]
+        if decoding:
+            out.append("tick_fault")  # a decode chip dies mid-step
+        return out
+
+    def apply(self, sys, label: str) -> None:
+        if label == "tick_fault":
+            with _pinned_chaos("decode_fault:times=1"):
+                sys["sched"].step()
+            return
+        super().apply(sys, label)
+
+    def canon(self, sys):
+        sched, engine = sys["sched"], sys["engine"]
+        ren = _Renamer()
+        seq = tuple(
+            sorted(
+                (sid, rec["stage"], rec["pslot"], rec["replica"],
+                 rec["dslot"], rec["expected"], rec["priority"])
+                for sid, rec in engine._seq.items()
+            )
+        )
+        tiers = (canon_engine(engine._prefill, ren),) + tuple(
+            canon_engine(r.engine, _Renamer()) for r in engine.replicas
+        )
+        pending = tuple(p.sid for p in engine._pending)
+        restarts = tuple(r.restarts for r in engine.replicas)
+        queue = tuple(st.rid for st in sched._queue)
+        active = tuple(
+            sorted(
+                (st.rid, st.status, st.slot, st.prefill_pos,
+                 st.tokens_done, st.evictions)
+                for st in sched._active.values()
+            )
+        )
+        finished = tuple(sorted(sched._finished))
+        return (seq, tiers, pending, restarts, queue, active, finished,
+                tuple(sorted(sys["submitted"])))
+
+    def check(self, sys) -> list[str]:
+        sched, engine = sys["sched"], sys["engine"]
+        errs: list[str] = []
+        errs += engine_invariants(engine._prefill, "tiered/prefill")
+        for r in engine.replicas:
+            errs += engine_invariants(
+                r.engine, f"tiered/decode{r.index}"
+            )
+        # sid <-> tier slot bijection
+        prefill_live = set(engine._prefill.allocator._slot_pages)
+        used_p: set[int] = set()
+        used_d: set[tuple[int, int]] = set()
+        for sid, rec in engine._seq.items():
+            if rec["stage"] in ("prefill", "stream_queued"):
+                if rec["pslot"] not in prefill_live:
+                    errs.append(
+                        f"[tiered] sid {sid} ({rec['stage']}) maps to "
+                        f"retired prefill slot {rec['pslot']}"
+                    )
+                if rec["pslot"] in used_p:
+                    errs.append(
+                        f"[tiered] prefill slot {rec['pslot']} owned by "
+                        "two sids"
+                    )
+                used_p.add(rec["pslot"])
+            elif rec["stage"] == "decode":
+                rep = engine.replicas[rec["replica"]]
+                if rec["dslot"] not in rep.engine.allocator._slot_pages:
+                    errs.append(
+                        f"[tiered] sid {sid} maps to retired decode "
+                        f"slot {rec['dslot']} on replica {rec['replica']}"
+                    )
+                key = (rec["replica"], rec["dslot"])
+                if key in used_d:
+                    errs.append(
+                        f"[tiered] decode slot {key} owned by two sids"
+                    )
+                used_d.add(key)
+            else:
+                errs.append(
+                    f"[tiered] sid {sid} in unknown stage "
+                    f"{rec['stage']!r}"
+                )
+        orphaned_p = prefill_live - used_p
+        if orphaned_p:
+            errs.append(
+                f"[tiered] prefill slot(s) {sorted(orphaned_p)} belong "
+                "to no sid"
+            )
+        for r in engine.replicas:
+            orphaned_d = set(r.engine.allocator._slot_pages) - {
+                d for (ri, d) in used_d if ri == r.index
+            }
+            if orphaned_d:
+                errs.append(
+                    f"[tiered] decode replica {r.index} slot(s) "
+                    f"{sorted(orphaned_d)} belong to no sid"
+                )
+        # stream-queue conservation
+        pend = [p.sid for p in engine._pending]
+        if len(set(pend)) != len(pend):
+            errs.append("[tiered] a stream is parked twice")
+        if len(pend) > engine.stream_queue_max:
+            errs.append(
+                f"[tiered] stream queue over its bound: {len(pend)} > "
+                f"{engine.stream_queue_max}"
+            )
+        for sid in pend:
+            rec = engine._seq.get(sid)
+            if rec is None or rec["stage"] != "stream_queued":
+                errs.append(
+                    f"[tiered] parked stream for sid {sid} whose stage "
+                    f"is {rec['stage'] if rec else 'gone'}"
+                )
+        for sid, rec in engine._seq.items():
+            if rec["stage"] == "stream_queued" and sid not in pend:
+                errs.append(
+                    f"[tiered] sid {sid} is stream_queued but no stream "
+                    "is parked"
+                )
+        # scheduler cross-check: active slots are known LIVE sids
+        for st in sched._active.values():
+            if st.slot not in engine._seq:
+                errs.append(
+                    f"[tiered] active request {st.rid} holds unknown "
+                    f"sid {st.slot} — a fault/eviction victim was never "
+                    "requeued"
+                )
+        # per-tier budget >= 0 by construction of the config; assert
+        # the configured budgets were not driven negative
+        if sched.prefill_budget < 0 or sched.decode_budget < 0:
+            errs.append("[tiered] negative tier budget")
+        if sched.done and not engine._pending:
+            for r in engine.replicas:
+                if r.engine.allocator.pages_in_use:
+                    errs.append(
+                        f"[tiered] drained scheduler leaks "
+                        f"{r.engine.allocator.pages_in_use} page(s) on "
+                        f"decode replica {r.index}"
+                    )
+            pre = engine._prefill
+            if (
+                pre.prefix is None or pre.prefix.resident_pages == 0
+            ) and pre.allocator.pages_in_use:
+                errs.append(
+                    f"[tiered] drained scheduler leaks "
+                    f"{pre.allocator.pages_in_use} page(s) on the "
+                    "prefill tier"
+                )
+        return errs
+
+
+# ---------------------------------------------------------------------------
+# replanted historical bugs (mutation self-tests)
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def planted_double_free():
+    """PR 9's pre-fix allocator retire path: pages go straight back to
+    the free list with no refcount decrement — a page still pinned by
+    the prefix trie (or a sibling fork) is handed out again. The
+    checker must find this with a short admit -> prefill(commit) ->
+    free trace."""
+    from ..serving.kv_cache import InvalidFreeError, PageAllocator
+
+    orig = PageAllocator.free
+
+    def bad_free(self, slot):
+        pages = self._slot_pages.get(slot)
+        if pages is None:
+            raise InvalidFreeError(f"slot {slot} not allocated")
+        del self._slot_pages[slot]
+        for p in reversed(pages):
+            self._page_refs.pop(p, None)  # the skipped decrement
+            self._free_pages.append(p)  # freed even while shared
+        self._free_slots.append(slot)
+
+    PageAllocator.free = bad_free
+    try:
+        yield
+    finally:
+        PageAllocator.free = orig
+
+
+@contextlib.contextmanager
+def planted_dangling_eviction():
+    """PR 12's pre-fix ``Scheduler._admit_queued``: eviction victims
+    were requeued only when the admission ultimately SUCCEEDED — a
+    bounded evict-then-give-up pass left its victims dangling in
+    ``_active`` with slots the engine had already released."""
+    from ..serving import scheduler as sched_mod
+    from ..telemetry import trace as reqtrace
+
+    orig = sched_mod.Scheduler._admit_queued
+
+    def bad_admit_queued(self):
+        admitted, rejected = [], []
+        for st in self._admission_order():
+            req = st.request
+            with reqtrace.request_context(st.trace_id, st.rid):
+                res = self.engine.admit(
+                    req.prompt_len,
+                    priority=req.priority,
+                    tokens=req.tokens,
+                )
+            if not res.admitted:
+                # the pre-fix bug: res.evicted is dropped on this path
+                if res.reason == "too_long":
+                    st.status = sched_mod.REJECTED
+                    self._queue.remove(st)
+                    self._finished[st.rid] = st
+                    rejected.append(st.rid)
+                    continue
+                break
+            for victim_slot in res.evicted:
+                self._handle_eviction(victim_slot)
+            st.slot = res.slot
+            st.prefix_len = res.prefix_len
+            st.prefill_pos = res.prefix_len
+            st.admitted_at = self._clock()
+            st.status = sched_mod.PREFILLING
+            self._queue.remove(st)
+            self._active[st.rid] = st
+            admitted.append(st.rid)
+        return admitted, rejected
+
+    sched_mod.Scheduler._admit_queued = bad_admit_queued
+    try:
+        yield
+    finally:
+        sched_mod.Scheduler._admit_queued = orig
+
+
+# ---------------------------------------------------------------------------
+# CLI entry points
+# ---------------------------------------------------------------------------
+
+
+def _rich_profiles(ps: int) -> tuple[_Profile, ...]:
+    """Four request shapes spanning the whole event surface: a trie
+    registrant, a fork that diverges past the shared tail (CoW), a
+    high-priority evictor, and a tokenless multi-step decoder."""
+    base = tuple(range(100, 100 + ps + 3))
+    return (
+        _Profile("A", base, len(base), gen=1),
+        _Profile("B", base + (7, 8), len(base) + 2, gen=1),
+        _Profile("C", None, 2 * ps, gen=1, priority=2),
+        _Profile("D", None, ps, gen=2, priority=1),
+    )
+
+
+def default_models(*, smoke: bool = False):
+    """The checked model suite; ``smoke`` keeps the default test tier
+    fast (the full-depth matrix runs in ``make lifecycle-check``)."""
+    if smoke:
+        return [
+            (EngineModel(), dict(max_depth=4)),
+            (SchedulerModel(), dict(max_depth=4)),
+            (TieredModel(), dict(max_depth=4)),
+        ]
+    ps = 8
+    return [
+        # the wide config: 4 request shapes x 3 slots x 6 pages at
+        # sub-page chunking — the bulk of the canonical state count
+        (
+            EngineModel(
+                num_pages=6, max_seqs=3, profiles=_rich_profiles(ps),
+                chunk=4,
+            ),
+            dict(max_depth=10),
+        ),
+        # the deep config: 2 slots force constant eviction/recycle
+        (EngineModel(), dict(max_depth=12)),
+        (SchedulerModel(), dict(max_depth=8)),
+        (
+            SchedulerModel(
+                max_seqs=3, num_pages=5, token_budget=12, chunk=4
+            ),
+            dict(max_depth=10),
+        ),
+        (TieredModel(chunk=4, prefill_budget=8), dict(max_depth=10)),
+    ]
+
+
+def run_lifecycle_check(
+    *, smoke: bool = False, max_states: int = 200_000
+) -> tuple[list[str], dict]:
+    """Explore the clean tree; any counterexample is a gate failure.
+    Returns (errors, report with per-model state counts)."""
+    errors: list[str] = []
+    report: dict = {}
+    with stubbed_device_layer():
+        for i, (model, opts) in enumerate(default_models(smoke=smoke)):
+            res = explore(model, max_states=max_states, **opts)
+            report[f"{i}:{model.name}"] = {
+                "states": res.states,
+                "transitions": res.transitions,
+                "max_depth": res.max_depth,
+                "truncated": res.truncated,
+            }
+            for cex in res.counterexamples:
+                errors.append(cex.render())
+    return errors, report
+
+
+def run_mutation_self_test(*, max_len: int = 8) -> list[str]:
+    """Both replanted historical bugs must be found, each with a
+    counterexample no longer than ``max_len`` events."""
+    errors: list[str] = []
+    with stubbed_device_layer():
+        with planted_double_free():
+            res = explore(EngineModel(), max_depth=6)
+        if res.ok:
+            errors.append(
+                "self-test: planted double-free (PR 9 pre-fix "
+                "allocator) was NOT caught"
+            )
+        elif len(res.counterexamples[0].trace) > max_len:
+            errors.append(
+                "self-test: double-free counterexample not minimal "
+                f"({len(res.counterexamples[0].trace)} > {max_len} "
+                "events)"
+            )
+        with planted_dangling_eviction():
+            res = explore(SchedulerModel(), max_depth=8)
+        if res.ok:
+            errors.append(
+                "self-test: planted dangling-eviction (PR 12 pre-fix "
+                "scheduler) was NOT caught"
+            )
+        elif len(res.counterexamples[0].trace) > max_len:
+            errors.append(
+                "self-test: dangling-eviction counterexample not "
+                f"minimal ({len(res.counterexamples[0].trace)} > "
+                f"{max_len} events)"
+            )
+    return errors

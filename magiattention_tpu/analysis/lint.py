@@ -22,6 +22,18 @@ rules that keep the SPMD stack portable and legible:
 - **MAGI004** — every ``lax.ppermute`` / ``lax.all_to_all`` /
   ``lax.psum`` call site lexically wrapped in a ``named_scope`` so
   profiler timelines and the measured-overlap audit stay legible.
+  ISSUE 13 extends the rule to ``jax.device_put`` inside ``serving/``:
+  there a device_put IS a wire hop (the page-stream / pool-pinning
+  transfer), and an unscoped hop is invisible on the hop timeline.
+- **MAGI005** — no ``axis_index`` / ``process_index``-dependent host
+  control flow (``if``/``while``/ternary) lexically guarding a
+  collective issue site. Rank-gated host branching around a collective
+  is the static root cause of cross-rank schedule divergence — one
+  rank traces an extra (or missing) collective and the pod hangs, not
+  errors (the value-level half of this check is
+  ``analysis/spmd_audit.py``). Rank-dependent *data* belongs in traced
+  selects (``jnp.where(lax.axis_index(...) == r, ...)``), never in
+  host branches around collective issue sites.
 
 Deliberate exceptions live in ``exps/data/analysis_allowlist.json`` as
 ``{rule, path, symbol, justification}`` records (symbol = dotted
@@ -47,6 +59,11 @@ RULES: dict[str, str] = {
     "MAGI004": (
         "collective (ppermute/all_to_all/psum) not wrapped in named_scope"
     ),
+    "MAGI005": (
+        "axis_index/process_index-dependent host control flow guards a "
+        "collective issue site — per-rank schedule divergence (pod "
+        "hang); use a traced select or restructure"
+    ),
 }
 
 # rule scopes (path prefixes are repo-relative, posix separators)
@@ -57,6 +74,21 @@ _HOT_PATHS = tuple(
     f"{_PACKAGE}/{d}/" for d in ("ops", "parallel", "serving", "comm")
 )
 _COLLECTIVES = ("ppermute", "all_to_all", "psum")
+# the wire-collective set MAGI005 treats as an issue site (a superset
+# of the MAGI004 scoping set — any of these inside rank-gated host
+# control flow diverges the per-rank schedule)
+_WIRE_COLLECTIVES = (
+    "ppermute",
+    "all_to_all",
+    "psum",
+    "psum_scatter",
+    "all_gather",
+    "reduce_scatter",
+)
+_RANK_SOURCES = ("axis_index", "process_index")
+# serving/ device_puts are wire hops (page streams, pool pinning) and
+# fall under MAGI004's named_scope rule there
+_DEVICE_PUT_SCOPE = f"{_PACKAGE}/serving/"
 _PRAGMA = "# magi-allow:"
 
 
@@ -162,6 +194,10 @@ class _Linter(ast.NodeVisitor):
         self._traced_depth = 0  # inside a traced-context function
         self._in_hot_path = path.startswith(_HOT_PATHS)
         self._traced_params: list[set[str]] = []
+        # names bound from axis_index()/process_index() calls, one set
+        # per lexical scope (nested scopes inherit — a closure over the
+        # rank is still the rank)
+        self._rank_names: list[set[str]] = [set()]
 
     # -- helpers --------------------------------------------------------
 
@@ -197,13 +233,73 @@ class _Linter(ast.NodeVisitor):
         traced = is_traced or self._traced_depth > 0
         self._traced_depth += 1 if traced else 0
         self._traced_params.append(traced_names)
+        self._rank_names.append(set(self._rank_names[-1]))
         self.generic_visit(node)
+        self._rank_names.pop()
         self._traced_params.pop()
         self._traced_depth -= 1 if traced else 0
         self._scope.pop()
 
     visit_FunctionDef = _visit_function
     visit_AsyncFunctionDef = _visit_function
+
+    # -- MAGI005: rank-gated host control flow over collectives ----------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        is_rank = (
+            isinstance(node.value, ast.Call)
+            and (_attr_chain(node.value.func) or "").split(".")[-1]
+            in _RANK_SOURCES
+        )
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                if is_rank:
+                    self._rank_names[-1].add(t.id)
+                else:
+                    # rebinding to a non-rank value clears the taint —
+                    # `r = axis_index(..); ...; r = 0` is rank-free
+                    self._rank_names[-1].discard(t.id)
+        self.generic_visit(node)
+
+    def _mentions_rank(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                chain = _attr_chain(sub.func) or ""
+                if chain.split(".")[-1] in _RANK_SOURCES:
+                    return True
+            elif (
+                isinstance(sub, ast.Name)
+                and sub.id in self._rank_names[-1]
+            ):
+                return True
+        return False
+
+    def _issues_collective(self, nodes) -> bool:
+        for n in nodes:
+            for sub in ast.walk(n):
+                if isinstance(sub, ast.Call):
+                    chain = _attr_chain(sub.func) or ""
+                    if chain.split(".")[-1] in _WIRE_COLLECTIVES:
+                        return True
+        return False
+
+    def _check_rank_gate(self, node, guarded) -> None:
+        if self._mentions_rank(node.test) and self._issues_collective(
+            guarded
+        ):
+            self._flag("MAGI005", node, RULES["MAGI005"])
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_rank_gate(node, node.body + node.orelse)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_rank_gate(node, node.body + node.orelse)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_rank_gate(node, [node.body, node.orelse])
+        self.generic_visit(node)
 
     def visit_With(self, node: ast.With) -> None:
         scoped = any(
@@ -280,6 +376,23 @@ class _Linter(ast.NodeVisitor):
                 "MAGI004",
                 node,
                 f"lax.{leaf} call site not under a named_scope block",
+            )
+
+        # MAGI004 (ISSUE 13): serving-layer device_put is a wire hop
+        # (page stream / pool pinning) and needs a scope for the hop
+        # timeline, same as the collectives above. Leaf-matched like
+        # MAGI005's rank sources, so aliased spellings
+        # (`from jax import device_put`) cannot evade it.
+        if (
+            leaf == "device_put"
+            and self.path.startswith(_DEVICE_PUT_SCOPE)
+            and self._with_scope_depth == 0
+        ):
+            self._flag(
+                "MAGI004",
+                node,
+                "jax.device_put (serving wire hop) not under a "
+                "named_scope block",
             )
 
         # MAGI003: host-sync idioms in traced hot-path contexts

@@ -1,0 +1,879 @@
+"""Pass 4 — SPMD collective-consistency auditor (ISSUE 13 tentpole).
+
+The pod-scale failure mode this pass exists for is *collective-order
+divergence*: one rank tracing a different collective sequence than its
+peers — an extra ppermute, a mismatched axis, a differently-shaped
+payload — is not an error anywhere; it is a silent hang the first time
+the schedule runs on real hardware, because every rank blocks inside a
+collective its peers never entered. The paper's zero-redundancy
+GroupCast/GroupReduce hop schedules make the collective sequence a
+*planned artifact*, which makes divergence statically checkable:
+
+- **Collective signatures.** :func:`collective_signature` abstract-evals
+  a program and extracts its ordered wire-collective sequence — one
+  :class:`CollectiveSig` per eqn, carrying the primitive, the mesh axes
+  it crosses, the payload aval, and (for ``ppermute``) the canonical
+  permutation. ``psum``-family eqns with empty ``axes`` are shard_map
+  transpose artifacts that move nothing and are exempt (the same
+  convention as the trace auditor's census).
+
+- **Cross-rank uniformity.** :func:`audit_uniform` builds the program
+  each rank would trace — the builder takes the HOST rank, modelling
+  the real pod contract where every host runs the same Python but may
+  carry per-rank host state — and asserts the signatures are identical
+  across ranks. For the production paths the builder re-derives the
+  comm meta per rank from the (host-replicated) send map, so a
+  nondeterministic or rank-dependent build shows up as divergence too.
+
+- **Hop-pairing well-formedness.** :func:`hop_pairing_errors` checks
+  every traced ``ppermute``: the permutation must be a bijection
+  (no rank twice as source or destination), must cover EVERY rank of
+  its axis (a partial perm means some rank enters the hop with no
+  matching post — the pod deadlock in miniature), and must be a single
+  uniform shift (every ``r -> (r+k) % cp`` send matched by the peer's
+  recv at the same schedule position). For hop-scheduled metas the
+  traced shift sequence is additionally matched against the meta's
+  active hops, and the reduce direction must trace exactly the negated
+  shifts in the same schedule order (the cast's linear transpose).
+
+The audited matrix covers every production collective path: flat group
+cast/reduce (both impls) across cp ∈ {1,2,4,8}, the 2-level
+hierarchical cast/reduce on (dcn, ici) meshes, ``dist_attn`` calc+grad,
+``cp_decode`` cross-rank merge, ``tp_decode_attn`` (which must trace
+ZERO collectives — the bitwise-parity claim's structural half), and the
+degradation/chaos variants (hops-build fallback to a2a; in-graph chaos
+corruption/straggler injection, which is rank-gated by a traced
+``axis_index`` select and therefore must NOT diverge the program).
+
+Everything is abstract tracing on the virtual CPU mesh — nothing
+executes. Run via ``exps/run_static_analysis.py`` / ``make spmd-audit``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+from .trace_audit import (
+    AuditFailure,
+    _build_key,
+    _mesh,
+    _pinned_env,
+    _pinned_impl,
+    _trace_calc,
+    iter_eqns,
+)
+
+# primitives that move payload across a mesh axis (the signature set);
+# superset of the census WIRE_PRIMS — pairing logic keys on ppermute
+SIG_PRIMS = (
+    "ppermute",
+    "all_to_all",
+    "all_gather",
+    "psum",
+    "psum_scatter",
+    "reduce_scatter",
+)
+
+MATRIX_CPS = (1, 2, 4, 8)
+HIER_MESHES = ((2, 2), (2, 4))
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveSig:
+    """One wire collective at one schedule position.
+
+    Equality across ranks is the uniformity criterion: same primitive,
+    same axes, same payload aval, same routing detail, same position in
+    the traced order."""
+
+    prim: str
+    axes: tuple[str, ...]
+    payload: str
+    detail: str = ""
+
+    def render(self) -> str:
+        d = f" {self.detail}" if self.detail else ""
+        return f"{self.prim}[{','.join(self.axes)}] {self.payload}{d}"
+
+
+def _axes_of(eqn) -> tuple[str, ...]:
+    """Mesh axes a collective eqn crosses, normalized to a string tuple."""
+    for key in ("axis_name", "axes"):
+        if key in eqn.params:
+            val = eqn.params[key]
+            if val is None:
+                continue
+            if isinstance(val, (tuple, list)):
+                return tuple(str(a) for a in val)
+            return (str(val),)
+    return ()
+
+
+def _payload_of(eqn) -> str:
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and getattr(aval, "shape", None) is not None:
+            return str(aval)
+    return "?"
+
+
+def _perm_detail(perm) -> str:
+    pairs = tuple((int(s), int(d)) for s, d in perm)
+    shifts = {(d - s) for s, d in pairs}
+    if len(pairs) > 1:
+        # full-shift perms serialize compactly; anything else verbatim
+        mods = {(d - s) % len(pairs) for s, d in pairs}
+        if len(mods) == 1:
+            return f"shift={mods.pop()}/{len(pairs)}"
+    if len(shifts) == 1:
+        return f"shift={shifts.pop()}"
+    return f"perm={tuple(sorted(pairs))}"
+
+
+def _wire_eqns(jaxpr):
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in SIG_PRIMS:
+            continue
+        axes = eqn.params.get("axes", None)
+        if axes is not None and len(tuple(axes)) == 0:
+            continue  # shard_map transpose artifact, no wire traffic
+        yield eqn
+
+
+def collective_signature(jaxpr) -> tuple[CollectiveSig, ...]:
+    """Ordered wire-collective sequence of a traced program."""
+    out = []
+    for eqn in _wire_eqns(jaxpr):
+        name = eqn.primitive.name
+        detail = ""
+        if name == "ppermute":
+            detail = _perm_detail(eqn.params["perm"])
+        out.append(
+            CollectiveSig(
+                prim=name,
+                axes=_axes_of(eqn),
+                payload=_payload_of(eqn),
+                detail=detail,
+            )
+        )
+    return tuple(out)
+
+
+def signature_shifts(
+    sig: Sequence[CollectiveSig], axis: str | None = None
+) -> list[int]:
+    """The ``shift=k/w`` values of a signature's ppermutes (in schedule
+    order), optionally restricted to one axis."""
+    out = []
+    for s in sig:
+        if s.prim != "ppermute" or not s.detail.startswith("shift="):
+            continue
+        if axis is not None and s.axes != (axis,):
+            continue
+        out.append(int(s.detail.split("=")[1].split("/")[0]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# hop-pairing well-formedness
+# ---------------------------------------------------------------------------
+
+
+def hop_pairing_errors(
+    jaxpr, axis_sizes: dict[str, int] | None = None
+) -> list[str]:
+    """Structural checks on every traced ``ppermute``.
+
+    A perm entry ``(r, d)`` is rank r posting a send matched by rank
+    d's recv at the same schedule position. Well-formedness requires a
+    bijection (no doubled source or destination), matched send/recv
+    sets (a rank that only sends — or only recvs — leaves its peer
+    blocked), a single uniform shift, and — when the axis size is known
+    — full participation: every rank of the axis enters the hop."""
+    errors: list[str] = []
+    ppermutes = (
+        e for e in _wire_eqns(jaxpr) if e.primitive.name == "ppermute"
+    )
+    for i, eqn in enumerate(ppermutes):
+        perm = tuple((int(s), int(d)) for s, d in eqn.params["perm"])
+        axes = _axes_of(eqn)
+        where = f"ppermute #{i} [{','.join(axes)}]"
+        srcs = [s for s, _ in perm]
+        dsts = [d for _, d in perm]
+        if len(set(srcs)) != len(srcs):
+            errors.append(f"{where}: a rank posts two sends ({perm})")
+        if len(set(dsts)) != len(dsts):
+            errors.append(f"{where}: a rank posts two recvs ({perm})")
+        if set(srcs) != set(dsts):
+            errors.append(
+                f"{where}: send/recv sets differ — ranks "
+                f"{sorted(set(srcs) ^ set(dsts))} enter the hop "
+                f"one-sided ({perm})"
+            )
+        world = None
+        if axis_sizes is not None and len(axes) == 1:
+            world = axis_sizes.get(axes[0])
+        if world is not None:
+            if len(perm) != world:
+                errors.append(
+                    f"{where}: {len(perm)}/{world} ranks participate — "
+                    "a partial hop blocks the absent ranks' peers "
+                    f"({perm})"
+                )
+            shifts = {(d - s) % world for s, d in perm}
+            if len(shifts) > 1:
+                errors.append(
+                    f"{where}: mixed shifts {sorted(shifts)} — the hop "
+                    "is not a uniform rotation, so schedule positions "
+                    f"disagree across ranks ({perm})"
+                )
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# cross-rank uniformity
+# ---------------------------------------------------------------------------
+
+
+def audit_uniform(
+    label: str,
+    build: Callable[[int], object],  # host rank -> traced jaxpr
+    world: int,
+    *,
+    axis_sizes: dict[str, int] | None = None,
+    expect: tuple[CollectiveSig, ...] | None = None,
+) -> tuple[list[str], tuple[CollectiveSig, ...]]:
+    """Trace the program each host rank would build and assert one
+    uniform collective signature (plus pairing well-formedness on
+    every rank's trace). Returns (errors, rank-0 signature)."""
+    errors: list[str] = []
+    sigs: list[tuple[CollectiveSig, ...]] = []
+    for r in range(world):
+        jaxpr = build(r)
+        sig = collective_signature(jaxpr)
+        sigs.append(sig)
+        for e in hop_pairing_errors(jaxpr, axis_sizes):
+            errors.append(f"{label} rank {r}: {e}")
+    base = sigs[0]
+    for r, sig in enumerate(sigs[1:], 1):
+        if sig == base:
+            continue
+        pos = next(
+            (
+                i
+                for i, (a, b) in enumerate(zip(base, sig))
+                if a != b
+            ),
+            min(len(base), len(sig)),
+        )
+        a = base[pos].render() if pos < len(base) else "<end of schedule>"
+        b = sig[pos].render() if pos < len(sig) else "<end of schedule>"
+        errors.append(
+            f"{label}: rank {r} diverges from rank 0 at schedule "
+            f"position {pos}: rank0={a} rank{r}={b} — this hangs at "
+            "pod scale (every rank blocks in a collective its peers "
+            "never entered)"
+        )
+    if expect is not None and base != expect:
+        errors.append(
+            f"{label}: traced signature {[s.render() for s in base]} != "
+            f"expected {[s.render() for s in expect]}"
+        )
+    return errors, base
+
+
+# ---------------------------------------------------------------------------
+# production-path builders
+# ---------------------------------------------------------------------------
+
+
+def _skewed_send_map(cp: int, T: int, seed: int = 0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [
+        [
+            rng.choice(T, size=int(rng.integers(0, max(T // 3, 2))),
+                       replace=False)
+            if s != d
+            else np.empty(0, np.int64)
+            for d in range(cp)
+        ]
+        for s in range(cp)
+    ]
+
+
+def _trace_group(kind: str, meta, mesh, cp: int, T: int = 24):
+    """Trace one group cast / reduce_sum / reduce_lse over ``mesh``
+    (the same shard_map harness as the trace auditor's census).
+    ``T`` must equal the ``num_local_rows`` the meta was built with
+    (the reduce's segment sentinel is ``T``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..comm.group_collective import (
+        group_cast_m,
+        group_reduce_lse_m,
+        group_reduce_sum_m,
+    )
+    from ..utils.compat import shard_map
+
+    arrays = tuple(jnp.asarray(a) for a in meta.reduce_device_arrays())
+    n = len(arrays)
+    R = meta.max_recv
+
+    def smap(f, n_in, n_out=1):
+        return shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(P("cp"),) * n_in,
+            out_specs=(P("cp"),) * n_out if n_out > 1 else P("cp"),
+            check_vma=False,
+        )
+
+    if kind == "cast":
+        x = jnp.zeros((cp, T, 4), jnp.float32)
+        f = smap(
+            lambda x_, *arrs: group_cast_m(
+                x_[0], meta, arrs, axis_name="cp"
+            )[None],
+            1 + n,
+        )
+        return jax.make_jaxpr(f)(x, *arrays)
+    if kind == "reduce_sum":
+        y = jnp.zeros((cp, R, 4), jnp.float32)
+        acc = jnp.zeros((cp, T, 4), jnp.float32)
+        f = smap(
+            lambda y_, a_, *arrs: group_reduce_sum_m(
+                y_[0], a_[0], meta, arrs, axis_name="cp"
+            )[None],
+            2 + n,
+        )
+        return jax.make_jaxpr(f)(y, acc, *arrays)
+    assert kind == "reduce_lse", kind
+    y = jnp.zeros((cp, R, 2, 4), jnp.float32)
+    lse = jnp.zeros((cp, R, 2), jnp.float32)
+    acc = jnp.zeros((cp, T, 2, 4), jnp.float32)
+    lacc = jnp.zeros((cp, T, 2), jnp.float32)
+
+    def _lse(y_, l_, ao_, al_, *arrs):
+        o, s = group_reduce_lse_m(
+            y_[0], l_[0], ao_[0], al_[0], meta, arrs, axis_name="cp"
+        )
+        return o[None], s[None]
+
+    f = smap(_lse, 4 + n, n_out=2)
+    return jax.make_jaxpr(f)(y, lse, acc, lacc, *arrays)
+
+
+def audit_group_matrix(
+    *, cps: Sequence[int] = MATRIX_CPS
+) -> tuple[list[str], dict]:
+    """Per-rank signature uniformity + hop pairing for the flat group
+    collectives, both impls, across cp. Each rank REBUILDS the meta
+    from the shared send map (the real pod contract: every host builds
+    its own routing plan from replicated inputs), so build
+    nondeterminism is divergence too. For hops metas the cast's traced
+    shift sequence must equal the meta's active hops and the reduce's
+    the negated shifts in the same order."""
+    from ..comm.group_collective import GroupCollectiveMeta
+
+    errors: list[str] = []
+    report: dict = {}
+    T = 24
+    for cp in cps:
+        send_map = _skewed_send_map(cp, T, seed=cp)
+        mesh = _mesh(cp)
+        # cp=1 is audited through the production auto resolution (a
+        # zero-volume map resolves to hops = no collective at all);
+        # pinning a2a on a 1-rank axis is not a production path
+        for impl in (("auto",) if cp == 1 else ("a2a", "hops")):
+            meta0 = GroupCollectiveMeta.build(send_map, [T] * cp, impl=impl)
+            active = [
+                h.shift for h in meta0.hops if h.shift % cp != 0
+            ]
+            for kind in ("cast", "reduce_sum", "reduce_lse"):
+                label = f"group_{kind} impl={impl} cp={cp}"
+
+                def build(rank, _kind=kind, _impl=impl):
+                    # a fresh per-host meta build: determinism audited
+                    m = GroupCollectiveMeta.build(
+                        send_map, [T] * cp, impl=_impl
+                    )
+                    return _trace_group(_kind, m, mesh, cp)
+
+                e, sig = audit_uniform(
+                    label, build, cp, axis_sizes={"cp": cp}
+                )
+                errors += e
+                report[label] = [s.render() for s in sig]
+                if cp == 1 and sig:
+                    errors.append(
+                        f"{label}: cp=1 traced collectives "
+                        f"{[s.render() for s in sig]}"
+                    )
+                if meta0.impl == "hops":
+                    got = signature_shifts(sig, "cp")
+                    if kind == "cast":
+                        want = list(active)
+                    elif kind == "reduce_sum":
+                        want = [(-k) % cp for k in active]
+                    else:  # reduce_lse reverses out and lse payloads
+                        want = [(-k) % cp for k in active] * 2
+                    if got != want:
+                        errors.append(
+                            f"{label}: traced hop shifts {got} != the "
+                            f"meta's schedule {want} — cast and reduce "
+                            "no longer mirror each other"
+                        )
+    return errors, report
+
+
+def audit_hier_matrix(
+    *,
+    meshes: Sequence[tuple[int, int]] = HIER_MESHES,
+    per_rank: bool = True,
+) -> tuple[list[str], dict]:
+    """The 2-level hierarchical cast/reduce: per-rank uniformity on a
+    (dcn, ici) mesh, with the per-level contract asserted — the inter
+    level is always exactly one ``all_to_all`` on the dcn axis, the
+    intra level one ici ``all_to_all`` (a2a impl) or exactly the active
+    intra hops as ici ``ppermute``s (hops impl). ``per_rank=False``
+    traces one rank per case — the census-only variant the trace-audit
+    pass reuses without re-paying the uniformity sweep pass 4 runs."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ..comm.hier import (
+        HierGroupCollectiveMeta,
+        group_cast_hier,
+        group_reduce_hier,
+    )
+    from ..utils.compat import shard_map
+
+    errors: list[str] = []
+    report: dict = {}
+    T = 16
+    for n_inter, n_intra in meshes:
+        n = n_inter * n_intra
+        devs = _mesh(n).devices.reshape(n_inter, n_intra)
+        mesh = Mesh(devs, ("dcn", "ici"))
+        send_map = _skewed_send_map(n, T, seed=100 + n)
+        for impl in ("a2a", "hops"):
+            meta0, _ = HierGroupCollectiveMeta.build(
+                send_map, [T] * n, n_inter, n_intra, impl=impl
+            )
+            active_intra = [
+                h.shift
+                for h in meta0.intra_hops
+                if h.shift % n_intra != 0
+            ]
+            for kind in ("cast", "reduce"):
+                label = (
+                    f"hier_{kind} impl={impl} mesh={n_inter}x{n_intra}"
+                )
+
+                def build(rank, _impl=impl, _kind=kind):
+                    m, _ = HierGroupCollectiveMeta.build(
+                        send_map, [T] * n, n_inter, n_intra, impl=_impl
+                    )
+                    # routing arrays carry a leading n axis (one row per
+                    # rank); fold it onto the 2D mesh so each rank reads
+                    # exactly its own slice inside shard_map
+                    arrays = tuple(
+                        jnp.asarray(a).reshape(
+                            (n_inter, n_intra) + a.shape[1:]
+                        )
+                        for a in m.cast_device_arrays()
+                    )
+                    x = jnp.zeros((n_inter, n_intra, T, 2), jnp.float32)
+                    y = jnp.zeros(
+                        (n_inter, n_intra, m.max_recv, 2), jnp.float32
+                    )
+                    spec = P("dcn", "ici")
+
+                    @functools.partial(
+                        shard_map,
+                        mesh=mesh,
+                        in_specs=(spec,) * (2 + len(arrays)),
+                        out_specs=spec,
+                        check_vma=False,
+                    )
+                    def run(x_, y_, *arrs, _m=m):
+                        # keep the leading per-rank dim-1 the routing
+                        # consumers expect (tables[i] is [1, ...])
+                        tabs = tuple(a[0] for a in arrs)
+                        if _kind == "cast":
+                            return group_cast_hier(
+                                x_[0, 0], tabs, meta=_m
+                            )[None, None]
+                        return group_reduce_hier(
+                            y_[0, 0], x_[0, 0], tabs, meta=_m
+                        )[None, None]
+
+                    return jax.make_jaxpr(run)(x, y, *arrays)
+
+                e, sig = audit_uniform(
+                    label,
+                    build,
+                    n if per_rank else 1,
+                    axis_sizes={"dcn": n_inter, "ici": n_intra},
+                )
+                errors += e
+                report[label] = [s.render() for s in sig]
+                # per-level census: exactly one dcn a2a; intra per impl
+                dcn = [s for s in sig if s.axes == ("dcn",)]
+                ici = [s for s in sig if s.axes == ("ici",)]
+                if (
+                    len(dcn) != 1
+                    or dcn[0].prim != "all_to_all"
+                ):
+                    errors.append(
+                        f"{label}: inter level must be exactly one dcn "
+                        f"all_to_all, traced "
+                        f"{[s.render() for s in dcn]}"
+                    )
+                if meta0.impl == "hops":
+                    got = [
+                        s for s in ici if s.prim == "ppermute"
+                    ]
+                    if len(got) != len(active_intra) or any(
+                        s.prim != "ppermute" for s in ici
+                    ):
+                        errors.append(
+                            f"{label}: intra level traced "
+                            f"{[s.render() for s in ici]}, expected "
+                            f"{len(active_intra)} ici ppermutes "
+                            "(the active intra hops)"
+                        )
+                else:
+                    if len(ici) != 1 or ici[0].prim != "all_to_all":
+                        errors.append(
+                            f"{label}: intra level must be one ici "
+                            f"all_to_all, traced "
+                            f"{[s.render() for s in ici]}"
+                        )
+    return errors, report
+
+
+def audit_dist_attn_matrix(
+    *, total: int = 512, chunk: int = 64
+) -> tuple[list[str], dict]:
+    """Per-rank uniformity of the full ``dist_attn`` calc + grad traces
+    (causal plan, pinned hops and a2a impls) — the production forward
+    and backward schedules end to end, per-rank plan resolution
+    included."""
+    errors: list[str] = []
+    report: dict = {}
+    for cp, impl in ((2, "hops"), (4, "hops"), (4, "a2a")):
+        mesh = _mesh(cp)
+        for grad in (False, True):
+            label = (
+                f"dist_attn {'grad' if grad else 'calc'} cp={cp} "
+                f"impl={impl}"
+            )
+
+            def build(rank, _impl=impl, _grad=grad):
+                with _pinned_impl(_impl):
+                    key = _build_key(
+                        cp, "causal", mesh, "bfloat16", total, chunk
+                    )
+                    return _trace_calc(key, "bfloat16", total, _grad)
+
+            e, sig = audit_uniform(
+                label, build, cp, axis_sizes={"cp": cp}
+            )
+            errors += e
+            report[label] = [s.render() for s in sig]
+            if impl == "hops" and any(
+                s.prim == "all_to_all" for s in sig
+            ):
+                errors.append(
+                    f"{label}: hops-pinned plan traced an all_to_all"
+                )
+    return errors, report
+
+
+def audit_cp_decode(
+    *, cps: Sequence[int] = (1, 2, 4, 8)
+) -> tuple[list[str], dict]:
+    """``cp_decode_attn``: per-rank uniformity; the cross-rank merge is
+    exactly two ``all_gather``s on the cp axis (out + lse partials),
+    and cp=1 traces nothing."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..serving.cp_decode import cp_decode_attn
+    from ..serving.kv_cache import make_paged_kv_cache
+    from ..utils.compat import shard_map
+
+    errors: list[str] = []
+    report: dict = {}
+    for cp in cps:
+        mesh = _mesh(cp)
+        label = f"cp_decode cp={cp}"
+
+        def build(rank, _cp=cp, _mesh=mesh):
+            cache = make_paged_kv_cache(
+                num_pages=4, page_size=8, num_kv_heads=2, head_dim=16,
+                max_seqs=2,
+            )
+            q = jnp.zeros((_cp, 2, 2, 16), jnp.float32)
+            slots = jnp.zeros((_cp, 2), jnp.int32)
+
+            if _cp == 1:
+                def run1(q_, slots_, _c=cache):
+                    return cp_decode_attn(
+                        q_[0], _c, slots_[0], axis_name="cp",
+                        cp_size=1, num_splits=1,
+                    )
+
+                return jax.make_jaxpr(run1)(q, slots)
+
+            @functools.partial(
+                shard_map,
+                mesh=_mesh,
+                in_specs=(P("cp"), P("cp")),
+                out_specs=(P("cp"), P("cp")),
+                check_vma=False,
+            )
+            def run(q_, slots_, _c=cache):
+                o, l = cp_decode_attn(
+                    q_[0], _c, slots_[0], axis_name="cp",
+                    cp_size=_cp, num_splits=1,
+                )
+                return o[None], l[None]
+
+            return jax.make_jaxpr(run)(q, slots)
+
+        e, sig = audit_uniform(label, build, cp, axis_sizes={"cp": cp})
+        errors += e
+        report[label] = [s.render() for s in sig]
+        prims = [s.prim for s in sig]
+        if cp == 1:
+            if sig:
+                errors.append(
+                    f"{label}: cp=1 must trace no collective, got "
+                    f"{[s.render() for s in sig]}"
+                )
+        elif prims != ["all_gather", "all_gather"]:
+            errors.append(
+                f"{label}: expected exactly two cp all_gathers "
+                f"(out + lse partials), traced "
+                f"{[s.render() for s in sig]}"
+            )
+    return errors, report
+
+
+def trace_tp_decode(tp: int, *, kv_heads: int = 4, hq: int = 4):
+    """Trace ``tp_decode_attn`` over a ``tp``-wide head-sharded mesh
+    (shared with the trace auditor's zero-collective census)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..serving.distributed import tp_decode_attn
+    from ..serving.kv_cache import make_paged_kv_cache
+
+    mesh = _mesh(max(tp, 1))
+    from jax.sharding import Mesh
+
+    mesh = Mesh(mesh.devices, ("tp",))
+    cache = make_paged_kv_cache(
+        num_pages=4, page_size=8, num_kv_heads=kv_heads, head_dim=16,
+        max_seqs=2,
+    )
+    q = jnp.zeros((2, hq, 16), jnp.bfloat16)
+    slots = jnp.zeros((2,), jnp.int32)
+
+    def run(q_, cache_, slots_):
+        return tp_decode_attn(
+            q_, cache_, slots_, mesh=mesh, num_splits=2
+        )
+
+    return jax.make_jaxpr(run)(q, cache, slots)
+
+
+def audit_tp_decode(
+    *, tps: Sequence[int] = (1, 2, 4)
+) -> tuple[list[str], dict]:
+    """TP decode must trace ZERO collectives across the head axis at
+    every width — softmax is per-head, so the KV-head-sharded layout's
+    bitwise-parity claim has this structural half. One trace per width:
+    the path has no per-rank host state to diverge on (a rank-loop here
+    would re-trace identical programs for a vacuous comparison)."""
+    errors: list[str] = []
+    report: dict = {}
+    for tp in tps:
+        label = f"tp_decode tp={tp}"
+        jaxpr = trace_tp_decode(tp)
+        sig = collective_signature(jaxpr)
+        errors += [
+            f"{label}: {e}"
+            for e in hop_pairing_errors(jaxpr, {"tp": max(tp, 1)})
+        ]
+        report[label] = [s.render() for s in sig]
+        if sig:
+            errors.append(
+                f"{label}: the KV-head-sharded decode traced "
+                f"{[s.render() for s in sig]} — zero collectives may "
+                "cross the head axis"
+            )
+    return errors, report
+
+
+def audit_variants(*, cp: int = 4) -> tuple[list[str], dict]:
+    """Degradation/chaos variants stay SPMD-uniform.
+
+    - With ``hops_build_error`` chaos armed per host build, EVERY rank's
+      meta degrades to the a2a fallback — the signatures must stay
+      uniform (and actually be a2a).
+    - With in-graph chaos (rank-gated corruption + straggler) enabled,
+      the rank gate is a traced ``axis_index`` select, so the traced
+      program must be identical on every rank — chaos must never become
+      host control flow."""
+    from ..comm.group_collective import GroupCollectiveMeta
+    from ..resilience import chaos as chaos_mod
+
+    errors: list[str] = []
+    report: dict = {}
+    T = 24
+    send_map = _skewed_send_map(cp, T, seed=7)
+    mesh = _mesh(cp)
+
+    label = f"degraded_hops_build cp={cp}"
+
+    def build_degraded(rank):
+        with _pinned_env("MAGI_ATTENTION_CHAOS", "hops_build_error"):
+            chaos_mod.reset_chaos()  # re-arm for THIS host's build
+            meta = GroupCollectiveMeta.build(
+                send_map, [T] * cp, impl="hops"
+            )
+        chaos_mod.reset_chaos()
+        if meta.impl != "a2a":
+            raise AuditFailure(
+                f"{label}: chaos-failed hops build did not degrade "
+                f"to a2a (impl={meta.impl})"
+            )
+        return _trace_group("cast", meta, mesh, cp)
+
+    e, sig = audit_uniform(
+        label, build_degraded, cp, axis_sizes={"cp": cp}
+    )
+    errors += e
+    report[label] = [s.render() for s in sig]
+    if [s.prim for s in sig] != ["all_to_all"]:
+        errors.append(
+            f"{label}: degraded cast must be the single a2a, traced "
+            f"{[s.render() for s in sig]}"
+        )
+
+    label = f"chaos_in_graph cp={cp}"
+    spec = "corrupt_cast:value=nan,rank=0;straggler:hop=1"
+
+    def build_chaos(rank):
+        with _pinned_env("MAGI_ATTENTION_CHAOS", spec):
+            chaos_mod.reset_chaos()
+            meta = GroupCollectiveMeta.build(
+                send_map, [T] * cp, impl="hops"
+            )
+            jaxpr = _trace_group("cast", meta, mesh, cp)
+        chaos_mod.reset_chaos()
+        return jaxpr
+
+    e, sig = audit_uniform(
+        label, build_chaos, cp, axis_sizes={"cp": cp}
+    )
+    errors += e
+    report[label] = [s.render() for s in sig]
+    return errors, report
+
+
+def run_full_audit() -> tuple[list[str], dict]:
+    """The whole pass-4 matrix (the CLI entry)."""
+    errors: list[str] = []
+    report: dict = {}
+    for fn in (
+        audit_group_matrix,
+        audit_hier_matrix,
+        audit_dist_attn_matrix,
+        audit_cp_decode,
+        audit_tp_decode,
+        audit_variants,
+    ):
+        e, r = fn()
+        errors += e
+        report.update(r)
+    return errors, report
+
+
+# ---------------------------------------------------------------------------
+# self-test plants
+# ---------------------------------------------------------------------------
+
+
+def self_test() -> list[str]:
+    """Prove the pass can fail: a rank-gated extra ppermute must break
+    uniformity, and a planted one-sided perm must break pairing."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
+
+    errors: list[str] = []
+    mesh = _mesh(2)
+
+    def build(rank):
+        def f(x):
+            y = jax.lax.ppermute(  # magi-allow: MAGI004
+                x, "cp", [(0, 1), (1, 0)]
+            )
+            if rank == 0:  # the planted host divergence
+                y = jax.lax.ppermute(  # magi-allow: MAGI004
+                    y, "cp", [(0, 1), (1, 0)]
+                )
+            return y
+
+        g = shard_map(
+            f, mesh=mesh, in_specs=P("cp"), out_specs=P("cp"),
+            check_vma=False,
+        )
+        return jax.make_jaxpr(g)(jnp.zeros((2, 4), jnp.float32))
+
+    e, _sig = audit_uniform(
+        "planted rank-gated ppermute", build, 2, axis_sizes={"cp": 2}
+    )
+    if not any("diverges from rank 0" in x for x in e):
+        errors.append(
+            "self-test: planted rank-gated extra ppermute NOT flagged "
+            f"(errors={e})"
+        )
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P("cp"), out_specs=P("cp"),
+        check_vma=False,
+    )
+    def one_sided(x):
+        # rank 1 never sends
+        return jax.lax.ppermute(x, "cp", [(0, 1)])  # magi-allow: MAGI004
+
+    jaxpr = jax.make_jaxpr(one_sided)(jnp.zeros((2, 4), jnp.float32))
+    pe = hop_pairing_errors(jaxpr, {"cp": 2})
+    if not pe:
+        errors.append(
+            "self-test: planted one-sided perm passed hop pairing"
+        )
+    return errors

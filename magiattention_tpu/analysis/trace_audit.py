@@ -762,3 +762,134 @@ def audit_retrace(*, total: int = 512, chunk: int = 64) -> list[str]:
             "traces) — a table value leaks into trace-time control flow"
         ]
     return []
+
+
+# ---------------------------------------------------------------------------
+# post-PR-6 serving surfaces (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+
+def audit_serving_traces(
+    expectations: dict | None = None,
+) -> tuple[list[str], dict]:
+    """Trace coverage for the serving surfaces added after ISSUE 6.
+
+    - ``tp_decode_attn`` (ISSUE 12): the KV-head-sharded shard_map
+      program must trace ZERO collectives at every tp width — the
+      jaxpr-asserted structural half of the bitwise-parity claim — and
+      keep the decode dtype contract (out bf16, lse f32).
+    - cascade decode (ISSUE 9): the two-level shared-prefix decode is
+      single-chip math and must also be collective-free, with the same
+      dtype contract.
+
+    Both paths contribute upcast censuses to
+    ``exps/data/trace_audit_expectations.json`` (recorded via
+    ``--update``), so a new silent bf16->f32 promotion on the serving
+    hot loops is census drift exactly like the flex entries.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..serving.kv_cache import make_paged_kv_cache
+    from ..serving.prefix import CascadeGroup, cascade_decode_attn
+    from .spmd_audit import trace_tp_decode
+
+    errors: list[str] = []
+    report: dict = {}
+    recordable: dict = {}
+
+    for tp in (1, 2, 4):
+        jaxpr = trace_tp_decode(tp)
+        census = collective_census(jaxpr)
+        if census:
+            errors.append(
+                f"tp_decode_attn tp={tp} traced collectives "
+                f"{_fmt(census)} — zero collectives may cross the "
+                "head axis (the bitwise-parity contract)"
+            )
+        out_aval, lse_aval = jaxpr.out_avals[0], jaxpr.out_avals[1]
+        if str(out_aval.dtype) != "bfloat16":
+            errors.append(
+                f"tp_decode tp={tp} out dtype {out_aval.dtype} != bfloat16"
+            )
+        if str(lse_aval.dtype) != "float32":
+            errors.append(
+                f"tp_decode tp={tp} lse dtype {lse_aval.dtype} != float32"
+            )
+        if tp == 2:
+            recordable["tp_decode_bf16_tp2"] = upcast_census(jaxpr)
+
+    # cascade decode: one shared-prefix group + one flat remainder row
+    import dataclasses as _dc
+
+    cache = make_paged_kv_cache(
+        num_pages=8, page_size=8, num_kv_heads=2, head_dim=32, max_seqs=4
+    )
+    bt = np.zeros((4, 8), np.int32)
+    bt[0, :3] = [1, 2, 3]
+    bt[1, :3] = [1, 2, 4]  # shares full pages (1, 2) with slot 0
+    bt[2, :2] = [5, 6]
+    cache = _dc.replace(
+        cache,
+        block_tables=jnp.asarray(bt),
+        seq_lens=jnp.asarray([22, 20, 11, 0], jnp.int32),
+    )
+    groups = [
+        CascadeGroup(shared_pages=(1, 2), prefix_len=16, members=(0, 1))
+    ]
+    slots = np.array([0, 1, 2])
+    q = jnp.zeros((3, 4, 32), jnp.bfloat16)
+
+    def f(q_, cache_):
+        return cascade_decode_attn(
+            q_, cache_, slots, groups, num_splits=2
+        )
+
+    jaxpr = jax.make_jaxpr(f)(q, cache)
+    census = collective_census(jaxpr)
+    if census:
+        errors.append(
+            f"cascade decode traced collectives {_fmt(census)} — the "
+            "single-chip cascade must be collective-free"
+        )
+    out_aval, lse_aval = jaxpr.out_avals[0], jaxpr.out_avals[1]
+    if str(out_aval.dtype) != "bfloat16":
+        errors.append(f"cascade out dtype {out_aval.dtype} != bfloat16")
+    if str(lse_aval.dtype) != "float32":
+        errors.append(f"cascade lse dtype {lse_aval.dtype} != float32")
+    recordable["cascade_decode_bf16"] = upcast_census(jaxpr)
+
+    report.update(
+        {k: dict(sorted(v.items())) for k, v in recordable.items()}
+    )
+    if expectations is not None:
+        for name, census in recordable.items():
+            want = expectations.get(name)
+            if want is None:
+                errors.append(
+                    f"no upcast expectation recorded for {name} — run "
+                    "exps/run_static_analysis.py --update"
+                )
+            elif {k: int(v) for k, v in want.items()} != census:
+                errors.append(
+                    f"{name}: upcast census {_fmt(census)} drifted from "
+                    f"recorded {_fmt(want)} — a new bf16->f32 promotion "
+                    "appeared on a serving hot loop (fix it, or "
+                    "--update after an intentional change)"
+                )
+    return errors, report
+
+
+def audit_hier_cast_levels() -> tuple[list[str], dict]:
+    """Per-level census of the 2-level hierarchical cast (ISSUE 13
+    satellite): the inter level is exactly one ``all_to_all`` on the
+    dcn axis; the intra level is one ici ``all_to_all`` (a2a impl) or
+    exactly the meta's active intra hops as ici ``ppermute``s (hops
+    impl). The cross-rank uniformity of the same programs is pass 4's
+    job (``analysis/spmd_audit.py``); this pins the level structure
+    into the trace-audit gate with one trace per case (``per_rank=
+    False`` — the full uniformity sweep is not re-paid here)."""
+    from .spmd_audit import audit_hier_matrix
+
+    return audit_hier_matrix(meshes=((2, 2),), per_rank=False)

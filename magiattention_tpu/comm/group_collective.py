@@ -626,8 +626,14 @@ def hop_cast(
                     # chosen hop (bit-transparent to the payload)
                     buf = chaos.straggler_delay(buf, hop.shift)
                 # pads point at the trash slot max_recv; real rows land at
-                # their (src-rank-major, send-pos) position
-                out = out.at[recv_pos].set(buf)
+                # their (src-rank-major, send-pos) position. Indices are
+                # unique except the pads' shared trash slot, whose primal
+                # is sliced off below and whose cotangent is therefore
+                # zero — declaring uniqueness keeps the scatter linearly
+                # TRANSPOSABLE (group_reduce_hier runs the hier reduce as
+                # jax.linear_transpose of this cast; without it the hops
+                # intra level dies in scatter's transpose rule)
+                out = out.at[recv_pos].set(buf, unique_indices=True)
         return out[:max_recv]
 
 

@@ -607,11 +607,17 @@ class TieredEngine:
         # sharding (device_put IS the wire hop on real hardware),
         # scatter into the replica pool
         idx = jnp.asarray(src_pages, jnp.int32)
-        pk = jax.device_put(src.k_pages[idx], kv_head_sharding(rep.mesh))
-        pv = jax.device_put(src.v_pages[idx], kv_head_sharding(rep.mesh))
         dst_pages = rep.engine.allocator.slot_pages(dslot)
         didx = jnp.asarray(dst_pages[:n], jnp.int32)
         with named_scope("magi_page_stream"):
+            # the device_put IS the cross-tier wire hop — it lives
+            # inside the stream scope so the hop timeline sees it
+            pk = jax.device_put(
+                src.k_pages[idx], kv_head_sharding(rep.mesh)
+            )
+            pv = jax.device_put(
+                src.v_pages[idx], kv_head_sharding(rep.mesh)
+            )
             cache = rep.engine.cache
             cache = PagedKVCache(
                 k_pages=cache.k_pages.at[didx].set(pk),
@@ -842,6 +848,11 @@ class TieredScheduler(Scheduler):
         )
 
     # -- decode (per replica) --------------------------------------------
+
+    def _admission_headroom(self) -> int:
+        # decode growth happens on the replicas' own pools, not the
+        # prefill pool admission draws from — no shared-pool watermark
+        return 0
 
     def _decode_states(self):
         # only sequences RESIDENT on the decode tier decode; a request

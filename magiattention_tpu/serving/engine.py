@@ -367,11 +367,19 @@ class ServingEngine:
             )
             if match is not None and match.hit:
                 if self.allocator.can_fork(match.pages, num_tokens):
+                    from ..resilience import chaos
+
                     try:
                         slot, pages = self.allocator.fork(
                             match.pages, num_tokens
                         )
-                    except PageAllocatorError:
+                    except (chaos.ChaosInjectedError, PageAllocatorError):
+                        # raced/injected allocator failure after the
+                        # can_fork probe — degrade to backpressure,
+                        # like the allocate path (admission never
+                        # raises on resource pressure). Deliberately
+                        # NOT bare RuntimeError: unrelated errors must
+                        # surface, not masquerade as pressure
                         res = AdmissionResult(
                             False, None, "alloc_error", tuple(evicted)
                         )
@@ -395,11 +403,15 @@ class ServingEngine:
                         ),
                     )
             elif self.allocator.can_admit(num_tokens):
+                from ..resilience import chaos
+
                 try:
                     slot, pages = self.allocator.allocate(num_tokens)
-                except RuntimeError:
+                except (chaos.ChaosInjectedError, PageAllocatorError):
                     # raced/injected allocator failure after the
                     # can_admit probe — degrade to backpressure
+                    # (narrowed like the fork path: unrelated
+                    # RuntimeErrors must surface, not masquerade)
                     res = AdmissionResult(
                         False, None, "alloc_error", tuple(evicted)
                     )

@@ -361,6 +361,8 @@ def shard_kv_cache(
     engine pins each tier's pool to its own mesh slice)."""
     from jax.sharding import NamedSharding, PartitionSpec
 
+    from ..utils.instrument import named_scope
+
     tp = int(mesh.shape.get(axis_name, 1)) if axis_name else 1
     if tp > 1 and cache.num_kv_heads % tp:
         raise ValueError(
@@ -370,12 +372,15 @@ def shard_kv_cache(
         )
     pages = kv_head_sharding(mesh, axis_name)
     host = NamedSharding(mesh, PartitionSpec())
-    return PagedKVCache(
-        k_pages=jax.device_put(cache.k_pages, pages),
-        v_pages=jax.device_put(cache.v_pages, pages),
-        block_tables=jax.device_put(cache.block_tables, host),
-        seq_lens=jax.device_put(cache.seq_lens, host),
-    )
+    with named_scope("magi_kvcache_shard"):
+        # re-pinning moves pool storage across chips: a wire hop on
+        # real hardware, scoped so the hop timeline attributes it
+        return PagedKVCache(
+            k_pages=jax.device_put(cache.k_pages, pages),
+            v_pages=jax.device_put(cache.v_pages, pages),
+            block_tables=jax.device_put(cache.block_tables, host),
+            seq_lens=jax.device_put(cache.seq_lens, host),
+        )
 
 
 class PageAllocator:

@@ -258,6 +258,63 @@ class Scheduler:
         admitted, rejected = [], []
         for st in self._admission_order():
             req = st.request
+            # a request whose prompt PLUS decode budget can never fit
+            # one sequence's page reservation — OR the whole pool —
+            # is permanently unservable: reject it here like the
+            # engine's own too_long verdict. The engine only sees the
+            # prompt; left unchecked, the decode-time reservation
+            # growth raises out of the tick loop (or, with the
+            # preemption below, self-preempts and replays forever) and
+            # one oversized request takes the whole scheduler down
+            # (ISSUE 13 interleaving checker).
+            alc = self.engine.allocator
+            cap = (
+                min(alc.max_pages_per_seq, alc.num_pages)
+                * alc.page_size
+            )
+            if req.prompt_len + req.num_new_tokens > cap:
+                st.status = REJECTED
+                self._queue.remove(st)
+                self._finished[st.rid] = st
+                rejected.append(st.rid)
+                # the engine never saw this admission — mirror its
+                # rejection telemetry so magi_admission_rejected and
+                # the flight recorder's storm detector keep counting
+                from .engine import AdmissionResult
+
+                telemetry.record_admission(
+                    AdmissionResult(False, None, "too_long")
+                )
+                self._flight.note_admission(False, "too_long")
+                reqtrace.span_rejected(
+                    st.trace_id, st.rid, reason="too_long"
+                )
+                continue
+            # pool-headroom watermark (ISSUE 13): an admission with NO
+            # eviction power (no live request of strictly lower
+            # priority) must leave one free page of decode-growth
+            # headroom per currently decoding sequence. Without it, a
+            # request the decode-pressure preemption below just
+            # requeued re-admits straight into the pages its own
+            # preemption freed, the survivor's growth fails again, and
+            # the loop ping-pongs forever without producing a token.
+            # Requests that CAN evict keep the engine's bounded
+            # evict-then-retry semantics untouched (priority admission
+            # may still displace decoders — that converges by rank).
+            if not any(
+                s.request.priority < req.priority
+                for s in self._active.values()
+            ):
+                headroom = self._admission_headroom()
+                alloc = self.engine.allocator
+                free = alloc.num_pages - alloc.pages_in_use
+                if headroom and (
+                    free - alloc.pages_needed(req.prompt_len) < headroom
+                ):
+                    reqtrace.span_backpressure(
+                        st.trace_id, st.rid, reason="decode_headroom"
+                    )
+                    break  # transient: decoders finish, pages free
             with reqtrace.request_context(st.trace_id, st.rid):
                 res = self.engine.admit(
                     req.prompt_len,
@@ -273,7 +330,12 @@ class Scheduler:
                 self._handle_eviction(victim_slot)
             if not res.admitted:
                 if res.reason == "too_long":
-                    # permanent: no eviction makes it fit — surface it
+                    # permanent: no eviction makes it fit — surface it.
+                    # The cap pre-check above strictly dominates this
+                    # for ServingEngine today; it stays as the backstop
+                    # should an engine's capacity notion ever diverge
+                    # from the scheduler's (a permanent reason treated
+                    # as transient backpressure would livelock)
                     st.status = REJECTED
                     self._queue.remove(st)
                     self._finished[st.rid] = st
@@ -312,6 +374,14 @@ class Scheduler:
                 tier=self._prefill_tier,
             )
         return admitted, rejected
+
+    def _admission_headroom(self) -> int:
+        """Free pages an admission must leave for decode growth: one
+        per decoding sequence sharing THIS allocator's pool. The
+        TieredScheduler overrides to a constant 0 — its decode pools
+        live on the replicas, disjoint from the admission-facing
+        prefill pool — and skips the decode-state scan entirely."""
+        return len(self._decode_states())
 
     def _handle_eviction(self, slot: int) -> None:
         """A live sequence was priority-evicted by the engine: push its
@@ -374,12 +444,33 @@ class Scheduler:
         per tick with every decoding state; the TieredScheduler calls
         it once per decode replica (``replica`` labels the spans) so a
         replica fault is isolated to its own group."""
+        from .kv_cache import PageAllocatorError
+
         qs = jnp.stack([st.request.decode_q[st.tokens_done] for st in states])
         ks = jnp.stack([st.request.decode_k[st.tokens_done] for st in states])
         vs = jnp.stack([st.request.decode_v[st.tokens_done] for st in states])
         slots = [st.slot for st in states]
         t0 = time.perf_counter()
-        out, _lse = self.engine.decode_step(qs, ks, vs, slots)
+        try:
+            out, _lse = self.engine.decode_step(qs, ks, vs, slots)
+        except PageAllocatorError:
+            # transient pool pressure mid-growth (reservation extension
+            # or a CoW split found the pool empty). Resource pressure
+            # is an operating condition, not a crash (the PR 8
+            # contract): preempt the lowest-priority, youngest group
+            # member — its pages go back to the pool, its request
+            # replays through admission — and retry the batch next
+            # tick. Found by the ISSUE 13 interleaving checker: the
+            # uncaught error killed the whole serving loop.
+            victim = min(
+                states,
+                key=lambda s: (s.request.priority, -s.submitted_at),
+            )
+            # unlike eviction/fault requeues, the engine still holds
+            # this slot — release it so the pages actually free
+            self.engine.free(victim.slot)
+            self._requeue(victim, reason="decode_pressure")
+            return 0
         dur = time.perf_counter() - t0
         # what the engine's step actually resolved (split count /
         # cascade grouping): per-request decode spans carry it

@@ -38,6 +38,7 @@ from .aggregate import (  # noqa: F401
     merge_snapshots,
 )
 from .collectors import (  # noqa: F401
+    REQUIRED_ANALYSIS_METRICS,
     REQUIRED_DISTSERVE_METRICS,
     REQUIRED_PLAN_CACHE_METRICS,
     REQUIRED_PLAN_METRICS,
@@ -50,6 +51,7 @@ from .collectors import (  # noqa: F401
     REQUIRED_TRACE_METRICS,
     REQUIRED_VALIDATE_METRICS,
     record_admission,
+    record_analysis_run,
     record_autotune_cache,
     record_autotune_decision,
     record_autotune_measure_failure,
@@ -196,6 +198,7 @@ __all__ = [
     "MeasuredTimeline",
     "MetricsRegistry",
     "MetricsServer",
+    "REQUIRED_ANALYSIS_METRICS",
     "REQUIRED_PLAN_METRICS",
     "REQUIRED_RESILIENCE_METRICS",
     "REQUIRED_ROOFLINE_METRICS",

@@ -415,6 +415,33 @@ REQUIRED_VALIDATE_METRICS: tuple[str, ...] = (
 )
 
 
+# the interleaving checker's exploration counters (ISSUE 13): canonical
+# states visited and counterexamples found across model-check runs;
+# populated by analysis/lifecycle.explore, asserted by
+# make telemetry-check's analysis step, documented in
+# docs/static_analysis.md "Pass 5"
+M_ANALYSIS_STATES = "magi_analysis_states_explored"
+M_ANALYSIS_CEX = "magi_analysis_counterexamples"
+
+REQUIRED_ANALYSIS_METRICS: tuple[str, ...] = (
+    M_ANALYSIS_STATES,
+    M_ANALYSIS_CEX,
+)
+
+
+def record_analysis_run(
+    states_explored: int, counterexamples: int
+) -> None:
+    """One interleaving-checker exploration: canonical states visited
+    and counterexamples found (0 increments still materialize the
+    series, so the catalog check sees a clean run)."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_ANALYSIS_STATES, max(int(states_explored), 0))
+    reg.counter_inc(M_ANALYSIS_CEX, max(int(counterexamples), 0))
+
+
 def _enabled() -> bool:
     from . import enabled
 

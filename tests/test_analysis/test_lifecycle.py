@@ -1,0 +1,231 @@
+"""Serving-state interleaving checker (analysis/lifecycle.py,
+ISSUE 13): the stubbed device layer drives the REAL host objects, the
+explorer covers bounded interleavings with canonical dedup, the
+invariant catalog holds on the clean tree, and both replanted
+historical bugs are found with minimal (<= 8 event) counterexamples."""
+
+import pytest
+
+from magiattention_tpu.analysis.lifecycle import (
+    EngineModel,
+    SchedulerModel,
+    TieredModel,
+    allocator_invariants,
+    engine_invariants,
+    explore,
+    planted_dangling_eviction,
+    planted_double_free,
+    run_lifecycle_check,
+    run_mutation_self_test,
+    stubbed_device_layer,
+)
+
+
+# ---------------------------------------------------------------------------
+# the stub layer drives the real objects
+# ---------------------------------------------------------------------------
+
+
+def test_stubbed_engine_lifecycle_roundtrip():
+    with stubbed_device_layer():
+        from magiattention_tpu.serving.engine import ServingEngine
+
+        eng = ServingEngine(
+            num_pages=5, num_kv_heads=2, head_dim=4, page_size=8,
+            max_seqs=2, max_pages_per_seq=4,
+        )
+        toks = tuple(range(11))  # one full page + a 3-token tail
+        res = eng.admit(len(toks), tokens=toks)
+        assert res.admitted
+        from magiattention_tpu.analysis.lifecycle import _StubArray
+
+        q = _StubArray((11, 2, 4))
+        eng.prefill(q, q, q, res.slot)  # registers the prefix
+        assert eng.prefix.resident_pages == 2
+        assert engine_invariants(eng) == []
+        d = _StubArray((1, 2, 4))
+        eng.decode_step(d, d, d, [res.slot])
+        assert eng._lengths[res.slot] == 12
+        assert engine_invariants(eng) == []
+        eng.free(res.slot)
+        assert engine_invariants(eng) == []
+        # trie still pins its resident copy; dropping it must quiesce
+        eng.prefix.drop_all(eng.allocator)
+        assert eng.allocator.pages_in_use == 0
+        assert engine_invariants(eng) == []
+
+
+def test_stubbed_fork_and_refcounts():
+    with stubbed_device_layer():
+        from magiattention_tpu.serving.engine import ServingEngine
+        from magiattention_tpu.analysis.lifecycle import _StubArray
+
+        eng = ServingEngine(
+            num_pages=6, num_kv_heads=2, head_dim=4, page_size=8,
+            max_seqs=3, max_pages_per_seq=4,
+        )
+        toks = tuple(range(8))  # exactly one full page
+        r1 = eng.admit(8, tokens=toks)
+        q = _StubArray((8, 2, 4))
+        eng.prefill(q, q, q, r1.slot)
+        r2 = eng.admit(10, tokens=toks + (9, 9))  # forks the shared page
+        assert r2.admitted and r2.prefix_len == 8
+        shared = eng.allocator.slot_pages(r1.slot)[0]
+        # registrant + trie + fork = 3 references, resident once
+        assert eng.allocator.page_ref(shared) == 3
+        assert allocator_invariants(eng.allocator, eng.prefix) == []
+        eng.free(r1.slot)
+        assert eng.allocator.page_ref(shared) == 2
+        assert allocator_invariants(eng.allocator, eng.prefix) == []
+
+
+# ---------------------------------------------------------------------------
+# exploration
+# ---------------------------------------------------------------------------
+
+
+def test_engine_model_smoke_clean():
+    with stubbed_device_layer():
+        res = explore(EngineModel(), max_depth=4)
+    assert res.ok, res.counterexamples[0].render()
+    assert res.states > 50
+    assert not res.truncated
+
+
+def test_scheduler_model_smoke_clean():
+    with stubbed_device_layer():
+        res = explore(SchedulerModel(), max_depth=5)
+    assert res.ok, res.counterexamples[0].render()
+    assert res.states > 20
+
+
+def test_tiered_model_smoke_clean():
+    with stubbed_device_layer():
+        res = explore(TieredModel(), max_depth=5)
+    assert res.ok, res.counterexamples[0].render()
+    assert res.states > 20
+
+
+def test_canonical_dedup_collapses_permuted_admissions():
+    """Admitting A then B must canonically reconverge with B then A
+    once both are resident — the renaming is what keeps the state
+    space enumerable."""
+    with stubbed_device_layer():
+        m = EngineModel()
+        s1 = m.initial()
+        m.apply(s1, "admit:A")
+        m.apply(s1, "admit:C")
+        s2 = m.initial()
+        m.apply(s2, "admit:C")
+        m.apply(s2, "admit:A")
+        # same logical occupancy, different page/slot id assignment
+        assert m.check(s1) == [] and m.check(s2) == []
+        assert s1["engine"].allocator.pages_in_use == s2[
+            "engine"
+        ].allocator.pages_in_use
+
+
+def test_decode_fault_requeues_and_replays():
+    """The ISSUE 12 no-hang path under the checker's event alphabet: a
+    decode-chip fault mid-run requeues exactly the victims, invariants
+    hold at every step, and the run still drains."""
+    with stubbed_device_layer():
+        m = TieredModel()
+        sys = m.initial()
+        m.apply(sys, "submit:A")
+        m.apply(sys, "tick")  # admit + prefill + stream
+        assert m.check(sys) == []
+        m.apply(sys, "tick_fault")  # decode replica dies mid-step
+        assert m.check(sys) == []
+        for _ in range(12):
+            if sys["sched"].done:
+                break
+            m.apply(sys, "tick")
+            assert m.check(sys) == []
+        assert sys["sched"].done
+        st = sys["sched"]._finished[0]
+        assert st.evictions >= 1  # the fault cost one requeue
+        assert st.tokens_done == 2
+
+
+# ---------------------------------------------------------------------------
+# replanted historical bugs
+# ---------------------------------------------------------------------------
+
+
+def test_double_free_mutation_caught_minimally():
+    with stubbed_device_layer():
+        with planted_double_free():
+            res = explore(EngineModel(), max_depth=6)
+    assert not res.ok
+    cex = res.counterexamples[0]
+    assert len(cex.trace) <= 8
+    assert any(
+        "refcount" in v or "free and referenced" in v
+        for v in cex.violations
+    )
+
+
+def test_dangling_eviction_mutation_caught_minimally():
+    with stubbed_device_layer():
+        with planted_dangling_eviction():
+            res = explore(SchedulerModel(), max_depth=8)
+    assert not res.ok
+    cex = res.counterexamples[0]
+    assert len(cex.trace) <= 8
+    assert any("never requeued" in v for v in cex.violations)
+
+
+def test_mutation_self_test_api():
+    assert run_mutation_self_test() == []
+
+
+# ---------------------------------------------------------------------------
+# the full matrix (the make lifecycle-check surface)
+# ---------------------------------------------------------------------------
+
+
+def test_smoke_matrix_clean():
+    errors, report = run_lifecycle_check(smoke=True)
+    assert errors == []
+    assert sum(r["states"] for r in report.values()) > 100
+
+
+@pytest.mark.slow
+def test_full_matrix_clean_and_deep():
+    errors, report = run_lifecycle_check()
+    assert errors == []
+    assert sum(r["states"] for r in report.values()) >= 10_000
+
+
+def test_pool_smaller_than_seq_cap_rejects_instead_of_spinning():
+    """Review regression (ISSUE 13): prompt+gen within the per-seq cap
+    but beyond the POOL must be a permanent too_long rejection — not a
+    decode-pressure self-preempt/replay spin."""
+    with stubbed_device_layer():
+        from magiattention_tpu.serving.engine import ServingEngine
+        from magiattention_tpu.serving.scheduler import Request, Scheduler
+        from magiattention_tpu.analysis.lifecycle import (
+            _CountingClock,
+            _StubArray,
+        )
+
+        eng = ServingEngine(
+            num_pages=3, num_kv_heads=2, head_dim=4, page_size=8,
+            max_seqs=2, max_pages_per_seq=4,
+        )
+        sched = Scheduler(
+            eng, token_budget=32, chunk=8, clock=_CountingClock()
+        )
+        q = _StubArray((24, 2, 4))
+        d = _StubArray((1, 2, 4))
+        sched.submit(
+            Request(
+                rid=0, prompt_q=q, prompt_k=q, prompt_v=q,
+                decode_q=d, decode_k=d, decode_v=d,
+                max_new_tokens=1, trace_id="lc-pool",
+            )
+        )
+        sched.run(max_steps=20)  # must terminate, not spin
+        assert sched.result(0).status == "rejected"
+        assert eng.allocator.pages_in_use == 0

@@ -280,3 +280,131 @@ def test_symbols_are_dotted_scopes():
     (v,) = lint_source(src, f"{PKG}/ops/x.py")
     assert v.symbol == "C.m"
     assert v.rule == "MAGI002"
+
+
+# ---------------------------------------------------------------------------
+# MAGI005: rank-gated host control flow over collectives (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_magi005_flags_axis_index_guarded_collective():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    r = jax.lax.axis_index('cp')\n"
+        "    if r == 0:\n"
+        "        x = jax.lax.ppermute(x, 'cp', [(0, 1)])\n"
+        "    return x\n"
+    )
+    rules = {v.rule for v in lint_source(src, f"{PKG}/comm/x.py")}
+    assert "MAGI005" in rules
+
+
+def test_magi005_flags_direct_call_in_test_and_while():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    while jax.lax.axis_index('cp') == 0:\n"
+        "        x = jax.lax.psum(x, 'cp')\n"
+        "    return x\n"
+    )
+    rules = {v.rule for v in lint_source(src, f"{PKG}/parallel/x.py")}
+    assert "MAGI005" in rules
+
+
+def test_magi005_flags_process_index_ternary():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    pi = jax.process_index()\n"
+        "    return jax.lax.psum(x, 'cp') if pi == 0 else x\n"
+    )
+    rules = {v.rule for v in lint_source(src, f"{PKG}/comm/x.py")}
+    assert "MAGI005" in rules
+
+
+def test_magi005_quiet_on_traced_select():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    r = jax.lax.axis_index('cp')\n"
+        "    y = jax.lax.ppermute(x, 'cp', [(0, 1), (1, 0)])"
+        "  # magi-allow: MAGI004\n"
+        "    return jnp.where(r == 0, y, x)\n"
+    )
+    assert lint_source(src, f"{PKG}/comm/x.py") == []
+
+
+def test_magi005_quiet_on_rank_gated_host_work():
+    # rank-gated placement (no collective in the branch) is the
+    # legitimate single-process fast path in parallel/dist_attn
+    src = (
+        "import jax\n"
+        "def f(tables, mesh):\n"
+        "    if all(d.process_index == jax.process_index()\n"
+        "           for d in mesh.devices.flat):\n"
+        "        return tuple(jax.device_put(t, None) for t in tables)\n"
+        "    return tables\n"
+    )
+    assert lint_source(src, f"{PKG}/parallel/x.py") == []
+
+
+def test_magi005_pragma_suppresses():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    r = jax.lax.axis_index('cp')\n"
+        "    if r == 0:  # magi-allow: MAGI005\n"
+        "        x = jax.lax.ppermute(x, 'cp', [(0, 1)])"
+        "  # magi-allow: MAGI004\n"
+        "    return x\n"
+    )
+    assert lint_source(src, f"{PKG}/comm/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# MAGI004 device_put extension (ISSUE 13): serving wire hops
+# ---------------------------------------------------------------------------
+
+
+def test_magi004_flags_unscoped_serving_device_put():
+    src = (
+        "import jax\n"
+        "def stream(x):\n"
+        "    return jax.device_put(x, None)\n"
+    )
+    (v,) = lint_source(src, f"{PKG}/serving/x.py")
+    assert v.rule == "MAGI004"
+    assert "device_put" in v.message
+
+
+def test_magi004_device_put_quiet_under_scope_and_outside_serving():
+    scoped = (
+        "import jax\n"
+        "from magiattention_tpu.utils.instrument import named_scope\n"
+        "def stream(x):\n"
+        "    with named_scope('magi_page_stream'):\n"
+        "        return jax.device_put(x, None)\n"
+    )
+    assert lint_source(scoped, f"{PKG}/serving/x.py") == []
+    unscoped_elsewhere = (
+        "import jax\n"
+        "def pin(x):\n"
+        "    return jax.device_put(x, None)\n"
+    )
+    assert lint_source(unscoped_elsewhere, f"{PKG}/parallel/x.py") == []
+
+
+def test_magi005_taint_cleared_on_rebinding():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    r = jax.lax.axis_index('cp')\n"
+        "    r = 0\n"
+        "    if r == 0:\n"
+        "        x = jax.lax.ppermute(x, 'cp', [(0, 1), (1, 0)])"
+        "  # magi-allow: MAGI004\n"
+        "    return x\n"
+    )
+    assert lint_source(src, f"{PKG}/comm/x.py") == []

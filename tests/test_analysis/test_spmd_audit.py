@@ -1,0 +1,202 @@
+"""SPMD collective-consistency auditor (analysis/spmd_audit.py,
+ISSUE 13): per-rank signature extraction, cross-rank uniformity,
+hop-pairing well-formedness, and the production-path matrices."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from magiattention_tpu.analysis.spmd_audit import (
+    audit_cp_decode,
+    audit_group_matrix,
+    audit_hier_matrix,
+    audit_tp_decode,
+    audit_uniform,
+    collective_signature,
+    hop_pairing_errors,
+    self_test,
+    signature_shifts,
+)
+from magiattention_tpu.utils.compat import shard_map
+
+
+def _mesh(cp):
+    return Mesh(np.array(jax.devices()[:cp]), ("cp",))
+
+
+def _smap(f, mesh):
+    return shard_map(
+        f, mesh=mesh, in_specs=P("cp"), out_specs=P("cp"),
+        check_vma=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# signature extraction
+# ---------------------------------------------------------------------------
+
+
+def test_signature_orders_collectives_with_axes_and_payload():
+    mesh = _mesh(2)
+
+    def f(x):
+        y = jax.lax.ppermute(  # magi-allow: MAGI004
+            x, "cp", [(0, 1), (1, 0)]
+        )
+        return jax.lax.psum(y, "cp")  # magi-allow: MAGI004
+
+    g = shard_map(
+        f, mesh=mesh, in_specs=P("cp"), out_specs=P("cp", None),
+        check_vma=False,
+    )
+    sig = collective_signature(
+        jax.make_jaxpr(g)(jnp.zeros((2, 4), jnp.float32))
+    )
+    assert [s.prim for s in sig] == ["ppermute", "psum"]
+    assert sig[0].axes == ("cp",)
+    assert sig[0].detail == "shift=1/2"
+    assert signature_shifts(sig, "cp") == [1]
+
+
+def test_signature_ignores_empty_axes_psum():
+    mesh = _mesh(2)
+
+    def f(x):
+        return jax.lax.psum(x, ())  # magi-allow: MAGI004
+
+    jaxpr = jax.make_jaxpr(_smap(f, mesh))(jnp.zeros((2, 4), jnp.float32))
+    assert collective_signature(jaxpr) == ()
+
+
+# ---------------------------------------------------------------------------
+# cross-rank uniformity
+# ---------------------------------------------------------------------------
+
+
+def test_rank_gated_extra_ppermute_is_divergence():
+    mesh = _mesh(2)
+
+    def build(rank):
+        def f(x):
+            y = jax.lax.ppermute(  # magi-allow: MAGI004
+                x, "cp", [(0, 1), (1, 0)]
+            )
+            if rank == 0:  # planted host divergence
+                y = jax.lax.ppermute(  # magi-allow: MAGI004
+                    y, "cp", [(0, 1), (1, 0)]
+                )
+            return y
+
+        return jax.make_jaxpr(_smap(f, mesh))(
+            jnp.zeros((2, 4), jnp.float32)
+        )
+
+    errors, _sig = audit_uniform(
+        "planted", build, 2, axis_sizes={"cp": 2}
+    )
+    assert any("diverges from rank 0" in e for e in errors)
+    assert any("schedule position 1" in e for e in errors)
+
+
+def test_uniform_builders_pass():
+    mesh = _mesh(2)
+
+    def build(rank):
+        def f(x):
+            return jax.lax.ppermute(  # magi-allow: MAGI004
+                x, "cp", [(0, 1), (1, 0)]
+            )
+
+        return jax.make_jaxpr(_smap(f, mesh))(
+            jnp.zeros((2, 4), jnp.float32)
+        )
+
+    errors, sig = audit_uniform("ok", build, 2, axis_sizes={"cp": 2})
+    assert errors == []
+    assert len(sig) == 1
+
+
+# ---------------------------------------------------------------------------
+# hop pairing
+# ---------------------------------------------------------------------------
+
+
+def _trace_perm(perm, cp=2):
+    mesh = _mesh(cp)
+
+    def f(x):
+        return jax.lax.ppermute(x, "cp", perm)  # magi-allow: MAGI004
+
+    return jax.make_jaxpr(_smap(f, mesh))(
+        jnp.zeros((cp, 4), jnp.float32)
+    )
+
+
+def test_one_sided_perm_flagged():
+    errs = hop_pairing_errors(_trace_perm([(0, 1)]), {"cp": 2})
+    assert any("participate" in e or "one-sided" in e for e in errs)
+
+
+def test_mixed_shift_perm_flagged():
+    errs = hop_pairing_errors(
+        _trace_perm([(0, 0), (1, 2), (2, 1)], cp=3), {"cp": 3}
+    )
+    assert any("mixed shifts" in e for e in errs)
+
+
+def test_full_rotation_clean():
+    errs = hop_pairing_errors(
+        _trace_perm([(0, 1), (1, 2), (2, 0)], cp=3), {"cp": 3}
+    )
+    assert errs == []
+
+
+# ---------------------------------------------------------------------------
+# production matrices (small default-tier slices; the full matrix runs
+# in make analyze / make spmd-audit)
+# ---------------------------------------------------------------------------
+
+
+def test_group_matrix_cp2_uniform():
+    errors, report = audit_group_matrix(cps=(1, 2))
+    assert errors == []
+    assert "group_cast impl=hops cp=2" in report
+
+
+def test_hier_2x2_per_level_census():
+    errors, report = audit_hier_matrix(meshes=((2, 2),))
+    assert errors == []
+    cast_hops = report["hier_cast impl=hops mesh=2x2"]
+    assert cast_hops[0].startswith("all_to_all[dcn]")
+    assert all("ici" in s for s in cast_hops[1:])
+
+
+def test_cp_decode_signature():
+    errors, report = audit_cp_decode(cps=(1, 2))
+    assert errors == []
+    assert report["cp_decode cp=1"] == []
+    assert [s.split("[")[0] for s in report["cp_decode cp=2"]] == [
+        "all_gather", "all_gather",
+    ]
+
+
+def test_tp_decode_zero_collectives():
+    errors, report = audit_tp_decode(tps=(1, 2))
+    assert errors == []
+    assert report["tp_decode tp=2"] == []
+
+
+@pytest.mark.slow
+def test_full_matrix_cp8():
+    errors, _report = audit_group_matrix(cps=(4, 8))
+    assert errors == []
+    errors, _report = audit_hier_matrix(meshes=((2, 4),))
+    assert errors == []
+
+
+def test_self_test_plants_are_caught():
+    assert self_test() == []
