@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze spmd-audit lifecycle-check resilience-check roofline-check roofline-report trace-check distserve-check check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze spmd-audit lifecycle-check resilience-check roofline-check roofline-report trace-check distserve-check memory-check check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -175,6 +175,18 @@ trace-check:
 distserve-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_distserve_check.py
 
+# memory observability gate (ISSUE 14, CPU): ledger-vs-measured bytes
+# within tolerance on the jitted decode + dist_attn programs (XLA
+# memory_analysis; per-stage cast buffers single-sourced with
+# CommMeta.scheduled_rows_per_rank), REQUIRED_MEMORY_METRICS populated
+# by a live serving trace + the telemetry_summary memory probe line,
+# fragmentation map bit-equal to a brute-force free-list scan, a chaos
+# pool_exhaust run ending in a flight dump carrying the memory ledger +
+# fragmentation snapshot and the triggering admission's trace id, and
+# --self-test proof that a planted ledger mispricing is caught
+memory-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_memory_check.py --self-test
+
 # mask-aware roofline report + occupancy JSON artifact for the 16k
 # varlen block-causal headline (docs/observability.md "Roofline &
 # occupancy"); host-side only
@@ -185,5 +197,6 @@ roofline-report:
 # timeline/aggregate semantics, autotuner rung expectations, perf gate,
 # serving parity, shared-prefix/scheduler gate, group-collective
 # parity/volume, resilience gate, roofline/occupancy gate, request
-# tracing/exposition gate, disaggregated-serving gate — all CPU-safe
-check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check distserve-check
+# tracing/exposition gate, disaggregated-serving gate, memory
+# observability gate — all CPU-safe
+check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check distserve-check memory-check

@@ -121,6 +121,23 @@ def _run_real_and_cache() -> None:
         meta["device"] = str(device)
         if extras:
             meta["extra_metrics"] = extras
+        # peak-HBM context (ISSUE 14): the device allocator's own
+        # peak_bytes_in_use high-water mark (a TRUE peak covering the
+        # measured kernels' transient scratch) where the runtime
+        # exposes one, else an instantaneous post-run bytes_in_use
+        # sample; CPU-safe (empty on backends without memory_stats)
+        try:
+            from magiattention_tpu.telemetry.memory import (
+                sample_memory_stats,
+            )
+
+            hbm = sample_memory_stats(key="peak_bytes_in_use")
+            if not hbm:
+                hbm = sample_memory_stats()
+            if hbm:
+                meta["peak_hbm_bytes"] = max(hbm.values())
+        except Exception as e:
+            print(f"peak-HBM sample failed: {e!r}", file=sys.stderr)
         meta["provenance"] = (
             "bench.py --real on-chip measurement (64k dense-causal bf16 "
             "flex fwd vs jax.experimental.pallas flash_attention, same "
@@ -228,6 +245,7 @@ def _append_history(meta: dict, extras: dict) -> None:
                 autotune_rung=_bench_autotune_rung(),
                 mask_density=densities,
                 roofline_efficiency=efficiencies,
+                peak_hbm_bytes=meta.get("peak_hbm_bytes"),
             ),
         )
         print(f"bench history appended -> {_HISTORY}", file=sys.stderr)

@@ -1,0 +1,447 @@
+"""Memory observability drift guard (``make memory-check``) — CPU.
+
+The ISSUE 14 acceptance surface, device-free:
+
+1. **ledger vs measured — decode**: the static serving ledger's io
+   bytes (page pools + tables + operands + outputs) must sit within
+   tolerance of XLA's compiled-executable ``memory_analysis`` on the
+   jitted split-KV decode program;
+2. **ledger vs measured — dist_attn**: same gate for the plan ledger
+   over a real cp=2 degree-2 plan's jitted shard_map program (XLA
+   reports per-device sizes, the ledger prices per-rank — the
+   convention match IS the test);
+3. **catalog presence via a live serving trace**: a multi-tenant
+   scheduler run (shared prefix, CoW, decode growth) plus one pool
+   forensics snapshot must populate every
+   ``REQUIRED_MEMORY_METRICS`` name, and ``telemetry_summary`` must
+   print the ``memory probe:`` line;
+4. **fragmentation map == brute-force scan**: the map's free runs and
+   unusable-fraction equal an independent page-by-page scan across an
+   admit/free churn;
+5. **chaos pool_exhaust forensics**: a ``MAGI_ATTENTION_CHAOS=
+   pool_exhaust`` admission storm inside a live scheduler must end in
+   a flight-recorder dump embedding the memory ledger + fragmentation
+   snapshot AND the triggering admission's trace id;
+6. ``--self-test``: a deliberately mispriced ledger (pool priced at
+   double itemsize) must FAIL the tolerance gate — the gate can catch
+   a real mispricing.
+
+Exits non-zero on any violation.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] = "jnp"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from magiattention_tpu import telemetry  # noqa: E402
+from magiattention_tpu.serving import (  # noqa: E402
+    Request,
+    Scheduler,
+    ServingEngine,
+)
+from magiattention_tpu.serving.kv_cache import PageAllocator  # noqa: E402
+from magiattention_tpu.telemetry import memory as mem  # noqa: E402
+from magiattention_tpu.telemetry import trace  # noqa: E402
+
+HQ, HK, D, PS = 4, 2, 16, 8
+VOCAB = 89
+TOLERANCE = 0.10  # |predicted/measured - 1| on the io bytes
+
+_rng = np.random.default_rng(0)
+EMB_K = _rng.standard_normal((VOCAB, HK, D)).astype(np.float32)
+EMB_V = _rng.standard_normal((VOCAB, HK, D)).astype(np.float32)
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _engine(**kw):
+    kw.setdefault("num_pages", 48)
+    kw.setdefault("max_seqs", 6)
+    kw.setdefault("max_pages_per_seq", 8)
+    return ServingEngine(
+        num_kv_heads=HK, head_dim=D, page_size=PS, dtype=jnp.float32, **kw
+    )
+
+
+def _req(rng, rid, tokens, gen, priority=0, with_tokens=True):
+    idx = np.asarray(tokens, np.int64)
+    return Request(
+        rid=rid,
+        prompt_q=jnp.asarray(
+            rng.standard_normal((len(tokens), HQ, D)), jnp.float32
+        ),
+        prompt_k=jnp.asarray(EMB_K[idx]),
+        prompt_v=jnp.asarray(EMB_V[idx]),
+        decode_q=jnp.asarray(rng.standard_normal((gen, HQ, D)), jnp.float32),
+        decode_k=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        decode_v=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        tokens=list(tokens) if with_tokens else None,
+        priority=priority,
+    )
+
+
+def _decode_pair(mispriced: bool = False):
+    """(ledger, measured) for the jitted decode program."""
+    from magiattention_tpu.serving.decode_attn import decode_attn_paged
+
+    rng = np.random.default_rng(1)
+    eng = _engine()
+    res = eng.admit(2 * PS + 3)
+    q0 = jnp.asarray(
+        rng.standard_normal((2 * PS + 3, HQ, D)), jnp.float32
+    )
+    k0 = jnp.asarray(
+        rng.standard_normal((2 * PS + 3, HK, D)), jnp.float32
+    )
+    v0 = jnp.asarray(
+        rng.standard_normal((2 * PS + 3, HK, D)), jnp.float32
+    )
+    eng.prefill(q0, k0, v0, res.slot)
+    led = mem.serving_memory_ledger(
+        eng, name="decode", num_q_heads=HQ, decode_batch=1, num_splits=2,
+    )
+    if mispriced:
+        led = mem.MemoryLedger(
+            name="decode_mispriced",
+            entries=tuple(
+                mem.LedgerEntry(
+                    e.phase, e.component, e.nbytes * 2, e.detail
+                )
+                if e.component == "pages_free" else e
+                for e in led.entries
+            ),
+        )
+    q = jnp.zeros((1, HQ, D), jnp.float32)
+    slots = jnp.zeros((1,), jnp.int32)
+    f = jax.jit(lambda q, c, s: decode_attn_paged(q, c, s, num_splits=2))
+    measured = mem.measure_program_memory(f, q, eng.cache, slots)
+    return led, measured
+
+
+def check_decode_gate() -> int:
+    led, measured = _decode_pair()
+    if measured is None:
+        return fail("memory_analysis unavailable on the CPU backend")
+    cmp = mem.ledger_vs_measured(led, measured, program="decode")
+    if not cmp.within(TOLERANCE):
+        return fail(
+            f"decode ledger outside tolerance: {json.dumps(cmp.to_json())}"
+        )
+    print(
+        f"memory-check: decode ledger within tolerance "
+        f"(delta {cmp.delta_ratio:.4f}, predicted "
+        f"{cmp.predicted_io_bytes} vs measured {cmp.measured_io_bytes} io "
+        f"bytes, unattributed temp {cmp.unattributed_bytes})"
+    )
+    return 0
+
+
+def check_dist_attn_gate() -> int:
+    from jax.sharding import Mesh
+
+    from magiattention_tpu.common.enum import AttnMaskType
+    from magiattention_tpu.common.ranges import AttnRanges
+    from magiattention_tpu.meta.dispatch_meta import (
+        make_dispatch_meta_from_qk_ranges,
+    )
+    from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+    from magiattention_tpu.parallel.dist_attn import (
+        build_dist_attn_plan,
+        make_attn_params,
+        make_dist_attn_fn,
+    )
+
+    total, cp = 2048, 2
+    hq = hk = 2
+    d = 64
+    qr = AttnRanges.from_ranges([(0, total)])
+    kr = AttnRanges.from_ranges([(0, total)])
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, [AttnMaskType.CAUSAL], total, total,
+        chunk_size=256, cp_size=cp,
+    )
+    plan = build_dist_attn_plan(
+        mq, bucket, block_q=64, block_k=64,
+        overlap_config=OverlapConfig(degree=2, min_stage_rows=64),
+    )
+    if len(plan.stages) < 2:
+        return fail("memory-check plan did not produce >= 2 stages")
+    # through the plan's own pricing hook (parallel/dist_attn.py)
+    led = plan.memory_ledger(
+        num_heads_q=hq, num_heads_kv=hk, head_dim=d,
+        bytes_per_elt=4, name="dist_attn",
+    )
+    # single-sourcing proof: the priced cast buffers ARE the comm
+    # metas' scheduled rows (what the solver and timeline price)
+    row_bytes = 2 * hk * d * 4
+    for i, sp in enumerate(plan.stages):
+        cast = next(
+            e for e in led.entries if e.phase == f"stage{i}_cast"
+        )
+        if cast.nbytes != sp.comm.scheduled_rows_per_rank * row_bytes:
+            return fail(
+                f"stage{i} cast buffer not single-sourced with "
+                f"CommMeta.scheduled_rows_per_rank"
+            )
+    mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
+    params = make_attn_params(plan, d, out_dtype="float32")
+    fn = make_dist_attn_fn(plan, mesh, params)
+    q = jnp.zeros((total, hq, d), jnp.float32)
+    k = jnp.zeros((total, hk, d), jnp.float32)
+    v = jnp.zeros((total, hk, d), jnp.float32)
+    measured = mem.measure_program_memory(fn, q, k, v)
+    if measured is None:
+        return fail("dist_attn memory_analysis unavailable")
+    cmp = mem.ledger_vs_measured(led, measured, program="dist_attn")
+    if not cmp.within(TOLERANCE):
+        return fail(
+            f"dist_attn ledger outside tolerance: "
+            f"{json.dumps(cmp.to_json())}"
+        )
+    print(
+        f"memory-check: dist_attn ledger within tolerance "
+        f"(delta {cmp.delta_ratio:.4f}, {len(plan.stages)} stages priced "
+        f"from scheduled_rows_per_rank, unattributed temp "
+        f"{cmp.unattributed_bytes})"
+    )
+    return 0
+
+
+def check_live_trace_catalog() -> int:
+    """A real multi-tenant trace (shared prefix fork + CoW + decode
+    growth) + one forensics snapshot must populate the whole
+    REQUIRED_MEMORY_METRICS catalog."""
+    rng = np.random.default_rng(3)
+    eng = _engine()
+    sched = Scheduler(eng, token_budget=48, chunk=PS)
+    sysp = [int(t) for t in rng.integers(0, VOCAB, 2 * PS)]
+    sched.submit(_req(rng, 0, sysp, gen=3))
+    for _ in range(4):
+        sched.step()
+    sched.submit(
+        _req(rng, 1, sysp + [int(t) for t in rng.integers(0, VOCAB, 5)],
+             gen=4)
+    )
+    sched.run()
+    page_bytes = 2 * PS * HK * D * 4
+    mem.fragmentation_map(
+        eng.allocator, pool="kvpool", page_bytes=page_bytes, record=True
+    )
+    snap = telemetry.snapshot()
+
+    def has_series(name):
+        return any(
+            k == name or k.startswith(name + "{")
+            for sec in snap.values() for k in sec
+        )
+
+    missing = [
+        m for m in telemetry.REQUIRED_MEMORY_METRICS if not has_series(m)
+    ]
+    if missing:
+        return fail(
+            f"documented memory metrics missing from a live serving "
+            f"trace (catalog drift): {missing}"
+        )
+    summary = telemetry.telemetry_summary(snap)
+    if "memory probe" not in summary:
+        return fail(
+            "telemetry_summary lacks the memory probe line:\n" + summary
+        )
+    print(
+        f"memory-check: live serving trace populated all "
+        f"{len(telemetry.REQUIRED_MEMORY_METRICS)} REQUIRED_MEMORY_METRICS "
+        "and the summary prints the memory probe line"
+    )
+    return 0
+
+
+def check_fragmentation_brute_force() -> int:
+    rng = np.random.default_rng(4)
+    alloc = PageAllocator(40, PS, 8, 8)
+    live = {}
+    for _ in range(120):
+        if live and rng.random() < 0.45:
+            slot = int(rng.choice(list(live)))
+            alloc.free(slot)
+            del live[slot]
+        else:
+            n = PS * int(rng.integers(1, 4))
+            if alloc.can_admit(n):
+                slot, pages = alloc.allocate(n)
+                live[slot] = pages
+        g = int(rng.integers(1, 5))
+        fmap = mem.fragmentation_map(alloc, granularity=g)
+        free = set(alloc.page_states()["free"])
+        runs, cur = [], 0
+        for p in range(40):  # the brute-force page-by-page scan
+            if p in free:
+                cur += 1
+            elif cur:
+                runs.append(cur)
+                cur = 0
+        if cur:
+            runs.append(cur)
+        if sorted(fmap.free_runs()) != sorted(runs):
+            return fail(
+                f"fragmentation map free runs {fmap.free_runs()} != "
+                f"brute-force scan {runs}"
+            )
+        unusable = sum(r % g for r in runs)
+        expect = unusable / len(free) if free else 0.0
+        if abs(fmap.fragmentation_ratio - expect) > 1e-12:
+            return fail(
+                f"fragmentation ratio {fmap.fragmentation_ratio} != "
+                f"brute-force {expect} at granularity {g}"
+            )
+    print(
+        "memory-check: fragmentation map bit-equal to the brute-force "
+        "free-list scan across 120 churn steps x random granularities"
+    )
+    return 0
+
+
+def check_chaos_pool_exhaust(tmpdir: str) -> int:
+    """The OOM post-mortem: chaos-exhausted pool -> pool_exhausted
+    backpressure inside a live scheduler -> flight dump carrying the
+    ledger + fragmentation snapshot and the triggering trace id."""
+    from magiattention_tpu.resilience.chaos import reset_chaos
+
+    os.environ["MAGI_ATTENTION_TRACE_DIR"] = tmpdir
+    fr = trace.reset_flight_recorder()
+    rng = np.random.default_rng(5)
+    eng = _engine(num_pages=8, max_seqs=4, max_pages_per_seq=4)
+    sched = Scheduler(eng, token_budget=48, chunk=None)
+    sched.submit(_req(rng, 0, list(rng.integers(0, VOCAB, PS)), gen=2))
+    sched.step()  # a healthy tick (and a live resident) in the ring
+    os.environ["MAGI_ATTENTION_CHAOS"] = "pool_exhaust"
+    reset_chaos()
+    victim = sched.submit(
+        _req(rng, 1, list(rng.integers(0, VOCAB, PS)), gen=1)
+    )
+    try:
+        sched.step()  # admission -> pool_exhausted -> armed -> flushed
+    finally:
+        os.environ.pop("MAGI_ATTENTION_CHAOS", None)
+        reset_chaos()
+    if not fr.dump_paths:
+        return fail("chaos pool_exhaust produced no flight dump")
+    payload = json.load(open(fr.dump_paths[-1]))
+    trig = payload["trigger"]
+    if trig["trigger"] != "pool_exhausted":
+        return fail(
+            f"dump trigger {trig['trigger']!r} != pool_exhausted"
+        )
+    if trig["context"].get("trace_id") != victim.trace_id:
+        return fail(
+            f"dump lacks the triggering admission's trace id "
+            f"(got {trig['context'].get('trace_id')!r}, want "
+            f"{victim.trace_id!r})"
+        )
+    memsec = payload.get("memory") or {}
+    srcs = [k for k in memsec if k.startswith("engine#")]
+    if not srcs:
+        return fail("dump carries no engine memory section")
+    snapshot = memsec[srcs[-1]]
+    led = snapshot.get("ledger") or {}
+    frag = snapshot.get("fragmentation") or {}
+    if "pool" not in (led.get("by_phase") or {}):
+        return fail(f"dump ledger lacks the pool phase: {led}")
+    counts = frag.get("state_counts") or {}
+    if sum(counts.values()) != eng.allocator.num_pages:
+        return fail(
+            f"dump fragmentation snapshot does not cover the pool: "
+            f"{counts}"
+        )
+    # drain the parked victim so the check leaves clean state
+    sched.run()
+    print(
+        "memory-check: chaos pool_exhaust -> flight dump with ledger + "
+        f"fragmentation snapshot and trace id {victim.trace_id} "
+        f"({trig['context'].get('pages_in_use')}/"
+        f"{trig['context'].get('pages_total')} pages at the incident)"
+    )
+    return 0
+
+
+def self_test() -> int:
+    """The gate must be able to FAIL: a ledger mispriced by 2x on the
+    free-pool bytes lands far outside tolerance."""
+    led, measured = _decode_pair(mispriced=True)
+    if measured is None:
+        return fail("memory_analysis unavailable for the self-test")
+    cmp = mem.ledger_vs_measured(
+        led, measured, program="decode_mispriced", record=False
+    )
+    if cmp.within(TOLERANCE):
+        return fail(
+            f"planted ledger mispricing was NOT caught: "
+            f"{json.dumps(cmp.to_json())}"
+        )
+    print(
+        f"memory-check: --self-test planted mispricing caught "
+        f"(delta {cmp.delta_ratio:.3f} outside ±{TOLERANCE})"
+    )
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args()
+    env_backup = {
+        k: os.environ.get(k)
+        for k in ("MAGI_ATTENTION_CHAOS", "MAGI_ATTENTION_TRACE_DIR")
+    }
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    trace.reset_flight_recorder()
+    try:
+        with tempfile.TemporaryDirectory(prefix="magi_mem_check_") as td:
+            checks = [
+                check_decode_gate,
+                check_dist_attn_gate,
+                check_live_trace_catalog,
+                check_fragmentation_brute_force,
+                lambda: check_chaos_pool_exhaust(td),
+            ]
+            if args.self_test:
+                checks.append(self_test)
+            for check in checks:
+                rc = check()
+                if rc:
+                    return rc
+    finally:
+        for k, v in env_backup.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        telemetry.set_enabled(None)
+        telemetry.reset()
+        trace.reset_flight_recorder()
+    print("memory-check: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,6 +74,11 @@ class MemoryRecorder:
     Backends without memory_stats (CPU) record nothing and stay usable —
     ``peak_bytes`` is then an empty dict.
 
+    The actual sampling is ONE implementation shared with the memory
+    observability layer (ISSUE 14):
+    :func:`~..telemetry.memory.sample_memory_stats` — this class only
+    adds the polling thread + peak folding.
+
     Usage::
 
         with MemoryRecorder() as rec:
@@ -90,15 +95,9 @@ class MemoryRecorder:
         self._thread = None
 
     def _poll_once(self) -> dict[Any, int]:
-        out = {}
-        for d in self.devices:
-            try:
-                stats = d.memory_stats()
-            except Exception:
-                stats = None
-            if stats and "bytes_in_use" in stats:
-                out[d] = int(stats["bytes_in_use"])
-        return out
+        from ..telemetry.memory import sample_memory_stats
+
+        return sample_memory_stats(self.devices)
 
     def __enter__(self):
         import threading
@@ -107,12 +106,7 @@ class MemoryRecorder:
 
         def loop():
             while not self._stop.is_set():
-                sample = self._poll_once()
-                if sample:
-                    self.samples.append(sample)
-                    for d, b in sample.items():
-                        if b > self.peak_bytes.get(d, 0):
-                            self.peak_bytes[d] = b
+                self.record()  # one fold implementation (gauges incl.)
                 self._stop.wait(self.interval_s)
 
         self._thread = threading.Thread(target=loop, daemon=True)
@@ -121,13 +115,18 @@ class MemoryRecorder:
 
     def record(self) -> None:
         """Take one sample now (for callers that poll at known-quiet
-        points instead of running the background thread)."""
+        points instead of running the background thread). With
+        telemetry on, the sample also lands on the
+        ``magi_mem_hbm_bytes_in_use``/``_peak`` gauges (ISSUE 14)."""
         sample = self._poll_once()
         if sample:
             self.samples.append(sample)
             for d, b in sample.items():
                 if b > self.peak_bytes.get(d, 0):
                     self.peak_bytes[d] = b
+            from ..telemetry import record_hbm_sample
+
+            record_hbm_sample(sample)
 
     def __exit__(self, exc_type, exc_val, exc_tb):
         self._stop.set()

@@ -200,6 +200,23 @@ def flight_recorder_depth() -> int:
     return v
 
 
+def mem_pressure_threshold() -> float:
+    """Free-page fraction under which the scheduler's memory-pressure
+    watcher (``telemetry/memory.MemPressureWatcher``) counts a tick as
+    pressured; N consecutive pressured ticks (watcher default 8) arm a
+    ``mem_pressure`` flight-recorder dump with the memory ledger +
+    fragmentation snapshot embedded (ISSUE 14 OOM forensics). ``0.0``
+    (the default) disables the watcher. Must be in [0, 1]. Pure
+    observability, NOT part of :func:`flags_fingerprint`."""
+    v = _env_float("MAGI_ATTENTION_MEM_PRESSURE_THRESHOLD", 0.0)
+    if not 0.0 <= v <= 1.0:
+        raise ValueError(
+            f"MAGI_ATTENTION_MEM_PRESSURE_THRESHOLD={v} must be in "
+            "[0, 1] (a free-page fraction; 0 disables)"
+        )
+    return v
+
+
 def perf_gate_tolerance() -> float:
     """Fractional TF/s regression the perf gate tolerates before failing
     (``exps/run_perf_gate.py`` / ``make perf-gate``): a run below

@@ -225,6 +225,35 @@ class DistAttnPlan:
             send_total=tuple(st),
         )
 
+    def memory_ledger(
+        self,
+        *,
+        num_heads_q: int,
+        num_heads_kv: int,
+        head_dim: int,
+        bytes_per_elt: int = 2,
+        **kw,
+    ):
+        """Price this plan's per-rank HBM footprint (ISSUE 14): one
+        :class:`~..telemetry.memory.MemoryLedger` with per-stage cast
+        buffers taken from each stage's
+        ``comm.scheduled_rows_per_rank`` — the same figure the overlap
+        solver and the timeline predictor price, so the byte accounting
+        can never drift from the cost model's — plus kernel
+        partial/LSE scratch and operand/table/output buffers.
+        ``make memory-check`` gates it against XLA's compiled
+        ``memory_analysis`` of the jitted program."""
+        from ..telemetry.memory import plan_memory_ledger
+
+        return plan_memory_ledger(
+            self,
+            num_heads_q=num_heads_q,
+            num_heads_kv=num_heads_kv,
+            head_dim=head_dim,
+            bytes_per_elt=bytes_per_elt,
+            **kw,
+        )
+
     def describe(self) -> str:
         """Multi-line plan summary (role of the reference's detailed plan
         dump, dist_attn_runtime_mgr.py:655-1014)."""

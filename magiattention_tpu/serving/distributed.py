@@ -313,6 +313,9 @@ class TieredEngine:
             head_dim=head_dim, page_size=page_size, max_seqs=max_seqs,
             max_pages_per_seq=max_pages_per_seq, dtype=dtype,
             max_admission_evictions=max_admission_evictions,
+            # the TieredEngine registers ONE aggregated per-tier memory
+            # source below; member engines must not each register too
+            register_flight_memory=False,
         )
         # prefill tier: the full slice is reserved for prefill compute
         # (CP/TP prefill over it composes via the existing dist_attn
@@ -340,6 +343,11 @@ class TieredEngine:
         self._next_sid = 0
         self.last_decode_info: dict = {}
         self._flight = reqtrace.get_flight_recorder()
+        # OOM forensics (ISSUE 14): one aggregated memory source for the
+        # whole fleet — per-tier ledgers + fragmentation maps in every
+        # flight dump (replicas rebuilt after a fault are picked up
+        # live because the snapshot walks self.replicas at dump time)
+        self._flight.register_memory_source("tiered", self)
         self._record_tiers()
 
     # -- construction ----------------------------------------------------
@@ -391,6 +399,24 @@ class TieredEngine:
             ],
             "pending_streams": len(self._pending),
         }
+
+    def memory_snapshot(self) -> dict:
+        """Per-tier memory forensics (ISSUE 14): the prefill tier's and
+        every decode replica's ledger + fragmentation map, keyed
+        ``tier_prefill`` / ``tier_decode_r<N>`` — the tier-split view a
+        fleet post-mortem needs (which pool actually ran out)."""
+        from ..telemetry.memory import engine_memory_snapshot
+
+        out = {
+            "tier_prefill": engine_memory_snapshot(
+                self._prefill, pool="tier_prefill"
+            ),
+            "pending_streams": len(self._pending),
+        }
+        for rep in self.replicas:
+            name = f"tier_decode_r{rep.index}"
+            out[name] = engine_memory_snapshot(rep.engine, pool=name)
+        return out
 
     # -- admission (fleet backpressure) ----------------------------------
 

@@ -271,6 +271,7 @@ class ServingEngine:
         max_admission_evictions: int = 4,
         prefix_sharing: bool = True,
         decode_attn_fn=None,
+        register_flight_memory: bool = True,
     ):
         from .. import env
 
@@ -315,6 +316,15 @@ class ServingEngine:
         # gated on telemetry
         self.last_decode_info: dict = {}
         self._flight = trace.get_flight_recorder()
+        # OOM forensics (ISSUE 14): every flight dump embeds this
+        # engine's memory ledger + pool fragmentation map (weakly held —
+        # a retired engine unregisters itself by dying); pool_exhausted
+        # backpressure arms a deferred dump once per pressure episode.
+        # A TieredEngine registers ONE aggregated per-tier source
+        # instead and opts its member engines out here
+        if register_flight_memory:
+            self._flight.register_memory_source("engine", self)
+        self._pool_exhausted_armed = False
         # live exposition (ISSUE 11): one scrape thread per process when
         # MAGI_ATTENTION_METRICS_PORT is set; no-op (None) by default
         exposition.ensure_metrics_server()
@@ -467,9 +477,27 @@ class ServingEngine:
         """Shared admission telemetry: registry counters (gated on the
         telemetry flag) + the always-on flight recorder's rejection-storm
         detector (ISSUE 11 — a run of consecutive rejections arms a
-        post-mortem dump)."""
+        post-mortem dump). ISSUE 14 adds OOM forensics: the FIRST
+        ``pool_exhausted`` verdict of a pressure episode arms a
+        deferred flight dump tagged with the triggering admission's
+        trace id (the scheduler's tick-end flush writes it, ledger +
+        fragmentation snapshot embedded); the arm re-enables once an
+        admission succeeds again."""
         telemetry.record_admission(res)
         self._flight.note_admission(res.admitted, res.reason)
+        if res.admitted:
+            self._pool_exhausted_armed = False
+        elif res.reason == "pool_exhausted" and not self._pool_exhausted_armed:
+            self._pool_exhausted_armed = True
+            cur = trace.current_trace()
+            self._flight.trigger(
+                "pool_exhausted",
+                immediate=False,
+                trace_id=cur[0] if cur is not None else None,
+                pages_in_use=self.allocator.pages_in_use,
+                pages_total=self.allocator.num_pages,
+                active_seqs=self.allocator.active_seqs,
+            )
 
     def _finish_admit(
         self,
@@ -842,6 +870,15 @@ class ServingEngine:
 
     def occupancy(self) -> dict:
         return self.allocator.occupancy()
+
+    def memory_snapshot(self) -> dict:
+        """JSON-safe memory forensics of this engine (ISSUE 14): the
+        priced serving ledger (pool split live/trie/free, CoW pages
+        once) + the page-granular fragmentation map — what the flight
+        recorder embeds in every post-mortem dump."""
+        from ..telemetry.memory import engine_memory_snapshot
+
+        return engine_memory_snapshot(self)
 
     def _record_pool(self) -> None:
         telemetry.record_kvcache_state(self.allocator.occupancy())

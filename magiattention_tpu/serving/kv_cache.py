@@ -419,6 +419,9 @@ class PageAllocator:
         # refcount per RESIDENT page (absent key = page is on the free
         # list); every owner — sequence slot or prefix cache — holds one
         self._page_refs: dict[int, int] = {}
+        # high-water mark of pages_in_use over this allocator's lifetime
+        # (ISSUE 14 pool forensics; updated on every page pop)
+        self._peak_pages_in_use = 0
 
     def pages_needed(self, num_tokens: int) -> int:
         return -(-max(int(num_tokens), 0) // self.page_size)
@@ -467,6 +470,9 @@ class PageAllocator:
     def _pop_free_page(self) -> int:
         page = self._free_pages.pop()
         self._page_refs[page] = 1
+        in_use = self.num_pages - len(self._free_pages)
+        if in_use > self._peak_pages_in_use:
+            self._peak_pages_in_use = in_use
         return page
 
     def _decref(self, page: int) -> bool:
@@ -647,6 +653,13 @@ class PageAllocator:
         return self.num_pages - len(self._free_pages)
 
     @property
+    def peak_pages_in_use(self) -> int:
+        """Lifetime high-water mark of resident pages (ISSUE 14): what
+        the pool ACTUALLY needed at its worst, next to what it holds
+        now — the capacity-planning number."""
+        return self._peak_pages_in_use
+
+    @property
     def shared_pages(self) -> int:
         """Resident pages with more than one reference (CoW-shared)."""
         return sum(1 for r in self._page_refs.values() if r > 1)
@@ -655,11 +668,41 @@ class PageAllocator:
     def active_seqs(self) -> int:
         return len(self._slot_pages)
 
+    def page_states(self) -> dict[str, tuple[int, ...]]:
+        """Exact ownership class of every page (ISSUE 14 forensics):
+
+        - ``free``: on the free list;
+        - ``live``: owned by exactly one sequence slot (ref 1);
+        - ``shared``: slot-owned with >1 reference (a CoW-shared prefix
+          page, and/or additionally pinned by the prefix trie);
+        - ``trie``: resident but owned by NO slot — the prefix cache's
+          reference is the only thing keeping it warm.
+
+        The four classes partition ``range(num_pages)`` (asserted by the
+        ledger parity tests); a page appears ONCE no matter how many
+        references it holds — residency, not reference, is what costs
+        pool capacity."""
+        slot_owned: set[int] = set()
+        for pages in self._slot_pages.values():
+            slot_owned.update(pages)
+        resident = set(self._page_refs)
+        live = tuple(sorted(
+            p for p in slot_owned if self._page_refs.get(p, 0) == 1
+        ))
+        shared = tuple(sorted(
+            p for p in slot_owned if self._page_refs.get(p, 0) > 1
+        ))
+        trie = tuple(sorted(resident - slot_owned))
+        free = tuple(sorted(self._free_pages))
+        return {"free": free, "live": live, "shared": shared, "trie": trie}
+
     def occupancy(self) -> dict:
         """Plain-dict pool state (the telemetry payload)."""
         return {
             "pages_total": self.num_pages,
             "pages_in_use": self.pages_in_use,
+            "free_pages": self.num_pages - self.pages_in_use,
+            "peak_pages_in_use": self._peak_pages_in_use,
             "occupancy_ratio": self.pages_in_use / max(self.num_pages, 1),
             "active_seqs": self.active_seqs,
             "shared_pages": self.shared_pages,

@@ -206,6 +206,12 @@ class Scheduler:
         self._finished: dict[int, RequestState] = {}
         self._step = 0
         self._flight = reqtrace.get_flight_recorder()
+        # OOM forensics (ISSUE 14): sustained low free-page fraction
+        # arms a mem_pressure flight dump (threshold from
+        # MAGI_ATTENTION_MEM_PRESSURE_THRESHOLD, 0 = off by default)
+        from ..telemetry.memory import MemPressureWatcher
+
+        self._mem_watcher = MemPressureWatcher()
 
     # -- submission ------------------------------------------------------
 
@@ -604,6 +610,26 @@ class Scheduler:
             budget_utilization=report.budget_utilization,
             queue_depth=report.queue_depth,
         )
+        # ISSUE 14: the admission watermark, observable — headroom the
+        # evictionless-admission rule demands vs the pages actually
+        # free — plus the sustained-pressure watcher: N consecutive
+        # ticks under the free-fraction threshold arm a mem_pressure
+        # flight dump (deferred; the flush below writes it with the
+        # ledger + fragmentation snapshots embedded)
+        alloc = self.engine.allocator
+        free = alloc.num_pages - alloc.pages_in_use
+        telemetry.record_admission_watermark(
+            self._admission_headroom(), free
+        )
+        if self._mem_watcher.observe(free / max(alloc.num_pages, 1)):
+            self._flight.trigger(
+                "mem_pressure",
+                immediate=False,
+                free_pages=free,
+                pages_total=alloc.num_pages,
+                threshold=self._mem_watcher.threshold,
+                consecutive_ticks=self._mem_watcher.ticks,
+            )
         self._flight.record_tick(
             {
                 "step": report.step,

@@ -40,6 +40,7 @@ from .aggregate import (  # noqa: F401
 from .collectors import (  # noqa: F401
     REQUIRED_ANALYSIS_METRICS,
     REQUIRED_DISTSERVE_METRICS,
+    REQUIRED_MEMORY_METRICS,
     REQUIRED_PLAN_CACHE_METRICS,
     REQUIRED_PLAN_METRICS,
     REQUIRED_PREFIX_METRICS,
@@ -51,6 +52,7 @@ from .collectors import (  # noqa: F401
     REQUIRED_TRACE_METRICS,
     REQUIRED_VALIDATE_METRICS,
     record_admission,
+    record_admission_watermark,
     record_analysis_run,
     record_autotune_cache,
     record_autotune_decision,
@@ -67,8 +69,13 @@ from .collectors import (  # noqa: F401
     record_guard_check,
     record_guard_repair,
     record_guard_violation,
+    record_hbm_sample,
     record_kvcache_state,
     record_measured_timeline,
+    record_memory_comparison,
+    record_memory_ledger,
+    record_memory_measurement,
+    record_memory_pool,
     record_overlap_choice,
     record_page_stream,
     record_plan,
@@ -122,6 +129,21 @@ from .trace import (  # noqa: F401
 from .occupancy import (  # noqa: F401
     BlockOccupancyMap,
     block_occupancy_map,
+)
+from .memory import (  # noqa: F401
+    LedgerEntry,
+    MemoryComparison,
+    MemoryLedger,
+    MemPressureWatcher,
+    PoolFragmentationMap,
+    engine_memory_snapshot,
+    fragmentation_map,
+    ledger_vs_measured,
+    measure_program_memory,
+    plan_memory_ledger,
+    sample_memory_stats,
+    serving_memory_ledger,
+    tiered_memory_ledger,
 )
 from .roofline import (  # noqa: F401
     RooflineReport,
@@ -195,10 +217,16 @@ __all__ = [
     "EventBuffer",
     "FlightRecorder",
     "HopTiming",
+    "LedgerEntry",
     "MeasuredTimeline",
+    "MemPressureWatcher",
+    "MemoryComparison",
+    "MemoryLedger",
     "MetricsRegistry",
     "MetricsServer",
+    "PoolFragmentationMap",
     "REQUIRED_ANALYSIS_METRICS",
+    "REQUIRED_MEMORY_METRICS",
     "REQUIRED_PLAN_METRICS",
     "REQUIRED_RESILIENCE_METRICS",
     "REQUIRED_ROOFLINE_METRICS",
@@ -218,19 +246,25 @@ __all__ = [
     "dump_request_traces",
     "dump_request_traces_jsonl",
     "enabled",
+    "engine_memory_snapshot",
     "ensure_metrics_server",
     "export_request_traces",
+    "fragmentation_map",
     "get_event_buffer",
     "get_flight_recorder",
     "get_logger",
     "get_registry",
+    "ledger_vs_measured",
+    "measure_program_memory",
     "merge_chrome_traces",
     "merge_snapshots",
     "parse_prometheus_text",
+    "plan_memory_ledger",
     "profile_key_timeline",
     "profile_plan_timeline",
     "profile_roofline",
     "record_admission",
+    "record_admission_watermark",
     "record_autotune_cache",
     "record_autotune_decision",
     "record_autotune_measure_failure",
@@ -247,7 +281,12 @@ __all__ = [
     "record_guard_check",
     "record_guard_repair",
     "record_guard_violation",
+    "record_hbm_sample",
     "record_measured_timeline",
+    "record_memory_comparison",
+    "record_memory_ledger",
+    "record_memory_measurement",
+    "record_memory_pool",
     "record_overlap_choice",
     "record_kvcache_state",
     "record_plan",
@@ -264,11 +303,14 @@ __all__ = [
     "record_tuning_cache_io_error",
     "record_validate",
     "reset",
+    "sample_memory_stats",
     "series_key",
+    "serving_memory_ledger",
     "set_enabled",
     "snapshot",
     "snapshot_delta",
     "span",
+    "tiered_memory_ledger",
     "start_metrics_server",
     "stop_metrics_server",
     "telemetry_summary",

@@ -97,6 +97,7 @@ def make_history_entry(
     autotune_rung: str | None = None,
     mask_density: dict | None = None,
     roofline_efficiency: dict | None = None,
+    peak_hbm_bytes: int | None = None,
 ) -> dict:
     """Canonical history-entry schema (one place, so bench.py and the
     seeding path can never drift).
@@ -104,7 +105,14 @@ def make_history_entry(
     ``mask_density`` / ``roofline_efficiency`` are per-metric context
     maps (``{metric_name: value}``) recorded NEXT TO the metrics, like
     ``autotune_rung`` — context for attributing a TF/s delta (workload
-    density changed vs kernel regressed), never gated themselves."""
+    density changed vs kernel regressed), never gated themselves.
+    ``peak_hbm_bytes`` (ISSUE 14) is the max across devices of the
+    allocator's ``peak_bytes_in_use`` high-water mark (the
+    ``telemetry/memory`` sampler; falls back to an instantaneous
+    post-run ``bytes_in_use`` where the runtime exposes no peak stat) —
+    memory context beside the density context, so a perf shift that
+    coincides with a footprint shift is attributable; absent on
+    backends without memory_stats (CPU)."""
     entry: dict = {
         "source": source,
         "metrics": {
@@ -129,6 +137,8 @@ def make_history_entry(
         entry["roofline_efficiency"] = {
             k: float(v) for k, v in sorted(roofline_efficiency.items())
         }
+    if peak_hbm_bytes:
+        entry["peak_hbm_bytes"] = int(peak_hbm_bytes)
     return entry
 
 

@@ -220,6 +220,40 @@ M_TIER_FAULTS = "magi_tier_faults_total"  # {tier=, replica=}
 M_TIER_PAGES_USED = "magi_tier_pages_in_use"  # {tier=, replica=}
 M_TIER_ACTIVE = "magi_tier_active_requests"  # {tier=}
 
+# gauges — memory observability (telemetry/memory.py; ISSUE 14; see
+# docs/observability.md "Memory ledger & OOM forensics"). The ledger
+# side ({ledger=, phase=}) is what the static pricing predicts; the
+# measured side ({program=, kind=argument|output|temp|alias}) is XLA's
+# compiled-executable memory_analysis; delta/unattributed pair them up
+# (delta gates args+outputs — both sides price those exactly —
+# unattributed is the honest temp residual, never folded into the gate)
+M_MEM_PREDICTED = "magi_mem_predicted_bytes"  # {ledger=, phase=}
+M_MEM_MEASURED = "magi_mem_measured_bytes"  # {program=, kind=}
+M_MEM_DELTA = "magi_mem_delta_ratio"  # {program=} predicted/measured io
+M_MEM_UNATTRIBUTED = "magi_mem_unattributed_bytes"  # {program=}
+# pool forensics ({pool=}): unusable-free-run fraction at the current
+# reservation granularity, longest free run, per-state page counts
+# ({state=free|live|shared|trie}; shared = CoW, counted once), and the
+# allocator's lifetime high-water mark
+M_MEM_POOL_FRAG = "magi_mem_pool_fragmentation_ratio"  # {pool=}
+M_MEM_POOL_FREE_RUN = "magi_mem_pool_free_run_max"  # {pool=}
+M_MEM_POOL_PAGES = "magi_mem_pool_pages"  # {pool=, state=}
+M_MEM_POOL_PEAK = "magi_mem_pool_peak_pages"  # {pool=}
+# device HBM sampler ({device=}) — populated only where the backend
+# exposes memory_stats (TPU/GPU; CPU runs record nothing), so NOT part
+# of REQUIRED_MEMORY_METRICS
+M_MEM_HBM_IN_USE = "magi_mem_hbm_bytes_in_use"  # {device=}
+M_MEM_HBM_PEAK = "magi_mem_hbm_peak_bytes"  # process high-water
+# admission watermark (ISSUE 13's headroom rule, made observable in
+# ISSUE 14): free pages an evictionless admission must leave for decode
+# growth, and the pool's current free pages — the pair a dashboard
+# needs to see backpressure coming. BOTH are single-sourced from the
+# scheduler's per-tick record_admission_watermark, which reads the
+# admission-facing allocator — so a TieredEngine's decode replicas can
+# never clobber the prefill-pool figure the headroom pairs with
+M_SCHED_HEADROOM = "magi_sched_admission_headroom"
+M_KVCACHE_FREE = "magi_kvcache_free_pages"
+
 # counters — request-lifecycle tracing (telemetry/trace.py; ISSUE 11).
 # traces started (one per Scheduler.submit); ring spans dropped
 # (M_TRACE_DROPPED, defined next to the ring in events.py — nonzero
@@ -388,6 +422,26 @@ REQUIRED_TRACE_METRICS: tuple[str, ...] = (
     M_REQ_TRACES,
     M_TRACE_DROPPED,
     M_FLIGHT_DUMPS,
+)
+
+# populated by one ledger_vs_measured pass over the jitted decode /
+# dist_attn programs plus a live serving trace (pool forensics +
+# admission watermark); asserted by make memory-check
+# (exps/run_memory_check.py), documented in docs/observability.md
+# "Memory ledger & OOM forensics". The HBM sampler gauges are
+# deliberately absent: CPU backends expose no memory_stats, and a
+# REQUIRED metric must be populatable everywhere the check runs
+REQUIRED_MEMORY_METRICS: tuple[str, ...] = (
+    M_MEM_PREDICTED,
+    M_MEM_MEASURED,
+    M_MEM_DELTA,
+    M_MEM_UNATTRIBUTED,
+    M_MEM_POOL_FRAG,
+    M_MEM_POOL_FREE_RUN,
+    M_MEM_POOL_PAGES,
+    M_MEM_POOL_PEAK,
+    M_SCHED_HEADROOM,
+    M_KVCACHE_FREE,
 )
 
 
@@ -930,6 +984,114 @@ def record_kvcache_state(occupancy: dict) -> None:
     reg.gauge_set(M_KVCACHE_ACTIVE_SEQS, int(occupancy["active_seqs"]))
     reg.gauge_set(M_KVCACHE_PAGE_SIZE, int(occupancy["page_size"]))
     reg.gauge_set(M_KVCACHE_SHARED, int(occupancy.get("shared_pages", 0)))
+    # magi_kvcache_free_pages is deliberately NOT set here: every
+    # engine's _record_pool runs this collector, and on a TieredEngine
+    # the decode replicas would overwrite the admission-facing prefill
+    # pool's figure — the one the headroom gauge pairs with. The
+    # scheduler's per-tick record_admission_watermark is the single
+    # source (it reads the admission-facing allocator).
+
+
+# ---------------------------------------------------------------------------
+# memory observability (telemetry/memory.py; ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def record_memory_ledger(ledger) -> None:
+    """One static memory-ledger pricing (``telemetry/memory.py``
+    :class:`MemoryLedger`): per-phase predicted bytes plus the total,
+    labeled with the ledger name so plan/serving/tier ledgers keep
+    separate series. Overwrite semantics per (ledger, phase): a
+    re-priced configuration with FEWER phases should use a fresh name
+    (how the checks do) rather than rely on stale-phase clearing."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    for phase, b in ledger.by_phase().items():
+        reg.gauge_set(M_MEM_PREDICTED, int(b), ledger=ledger.name,
+                      phase=phase)
+    reg.gauge_set(M_MEM_PREDICTED, int(ledger.total()),
+                  ledger=ledger.name, phase="total")
+
+
+def record_memory_measurement(program: str, measured: dict) -> None:
+    """One XLA compiled-executable memory analysis
+    (``measure_program_memory`` payload): argument/output/temp/alias
+    bytes of a jitted program."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    for kind in ("argument", "output", "temp", "alias"):
+        v = measured.get(f"{kind}_bytes")
+        if v is not None:
+            reg.gauge_set(M_MEM_MEASURED, int(v), program=program,
+                          kind=kind)
+
+
+def record_memory_comparison(cmp) -> None:
+    """One predicted-vs-measured verdict
+    (``telemetry/memory.MemoryComparison``): the gated io delta ratio
+    and the honest unattributed temp residual."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.gauge_set(M_MEM_DELTA, float(cmp.delta_ratio), program=cmp.program)
+    reg.gauge_set(
+        M_MEM_UNATTRIBUTED, int(cmp.unattributed_bytes),
+        program=cmp.program,
+    )
+    _marker_event(
+        "memory_probe",
+        {
+            "program": cmp.program,
+            "predicted_io_bytes": cmp.predicted_io_bytes,
+            "measured_io_bytes": cmp.measured_io_bytes,
+            "delta_ratio": cmp.delta_ratio,
+            "unattributed_bytes": cmp.unattributed_bytes,
+        },
+    )
+
+
+def record_memory_pool(fmap) -> None:
+    """One pool-forensics snapshot (``telemetry/memory.
+    PoolFragmentationMap``): fragmentation ratio, longest free run,
+    per-state page counts, lifetime peak."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    p = fmap.pool
+    reg.gauge_set(M_MEM_POOL_FRAG, float(fmap.fragmentation_ratio), pool=p)
+    reg.gauge_set(M_MEM_POOL_FREE_RUN, int(fmap.free_run_max), pool=p)
+    reg.gauge_set(M_MEM_POOL_PEAK, int(fmap.peak_pages), pool=p)
+    for state, count in fmap.state_counts().items():
+        reg.gauge_set(M_MEM_POOL_PAGES, int(count), pool=p, state=state)
+
+
+def record_hbm_sample(samples: dict) -> None:
+    """One device memory_stats sample (``telemetry/memory.
+    sample_memory_stats``): bytes_in_use per device plus the running
+    process-wide peak. Empty samples (CPU) record nothing."""
+    if not _enabled() or not samples:
+        return
+    reg = get_registry()
+    peak = 0
+    for dev, b in samples.items():
+        reg.gauge_set(M_MEM_HBM_IN_USE, int(b), device=str(dev))
+        peak = max(peak, int(b))
+    prev = reg.gauge_value(M_MEM_HBM_PEAK, default=0)
+    reg.gauge_set(M_MEM_HBM_PEAK, max(int(prev or 0), peak))
+
+
+def record_admission_watermark(headroom: int, free_pages: int) -> None:
+    """The scheduler's per-tick admission watermark (ISSUE 13's rule,
+    observable since ISSUE 14): pages an evictionless admission must
+    leave free for decode growth, next to the pool's actual free
+    pages — ``free - headroom`` trending to 0 is backpressure arriving."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.gauge_set(M_SCHED_HEADROOM, int(headroom))
+    reg.gauge_set(M_KVCACHE_FREE, int(free_pages))
 
 
 # ---------------------------------------------------------------------------
@@ -1233,5 +1395,20 @@ def telemetry_summary(snapshot: dict | None = None) -> str:
             f"active seqs {fmt(g.get(M_KVCACHE_ACTIVE_SEQS))}  "
             f"page size {fmt(g.get(M_KVCACHE_PAGE_SIZE))}  "
             f"prefill tokens {fmt(c.get(M_PREFILL_TOKENS, 0))}"
+        )
+    # one line per compared program: predicted-vs-measured io bytes +
+    # the honest unattributed temp residual (ISSUE 14)
+    from .registry import series_key
+
+    for key in sorted(k for k in g if k.startswith(M_MEM_DELTA + "{")):
+        labels = key[len(M_MEM_DELTA):]
+        prog = labels[len("{program="):-1]
+        pred = g.get(series_key(
+            M_MEM_PREDICTED, {"ledger": prog, "phase": "total"}
+        ))
+        lines.append(
+            f"  memory probe{labels}: predicted {fmt(pred)} B, "
+            f"io delta {fmt(g.get(key))}, unattributed "
+            f"{fmt(g.get(M_MEM_UNATTRIBUTED + labels))} B temp"
         )
     return "\n".join(lines)

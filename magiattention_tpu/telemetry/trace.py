@@ -46,6 +46,7 @@ import json
 import os
 import threading
 import time
+import weakref
 from typing import Sequence
 
 # ---------------------------------------------------------------------------
@@ -570,6 +571,11 @@ class FlightRecorder:
         self._last_tick_start: float | None = None
         self._dump_count = 0
         self.dump_paths: list[str] = []
+        # memory-forensics sources (ISSUE 14): weakly-held objects with
+        # a memory_snapshot() method (engines register themselves);
+        # every dump embeds their ledger + fragmentation snapshots
+        self._memory_sources: list[tuple[str, weakref.ref]] = []
+        self._source_counter = 0
 
     @property
     def enabled(self) -> bool:
@@ -597,19 +603,23 @@ class FlightRecorder:
     def note_admission(self, admitted: bool, reason: str = "ok") -> None:
         """One engine admission verdict; a run of ``storm_threshold``
         consecutive rejections arms a ``admission_rejection_storm``
-        dump (re-armed only after the storm breaks)."""
+        dump (re-armed only after the storm breaks). The verdict record
+        — and any storm trigger it tips — carries the trace id of the
+        request being admitted when a ``request_context`` is live, so a
+        post-mortem names the admission that broke the camel's back."""
         if not self.enabled:
             return
+        cur = current_trace()
         storm = False
         with self._lock:
-            self._append(
-                self._admissions,
-                {
-                    "t": time.perf_counter(),
-                    "admitted": bool(admitted),
-                    "reason": reason,
-                },
-            )
+            rec = {
+                "t": time.perf_counter(),
+                "admitted": bool(admitted),
+                "reason": reason,
+            }
+            if cur is not None:
+                rec["trace_id"] = cur[0]
+            self._append(self._admissions, rec)
             if admitted:
                 self._consecutive_rejections = 0
             else:
@@ -623,6 +633,7 @@ class FlightRecorder:
                 immediate=False,
                 consecutive_rejections=self.storm_threshold,
                 reason=reason,
+                trace_id=cur[0] if cur is not None else None,
             )
 
     def trigger(self, signal: str, *, immediate: bool = True, **context):
@@ -661,10 +672,65 @@ class FlightRecorder:
             return False
         return time.perf_counter() - rec["t"] > self.ARM_TTL_S
 
+    def register_memory_source(self, name: str, obj) -> str:
+        """Attach a memory-forensics source (ISSUE 14): ``obj`` must
+        expose ``memory_snapshot() -> dict`` (JSON-safe; ledger +
+        fragmentation map — see ``telemetry/memory.
+        engine_memory_snapshot``). Held weakly, so a retired engine
+        never pins itself or stales the recorder; every subsequent dump
+        embeds a ``memory`` section with one entry per live source.
+        Returns the (uniquified) registered name."""
+        with self._lock:
+            # prune dead sources here too: churny construction (tests,
+            # the lifecycle model checker) must not grow the list
+            # unboundedly between dumps
+            self._memory_sources = [
+                (n, r) for n, r in self._memory_sources
+                if r() is not None
+            ]
+            self._source_counter += 1
+            uname = f"{name}#{self._source_counter}"
+            self._memory_sources.append((uname, weakref.ref(obj)))
+        return uname
+
+    def _collect_memory(self) -> dict | None:
+        """Snapshot every live memory source (best-effort — forensics
+        must never turn a dump into a crash). Runs OUTSIDE the ring
+        lock: sources execute arbitrary ledger code that may itself
+        touch the recorder. Dead weakrefs are pruned."""
+        with self._lock:
+            sources = list(self._memory_sources)
+        out: dict = {}
+        alive: list[tuple[str, weakref.ref]] = []
+        for name, ref in sources:
+            obj = ref()
+            if obj is None:
+                continue
+            alive.append((name, ref))
+            try:
+                out[name] = obj.memory_snapshot()
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                out[name] = {"error": repr(e)}
+        with self._lock:
+            # prune dead refs from the CURRENT list (never replace it
+            # wholesale: a source registered while the snapshots ran
+            # above must survive into future dumps)
+            self._memory_sources = [
+                (n, r) for n, r in self._memory_sources
+                if r() is not None
+            ]
+        return out or None
+
     def flush(self) -> str | None:
         """Write the armed dump, if any (no-op otherwise). Returns the
         dump path (None when nothing was armed, the tick ring is empty,
         or the per-process dump cap was reached)."""
+        with self._lock:
+            armed = self._armed is not None
+        # ledger + fragmentation snapshots are collected lock-free and
+        # only when a dump is plausibly coming (the OOM-forensics
+        # payload: what the pools looked like at the incident)
+        memory = self._collect_memory() if armed else None
         with self._lock:
             rec = self._armed
             if rec is None:
@@ -686,6 +752,8 @@ class FlightRecorder:
                 "admissions": list(self._admissions),
                 "wall_time": time.time(),
             }
+            if memory is not None:
+                payload["memory"] = memory
             n = self._dump_count
         path = self._write_dump(payload, n)
         if path is not None:

@@ -599,3 +599,54 @@ def test_aggregate_decode_scales_with_replicas(telemetry_on):
         ticks = len([r for r in reports if r.decode_ran])
         tokens_per_tick[dp] = total / ticks
     assert tokens_per_tick[2] > tokens_per_tick[1], tokens_per_tick
+
+
+def test_tier_memory_ledger_split(telemetry_on):
+    """ISSUE 14 tier-split correctness on the emulated 8-device mesh:
+    per-tier ledgers each price their OWN pool exactly, and a streamed
+    prompt's pages move from the prefill tier's live class to exactly
+    one decode replica's — the fleet totals conserve."""
+    from magiattention_tpu.telemetry.memory import tiered_memory_ledger
+
+    rng = np.random.default_rng(21)
+    eng = _tiered({"prefill": 1, "decode_dp": 2, "decode_tp": 2})
+    page_bytes = 2 * 8 * HK * D * 4  # ps=8, float32 pools
+    leds = tiered_memory_ledger(eng)
+    assert set(leds) == {"tier_prefill", "tier_decode_r0", "tier_decode_r1"}
+    for led in leds.values():
+        # every tier's pool ledger covers its whole 64-page pool
+        assert led.total("pool") == 64 * page_bytes
+    toks = list(rng.integers(0, VOCAB, 17))  # 3 pages (2 full + 1 part)
+    res = eng.admit(len(toks), tokens=toks)
+    k, v = _kv_of(toks)
+    q = jnp.asarray(rng.standard_normal((len(toks), HQ, D)), jnp.float32)
+    eng.prefill(q, k, v, res.slot)  # completes -> streams to a replica
+    rec = eng._seq[res.slot]
+    assert rec["stage"] == "decode"
+    leds = tiered_memory_ledger(eng)
+
+    def pages(led, comp):
+        return next(
+            e for e in led.entries if e.component == comp
+        ).nbytes // page_bytes
+
+    # prefill tier: the slot retired; only the trie's resident prefix
+    # copy (2 full pages + the partial tail its node keeps) remains
+    assert pages(leds["tier_prefill"], "pages_live") == 0
+    assert pages(leds["tier_prefill"], "pages_trie") == 3
+    # exactly the chosen replica holds the streamed pages, live
+    live = {
+        r: pages(leds[f"tier_decode_r{r}"], "pages_live") for r in (0, 1)
+    }
+    assert live[rec["replica"]] == 3
+    assert live[1 - rec["replica"]] == 0
+    # conservation per tier: live + trie + free == the whole pool
+    for led in leds.values():
+        assert led.total("pool") == 64 * page_bytes
+    # the aggregated flight-recorder snapshot carries the same split
+    snap = eng.memory_snapshot()
+    assert set(snap) >= {"tier_prefill", "tier_decode_r0", "tier_decode_r1"}
+    states = snap[f"tier_decode_r{rec['replica']}"]["fragmentation"][
+        "state_counts"
+    ]
+    assert states["live"] == 3
