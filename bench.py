@@ -179,6 +179,28 @@ def _bench_autotune_rung() -> "str | None":
         return None
 
 
+def _bench_varlen_rung() -> "str | None":
+    """The 16k-varlen headline's resolved rung INCLUDING the grid
+    layout, ``"BQxBKxHB:grid"`` (ISSUE 15): the sparse-grid kernel is
+    what the varlen TF/s extra measures now, and a silent fallback to
+    the row-major grid (or a rung change) must be attributable when the
+    number moves — same host-side re-query discipline as
+    :func:`_bench_autotune_rung`."""
+    try:
+        from magiattention_tpu.ops.flex_attn import auto_kernel_config
+
+        qr, kr, ts = _varlen_slices()
+        bq, bk, hb, grid = auto_kernel_config(
+            qr, kr, _HEADLINE_HQ, _HEADLINE_HK,
+            attn_type_map=ts, head_dim=_HEADLINE_D,
+            dtype=_HEADLINE_DTYPE,
+        )
+        return f"{bq}x{bk}x{hb}:{grid}"
+    except Exception as e:
+        print(f"varlen rung query failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def _bench_mask_profile(metrics: dict) -> "tuple[dict, dict]":
     """Per-metric (mask_density, roofline_efficiency) context maps for
     the benched workloads (ISSUE 10): density = true entries / dense S²
@@ -243,6 +265,7 @@ def _append_history(meta: dict, extras: dict) -> None:
                 device=meta.get("device"),
                 vs_baseline=meta.get("vs_baseline"),
                 autotune_rung=_bench_autotune_rung(),
+                varlen_rung=_bench_varlen_rung(),
                 mask_density=densities,
                 roofline_efficiency=efficiencies,
                 peak_hbm_bytes=meta.get("peak_hbm_bytes"),

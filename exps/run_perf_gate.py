@@ -58,13 +58,17 @@ DEFAULT_EXPECTATIONS = os.path.join(_ROOT, baseline.EXPECTATIONS_RELPATH)
 
 def _gated_metric(name: str) -> bool:
     """Gate our kernel/runtime metrics only: ``flex_attn_*`` TF/s plus
-    the group-collective scheduled-volume reduction ratio (ISSUE 5;
-    higher = better, like TF/s — a regression in scheduled comm volume
-    lowers it). Stock-kernel controls (``jax_flash_*``) and one-off
-    bring-up metrics stay in history for the record but never fail the
-    gate."""
+    the group-collective scheduled-volume reduction ratio (ISSUE 5) and
+    the sparse-grid step-reduction ratio (ISSUE 15; model-derived,
+    seeded by ``run_roofline_report.py --seed-history``) — all higher =
+    better, like TF/s: a regression in scheduled comm volume or in the
+    sparse grid's step elimination lowers them. Stock-kernel controls
+    (``jax_flash_*``) and one-off bring-up metrics stay in history for
+    the record but never fail the gate."""
     return name.startswith("flex_attn_") and (
-        "tflops" in name or "comm_volume" in name
+        "tflops" in name
+        or "comm_volume" in name
+        or "step_reduction" in name
     )
 
 

@@ -79,6 +79,13 @@ def main() -> int:
         "--occupancy-out", default=None,
         help="occupancy JSON path (default exps/data/occupancy_*.json)",
     )
+    p.add_argument(
+        "--seed-history",
+        action="store_true",
+        help="append the sparse-grid step-reduction metric to "
+        "BENCH_HISTORY.jsonl (TF/s carried forward from the newest "
+        "entry) so run_perf_gate.py gates it",
+    )
     args = p.parse_args()
 
     from magiattention_tpu.telemetry.occupancy import block_occupancy_map
@@ -140,7 +147,103 @@ def main() -> int:
         f"tiles, block density {occ.block_density:.4f}; the block-sparse "
         "grid input of ROADMAP item 1)"
     )
+
+    # ISSUE 15 acceptance on the headline workload: the autotuner must
+    # resolve it to the compact sparse grid — dead-step fraction ~0 and
+    # a >= 6x grid-step reduction over the best row-major candidate
+    # (the configuration the 8.44 TF/s was measured on)
+    headline = (
+        args.workload == "varlen_block_causal" and args.total == 16384
+    )
+    if headline:
+        from magiattention_tpu.tuning import rank_candidates
+
+        if rep.grid != "sparse":
+            print(
+                f"FAIL: headline workload resolved to grid={rep.grid!r}, "
+                "not the block-sparse grid (ISSUE 15 regression)"
+            )
+            return 1
+        dead_frac = rep.gap_fractions()["dead_steps"]
+        if rep.dead_slots != 0 or dead_frac > 1e-9:
+            print(
+                f"FAIL: headline dead-step fraction {dead_frac:.2%} "
+                f"({rep.dead_slots} dead slots) != ~0 on the sparse grid"
+            )
+            return 1
+        rm = rank_candidates(
+            qr, kr, ts, args.heads, args.kv_heads,
+            head_dim=args.head_dim, generation=args.generation,
+            include_sparse=False,
+        )[0]
+        rm_slots = rm.grid_slots
+        sparse_slots = rep.live_slots + rep.dead_slots
+        reduction = rm_slots / max(sparse_slots, 1)
+        print(
+            f"sparse-grid step reduction: {rm_slots} row-major slots "
+            f"({rm.block_q}x{rm.block_k}x{rm.head_block}) -> "
+            f"{sparse_slots} sparse slots "
+            f"({rep.block_q}x{rep.block_k}x{rep.head_block}) = "
+            f"{reduction:.2f}x (dead-step fraction {dead_frac:.1%})"
+        )
+        if reduction < 6.0:
+            print(
+                f"FAIL: step reduction {reduction:.2f}x < the 6x "
+                "acceptance floor (ISSUE 15)"
+            )
+            return 1
+        if args.seed_history:
+            _seed_history(reduction)
+    elif args.seed_history:
+        print("--seed-history only applies to the 16k varlen headline")
+        return 1
     return 0
+
+
+STEP_REDUCTION_METRIC = (
+    "flex_attn_sparse_grid_step_reduction_16k_varlen_block_causal"
+)
+
+
+def _seed_history(reduction: float) -> None:
+    """Append a BENCH_HISTORY entry carrying the sparse-grid
+    step-reduction ratio (a model-derived, higher-is-better metric the
+    perf gate windows like a TF/s: a cost-model or rung regression that
+    shrinks it trips the gate). TF/s metrics are carried forward from
+    the newest entry — this is NOT an on-chip measurement and says so in
+    its source string (the run_comm_check --seed-history convention)."""
+    from magiattention_tpu.telemetry import baseline
+
+    path = os.path.join(_ROOT, baseline.HISTORY_FILENAME)
+    history = baseline.load_history(path)
+    metrics = {
+        k: v
+        for k, v in baseline.newest_metrics(history).items()
+        if k.startswith("flex_attn_")
+    }
+    metrics[STEP_REDUCTION_METRIC] = round(float(reduction), 3)
+    rung = next(
+        (
+            e["autotune_rung"]
+            for e in reversed(history)
+            if e.get("autotune_rung")
+        ),
+        None,
+    )
+    entry = baseline.make_history_entry(
+        source=(
+            "exps/run_roofline_report.py --seed-history (sparse-grid "
+            "step reduction from the cost model; TF/s carried forward "
+            "from the newest entry)"
+        ),
+        metrics=metrics,
+        autotune_rung=rung,
+    )
+    baseline.append_history(path, entry)
+    print(
+        f"history appended -> {path} ({STEP_REDUCTION_METRIC} = "
+        f"{metrics[STEP_REDUCTION_METRIC]})"
+    )
 
 
 if __name__ == "__main__":

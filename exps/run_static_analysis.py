@@ -153,6 +153,12 @@ def run_trace_audit(update: bool) -> tuple[list[str], dict]:
     errors += e
     report["serving_upcasts"] = serving_census
 
+    # ISSUE 15: the compact sparse-grid kernel's own trace contract
+    # (zero collectives, out bf16 / lse f32, stable AMLA upcast census)
+    e, sparse_census = ta.audit_sparse_grid(expectations)
+    errors += e
+    report["sparse_grid_upcasts"] = sparse_census
+
     e, r = ta.audit_hier_cast_levels()
     errors += e
     report.update(r)
@@ -170,6 +176,9 @@ def run_trace_audit(update: bool) -> tuple[list[str], dict]:
         payload.update({k: dict(sorted(v.items())) for k, v in census.items()})
         payload.update(
             {k: dict(sorted(v.items())) for k, v in serving_census.items()}
+        )
+        payload.update(
+            {k: dict(sorted(v.items())) for k, v in sparse_census.items()}
         )
         with open(EXPECTATIONS, "w") as f:
             json.dump(payload, f, indent=2, sort_keys=True)

@@ -893,3 +893,92 @@ def audit_hier_cast_levels() -> tuple[list[str], dict]:
     from .spmd_audit import audit_hier_matrix
 
     return audit_hier_matrix(meshes=((2, 2),), per_rank=False)
+
+
+def audit_sparse_grid(
+    expectations: dict | None,
+) -> tuple[list[str], dict]:
+    """ISSUE 15: the compact sparse-grid flex kernel's trace contract.
+
+    Traces the PALLAS sparse-grid forward (interpret-mode ``pallas_call``
+    — the kernel jaxpr is identical to the compiled one at trace level)
+    on a small varlen block-causal mask in bf16 and asserts:
+
+    - zero collectives (a single-device kernel must trace none),
+    - the dtype contract: out bf16, lse f32 (the AMLA base-2 softmax and
+      exponent-add rescaling must not silently upcast the output), and
+    - a stable bf16->f32 upcast census vs the checked-in expectations
+      (key ``flex_fwd_bf16_sparse_grid_varlen``) — drift = a new silent
+      promotion inside the sparse kernel.
+    """
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.block_meta import build_block_meta
+    from ..ops.flex_attn import (
+        FlexAttnParams,
+        _flex_attn_core,
+        bwd_tables,
+        fwd_tables,
+    )
+
+    name = "flex_fwd_bf16_sparse_grid_varlen"
+    errors: list[str] = []
+    qr = [(0, 192), (192, 512)]
+    kr = [(0, 192), (192, 512)]
+    ts = [1, 1]
+    meta = build_block_meta(qr, kr, ts, 512, 512, block_q=64, block_k=64)
+    # the differentiable Pallas core directly (head-major operands): the
+    # audit must trace the sparse KERNEL regardless of the process-wide
+    # MAGI_ATTENTION_KERNEL_BACKEND (the analyze gate pins jnp), and the
+    # core is the one layer below that dispatch
+    params = FlexAttnParams(
+        block_q=64,
+        block_k=64,
+        scale=1.0 / math.sqrt(64),
+        softcap=0.0,
+        has_sink=False,
+        out_dtype="bfloat16",
+        interpret=True,
+        grid="sparse",
+    )
+    qh = jnp.zeros((4, 512, 64), jnp.bfloat16)
+    sink2d = jnp.zeros((4, 1), jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda q_, k_, v_: _flex_attn_core(
+            q_, k_, v_, sink2d, fwd_tables(meta), bwd_tables(meta), params
+        )
+    )(qh, qh, qh)
+
+    census = collective_census(jaxpr)
+    if census:
+        errors.append(
+            f"sparse-grid flex fwd traced collectives {_fmt(census)} — "
+            "the single-device sparse kernel must be collective-free"
+        )
+    out_aval, lse_aval = jaxpr.out_avals[0], jaxpr.out_avals[1]
+    if str(out_aval.dtype) != "bfloat16":
+        errors.append(
+            f"sparse-grid out dtype {out_aval.dtype} != bfloat16 — the "
+            "AMLA epilogue upcast the kernel output"
+        )
+    if str(lse_aval.dtype) != "float32":
+        errors.append(f"sparse-grid lse dtype {lse_aval.dtype} != float32")
+    upcasts = upcast_census(jaxpr)
+    if expectations is not None:
+        want = expectations.get(name)
+        if want is None:
+            errors.append(
+                f"no upcast expectation recorded for {name} — run "
+                "exps/run_static_analysis.py --update"
+            )
+        elif {k: int(v) for k, v in want.items()} != upcasts:
+            errors.append(
+                f"{name}: upcast census {_fmt(upcasts)} drifted from "
+                f"recorded {_fmt(want)} — a new bf16->f32 promotion "
+                "appeared in the sparse kernel (fix it, or --update "
+                "after an intentional change)"
+            )
+    return errors, {name: upcasts}

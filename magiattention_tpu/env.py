@@ -300,6 +300,22 @@ def autotune_mode() -> str:
     return _env_str("MAGI_ATTENTION_AUTOTUNE", "model").strip().lower()
 
 
+def grid_override() -> str | None:
+    """Pinned flex-kernel grid layout, or None (auto). 'row_major' keeps
+    the static (heads, q-blocks, steps) grid, 'sparse' forces the
+    compact occupied-entry walk (``ops/flex_attn.py`` GRID_KINDS) — the
+    A/B lever for benching the two grids at a fixed blocking."""
+    v = _env_str("MAGI_ATTENTION_GRID", "auto").strip().lower()
+    if v in ("", "auto"):
+        return None
+    if v not in ("row_major", "sparse"):
+        raise ValueError(
+            f"MAGI_ATTENTION_GRID={v!r} must be 'auto', 'row_major', or "
+            "'sparse'"
+        )
+    return v
+
+
 def autotune_cache_dir() -> str:
     """Disk directory backing the tuning cache ('' = process-level cache
     only). Winners are stored per workload fingerprint; see

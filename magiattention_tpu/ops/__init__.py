@@ -1,7 +1,12 @@
 """Pallas TPU kernels + host-side kernel planning."""
 
 from .block_meta import FlexAttnBlockMeta, build_block_meta
-from .block_sparse import block_sparse_attn_func, build_block_meta_from_block_mask
+from .block_sparse import (
+    BlockEnumeration,
+    block_sparse_attn_func,
+    build_block_meta_from_block_mask,
+    build_block_meta_from_occupancy,
+)
 from .correction import (
     correct_attn_lse,
     correct_attn_lse_with_sink,
@@ -16,8 +21,10 @@ from .index_attn import index_attn_func, sparse_load_attn_func
 from .range_merge import merge_ranges
 
 __all__ = [
+    "BlockEnumeration",
     "FlexAttnBlockMeta",
     "block_sparse_attn_func",
+    "build_block_meta_from_occupancy",
     "correct_attn_lse",
     "correct_attn_lse_with_sink",
     "correct_attn_out",

@@ -1,4 +1,5 @@
-"""Block-sparse attention: drive the flex kernel from a block mask.
+"""Block-sparse attention: the shared block-enumeration primitive plus
+the block-mask driver for the flex kernel.
 
 Role of reference block-sparse / sparse-load modes (flex_flash_attn.py
 sparse options :1110-1123, utils/sparse_utils.py, tests/
@@ -13,19 +14,259 @@ kept-block list into the kernel's SMEM bounds table (~33k slices x 20 B
 at 64k keep-4th: past the ~1 MB SMEM budget, crashing compilation);
 per-entry windows cost nothing extra because every entry carries them
 anyway.
+
+:class:`BlockEnumeration` is the ONE sparse-core primitive under
+prefill, decode, and cascade (ROADMAP item 1): a flattened major->minor
+block walk — per major row, the sorted list of minor blocks it touches —
+with the row tables and clamped entry lookup every sparse consumer
+needs. The flex kernels' compact sparse grid walks it over the entry
+tables (``ops/flex_attn.py``), the split-KV decode kernel walks it over
+the paged block table (``serving/decode_attn.py``), and the occupancy
+profiler's JSON artifact (``telemetry/occupancy.py``,
+``exps/data/occupancy_*.json``) loads straight into it
+(:meth:`BlockEnumeration.from_occupancy`).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import numpy as np
 
 from .block_meta import (
     FlexAttnBlockMeta,
+    _slice_k_span,
     _sub_area,
     assemble_block_meta,
 )
+
+
+# ---------------------------------------------------------------------------
+# the shared block-enumeration primitive
+# ---------------------------------------------------------------------------
+
+
+def row_tables(major, num_rows: int):
+    """Per-major-row ``(start, count)`` over a SORTED major array.
+
+    Works on numpy arrays and traced jax arrays alike (searchsorted) —
+    these are the two extra scalar-prefetch operands of every sparse
+    consumer: the flex kernels' compact grid uses them to detect the
+    first/last entry of an output row, the row-major kernels to clamp
+    dead steps, the decode kernel to locate a (sequence, split) row's
+    pages.
+    """
+    import jax.numpy as jnp
+
+    if isinstance(major, np.ndarray):
+        idx = np.arange(num_rows, dtype=major.dtype)
+        rs = np.searchsorted(major, idx, side="left").astype(np.int32)
+        re = np.searchsorted(major, idx, side="right").astype(np.int32)
+        return rs, (re - rs).astype(np.int32)
+    idx = jnp.arange(num_rows, dtype=major.dtype)
+    rs = jnp.searchsorted(major, idx, side="left").astype(jnp.int32)
+    re = jnp.searchsorted(major, idx, side="right").astype(jnp.int32)
+    return rs, re - rs
+
+
+def clamped_entry(row_start, row_count, i, j):
+    """Entry index for step j of major row i: the row's entries occupy
+    ``row_start[i] .. row_start[i]+row_count[i]``; steps past the count
+    clamp to the last live entry (same minor block -> no fresh DMA) and
+    the caller skips compute via ``j < row_count[i]``. Shared by the
+    kernel bodies and the launchers' minor-side index maps — the two
+    MUST agree or the DMA'd block and the entry the kernel evaluates
+    silently diverge."""
+    import jax.numpy as jnp
+
+    if isinstance(row_start, np.ndarray):
+        return row_start[i] + min(j, max(int(row_count[i]) - 1, 0))
+    return row_start[i] + jnp.minimum(j, jnp.maximum(row_count[i] - 1, 0))
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class BlockEnumeration:
+    """Flattened major->minor block walk: entry e pairs major row
+    ``major[e]`` with minor block ``minor[e]``; ``major`` is sorted
+    ascending so each row's entries are consecutive —
+    ``row_start[i] .. row_start[i]+row_count[i]``. Arrays may be host
+    numpy (kernel planning) or traced jax values (the decode block
+    table); a row with no entries has ``row_count == 0``."""
+
+    num_rows: int
+    major: np.ndarray  # [E] sorted row id per entry
+    minor: np.ndarray  # [E] minor block id per entry
+    row_start: np.ndarray  # [num_rows]
+    row_count: np.ndarray  # [num_rows]
+
+    @property
+    def num_entries(self) -> int:
+        return int(self.major.shape[0])
+
+    def entry(self, i, j):
+        """Clamped entry index of step j in row i (see
+        :func:`clamped_entry`)."""
+        return clamped_entry(self.row_start, self.row_count, i, j)
+
+    def occupied_pairs(self) -> np.ndarray:
+        """[E, 2] (major, minor) pairs — the brute-force-scan parity
+        surface (host arrays only)."""
+        return np.stack(
+            [np.asarray(self.major), np.asarray(self.minor)], axis=1
+        )
+
+    @staticmethod
+    def from_sorted(major, minor, num_rows: int) -> "BlockEnumeration":
+        """Wrap already-sorted (major, minor) arrays — the flex entry
+        tables' orientation (numpy or traced jax)."""
+        rs, rc = row_tables(major, num_rows)
+        return BlockEnumeration(
+            num_rows=int(num_rows),
+            major=major,
+            minor=minor,
+            row_start=rs,
+            row_count=rc,
+        )
+
+    @staticmethod
+    def from_active_lists(
+        active, num_rows: int | None = None
+    ) -> "BlockEnumeration":
+        """Host-side construction from per-row active-minor lists — the
+        exact ``active_k_blocks`` shape the occupancy profiler emits."""
+        rows = [sorted(int(b) for b in row) for row in active]
+        if num_rows is None:
+            num_rows = len(rows)
+        if len(rows) != num_rows:
+            raise ValueError(
+                f"block enumeration: {len(rows)} active rows != "
+                f"num_rows {num_rows}"
+            )
+        counts = np.asarray([len(r) for r in rows], dtype=np.int32)
+        major = np.repeat(
+            np.arange(num_rows, dtype=np.int32), counts
+        )
+        minor = np.asarray(
+            [b for row in rows for b in row], dtype=np.int32
+        ).reshape(-1)
+        starts = np.concatenate(
+            ([0], np.cumsum(counts)[:-1])
+        ).astype(np.int32)
+        return BlockEnumeration(
+            num_rows=int(num_rows),
+            major=major,
+            minor=minor,
+            row_start=starts,
+            row_count=counts,
+        )
+
+    @staticmethod
+    def from_occupancy(occ) -> "BlockEnumeration":
+        """From a ``telemetry.occupancy.BlockOccupancyMap`` or its
+        ``as_json()`` dict (the committed ``exps/data/occupancy_*.json``
+        artifact): the profiler's measurement output IS the sparse
+        grid's input format."""
+        if isinstance(occ, dict):
+            active = occ["active_k_blocks"]
+            num_rows = int(occ["num_q_blocks"])
+        else:
+            active = occ.active
+            num_rows = int(occ.num_q_blocks)
+        return BlockEnumeration.from_active_lists(active, num_rows)
+
+    @staticmethod
+    def from_block_table(block_table, num_splits: int) -> "BlockEnumeration":
+        """The split-KV decode walk: rows are (sequence, split) pairs,
+        minors the page ids of the paged block table ``[b, MPP]``
+        (traced jax values at decode time). Row counts are uniform
+        (``MPP // num_splits`` pages per split), so the clamped lookup
+        degenerates to plain flat indexing — the same primitive, fully
+        occupied."""
+        import jax.numpy as jnp
+
+        b, mpp = block_table.shape
+        if mpp % num_splits:
+            raise ValueError(
+                f"block enumeration: table width {mpp} is not divisible "
+                f"by num_splits {num_splits}"
+            )
+        pps = mpp // num_splits
+        num_rows = b * num_splits
+        flat = block_table.reshape(-1).astype(jnp.int32)
+        rows = jnp.arange(num_rows, dtype=jnp.int32)
+        return BlockEnumeration(
+            num_rows=int(num_rows),
+            major=jnp.repeat(rows, pps),
+            minor=flat,
+            row_start=rows * pps,
+            row_count=jnp.full((num_rows,), pps, jnp.int32),
+        )
+
+
+def build_block_meta_from_occupancy(
+    occ,
+    q_ranges,
+    k_ranges,
+    attn_type_map,
+    total_q: int,
+    total_k: int,
+) -> FlexAttnBlockMeta:
+    """Kernel plan from a precomputed block-occupancy map: one entry per
+    occupied (q-block, k-block) pair x intersecting slice, windows taken
+    from the slice geometry. Consumes exactly the per-q-block
+    active-k-block shape ``telemetry.occupancy.block_occupancy_map``
+    emits (and ``exps/data/occupancy_*.json`` stores), and — when the
+    occupancy map is exact — produces tables identical to
+    :func:`~.block_meta.build_block_meta` on the same slices (the parity
+    oracle in ``tests/test_ops/test_block_sparse_grid.py``)."""
+    enum = BlockEnumeration.from_occupancy(occ)
+    q_arr = np.asarray(q_ranges, dtype=np.int64).reshape(-1, 2)
+    k_arr = np.asarray(k_ranges, dtype=np.int64).reshape(-1, 2)
+    t_arr = np.asarray(attn_type_map, dtype=np.int64).reshape(-1)
+    slices = np.concatenate([q_arr, k_arr, t_arr[:, None]], axis=1)
+    if isinstance(occ, dict):
+        bq, bk = int(occ["block_q"]), int(occ["block_k"])
+    else:
+        bq, bk = int(occ.block_q), int(occ.block_k)
+
+    entries: list[tuple] = []
+    area = 0
+    minor = np.asarray(enum.minor).tolist()
+    row_start = np.asarray(enum.row_start).tolist()
+    row_count = np.asarray(enum.row_count).tolist()
+    for sid in range(slices.shape[0]):
+        qs, qe, ks, ke, mt = (int(x) for x in slices[sid])
+        if qs >= qe or ks >= ke:
+            continue
+        area += _sub_area(qs, qe, ks, ke, qs, qe, ks, ke, mt)
+        # only rows whose q-block range intersects the slice — the row
+        # tables make this O(slice rows + touched entries), not O(E)
+        for i in range(qs // bq, min(-(-qe // bq), enum.num_rows)):
+            gq_lo = max(qs, i * bq)
+            gq_hi = min(qe, (i + 1) * bq)
+            if gq_lo >= gq_hi:
+                continue
+            k_lo, k_hi = _slice_k_span(gq_lo, gq_hi, ks, ke, qs, qe, mt)
+            if k_hi <= k_lo:
+                continue
+            rs, rc = row_start[i], row_count[i]
+            for j in minor[rs : rs + rc]:
+                gk_lo = max(k_lo, j * bk)
+                gk_hi = min(k_hi, (j + 1) * bk)
+                if gk_lo >= gk_hi:
+                    continue
+                entries.append(
+                    (i, j, sid, gq_lo, gq_hi, gk_lo, gk_hi, 0, 0)
+                )
+    ent = (
+        np.asarray(entries, dtype=np.int64)
+        if entries
+        else np.empty((0, 9), dtype=np.int64)
+    )
+    return assemble_block_meta(
+        ent, slices, total_q, total_k, bq, bk, int(area)
+    )
 
 
 def build_block_meta_from_block_mask(
@@ -45,10 +286,20 @@ def build_block_meta_from_block_mask(
     bm = np.asarray(block_mask, dtype=bool)
     nq = -(-total_q // block_q)
     nk = -(-total_k // block_k)
-    assert bm.shape == (nq, nk), (
-        f"block_mask shape {bm.shape} != blocks ({nq}, {nk}) for "
-        f"({total_q}, {total_k}) at ({block_q}, {block_k})"
-    )
+    if bm.ndim != 2 or bm.shape != (nq, nk):
+        # typed error with the full shape context (was a bare assert):
+        # the usual way to get here is a block mask built for a
+        # different blocking or a transposed (k, q) layout, and a bare
+        # assert stripped under ``python -O`` would silently build a
+        # corrupt plan
+        raise ValueError(
+            f"block_sparse: block_mask shape {bm.shape} does not match "
+            f"the ({nq}, {nk}) = (ceil({total_q}/{block_q}), "
+            f"ceil({total_k}/{block_k})) tile grid of a "
+            f"({total_q}, {total_k})-token problem at blocking "
+            f"({block_q}, {block_k}) — check the mask's blocking and "
+            "that it is laid out [num_q_blocks, num_k_blocks]"
+        )
     off = total_k - total_q
     # at most two slices, both spanning the whole problem
     slices = np.asarray(

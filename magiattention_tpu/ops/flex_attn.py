@@ -36,10 +36,20 @@ from .block_meta import (
     FlexAttnBlockMeta,
     build_block_meta,
 )
+from .block_sparse import clamped_entry, row_tables
 from ..utils.compat import tpu_compiler_params
 
 NEG_INF = float("-inf")
 LANES = 128
+LOG2E = math.log2(math.e)  # base-2 softmax domain (AMLA rescaling)
+LN2 = math.log(2.0)
+# the two kernel grid layouts (FlexAttnParams.grid / the autotuner's
+# rung axis): "row_major" = the static (heads, num_blocks, steps) grid
+# (dense-optimal: static q-side index maps keep block residency
+# provable); "sparse" = the compact entry-walk grid (heads, entries)
+# that visits ONLY occupied (q-block, k-block) tiles — zero dead steps
+# on heterogeneous masks (ROADMAP item 1)
+GRID_KINDS = ("row_major", "sparse")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,6 +82,9 @@ class FlexAttnParams:
     head_block: int = 1
     fwd_steps: int = 0
     bwd_steps: int = 0
+    # "row_major" (static steps grid) or "sparse" (compact entry walk
+    # with AMLA mul-by-add rescaling in the forward) — see GRID_KINDS
+    grid: str = "row_major"
 
     @property
     def out_jnp_dtype(self):
@@ -104,21 +117,16 @@ def bwd_tables(meta: FlexAttnBlockMeta):
 
 def _row_tables(major, num_major: int):
     """Per-major-block [start, count] over a sorted (possibly traced)
-    major array — the kernels' two extra scalar-prefetch operands."""
-    idx = jnp.arange(num_major, dtype=major.dtype)
-    rs = jnp.searchsorted(major, idx, side="left").astype(jnp.int32)
-    re = jnp.searchsorted(major, idx, side="right").astype(jnp.int32)
-    return rs, re - rs
+    major array — the kernels' two extra scalar-prefetch operands
+    (``block_sparse.row_tables``, the shared enumeration primitive; the
+    decode kernel derives the same tables from its block table)."""
+    maj = major if not isinstance(major, np.ndarray) else jnp.asarray(major)
+    return row_tables(maj, num_major)
 
 
-def _clamped_entry(rs, rc, i, j):
-    """Entry index for inner-grid step j of major block i: the block's
-    entries occupy rs[i]..rs[i]+rc[i]; steps past the count clamp to the
-    last live entry (same K block -> no fresh DMA) and the kernel skips
-    compute via ``j < rc[i]``. Shared by the kernel bodies and the
-    launchers' K-side index maps — the two MUST agree or the DMA'd block
-    and the entry the kernel evaluates silently diverge."""
-    return rs[i] + jnp.minimum(j, jnp.maximum(rc[i] - 1, 0))
+# the shared clamped lookup (``block_sparse.clamped_entry``): kernel
+# bodies and launcher index maps resolve steps through ONE function
+_clamped_entry = clamped_entry
 
 
 def _resolve_steps(explicit: int, major, num_major: int) -> int:
@@ -600,8 +608,417 @@ def _fwd_pallas(q, k, v, sink2d, tables, params: FlexAttnParams):
 
 
 # ---------------------------------------------------------------------------
+# forward: compact sparse grid (entry walk + AMLA mul-by-add rescaling)
+# ---------------------------------------------------------------------------
+
+
+def _amla_rescale(x, delta_exp):
+    """Multiply an f32 tensor by ``2**delta_exp`` (int32, <= 0) via an
+    integer ADD on the exponent field — AMLA's mul-by-add rescaling
+    (PAPERS.md, arxiv 2509.25224) folded into the online-softmax
+    accumulator update: with the running max quantized to integers in
+    the base-2 domain, the per-step rescale factor is an exact power of
+    two, so ``acc * alpha`` becomes ``bits(acc) + (delta << 23)`` on the
+    VPU's integer lanes instead of an FMUL. Exact for normal floats
+    (sign and mantissa untouched); values whose exponent would leave the
+    normal range flush to zero — precisely what the FMUL would round
+    them to at these magnitudes."""
+    bits = jax.lax.bitcast_convert_type(x, jnp.int32)
+    shifted = jax.lax.bitcast_convert_type(
+        bits + delta_exp * jnp.int32(1 << 23), jnp.float32
+    )
+    exp_field = (
+        jax.lax.shift_right_logical(bits, jnp.int32(23)) & jnp.int32(0xFF)
+    )
+    ok = (exp_field + delta_exp) > 0  # stays a normal float (and x != 0)
+    return jnp.where(ok, shifted, 0.0)
+
+
+def _amla_update(s, m_prev, l_prev, acc_prev, contract):
+    """One AMLA online-softmax step shared by the sparse forward bodies.
+
+    ``s`` are natural-scale masked logits (-inf off-mask); the running
+    state lives in the base-2 domain with an INTEGER-quantized max
+    ``m`` (f32-stored, integer-valued, -inf until the row sees a live
+    entry), so the rescale ``2**(m_prev - m_new)`` applies to ``l`` and
+    ``acc`` through :func:`_amla_rescale`'s exponent add. Returns
+    ``(m_new, l_new, acc_new)``; reduction axis of ``s`` is its last.
+    ``contract(p)`` computes the probs x V product.
+    """
+    s2 = s * jnp.float32(LOG2E)
+    m_cur = jnp.ceil(jnp.max(s2, axis=-1, keepdims=True))
+    m_new = jnp.maximum(m_prev, m_cur)
+    m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+    # fresh rows (m_prev == -inf) carry zero state: rescale by 2^0
+    delta = (
+        jnp.where(m_prev == NEG_INF, m_safe, m_prev) - m_safe
+    ).astype(jnp.int32)
+    p = jnp.exp2(s2 - m_safe)
+    l_new = _amla_rescale(l_prev, delta) + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = _amla_rescale(acc_prev, delta) + contract(p)
+    return m_new, l_new, acc_new
+
+
+def _amla_finalize(m2, l, acc, sink, params: FlexAttnParams):
+    """Shared sparse-forward epilogue: fold the base-2 quantized max
+    back to the natural-scale reference logit ``mu = m2 * ln2``, apply
+    the optional sink, and emit ``(out, lse, covered)`` under the
+    uncovered convention (out=0, lse=-inf). ``sink`` is a broadcastable
+    f32 (or None)."""
+    mu = m2 * jnp.float32(LN2)
+    if params.has_sink:
+        m_tot = jnp.maximum(mu, sink)
+        m_tot_safe = jnp.where(m_tot == NEG_INF, 0.0, m_tot)
+        resc = jnp.exp(jnp.where(mu == NEG_INF, NEG_INF, mu - m_tot_safe))
+        l_tot = l * resc + jnp.exp(sink - m_tot_safe)
+        acc_fin = acc * resc
+    else:
+        m_tot_safe = jnp.where(mu == NEG_INF, 0.0, mu)
+        l_tot = l
+        acc_fin = acc
+    covered = l_tot > 0.0
+    inv = jnp.where(covered, 1.0 / jnp.where(covered, l_tot, 1.0), 0.0)
+    out = acc_fin * inv
+    lse = jnp.where(
+        covered, m_tot_safe + jnp.log(jnp.where(covered, l_tot, 1.0)), NEG_INF
+    )
+    return out, lse, covered
+
+
+def _fwd_kernel_sparse(
+    qblk,
+    kblk,
+    sid,
+    runs,
+    bounds,
+    rs,
+    rc,
+    q_ref,
+    k_ref,
+    v_ref,
+    sink_ref,
+    out_ref,
+    lse_ref,
+    rowmax_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    mx_scr,
+    *,
+    params: FlexAttnParams,
+):
+    """Compact-grid forward: grid (hq, E) — ONE grid step per occupied
+    entry, no dead steps. Entries are q-major sorted, so a q block's
+    state initializes at its first entry (``e == rs[i]``) and the output
+    tile writes at its last (``e == rs[i] + rc[i] - 1``); dummy entries
+    (sentinel slice, fully masked) keep dead q-block rows written with
+    the uncovered convention. The online softmax runs in the base-2
+    domain with AMLA mul-by-add rescaling (:func:`_amla_update`);
+    ``mx_scr`` tracks the exact natural-scale row max separately (the
+    rowmax output contract is unchanged)."""
+    bq, bk = params.block_q, params.block_k
+    h = pl.program_id(0)
+    e = pl.program_id(1)
+    i = qblk[e]
+
+    @pl.when(e == rs[i])
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        mx_scr[...] = jnp.full_like(mx_scr, NEG_INF)
+
+    # every grid slot IS an occupied entry: compute unconditionally
+    s = _scores(q_ref[0], k_ref[0], params.scale, params.softcap)
+    s = jnp.where(
+        _entry_interval_mask(
+            bounds, runs, sid[e], e, i * bq, kblk[e] * bk, bq, bk
+        ),
+        s,
+        NEG_INF,
+    )
+    m_new, l_new, acc_new = _amla_update(
+        s,
+        m_scr[:, :1],
+        l_scr[:, :1],
+        acc_scr[...],
+        lambda p: jax.lax.dot_general(
+            p.astype(v_ref.dtype),
+            v_ref[0],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ),
+    )
+    m_scr[:, :1] = m_new
+    l_scr[:, :1] = l_new
+    acc_scr[...] = acc_new
+    mx_scr[:, :1] = jnp.maximum(
+        mx_scr[:, :1], jnp.max(s, axis=1, keepdims=True)
+    )
+
+    @pl.when(e == rs[i] + rc[i] - 1)
+    def _finalize():
+        sink = sink_ref[h, 0] if params.has_sink else None
+        out, lse, _ = _amla_finalize(
+            m_scr[:, :1], l_scr[:, :1], acc_scr[...], sink, params
+        )
+        out_ref[0] = out.astype(out_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], LANES))
+        rowmax_ref[0] = jnp.broadcast_to(
+            mx_scr[:, :1], (mx_scr.shape[0], LANES)
+        )
+
+
+def _fwd_pallas_sparse(q, k, v, sink2d, tables, params: FlexAttnParams):
+    """Sparse-grid launcher: grid (hq, E) walking the entry table
+    directly — the splash-attention-style compact grid (SNIPPETS.md [2])
+    over the shared block enumeration. The q/out index maps are dynamic
+    (``qblk[e]``) but non-decreasing, so blocks stay resident across a
+    row's consecutive entries; K/V stream per entry exactly as the
+    row-major grid's live steps do. Zero dead slots by construction."""
+    qblk, kblk, sid, runs, bounds = tables
+    hq, tqp, d = q.shape
+    hk = k.shape[0]
+    group = hq // hk
+    bq, bk = params.block_q, params.block_k
+    E = qblk.shape[0]
+    nq = tqp // bq
+    rs, rc = _row_tables(qblk, nq)
+
+    def qmap(h, e, qb, kb, si, ru, bo, rs, rc):
+        return (h, qb[e], 0)
+
+    def kmap(h, e, qb, kb, si, ru, bo, rs, rc):
+        return (h // group, kb[e], 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=7,
+        grid=(hq, E),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # sink [hq, 1]
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bq, LANES), qmap),
+            pl.BlockSpec((1, bq, LANES), qmap),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel_sparse, params=params),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hq, tqp, d), params.out_jnp_dtype),
+            jax.ShapeDtypeStruct((hq, tqp, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((hq, tqp, LANES), jnp.float32),
+        ],
+        interpret=params.interpret,
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * int(E) * bq * bk * d * hq,
+            bytes_accessed=q.size * q.dtype.itemsize + 2 * k.size * k.dtype.itemsize,
+            transcendentals=int(E) * bq * bk * hq,
+        ),
+    )(qblk, kblk, sid, runs, bounds, rs, rc, q, k, v, sink2d)
+
+
+def _fwd_kernel_hb_sparse(
+    qblk,
+    kblk,
+    sid,
+    runs,
+    bounds,
+    rs,
+    rc,
+    q_ref,  # (HBG, bq, d)
+    k_ref,  # (HB, bk, d)
+    v_ref,
+    sink_ref,
+    out_ref,
+    lse_ref,
+    rowmax_ref,
+    m_scr,  # (HB, G*bq, LANES)
+    l_scr,
+    acc_scr,  # (HB, G*bq, d)
+    mx_scr,
+    *,
+    params: FlexAttnParams,
+    group: int,
+):
+    """Head-batched sparse grid: (hq/HBG, E) — the compact entry walk of
+    :func:`_fwd_kernel_sparse` at the head-batched layout of
+    :func:`_fwd_kernel_hb`, AMLA rescaling included."""
+    bq, bk = params.block_q, params.block_k
+    hbg = q_ref.shape[0]
+    hb = k_ref.shape[0]
+    h = pl.program_id(0)
+    e = pl.program_id(1)
+    i = qblk[e]
+
+    @pl.when(e == rs[i])
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        mx_scr[...] = jnp.full_like(mx_scr, NEG_INF)
+
+    q_ = q_ref[...].reshape(hb, group * bq, q_ref.shape[2])
+    s = jax.lax.dot_general(
+        q_,
+        k_ref[...],
+        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * jnp.float32(params.scale)  # (HB, G*bq, bk)
+    if params.softcap > 0.0:
+        s = jnp.float32(params.softcap) * jnp.tanh(
+            s / jnp.float32(params.softcap)
+        )
+    mask = _entry_interval_mask(
+        bounds, runs, sid[e], e, i * bq, kblk[e] * bk, bq, bk
+    )
+    s4 = s.reshape(hb, group, bq, bk)
+    s4 = jnp.where(mask[None, None], s4, NEG_INF)
+    s = s4.reshape(hb, group * bq, bk)
+
+    m_new, l_new, acc_new = _amla_update(
+        s,
+        m_scr[:, :, :1],
+        l_scr[:, :, :1],
+        acc_scr[...],
+        lambda p: jax.lax.dot_general(
+            p.astype(v_ref.dtype),
+            v_ref[...],
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ),
+    )
+    m_scr[:, :, :1] = m_new
+    l_scr[:, :, :1] = l_new
+    acc_scr[...] = acc_new
+    mx_scr[:, :, :1] = jnp.maximum(
+        mx_scr[:, :, :1], jnp.max(s, axis=2, keepdims=True)
+    )
+
+    @pl.when(e == rs[i] + rc[i] - 1)
+    def _finalize():
+        if params.has_sink:
+            sink = jnp.stack(
+                [
+                    jnp.full((bq, 1), sink_ref[h * hbg + hh, 0], jnp.float32)
+                    for hh in range(hbg)
+                ],
+                axis=0,
+            ).reshape(hb, group * bq, 1)
+        else:
+            sink = None
+        out, lse, _ = _amla_finalize(
+            m_scr[:, :, :1], l_scr[:, :, :1], acc_scr[...], sink, params
+        )
+        out_ref[...] = out.reshape(hbg, bq, out_ref.shape[2]).astype(
+            out_ref.dtype
+        )
+        lse_ref[...] = jnp.broadcast_to(
+            lse.reshape(hbg, bq, 1), (hbg, bq, LANES)
+        )
+        rowmax_ref[...] = jnp.broadcast_to(
+            mx_scr[:, :, :1].reshape(hbg, bq, 1), (hbg, bq, LANES)
+        )
+
+
+def _fwd_pallas_hb_sparse(q, k, v, sink2d, tables, params: FlexAttnParams):
+    """Head-batched sparse-grid launcher: grid (hq/HBG, E)."""
+    qblk, kblk, sid, runs, bounds = tables
+    hq, tqp, d = q.shape
+    hk = k.shape[0]
+    group = hq // hk
+    hbg = params.head_block
+    assert hbg % group == 0 and hq % hbg == 0, (
+        f"head_block {hbg} must be a multiple of the GQA group {group} and "
+        f"divide hq {hq}"
+    )
+    hb = hbg // group
+    bq, bk = params.block_q, params.block_k
+    E = qblk.shape[0]
+    nq = tqp // bq
+    rs, rc = _row_tables(qblk, nq)
+
+    def qmap(h, e, qb, kb, si, ru, bo, rs, rc):
+        return (h, qb[e], 0)
+
+    def kmap(h, e, qb, kb, si, ru, bo, rs, rc):
+        return (h, kb[e], 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=7,
+        grid=(hq // hbg, E),
+        in_specs=[
+            pl.BlockSpec((hbg, bq, d), qmap),
+            pl.BlockSpec((hb, bk, d), kmap),
+            pl.BlockSpec((hb, bk, d), kmap),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((hbg, bq, d), qmap),
+            pl.BlockSpec((hbg, bq, LANES), qmap),
+            pl.BlockSpec((hbg, bq, LANES), qmap),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((hb, group * bq, LANES), jnp.float32),
+            pltpu.VMEM((hb, group * bq, LANES), jnp.float32),
+            pltpu.VMEM((hb, group * bq, d), jnp.float32),
+            pltpu.VMEM((hb, group * bq, LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel_hb_sparse, params=params, group=group),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hq, tqp, d), params.out_jnp_dtype),
+            jax.ShapeDtypeStruct((hq, tqp, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((hq, tqp, LANES), jnp.float32),
+        ],
+        interpret=params.interpret,
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(qblk, kblk, sid, runs, bounds, rs, rc, q, k, v, sink2d)
+
+
+# ---------------------------------------------------------------------------
 # backward: dq (q-major walk)
 # ---------------------------------------------------------------------------
+
+
+def _bwd_p_ds(s, lse_ref, do_ref, v_ref, delta_ref, params: FlexAttnParams):
+    """Shared backward core for all four bwd kernel bodies (row-major +
+    sparse, dq + dkv): probabilities from the stored lse and the masked
+    logits, then ``ds = p * (dP - delta)`` with the softcap derivative
+    and the off-mask NaN guard. This block is numerically delicate and
+    MUST stay in lockstep across grids — one copy only."""
+    lse = lse_ref[0][:, :1]
+    lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
+    p = jnp.exp(s - lse_safe)
+    dp = jax.lax.dot_general(
+        do_ref[0],
+        v_ref[0],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0][:, :1])
+    if params.softcap > 0.0:
+        ds = ds * (1.0 - (s / jnp.float32(params.softcap)) ** 2)
+        ds = jnp.where(jnp.isneginf(s), 0.0, ds)  # nan guard off-mask
+    return p, ds
 
 
 def _dq_kernel(
@@ -643,20 +1060,7 @@ def _dq_kernel(
             s,
             NEG_INF,
         )
-        lse = lse_ref[0][:, :1]
-        lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
-        p = jnp.exp(s - lse_safe)
-        dp = jax.lax.dot_general(
-            do_ref[0],
-            v_ref[0],
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        delta = delta_ref[0][:, :1]
-        ds = p * (dp - delta)
-        if params.softcap > 0.0:
-            ds = ds * (1.0 - (s / jnp.float32(params.softcap)) ** 2)
-            ds = jnp.where(jnp.isneginf(s), 0.0, ds)  # nan guard off-mask
+        _, ds = _bwd_p_ds(s, lse_ref, do_ref, v_ref, delta_ref, params)
         dq_scr[...] += jnp.float32(params.scale) * jax.lax.dot_general(
             ds.astype(k_ref.dtype),
             k_ref[0],
@@ -669,7 +1073,101 @@ def _dq_kernel(
         dq_ref[0] = dq_scr[...]
 
 
+def _dq_kernel_sparse(
+    qblk,
+    kblk,
+    sid,
+    runs,
+    bounds,
+    rs,
+    rc,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,
+    dq_scr,
+    *,
+    params: FlexAttnParams,
+):
+    """Compact-grid dq: grid (hq, E) over the q-major entry table — the
+    sparse twin of :func:`_dq_kernel` (no online rescale in the
+    backward, so no AMLA here; the stored lse is the reference)."""
+    bq, bk = params.block_q, params.block_k
+    e = pl.program_id(1)
+    i = qblk[e]
+
+    @pl.when(e == rs[i])
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    s = _scores(q_ref[0], k_ref[0], params.scale, params.softcap)
+    s = jnp.where(
+        _entry_interval_mask(
+            bounds, runs, sid[e], e, i * bq, kblk[e] * bk, bq, bk
+        ),
+        s,
+        NEG_INF,
+    )
+    _, ds = _bwd_p_ds(s, lse_ref, do_ref, v_ref, delta_ref, params)
+    dq_scr[...] += jnp.float32(params.scale) * jax.lax.dot_general(
+        ds.astype(k_ref.dtype),
+        k_ref[0],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(e == rs[i] + rc[i] - 1)
+    def _write():
+        dq_ref[0] = dq_scr[...]
+
+
+def _dq_pallas_sparse(q, k, v, do, lse, delta, tables, params: FlexAttnParams):
+    qblk, kblk, sid, runs, bounds = tables
+    hq, tqp, d = q.shape
+    hk = k.shape[0]
+    group = hq // hk
+    bq, bk = params.block_q, params.block_k
+    E = qblk.shape[0]
+    nq = tqp // bq
+    rs, rc = _row_tables(qblk, nq)
+
+    def qmap(h, e, qb, kb, si, ru, bo, rs, rc):
+        return (h, qb[e], 0)
+
+    def kmap(h, e, qb, kb, si, ru, bo, rs, rc):
+        return (h // group, kb[e], 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=7,
+        grid=(hq, E),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bq, LANES), qmap),
+            pl.BlockSpec((1, bq, LANES), qmap),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), qmap),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_dq_kernel_sparse, params=params),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((hq, tqp, d), jnp.float32),
+        interpret=params.interpret,
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(qblk, kblk, sid, runs, bounds, rs, rc, q, k, v, do, lse, delta)
+
+
 def _dq_pallas(q, k, v, do, lse, delta, tables, params: FlexAttnParams):
+    if params.grid == "sparse":
+        return _dq_pallas_sparse(q, k, v, do, lse, delta, tables, params)
     qblk, kblk, sid, runs, bounds = tables
     hq, tqp, d = q.shape
     hk = k.shape[0]
@@ -763,26 +1261,13 @@ def _dkv_kernel(
             s,
             NEG_INF,
         )
-        lse = lse_ref[0][:, :1]
-        lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
-        p = jnp.exp(s - lse_safe)
+        p, ds = _bwd_p_ds(s, lse_ref, do_ref, v_ref, delta_ref, params)
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do_ref.dtype),
             do_ref[0],
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
-            do_ref[0],
-            v_ref[0],
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        delta = delta_ref[0][:, :1]
-        ds = p * (dp - delta)
-        if params.softcap > 0.0:
-            ds = ds * (1.0 - (s / jnp.float32(params.softcap)) ** 2)
-            ds = jnp.where(jnp.isneginf(s), 0.0, ds)  # nan guard off-mask
         dk_scr[...] += jnp.float32(params.scale) * jax.lax.dot_general(
             ds.astype(q_ref.dtype),
             q_ref[0],
@@ -796,7 +1281,122 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[...]
 
 
+def _dkv_kernel_sparse(
+    kblk,
+    qblk,
+    sid,
+    runs,
+    bounds,
+    rs,
+    rc,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    dk_scr,
+    dv_scr,
+    *,
+    params: FlexAttnParams,
+    group: int,
+):
+    """Compact-grid dkv: grid (hk, E2, group) over the k-major entry
+    table — K/V and the dk/dv accumulators stay resident per k block
+    while Q/dO/lse stream through the entry walk."""
+    bq, bk = params.block_q, params.block_k
+    e = pl.program_id(1)
+    g = pl.program_id(2)
+    i = kblk[e]
+
+    @pl.when((e == rs[i]) & (g == 0))
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    s = _scores(q_ref[0], k_ref[0], params.scale, params.softcap)
+    s = jnp.where(
+        _entry_interval_mask(
+            bounds, runs, sid[e], e, qblk[e] * bq, i * bk, bq, bk
+        ),
+        s,
+        NEG_INF,
+    )
+    p, ds = _bwd_p_ds(s, lse_ref, do_ref, v_ref, delta_ref, params)
+    dv_scr[...] += jax.lax.dot_general(
+        p.astype(do_ref.dtype),
+        do_ref[0],
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dk_scr[...] += jnp.float32(params.scale) * jax.lax.dot_general(
+        ds.astype(q_ref.dtype),
+        q_ref[0],
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when((e == rs[i] + rc[i] - 1) & (g == group - 1))
+    def _write():
+        dk_ref[0] = dk_scr[...]
+        dv_ref[0] = dv_scr[...]
+
+
+def _dkv_pallas_sparse(q, k, v, do, lse, delta, tables, params: FlexAttnParams):
+    kblk, qblk, sid, runs, bounds = tables
+    hq, tqp, d = q.shape
+    hk, tkp, _ = k.shape
+    group = hq // hk
+    bq, bk = params.block_q, params.block_k
+    E = kblk.shape[0]
+    nk = tkp // bk
+    rs, rc = _row_tables(kblk, nk)
+
+    def qmap(h, e, g, kb, qb, si, ru, bo, rs, rc):
+        return (h * group + g, qb[e], 0)
+
+    def kmap(h, e, g, kb, qb, si, ru, bo, rs, rc):
+        return (h, kb[e], 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=7,
+        grid=(hk, E, group),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bq, LANES), qmap),
+            pl.BlockSpec((1, bq, LANES), qmap),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bk, d), kmap),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_dkv_kernel_sparse, params=params, group=group),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hk, tkp, d), jnp.float32),
+            jax.ShapeDtypeStruct((hk, tkp, d), jnp.float32),
+        ],
+        interpret=params.interpret,
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+    )(kblk, qblk, sid, runs, bounds, rs, rc, q, k, v, do, lse, delta)
+
+
 def _dkv_pallas(q, k, v, do, lse, delta, tables, params: FlexAttnParams):
+    if params.grid == "sparse":
+        return _dkv_pallas_sparse(q, k, v, do, lse, delta, tables, params)
     kblk, qblk, sid, runs, bounds = tables
     hq, tqp, d = q.shape
     hk, tkp, _ = k.shape
@@ -860,6 +1460,15 @@ def _zero_tangents(tables):
 
 
 def _fwd_dispatch(q, k, v, sink2d, ftab, params: FlexAttnParams):
+    if params.grid not in GRID_KINDS:
+        raise ValueError(
+            f"flex-attn: params.grid={params.grid!r} must be one of "
+            f"{GRID_KINDS}"
+        )
+    if params.grid == "sparse":
+        if params.head_block > 1:
+            return _fwd_pallas_hb_sparse(q, k, v, sink2d, ftab, params)
+        return _fwd_pallas_sparse(q, k, v, sink2d, ftab, params)
     if params.head_block > 1:
         return _fwd_pallas_hb(q, k, v, sink2d, ftab, params)
     return _fwd_pallas(q, k, v, sink2d, ftab, params)
@@ -1156,10 +1765,16 @@ def flex_attn_with_meta(
     sink: jax.Array | None = None,
     out_dtype=None,
     head_block: int = 1,
+    grid: str = "row_major",
     return_max_logits: bool = False,
     interpret: bool | None = None,
 ):
     """Flex attention with a prebuilt block plan. Differentiable in q/k/v/sink.
+
+    ``grid`` selects the kernel grid layout (:data:`GRID_KINDS`):
+    ``"sparse"`` walks the compact occupied-entry enumeration (zero dead
+    steps, AMLA rescaling) — the heterogeneous-mask rung; ``"row_major"``
+    keeps the static steps grid the dense paths measured fastest.
 
     Returns (out [tq, hq, d], lse [tq, hq]) plus max_logits [hq] when
     ``return_max_logits`` (max_logits is non-differentiable).
@@ -1193,6 +1808,7 @@ def flex_attn_with_meta(
         head_block=int(head_block),
         fwd_steps=meta.fwd_steps,
         bwd_steps=meta.bwd_steps,
+        grid=str(grid),
     )
     out_h, lse_lanes, rowmax_lanes = flex_attn_headmajor(
         qh, kh, vh, fwd_tables(meta), bwd_tables(meta), params, sink=sink
@@ -1355,6 +1971,105 @@ def _static_block_config(
     return last
 
 
+def auto_kernel_config(
+    q_ranges,
+    k_ranges,
+    hq: int,
+    hk: int,
+    *,
+    fixed_block_q: int | None = None,
+    fixed_block_k: int | None = None,
+    attn_type_map=None,
+    head_dim: int = 128,
+    dtype: str = "bfloat16",
+    measure_fn=None,
+    grid: str | None = None,
+) -> tuple[int, int, int, str]:
+    """Pick (block_q, block_k, head_block, grid) for a mask.
+
+    Default path: the plan-aware autotuner (``tuning/``) — workload
+    fingerprint, analytic cost model pricing tile-occupancy waste /
+    grid-step overhead / SMEM pressure across BOTH grid layouts
+    (row-major and the compact sparse entry walk), persistent winner
+    cache, optional on-device microbenchmark
+    (``MAGI_ATTENTION_AUTOTUNE=measure`` with a ``measure_fn``).
+    ``MAGI_ATTENTION_AUTOTUNE=off`` or caller-fixed block dims restore
+    the legacy seqlen-keyed table (:func:`_static_block_config`) exactly
+    (always row-major).
+
+    ``grid`` (caller pin, else ``MAGI_ATTENTION_GRID``) pins the grid
+    layout. A ``"row_major"`` pin restricts the RANKING to row-major
+    rungs too — a sparse-only small-tile winner launched on the
+    static-steps grid would be exactly the grid-step-bound
+    configuration the row-major rung table excludes. A ``"sparse"`` pin
+    keeps the full ranking's blocking (every row-major rung is also a
+    valid sparse blocking — the A/B lever compares grids at one rung).
+
+    ``attn_type_map`` (mask type per slice) sharpens the cost model's
+    entry counting; omitted, slices are priced as FULL — uniformly
+    conservative across candidates, so the ranking stays sound.
+    """
+    from .. import env
+
+    grid_pin = grid if grid is not None else env.grid_override()
+
+    def _pin(cfg: tuple[int, int, int], chosen: str):
+        return (*cfg, grid_pin if grid_pin is not None else chosen)
+
+    if fixed_block_q is not None or fixed_block_k is not None:
+        # explicit user blocking: honored verbatim, measured hb mapping
+        return _pin(
+            _static_block_config(
+                q_ranges,
+                k_ranges,
+                hq,
+                hk,
+                fixed_block_q=fixed_block_q,
+                fixed_block_k=fixed_block_k,
+            ),
+            "row_major",
+        )
+    if env.autotune_mode() == "off":
+        return _pin(
+            _static_block_config(q_ranges, k_ranges, hq, hk), "row_major"
+        )
+    if grid_pin == "row_major":
+        return (
+            *auto_block_config(
+                q_ranges,
+                k_ranges,
+                hq,
+                hk,
+                attn_type_map=attn_type_map,
+                head_dim=head_dim,
+                dtype=dtype,
+                measure_fn=measure_fn,
+            ),
+            "row_major",
+        )
+    from ..tuning import select_block_config
+
+    decision = select_block_config(
+        q_ranges,
+        k_ranges,
+        attn_type_map,
+        hq,
+        hk,
+        head_dim=head_dim,
+        dtype=dtype,
+        measure_fn=measure_fn,
+    )
+    if decision is None:  # unconstrained call: cannot happen, but stay safe
+        return _pin(
+            _static_block_config(q_ranges, k_ranges, hq, hk), "row_major"
+        )
+    return (
+        decision.kernel_config
+        if grid_pin is None
+        else (*decision.config, grid_pin)
+    )
+
+
 def auto_block_config(
     q_ranges,
     k_ranges,
@@ -1368,22 +2083,16 @@ def auto_block_config(
     dtype: str = "bfloat16",
     measure_fn=None,
 ) -> tuple[int, int, int]:
-    """Pick (block_q, block_k, head_block) for a mask.
-
-    Default path: the plan-aware autotuner (``tuning/``) — workload
-    fingerprint, analytic cost model pricing tile-occupancy waste /
-    grid-step overhead / SMEM pressure, persistent winner cache, optional
-    on-device microbenchmark (``MAGI_ATTENTION_AUTOTUNE=measure`` with a
-    ``measure_fn``). ``MAGI_ATTENTION_AUTOTUNE=off`` or caller-fixed block
-    dims restore the legacy seqlen-keyed table
-    (:func:`_static_block_config`) exactly.
-
-    ``attn_type_map`` (mask type per slice) sharpens the cost model's
-    entry counting; omitted, slices are priced as FULL — uniformly
-    conservative across candidates, so the ranking stays sound.
-    """
+    """Historical (block_q, block_k, head_block) triple for callers that
+    run the row-major grid regardless (the distributed plan builder,
+    rung benches): the ranking is restricted to row-major rungs
+    (``include_sparse=False``), so the returned blocking was priced for
+    the grid the caller will actually launch — a sparse-only small-tile
+    winner would be exactly the grid-step-bound configuration the
+    row-major rung table excludes. Row-major-only decisions live under
+    their own fingerprint axis, so they never collide with
+    :func:`auto_kernel_config`'s full-ranking cache entries."""
     if fixed_block_q is not None or fixed_block_k is not None:
-        # explicit user blocking: honored verbatim, measured hb mapping
         return _static_block_config(
             q_ranges,
             k_ranges,
@@ -1407,8 +2116,9 @@ def auto_block_config(
         head_dim=head_dim,
         dtype=dtype,
         measure_fn=measure_fn,
+        include_sparse=False,
     )
-    if decision is None:  # unconstrained call: cannot happen, but stay safe
+    if decision is None:
         return _static_block_config(q_ranges, k_ranges, hq, hk)
     return decision.config
 
@@ -1446,7 +2156,7 @@ def _make_measure_fn(
     plan is already built when the tuned call follows."""
     import time
 
-    def measure(bq: int, bk: int, hb: int) -> float:
+    def measure(bq: int, bk: int, hb: int, grid: str = "row_major") -> float:
         meta = _cached_meta(
             q_arr.tobytes(),
             k_arr.tobytes(),
@@ -1463,7 +2173,7 @@ def _make_measure_fn(
                 flex_attn_with_meta(
                     q, k, v, meta,
                     scale=scale, softcap=softcap, sink=sink,
-                    out_dtype=out_dtype, head_block=hb,
+                    out_dtype=out_dtype, head_block=hb, grid=grid,
                     interpret=interpret,
                 )[0]
             )
@@ -1493,6 +2203,7 @@ def flex_flash_attn_func(
     block_q: int | None = None,
     block_k: int | None = None,
     head_block: int | None = None,
+    grid: str | None = None,
     return_max_logits: bool = False,
     interpret: bool | None = None,
 ):
@@ -1502,8 +2213,10 @@ def flex_flash_attn_func(
     (mask, shape, blocking) and cached — the TPU-idiomatic replacement for the
     reference's runtime q_ranges device tensors + persistent-kernel scheduler.
 
-    ``block_q``/``block_k``/``head_block`` default to an automatic choice
-    (:func:`auto_block_config`) keyed on the mask and head counts.
+    ``block_q``/``block_k``/``head_block``/``grid`` default to an automatic
+    choice (:func:`auto_kernel_config`) keyed on the mask and head counts —
+    heterogeneous masks resolve to the compact sparse grid, dense ones to
+    the measured row-major rungs.
     """
     q_arr = np.ascontiguousarray(np.asarray(q_ranges, dtype=np.int64).reshape(-1, 2))
     k_arr = np.ascontiguousarray(np.asarray(k_ranges, dtype=np.int64).reshape(-1, 2))
@@ -1537,7 +2250,7 @@ def flex_flash_attn_func(
                 scale=scale, softcap=softcap, sink=sink,
                 out_dtype=out_dtype, interpret=interpret,
             )
-        abq, abk, ahb = auto_block_config(
+        abq, abk, ahb, agrid = auto_kernel_config(
             q_arr.tolist(),
             k_arr.tolist(),
             int(q.shape[1]),
@@ -1548,9 +2261,14 @@ def flex_flash_attn_func(
             head_dim=int(q.shape[2]),
             dtype=str(q.dtype),
             measure_fn=measure_fn,
+            grid=grid,  # a caller pin also restricts the ranking
         )
         block_q, block_k = abq, abk
         head_block = ahb if head_block is None else head_block
+        grid = agrid
+    if grid is None:
+        override = _env.grid_override()
+        grid = override if override is not None else "row_major"
     meta = _cached_meta(
         q_arr.tobytes(),
         k_arr.tobytes(),
@@ -1571,6 +2289,7 @@ def flex_flash_attn_func(
         sink=sink,
         out_dtype=out_dtype,
         head_block=head_block,
+        grid=grid,
         return_max_logits=return_max_logits,
         interpret=interpret,
     )

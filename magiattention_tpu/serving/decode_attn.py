@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..ops.block_sparse import BlockEnumeration, clamped_entry
 from ..ops.correction import merge_partials
 from ..utils.compat import tpu_compiler_params
 from ..utils.instrument import named_scope
@@ -164,7 +165,9 @@ def _decode_jnp(q, cache: PagedKVCache, bt, seq_lens, params: DecodeParams):
 
 
 def _decode_kernel(
-    bt,  # [b * MPP] flattened block-table rows (scalar prefetch)
+    pages,  # [b * MPP] page id per enumeration entry (scalar prefetch)
+    rs,  # [b * s] per-(sequence, split) row starts (scalar prefetch)
+    rc,  # [b * s] per-row entry counts (uniform pages-per-split)
     sl,  # [b] true lengths (scalar prefetch)
     q_ref,  # (1, hq, d)
     k_ref,  # (1, ps, hk, d) — the page this step DMA'd
@@ -247,7 +250,16 @@ def _decode_kernel(
 def _decode_pallas(q, cache: PagedKVCache, bt, seq_lens, params: DecodeParams):
     """Launcher: partial (out, lse) per (batch, split); splits merged by
     the caller through ``ops/correction`` (the design's point — the CP
-    merge and the split merge are the same associative reduction)."""
+    merge and the split merge are the same associative reduction).
+
+    The page walk goes through the SHARED block-enumeration primitive
+    (``ops/block_sparse.BlockEnumeration``): rows are (sequence, split)
+    pairs, minors the block table's page ids, and the K-side index map
+    resolves grid steps with the same clamped lookup the flex kernels'
+    sparse grid uses — one sparse core under prefill, decode, and
+    cascade (ROADMAP item 1). Decode rows are fully occupied (uniform
+    pages-per-split), so the clamp is a no-op and the lowering is
+    unchanged from the direct flat indexing it replaces."""
     b, hq, d = q.shape
     hk = cache.num_kv_heads
     group = hq // hk
@@ -255,20 +267,21 @@ def _decode_pallas(q, cache: PagedKVCache, bt, seq_lens, params: DecodeParams):
     mpp = bt.shape[1]
     s = params.num_splits
     pps = mpp // s
-    bt_flat = bt.reshape(-1).astype(jnp.int32)
+    enum = BlockEnumeration.from_block_table(bt, s)
     sl = seq_lens.astype(jnp.int32)
 
-    def qmap(b_, s_, p_, bt_, sl_):
+    def qmap(b_, s_, p_, pages_, rs_, rc_, sl_):
         return (b_, 0, 0)
 
-    def kmap(b_, s_, p_, bt_, sl_):
-        return (bt_[b_ * mpp + s_ * pps + p_], 0, 0, 0)
+    def kmap(b_, s_, p_, pages_, rs_, rc_, sl_):
+        e = clamped_entry(rs_, rc_, b_ * s + s_, p_)
+        return (pages_[e], 0, 0, 0)
 
-    def omap(b_, s_, p_, bt_, sl_):
+    def omap(b_, s_, p_, pages_, rs_, rc_, sl_):
         return (b_, s_, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=4,
         grid=(b, s, pps),
         in_specs=[
             pl.BlockSpec((1, hq, d), qmap),
@@ -296,7 +309,8 @@ def _decode_pallas(q, cache: PagedKVCache, bt, seq_lens, params: DecodeParams):
         compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
-    )(bt_flat, sl, q, cache.k_pages, cache.v_pages)
+    )(enum.minor, enum.row_start, enum.row_count, sl, q, cache.k_pages,
+      cache.v_pages)
     outs = [out_parts[:, i] for i in range(s)]
     lses = [lse_parts[:, i, :, 0] for i in range(s)]
     outs, lses, code = _apply_split_resilience(outs, lses)

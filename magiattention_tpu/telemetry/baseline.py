@@ -95,6 +95,7 @@ def make_history_entry(
     device: str | None = None,
     vs_baseline: float | None = None,
     autotune_rung: str | None = None,
+    varlen_rung: str | None = None,
     mask_density: dict | None = None,
     roofline_efficiency: dict | None = None,
     peak_hbm_bytes: int | None = None,
@@ -129,6 +130,11 @@ def make_history_entry(
         entry["vs_baseline"] = vs_baseline
     if autotune_rung is not None:
         entry["autotune_rung"] = autotune_rung
+    if varlen_rung is not None:
+        # the 16k-varlen workload's resolved rung incl. grid layout
+        # ("BQxBKxHB:grid", ISSUE 15) — the sparse-grid sibling of
+        # ``autotune_rung`` (which names the 64k dense headline's rung)
+        entry["varlen_rung"] = varlen_rung
     if mask_density:
         entry["mask_density"] = {
             k: float(v) for k, v in sorted(mask_density.items())
@@ -167,21 +173,27 @@ def newest_metric_value(
 
 def rung_changes(history: list[dict]) -> list[str]:
     """Human-readable flags for autotuner rung changes between
-    consecutive runs that recorded one. A rung change re-prices every
-    kernel-tier number, so the gate surfaces it next to any TF/s delta."""
+    consecutive runs that recorded one (both the 64k headline's
+    ``autotune_rung`` and the 16k-varlen ``varlen_rung``, incl. its
+    grid layout). A rung change re-prices every kernel-tier number, so
+    the gate surfaces it next to any TF/s delta."""
     flags: list[str] = []
-    prev: tuple[str, str] | None = None  # (source, rung)
-    for entry in history:
-        rung = entry.get("autotune_rung")
-        if not rung:
-            continue
-        src = str(entry.get("source", "?"))
-        if prev is not None and prev[1] != rung:
-            flags.append(
-                f"autotune rung changed {prev[1]} -> {rung} "
-                f"(between {prev[0]} and {src})"
-            )
-        prev = (src, rung)
+    for key, label in (
+        ("autotune_rung", "autotune rung"),
+        ("varlen_rung", "varlen rung"),
+    ):
+        prev: tuple[str, str] | None = None  # (source, rung)
+        for entry in history:
+            rung = entry.get(key)
+            if not rung:
+                continue
+            src = str(entry.get("source", "?"))
+            if prev is not None and prev[1] != rung:
+                flags.append(
+                    f"{label} changed {prev[1]} -> {rung} "
+                    f"(between {prev[0]} and {src})"
+                )
+            prev = (src, rung)
     return flags
 
 

@@ -736,7 +736,8 @@ def record_roofline(report) -> None:
         "roofline",
         {
             "workload": w,
-            "rung": f"{report.block_q}x{report.block_k}x{report.head_block}",
+            "rung": f"{report.block_q}x{report.block_k}x{report.head_block}"
+            + (f":{report.grid}" if report.grid != "row_major" else ""),
             "mask_density": report.mask_density,
             "measured_tflops": report.measured_tflops,
             "efficiency": report.efficiency,

@@ -126,6 +126,15 @@ class BlockOccupancyMap:
         with open(path) as f:
             return BlockOccupancyMap.from_json(json.load(f))
 
+    def to_enumeration(self):
+        """The shared sparse-core view of this map
+        (``ops.block_sparse.BlockEnumeration``): the flattened
+        major->minor walk a compact sparse grid launches over — the
+        profiler's measurement output IS the kernel's input format."""
+        from ..ops.block_sparse import BlockEnumeration
+
+        return BlockEnumeration.from_occupancy(self)
+
     def ascii_heatmap(self, max_rows: int = 32, max_cols: int = 64) -> str:
         """Downsampled tile-occupancy picture for the report: rows are
         q-blocks, columns k-blocks, shade = fraction of the cell's tiles

@@ -46,6 +46,7 @@ import numpy as np
 
 from ..tuning.cost_model import (
     DEAD_STEP_OVERHEAD_S,
+    SPARSE_STEP_OVERHEAD_S,
     STEP_OVERHEAD_S,
     _normalize_slices,
     estimate_entries,
@@ -105,6 +106,10 @@ class RooflineReport:
     live_slots: int
     dead_slots: int
     bytes_moved: float  # modeled HBM traffic (q/o + per-entry kv re-reads)
+    # kernel grid layout the accounting describes: the sparse entry walk
+    # launches exactly ``live_slots`` slots (dead_slots == 0 by
+    # construction — ROADMAP item 1's gate condition)
+    grid: str = "row_major"
     # measurement (mask-FLOPs TF/s convention); None = static analysis
     measured_tflops: float | None = None
     measured_ms: float | None = None
@@ -157,7 +162,10 @@ class RooflineReport:
 
     @property
     def live_step_seconds(self) -> float:
-        return self.live_slots * STEP_OVERHEAD_S
+        fee = STEP_OVERHEAD_S + (
+            SPARSE_STEP_OVERHEAD_S if self.grid == "sparse" else 0.0
+        )
+        return self.live_slots * fee
 
     @property
     def modeled_seconds(self) -> float:
@@ -226,7 +234,8 @@ class RooflineReport:
         lines = [
             f"mask-aware roofline: {self.workload} on {self.generation} "
             f"(peak {self.peak_tflops:g} TF/s)",
-            f"  rung {self.block_q}x{self.block_k}x{self.head_block}: "
+            f"  rung {self.block_q}x{self.block_k}x{self.head_block} "
+            f"[{self.grid}]: "
             f"{self.entries} entries over {self.num_q_blocks} q-blocks x "
             f"{self.steps} steps x {self.grid_rows} head rows "
             f"(dead slots {self.dead_slots}/"
@@ -291,6 +300,7 @@ def analyze_workload(
     block_q: int,
     block_k: int,
     head_block: int = 1,
+    grid: str = "row_major",
     bytes_per_elt: int = 2,
     generation: str | None = None,
     backend: str | None = None,
@@ -301,6 +311,12 @@ def analyze_workload(
     total_seqlen_k: int | None = None,
 ) -> RooflineReport:
     """Static mask-aware roofline accounting of one workload at one rung.
+
+    ``grid`` names the kernel grid layout being priced: the sparse entry
+    walk has zero dead slots by construction (its grid extent IS the
+    entry count), so the dead-step term vanishes and live slots carry
+    the sparse dynamic-map fee — the same pricing the autotuner ranks
+    with (single-sourced constants).
 
     Exactly one of ``measured_tflops`` / ``measured_ms`` (or neither, for
     a pure static analysis) — the other is derived through the mask-FLOPs
@@ -328,7 +344,7 @@ def analyze_workload(
     )
     grid_rows = max(num_heads_q // max(head_block, 1), 1)
     live = grid_rows * entries
-    dead = max(grid_rows * nq * steps - live, 0)
+    dead = 0 if grid == "sparse" else max(grid_rows * nq * steps - live, 0)
     # modeled HBM traffic: Q read + O write once per row-head, K+V
     # re-read once per emitted tile column (the entry table's DMA shape)
     qo_bytes = 2.0 * sq * num_heads_q * head_dim * bytes_per_elt
@@ -358,6 +374,7 @@ def analyze_workload(
         live_slots=live,
         dead_slots=dead,
         bytes_moved=qo_bytes + kv_bytes,
+        grid=grid,
         measured_tflops=measured_tflops,
         measured_ms=measured_ms,
     )
@@ -374,6 +391,7 @@ def profile_roofline(
     block_q: int | None = None,
     block_k: int | None = None,
     head_block: int | None = None,
+    grid: str | None = None,
     dtype: str = "bfloat16",
     generation: str | None = None,
     workload: str = "workload",
@@ -399,9 +417,9 @@ def profile_roofline(
     """
     hkv = num_heads_kv if num_heads_kv is not None else num_heads_q
     if block_q is None or block_k is None or head_block is None:
-        from ..ops.flex_attn import auto_block_config
+        from ..ops.flex_attn import auto_kernel_config
 
-        bq, bk, hb = auto_block_config(
+        bq, bk, hb, ag = auto_kernel_config(
             [(int(a), int(b)) for a, b in np.asarray(q_ranges).reshape(-1, 2)],
             [(int(a), int(b)) for a, b in np.asarray(k_ranges).reshape(-1, 2)],
             num_heads_q,
@@ -413,6 +431,16 @@ def profile_roofline(
         block_q = block_q if block_q is not None else bq
         block_k = block_k if block_k is not None else bk
         head_block = head_block if head_block is not None else hb
+        grid = grid if grid is not None else ag
+    if grid is None:
+        # fully pinned blocking: price/run what a pinned
+        # flex_flash_attn_func call at this blocking actually executes
+        # (env override, else row-major) — NOT the autotuner's winning
+        # grid for a DIFFERENT rung
+        from .. import env
+
+        override = env.grid_override()
+        grid = override if override is not None else "row_major"
     if measure:
         measured_ms = _measure_ms(
             q_ranges, k_ranges, attn_type_map,
@@ -420,7 +448,7 @@ def profile_roofline(
             # pin the kernel to the rung being priced — an explicitly
             # requested blocking must be the one that runs
             block_q=block_q, block_k=block_k, head_block=head_block,
-            reps=reps, warmup=warmup, seed=seed,
+            grid=grid, reps=reps, warmup=warmup, seed=seed,
         )
         measured_tflops = None  # re-derived from the mask-FLOPs convention
     rep = analyze_workload(
@@ -433,6 +461,7 @@ def profile_roofline(
         block_q=block_q,
         block_k=block_k,
         head_block=head_block,
+        grid=grid,
         bytes_per_elt=int(np.dtype(dtype).itemsize),
         generation=generation,
         workload=workload,
@@ -448,7 +477,7 @@ def profile_roofline(
 
 def _measure_ms(
     q_ranges, k_ranges, attn_type_map, hq, hkv, head_dim, dtype,
-    *, block_q, block_k, head_block, reps, warmup, seed,
+    *, block_q, block_k, head_block, grid, reps, warmup, seed,
 ) -> float:
     """Time the single-device flex kernel on synthesized operands with
     the tunnel-safe ``do_bench`` sync discipline, at the EXACT blocking
@@ -477,6 +506,7 @@ def _measure_ms(
         lambda q, k, v: flex_flash_attn_func(
             q, k, v, qr, kr, ts,
             block_q=block_q, block_k=block_k, head_block=head_block,
+            grid=grid,
         )[0]
     )
     return do_bench(fwd, q, k, v, warmup=warmup, rep=reps).median_ms

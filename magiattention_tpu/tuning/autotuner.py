@@ -44,10 +44,17 @@ class TuningDecision:
     predicted_ms: float
     measured_ms: float | None
     reason: str  # one-line human-readable why
+    # kernel grid layout ("row_major" | "sparse"): heterogeneous masks
+    # resolve to the compact sparse entry walk (ROADMAP item 1)
+    grid: str = "row_major"
 
     @property
     def config(self) -> tuple[int, int, int]:
         return (self.block_q, self.block_k, self.head_block)
+
+    @property
+    def kernel_config(self) -> tuple[int, int, int, str]:
+        return (self.block_q, self.block_k, self.head_block, self.grid)
 
 
 def _static_decision(q_ranges, k_ranges, hq: int, hk: int) -> TuningDecision:
@@ -81,12 +88,15 @@ def select_block_config(
     max_block_k: int | None = None,
     smem_headroom: float = 1.0,
     measure_fn=None,
+    include_sparse: bool = True,
 ) -> TuningDecision | None:
-    """Resolve (block_q, block_k, head_block) for one workload.
+    """Resolve (block_q, block_k, head_block, grid) for one workload.
 
-    ``measure_fn(block_q, block_k, head_block) -> seconds`` times one
-    candidate on device (only consulted in ``measure`` mode; exceptions
-    disqualify the candidate rather than failing the plan).
+    ``measure_fn(block_q, block_k, head_block, grid) -> seconds`` times
+    one candidate on device (only consulted in ``measure`` mode;
+    exceptions disqualify the candidate rather than failing the plan).
+    ``include_sparse=False`` restricts the ranking to the row-major grid
+    (the distributed plan builder's contract).
 
     Returns ``None`` when the caller's ``max_block_q``/``max_block_k``
     constraints leave no candidate rung — the caller falls back to its
@@ -116,6 +126,7 @@ def select_block_config(
         dtype=dtype,
         max_block_q=max_block_q,
         max_block_k=max_block_k,
+        include_sparse=include_sparse,
     )
     cache = get_tuning_cache()
     rec, layer = cache.get(fp)
@@ -175,6 +186,7 @@ def select_block_config(
             predicted_ms=rec.predicted_ms,
             measured_ms=rec.measured_ms,
             reason=f"tuning-cache {layer} hit ({rec.source} winner)",
+            grid=rec.grid,
         )
         _record(decision)
         return decision
@@ -190,6 +202,7 @@ def select_block_config(
         max_block_q=max_block_q,
         max_block_k=max_block_k,
         smem_headroom=smem_headroom,
+        include_sparse=include_sparse,
     )
     if not scores:
         return None  # constraints excluded every rung
@@ -198,24 +211,28 @@ def select_block_config(
     measured_ms = None
     reason = (
         f"cost model: {best.block_q}x{best.block_k}x{best.head_block} "
-        f"~{best.cost_seconds * 1e3:.2f} ms "
+        f"({best.grid}) ~{best.cost_seconds * 1e3:.2f} ms "
         f"(mxu {best.mxu_seconds * 1e3:.2f} + grid "
         f"{best.step_seconds * 1e3:.2f}; {best.entries} entries, "
         f"steps {best.steps})"
     )
     if mode == "measure" and measure_fn is not None:
+        _check_measure_fn_arity(measure_fn)
         timed: list[tuple[float, object]] = []
         attempted = 0
         for cand in [s for s in scores if s.feasible][:MEASURE_TOP_K]:
             attempted += 1
             try:
                 t = float(
-                    measure_fn(cand.block_q, cand.block_k, cand.head_block)
+                    measure_fn(
+                        cand.block_q, cand.block_k, cand.head_block, cand.grid
+                    )
                 )
             except Exception as e:  # noqa: BLE001 — a crashing candidate
                 # is disqualified, not fatal (e.g. over-budget SMEM)
                 telemetry.record_autotune_measure_failure(
-                    f"{cand.block_q}x{cand.block_k}x{cand.head_block}",
+                    f"{cand.block_q}x{cand.block_k}x{cand.head_block}"
+                    f":{cand.grid}",
                     str(e),
                 )
                 continue
@@ -227,8 +244,8 @@ def select_block_config(
             measured_ms = t_best * 1e3
             reason = (
                 f"measured winner {best.block_q}x{best.block_k}x"
-                f"{best.head_block}: {measured_ms:.2f} ms over "
-                f"{len(timed)} candidates (fwd-only timing)"
+                f"{best.head_block} ({best.grid}): {measured_ms:.2f} ms "
+                f"over {len(timed)} candidates (fwd-only timing)"
             )
         elif attempted:
             source = "measure_failed"
@@ -251,6 +268,7 @@ def select_block_config(
         predicted_ms=best.cost_seconds * 1e3,
         measured_ms=measured_ms,
         candidates=tuple(s.as_dict() for s in scores),
+        grid=best.grid,
     )
     if not aliased:
         cache.put(fp, rec)
@@ -268,9 +286,39 @@ def select_block_config(
         predicted_ms=rec.predicted_ms,
         measured_ms=measured_ms,
         reason=reason,
+        grid=best.grid,
     )
     _record(decision)
     return decision
+
+
+def _check_measure_fn_arity(measure_fn) -> None:
+    """Fail loudly on a pre-sparse 3-arg ``measure_fn``: the contract
+    grew a 4th ``grid`` argument (ISSUE 15), and without this check the
+    per-candidate TypeError would be swallowed by the crashed-candidate
+    handler — measure mode silently degrading to the model with the
+    caller believing on-device timings ranked the rungs."""
+    import inspect
+
+    try:
+        sig = inspect.signature(measure_fn)
+    except (TypeError, ValueError):  # builtins/C callables: trust them
+        return
+    positional = [
+        p
+        for p in sig.parameters.values()
+        if p.kind
+        in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD, p.VAR_POSITIONAL)
+    ]
+    if any(p.kind == p.VAR_POSITIONAL for p in positional):
+        return
+    if len(positional) < 4:
+        raise TypeError(
+            "measure_fn must accept (block_q, block_k, head_block, grid) "
+            f"— got a {len(positional)}-argument callable; the grid axis "
+            "was added to the microbenchmark contract by the sparse-grid "
+            "autotuner (ISSUE 15)"
+        )
 
 
 def _record(decision: TuningDecision) -> None:
@@ -444,7 +492,10 @@ def resolve_block_config(
     is scaled to per-rank tables (global entries / cp, doubled for run
     fragmentation). ``measure`` mode degrades to the cost model here —
     there is no way to microbenchmark a full distributed plan during key
-    creation; the decision's telemetry records that.
+    creation; the decision's telemetry records that. Sparse-grid rungs
+    are excluded (``include_sparse=False``): the distributed kernels run
+    the row-major grid (per-rank stacked tables with a static steps
+    extent), so pricing a grid they cannot launch would mis-rank.
     """
     from .. import env
 
@@ -466,6 +517,7 @@ def resolve_block_config(
         max_block_q=shard_q,
         max_block_k=shard_k,
         smem_headroom=(1.0 if cp_size <= 1 else 2.0 / cp_size),
+        include_sparse=False,
     )
     if decision is None:
         return None

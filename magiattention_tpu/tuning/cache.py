@@ -50,6 +50,9 @@ class TuningRecord:
     predicted_ms: float  # cost-model estimate for the winner
     measured_ms: float | None  # microbenchmark time (measure mode only)
     candidates: tuple[dict, ...]  # per-rung diagnostics, ranked
+    # kernel grid layout of the winner ("row_major" | "sparse"); old
+    # disk records predate the sparse grid and default to row_major
+    grid: str = "row_major"
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -70,6 +73,7 @@ class TuningRecord:
                 else None
             ),
             candidates=tuple(dict(c) for c in d.get("candidates", ())),
+            grid=str(d.get("grid", "row_major")),
         )
 
 

@@ -40,11 +40,54 @@ STEP_OVERHEAD_S = 3.0e-7
 # Clamped dead steps skip compute and re-DMA nothing; they still occupy a
 # grid slot. Measured indirectly (leveled-pad experiments, round 4).
 DEAD_STEP_OVERHEAD_S = 5.0e-8
+# Extra per-live-step fee of the compact sparse grid: its q-side index
+# maps are dynamic (``qblk[e]``), so Mosaic cannot statically prove
+# q-block residency across steps the way the row-major grid's static
+# maps allow — the round-5 flat-grid experiment bounds the worst case
+# (dynamic maps on FULLY dense 64k: 76 vs 132 TF/s) but the sparse walk
+# keeps entries q-sorted (residency changes only at row boundaries), so
+# the priced fee is a fraction of that bound. The asymmetry is the
+# point: dense workloads (dead slots ~0 anyway) stay on the measured
+# row-major rungs, heterogeneous masks (dead + partial-tile dominated)
+# escape to the sparse grid.
+SPARSE_STEP_OVERHEAD_S = 1.5e-7
 # Candidates within this relative cost of the best are considered a tie
 # and resolved by the measured preference order (the analytic model is
 # deliberately not trusted below its own error bar — the static table's
 # on-chip measurements are).
 TIE_TOLERANCE = 0.15
+
+# Sparse-only blockings: smaller tiles than any row-major rung carries.
+# On the row-major grid small tiles lose to grid-step overhead (the
+# static ``steps`` extent multiplies every row), but the sparse walk
+# pays only live entries — and small tiles are what kill the
+# partial-tile/masked-entry overcompute on narrow varlen blocks (the
+# 16k varlen headline's ~6x scheduled-vs-true FLOPs at (128, 512)).
+# head_block preferences sized like the small row-major rungs (the K/V
+# double-buffer footprint is smaller than (128, 512, 8)'s).
+SPARSE_ONLY_CONFIGS: tuple[tuple[int, int, int], ...] = (
+    (128, 256, 8),
+    (256, 256, 8),
+    (256, 512, 8),
+    (256, 768, 8),
+    (512, 512, 4),
+    (512, 768, 4),
+)
+
+# Below this covered fraction (true mask area / dense extent) a workload
+# is in the heterogeneous regime where the row-major grid's measured
+# throughput collapses (16k varlen block-causal: 8.44 TF/s at ~0.20
+# density vs 101-113 TF/s on >= 0.5-density dense causal) — per-step
+# overheads the analytic model cannot price dominate. Ties are then
+# resolved toward the sparse grid with the FEWEST total grid slots
+# instead of the dense-measured preference order.
+SPARSE_DENSITY_THRESHOLD = 0.25
+# Tie band in that regime: the model's residual on the one measured
+# heterogeneous workload is ~8x (8.44 TF/s measured vs ~70 modeled), so
+# the dense-calibrated 15% band is false precision there; 30% still
+# bounds the modeled regression a slot-minimizing rung may accept while
+# letting coarse-tile sparse candidates (fewest grid steps) through.
+SPARSE_TIE_TOLERANCE = 0.30
 
 
 def _cdiv(a: int, b: int) -> int:
@@ -64,14 +107,26 @@ class CandidateScore:
     feasible: bool
     mxu_seconds: float
     step_seconds: float
+    # "row_major" (static steps grid) or "sparse" (compact entry walk);
+    # sparse candidates have zero dead slots by construction
+    grid: str = "row_major"
+    live_slots: int = 0  # grid_rows * entries (slots that compute)
+    dead_slots: int = 0  # clamped slots past a row's entry count
 
     @property
     def cost_seconds(self) -> float:
         return self.mxu_seconds + self.step_seconds
 
+    @property
+    def grid_slots(self) -> int:
+        """Total grid slots the candidate launches (live + dead) — the
+        step count the acceptance gate tracks on the headline workload."""
+        return self.live_slots + self.dead_slots
+
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["cost_seconds"] = self.cost_seconds
+        d["grid_slots"] = self.grid_slots
         return d
 
 
@@ -314,8 +369,20 @@ def rank_candidates(
     max_block_q: int | None = None,
     max_block_k: int | None = None,
     smem_headroom: float = 1.0,
+    include_sparse: bool = True,
 ) -> list[CandidateScore]:
     """Score every candidate rung for the workload, best first.
+
+    Each blocking is priced under BOTH grid layouts: the row-major grid
+    pays calibrated live + dead step fees (dead = clamped slots past a
+    row's entry count — the static ``steps`` extent is the max over q
+    blocks, so skewed varlen rows burn dead slots), the sparse grid pays
+    zero dead slots but a dynamic-index-map fee per live step
+    (:data:`SPARSE_STEP_OVERHEAD_S`), plus the sparse-only small-tile
+    blockings (:data:`SPARSE_ONLY_CONFIGS`) that only make sense without
+    a steps extent. ``include_sparse=False`` restores the pre-sparse
+    row-major-only ranking — the distributed plan builder's contract
+    (its kernels run the row-major grid).
 
     The returned order is cost-ascending EXCEPT that candidates within
     :data:`TIE_TOLERANCE` of the best are resolved by the measured
@@ -351,33 +418,62 @@ def rank_candidates(
     naive = [(r[0], r[1]) for r in q.tolist()]
     naive_k = [(r[0], r[1]) for r in k.tolist()]
 
-    scores: list[CandidateScore] = []
-    for bq, bk, hb_pref in _preference_order(extent):
-        if max_block_q is not None and bq > max_block_q:
-            continue
-        if max_block_k is not None and bk > max_block_k:
-            continue
+    def score_one(bq: int, bk: int, hb_pref: int, grid: str):
         hb = _auto_head_block(hb_pref, hq, group)
         entries, steps, nq = estimate_entries(q, k, t, bq, bk)
         smem_est = int(_est_entries(naive, naive_k, bq, bk) * smem_headroom)
         grid_rows = max(hq // max(hb, 1), 1)
         live = grid_rows * entries
-        dead = max(grid_rows * nq * steps - live, 0)
+        if grid == "sparse":
+            dead = 0
+            step_s = live * (STEP_OVERHEAD_S + SPARSE_STEP_OVERHEAD_S)
+        else:
+            dead = max(grid_rows * nq * steps - live, 0)
+            step_s = live * STEP_OVERHEAD_S + dead * DEAD_STEP_OVERHEAD_S
         mxu_s = 4.0 * head_dim * hq * entries * bq * bk / eff_flops
-        step_s = live * STEP_OVERHEAD_S + dead * DEAD_STEP_OVERHEAD_S
-        scores.append(
-            CandidateScore(
-                block_q=bq,
-                block_k=bk,
-                head_block=hb,
-                entries=entries,
-                steps=steps,
-                smem_entries=smem_est,
-                feasible=smem_est <= _MAX_SMEM_ENTRIES,
-                mxu_seconds=mxu_s,
-                step_seconds=step_s,
-            )
+        return CandidateScore(
+            block_q=bq,
+            block_k=bk,
+            head_block=hb,
+            entries=entries,
+            steps=steps,
+            smem_entries=smem_est,
+            feasible=smem_est <= _MAX_SMEM_ENTRIES,
+            mxu_seconds=mxu_s,
+            step_seconds=step_s,
+            grid=grid,
+            live_slots=live,
+            dead_slots=dead,
         )
+
+    scores: list[CandidateScore] = []
+    seen: set[tuple[int, int, int, str]] = set()
+
+    def emit(bq: int, bk: int, hb_pref: int, grid: str) -> None:
+        if max_block_q is not None and bq > max_block_q:
+            return
+        if max_block_k is not None and bk > max_block_k:
+            return
+        cand = score_one(bq, bk, hb_pref, grid)
+        # _auto_head_block can collapse different hb preferences onto
+        # one head_block (small hq / GQA snapping) — a value-equal
+        # duplicate would waste a MEASURE_TOP_K microbenchmark slot
+        key = (cand.block_q, cand.block_k, cand.head_block, cand.grid)
+        if key in seen:
+            return
+        seen.add(key)
+        scores.append(cand)
+
+    for bq, bk, hb_pref in _preference_order(extent):
+        # row-major FIRST: tied candidates resolve by generation order,
+        # and inside the model's error bar the on-chip-measured
+        # row-major rungs outrank the unmeasured sparse pricing
+        emit(bq, bk, hb_pref, "row_major")
+        if include_sparse:
+            emit(bq, bk, hb_pref, "sparse")
+    if include_sparse:
+        for bq, bk, hb_pref in SPARSE_ONLY_CONFIGS:
+            emit(bq, bk, hb_pref, "sparse")
 
     feasible = [s for s in scores if s.feasible]
     if not feasible:
@@ -390,12 +486,30 @@ def rank_candidates(
             key=lambda s: (-s.block_q * s.block_k, -s.block_k, s.smem_entries),
         )
     best = min(s.cost_seconds for s in feasible)
-    tied = [
-        s for s in feasible if s.cost_seconds <= best * (1.0 + TIE_TOLERANCE)
-    ]
+    sq = int(q[:, 1].max()) if q.size else 0
+    sk = int(k[:, 1].max()) if k.size else 0
+    density = exact_mask_area(q, k, t) / max(sq * sk, 1)
+    hetero = (
+        include_sparse
+        and density < SPARSE_DENSITY_THRESHOLD
+        and any(s.grid == "sparse" for s in feasible)
+    )
+    tol = SPARSE_TIE_TOLERANCE if hetero else TIE_TOLERANCE
+    tied = [s for s in feasible if s.cost_seconds <= best * (1.0 + tol)]
+    if hetero and any(s.grid == "sparse" for s in tied):
+        # heterogeneous regime: inside the model's error bar, minimize
+        # grid steps on the sparse grid — the measured 8.44 TF/s
+        # collapse is step-overhead-shaped, and dead-step-free compact
+        # grids with the fewest slots are the fix ROADMAP item 1 names
+        tied = sorted(
+            tied,
+            key=lambda s: (s.grid != "sparse", s.grid_slots, s.cost_seconds),
+        )
     rest = sorted(
         (s for s in scores if s not in tied), key=lambda s: s.cost_seconds
     )
     # tied candidates keep the measured preference order they were
-    # generated in; clear winners sort ahead of the tie-pool's losers
+    # generated in (dense regime) or the sparse slot-minimizing order
+    # (heterogeneous regime); clear winners sort ahead of the tie-pool's
+    # losers
     return tied + rest

@@ -52,12 +52,31 @@ class WorkloadFingerprint:
     max_block_q: int  # caller shard constraint (0 = unconstrained)
     max_block_k: int
     entry_est: tuple[tuple[int, int, int], ...]  # (bq, bk, bucketed E)
+    # v3: the sparse-grid rung axes (ISSUE 15). ``step_est`` buckets the
+    # per-rung static steps extent (max entries on any q block) — the
+    # row-skew statistic that decides sparse-vs-row-major, absent from
+    # every other field; ``sparse_entry_est`` covers the sparse-only
+    # small-tile blockings. Two workloads whose sparse ranking differs
+    # can no longer alias one cached winner, and the version bump alone
+    # retires every pre-sparse cache entry (a dense winner recorded
+    # before the sparse rungs existed must not be served to a workload
+    # the new ranking would send to the sparse grid).
+    step_est: tuple[tuple[int, int, int], ...] = ()
+    sparse_entry_est: tuple[tuple[int, int, int], ...] = ()
+    # whether sparse rungs were in the ranking this key describes: a
+    # row-major-only decision (``include_sparse=False`` — the
+    # distributed builder, ``auto_block_config``) and a full-ranking
+    # decision for the SAME mask are different answers and must not
+    # share a cache slot in either direction
+    sparse_rungs: int = 1
 
-    FINGERPRINT_VERSION = 2
+    FINGERPRINT_VERSION = 3
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["entry_est"] = [list(e) for e in self.entry_est]
+        d["step_est"] = [list(e) for e in self.step_est]
+        d["sparse_entry_est"] = [list(e) for e in self.sparse_entry_est]
         return d
 
     def stable_hash(self) -> str:
@@ -79,6 +98,7 @@ def make_fingerprint(
     dtype: str = "bfloat16",
     max_block_q: int | None = None,
     max_block_k: int | None = None,
+    include_sparse: bool = True,
 ) -> WorkloadFingerprint:
     """Derive the fingerprint from host-side slice ranges.
 
@@ -110,6 +130,7 @@ def make_fingerprint(
         str(dtype),
         int(max_block_q or 0),
         int(max_block_k or 0),
+        int(bool(include_sparse)),
     )
     fp = _FP_MEMO.get(key)
     if fp is None:
@@ -215,12 +236,13 @@ def _make_fingerprint_impl(
     dtype: str,
     max_block_q: int,
     max_block_k: int,
+    sparse_rungs: int,
 ) -> WorkloadFingerprint:
     import numpy as np
 
     from ..common.mask import slice_area
     from ..ops.flex_attn import _AUTO_BLOCK_CONFIGS
-    from .cost_model import estimate_entries
+    from .cost_model import SPARSE_ONLY_CONFIGS, estimate_entries
 
     total_q = int(q[:, 1].max()) if q.size else 0
     total_k = int(k[:, 1].max()) if k.size else 0
@@ -237,6 +259,14 @@ def _make_fingerprint_impl(
     entry_est = tuple(
         (bq, bk, _log2_bucket(estimate_entries(q, k, t, bq, bk)[0]))
         for bq, bk, _hb in _AUTO_BLOCK_CONFIGS
+    )
+    step_est = tuple(
+        (bq, bk, _log2_bucket(estimate_entries(q, k, t, bq, bk)[1]))
+        for bq, bk, _hb in _AUTO_BLOCK_CONFIGS
+    )
+    sparse_entry_est = tuple(
+        (bq, bk, _log2_bucket(estimate_entries(q, k, t, bq, bk)[0]))
+        for bq, bk, _hb in SPARSE_ONLY_CONFIGS
     )
     return WorkloadFingerprint(
         version=WorkloadFingerprint.FINGERPRINT_VERSION,
@@ -257,4 +287,7 @@ def _make_fingerprint_impl(
         max_block_q=max_block_q,
         max_block_k=max_block_k,
         entry_est=entry_est,
+        step_est=step_est,
+        sparse_entry_est=sparse_entry_est,
+        sparse_rungs=sparse_rungs,
     )

@@ -125,3 +125,23 @@ def test_block_sparse_rect_cross(tq, tk, causal):
         np.asarray(lse)[finite], np.asarray(ref_lse)[finite],
         atol=2e-5, rtol=2e-5,
     )
+
+
+def test_block_mask_shape_mismatch_raises_typed_error():
+    """ISSUE 15 hardening: a block mask built for the wrong blocking (or
+    transposed [k, q]) raises a ValueError carrying the full shape
+    context — not a bare assert that ``python -O`` would strip."""
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((256, 2, 32)), jnp.float32)
+    bad = np.ones((3, 4), bool)  # 256 tokens at 64x64 needs (4, 4)
+    with pytest.raises(ValueError) as ei:
+        block_sparse_attn_func(q, q, q, bad, block_q=64, block_k=64)
+    msg = str(ei.value)
+    assert "(3, 4)" in msg and "(4, 4)" in msg
+    assert "(256, 256)" in msg and "(64, 64)" in msg
+    # transposed layout of a rectangular problem is called out too
+    k = jnp.asarray(rng.standard_normal((512, 2, 32)), jnp.float32)
+    with pytest.raises(ValueError, match="num_q_blocks, num_k_blocks"):
+        block_sparse_attn_func(
+            q, k, k, np.ones((8, 4), bool), block_q=64, block_k=64
+        )

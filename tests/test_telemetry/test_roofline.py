@@ -358,3 +358,29 @@ def test_rerecord_without_measurement_clears_stale_efficiency():
     assert "magi_roofline_efficiency{workload=reprofiled}" not in g
     assert "magi_roofline_achieved_tflops{workload=reprofiled}" not in g
     assert "magi_roofline_peak_tflops{workload=reprofiled}" in g
+
+
+def test_sparse_grid_report_has_zero_dead_slots():
+    """ISSUE 15: a sparse-grid analysis prices zero dead slots (the
+    compact grid's extent IS the entry count) and its dead-step gap
+    share is exactly 0 — the roofline-report acceptance condition."""
+    from magiattention_tpu.telemetry.roofline import analyze_workload
+
+    qr = [(0, 1000), (1000, 4096)]
+    kr = [(0, 1000), (1000, 4096)]
+    ts = [1, 1]
+    row = analyze_workload(
+        qr, kr, ts, num_heads_q=8, num_heads_kv=8, head_dim=128,
+        block_q=128, block_k=512, head_block=8,
+    )
+    sp = analyze_workload(
+        qr, kr, ts, num_heads_q=8, num_heads_kv=8, head_dim=128,
+        block_q=128, block_k=512, head_block=8, grid="sparse",
+    )
+    assert row.dead_slots > 0  # the skewed rows burn dead slots
+    assert sp.dead_slots == 0
+    assert sp.grid == "sparse"
+    assert sp.gap_fractions()["dead_steps"] == 0.0
+    assert sp.live_slots == row.live_slots  # same entries, no clamping
+    # the sparse grid prices the dynamic-map fee on live steps
+    assert sp.live_step_seconds > row.live_step_seconds

@@ -119,7 +119,7 @@ def test_measure_mode_winner_roundtrips_disk_cache(tmp_path, monkeypatch):
     target = (top[1].block_q, top[1].block_k)
     calls = []
 
-    def fake_measure(bq, bk, hb):
+    def fake_measure(bq, bk, hb, grid):
         calls.append((bq, bk, hb))
         return 0.001 if (bq, bk) == target else 0.010
 
@@ -160,7 +160,9 @@ def test_measure_mode_upgrades_model_sourced_cache_entry(tmp_path, monkeypatch):
     target = (top[1].block_q, top[1].block_k)
     upgraded = select_block_config(
         qr, kr, ts, 8, 8, mode="measure",
-        measure_fn=lambda bq, bk, hb: 0.001 if (bq, bk) == target else 0.010,
+        measure_fn=lambda bq, bk, hb, grid: (
+            0.001 if (bq, bk) == target else 0.010
+        ),
     )
     assert upgraded.source == "measured"
     assert (upgraded.block_q, upgraded.block_k) == target
@@ -206,17 +208,21 @@ def test_flex_func_measure_mode_honors_pinned_head_block(monkeypatch):
 
 
 def test_measure_mode_survives_crashing_candidates():
-    def bomb(bq, bk, hb):
-        if (bq, bk) != (128, 512):
+    from magiattention_tpu.tuning import rank_candidates
+
+    qr, kr, ts = [(0, 16384)], [(0, 16384)], [1]
+    top = [s for s in rank_candidates(qr, kr, ts, 8, 8) if s.feasible][:3]
+    assert len(top) >= 2
+    ok = (top[1].block_q, top[1].block_k, top[1].grid)
+
+    def bomb(bq, bk, hb, grid):
+        if (bq, bk, grid) != ok:
             raise RuntimeError("smem")
         return 0.005
 
-    d = select_block_config(
-        [(0, 16384)], [(0, 16384)], [1], 8, 8, mode="measure",
-        measure_fn=bomb,
-    )
+    d = select_block_config(qr, kr, ts, 8, 8, mode="measure", measure_fn=bomb)
     assert d.source == "measured"
-    assert (d.block_q, d.block_k) == (128, 512)
+    assert (d.block_q, d.block_k, d.grid) == ok
 
 
 def test_measure_mode_all_candidates_failing_does_not_retry_forever():
@@ -226,7 +232,7 @@ def test_measure_mode_all_candidates_failing_does_not_retry_forever():
     qr, kr, ts = [(0, 16384)], [(0, 16384)], [1]
     attempts = []
 
-    def always_bomb(bq, bk, hb):
+    def always_bomb(bq, bk, hb, grid):
         attempts.append((bq, bk))
         raise RuntimeError("device OOM")
 
@@ -256,7 +262,7 @@ def test_measure_failed_is_not_persisted_to_disk(tmp_path, monkeypatch):
     reset_tuning_cache()
     qr, kr, ts = [(0, 16384)], [(0, 16384)], [1]
 
-    def always_bomb(bq, bk, hb):
+    def always_bomb(bq, bk, hb, grid):
         raise RuntimeError("transient OOM")
 
     d = select_block_config(
@@ -419,3 +425,14 @@ def test_key_path_large_shards_get_tuned_blocking():
     assert cfg is not None
     bq, bk, hb = cfg
     assert bq <= 8192 and bk <= 8192 and hb >= 1
+
+
+def test_measure_mode_rejects_pre_sparse_three_arg_callback():
+    """A legacy 3-arg measure_fn must fail LOUDLY (the grid axis joined
+    the contract), not be silently swallowed as per-candidate crashes
+    that degrade measure mode to the model."""
+    with pytest.raises(TypeError, match="grid"):
+        select_block_config(
+            [(0, 16384)], [(0, 16384)], [1], 8, 8, mode="measure",
+            measure_fn=lambda bq, bk, hb: 0.001,
+        )

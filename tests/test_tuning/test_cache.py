@@ -104,3 +104,17 @@ def test_singleton_follows_env_dir(tmp_path, monkeypatch):
     c2 = get_tuning_cache()
     assert c2 is not c1 and c2.cache_dir == str(tmp_path)
     reset_tuning_cache()
+
+
+def test_record_grid_roundtrips_and_defaults():
+    """ISSUE 15: the winner's grid layout survives the disk round-trip,
+    and pre-sparse records (no ``grid`` key) load as row_major."""
+    from magiattention_tpu.tuning.cache import TuningRecord
+
+    rec = TuningRecord(
+        block_q=256, block_k=768, head_block=8, source="model",
+        predicted_ms=1.0, measured_ms=None, candidates=(), grid="sparse",
+    )
+    assert TuningRecord.from_dict(rec.as_dict()).grid == "sparse"
+    legacy = {k: v for k, v in rec.as_dict().items() if k != "grid"}
+    assert TuningRecord.from_dict(legacy).grid == "row_major"

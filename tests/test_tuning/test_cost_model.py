@@ -147,3 +147,51 @@ def test_gqa_head_block_snaps_to_group():
         assert s.head_block == 1 or (
             s.head_block % group == 0 and 8 % s.head_block == 0
         )
+
+
+def test_sparse_rungs_have_zero_dead_slots():
+    """ISSUE 15: every sparse-grid candidate prices zero dead steps —
+    the compact grid's extent IS the entry count."""
+    qr, kr, ts = _varlen_16k()
+    ranked = rank_candidates(qr, kr, ts, 8, 8)
+    sparse = [s for s in ranked if s.grid == "sparse"]
+    assert sparse, "sparse rungs missing from the ranking"
+    for s in sparse:
+        assert s.dead_slots == 0
+        assert s.grid_slots == s.live_slots
+
+
+def test_heterogeneous_headline_resolves_to_sparse_grid():
+    """The 16k varlen block-causal headline (the 8.44 TF/s regression)
+    must pick a sparse rung with >= 6x fewer grid slots than the best
+    row-major candidate, and dense 64k causal must NOT."""
+    qr, kr, ts = _varlen_16k()
+    best = rank_candidates(qr, kr, ts, 8, 8, generation="v5e")[0]
+    rm = rank_candidates(
+        qr, kr, ts, 8, 8, generation="v5e", include_sparse=False
+    )[0]
+    assert best.grid == "sparse"
+    assert best.dead_slots == 0
+    assert rm.grid_slots >= 6 * best.grid_slots
+    dense = rank_candidates(
+        [(0, 65536)], [(0, 65536)], [1], 8, 8, generation="v5e"
+    )[0]
+    assert dense.grid == "row_major"
+    assert (dense.block_q, dense.block_k) == (1024, 1024)
+
+
+def test_include_sparse_false_restores_row_major_only_ranking():
+    qr, kr, ts = _varlen_16k()
+    ranked = rank_candidates(qr, kr, ts, 8, 8, include_sparse=False)
+    assert ranked and all(s.grid == "row_major" for s in ranked)
+
+
+def _varlen_16k():
+    from magiattention_tpu.testing.workloads import varlen_block_causal
+
+    sl = varlen_block_causal(16384)
+    return (
+        [(a, b) for a, b, *_ in sl],
+        [(s[2], s[3]) for s in sl],
+        [s[4] for s in sl],
+    )

@@ -139,3 +139,27 @@ def test_log2_bucket_edges():
     assert _log2_bucket(1) == 0
     assert _log2_bucket(2) == 8
     assert _log2_bucket(4096) == 96
+
+
+def test_fingerprint_v3_carries_sparse_rung_axes():
+    """ISSUE 15: the fingerprint records the steps extent per rung and
+    the sparse-only rung entry estimates — workloads whose row skew (and
+    with it the sparse-vs-row-major ranking) differs must not share a
+    cached winner even when their aggregate statistics alias."""
+    fp = make_fingerprint([(0, 4096)], [(0, 4096)], [1], 8, 8)
+    assert fp.version == 3
+    assert fp.step_est and fp.sparse_entry_est
+    # one uniform 4k doc vs 4 skewed docs with the same total: the
+    # coarse aggregates may bucket together, the steps extent must not
+    uniform = make_fingerprint(
+        [(0, 1024), (1024, 2048), (2048, 3072), (3072, 4096)],
+        [(0, 1024), (1024, 2048), (2048, 3072), (3072, 4096)],
+        [1, 1, 1, 1], 8, 8,
+    )
+    skewed = make_fingerprint(
+        [(0, 3328), (3328, 3584), (3584, 3840), (3840, 4096)],
+        [(0, 3328), (3328, 3584), (3584, 3840), (3840, 4096)],
+        [1, 1, 1, 1], 8, 8,
+    )
+    assert uniform.step_est != skewed.step_est
+    assert uniform.stable_hash() != skewed.stable_hash()
