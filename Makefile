@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze spmd-audit lifecycle-check resilience-check roofline-check roofline-report trace-check distserve-check memory-check check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze spmd-audit lifecycle-check resilience-check roofline-check roofline-report trace-check distserve-check memory-check compile-check check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -187,6 +187,14 @@ distserve-check:
 memory-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_memory_check.py --self-test
 
+# program-observability gate (ISSUE 16; CPU): launch ledger + compile
+# registry reconciled on a multi-tenant trace, warm-pass solver-ms
+# credit with flat per-shape compiles, full REQUIRED_COMPILE_METRICS
+# exposition; --self-test plants a recompile storm that must produce a
+# tick-tagged flight dump
+compile-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_compile_check.py --self-test
+
 # mask-aware roofline report + occupancy JSON artifact for the 16k
 # varlen block-causal headline (docs/observability.md "Roofline &
 # occupancy"); host-side only
@@ -199,4 +207,4 @@ roofline-report:
 # parity/volume, resilience gate, roofline/occupancy gate, request
 # tracing/exposition gate, disaggregated-serving gate, memory
 # observability gate — all CPU-safe
-check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check distserve-check memory-check
+check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check distserve-check memory-check compile-check

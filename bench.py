@@ -269,6 +269,7 @@ def _append_history(meta: dict, extras: dict) -> None:
                 mask_density=densities,
                 roofline_efficiency=efficiencies,
                 peak_hbm_bytes=meta.get("peak_hbm_bytes"),
+                compile_s=meta.get("compile_s"),
             ),
         )
         print(f"bench history appended -> {_HISTORY}", file=sys.stderr)
@@ -663,9 +664,22 @@ def _measure() -> dict:
     fwd = jax.jit(
         lambda q, k, v: flex_flash_attn_func(q, k, v, qr, kr, ts)[0]
     )
+    # cold-compile seconds vs warm step time (ISSUE 16 satellite): the
+    # first call pays trace + lowering + XLA compile (minus whatever the
+    # persistent compile cache absorbed); subtracting the warm step
+    # isolates the compile share so compile-time regressions become
+    # perf-gate-visible alongside TF/s
+    t_cold = time.perf_counter()
+    jax.block_until_ready(fwd(q, k, v))
+    cold_s = time.perf_counter() - t_cold
     dt = _timeit(fwd, q, k, v, n=5)
+    compile_s = max(cold_s - dt, 0.0)
     tflops = flops / dt / 1e12
-    print(f"flex fwd: {dt*1e3:.2f} ms  {tflops:.2f} TFLOPs/s", file=sys.stderr)
+    print(
+        f"flex fwd: {dt*1e3:.2f} ms  {tflops:.2f} TFLOPs/s  "
+        f"(cold compile {compile_s:.2f} s)",
+        file=sys.stderr,
+    )
 
     # baseline: jax official TPU flash attention, causal, same shape
     try:
@@ -684,6 +698,7 @@ def _measure() -> dict:
         "value": round(tflops, 3),
         "unit": "TFLOPs/s",
         "vs_baseline": round(vs, 3),
+        "compile_s": round(compile_s, 3),
     }, dt
 
 

@@ -1,0 +1,390 @@
+"""Program-observability drift guard (``make compile-check``) — CPU.
+
+The ISSUE 16 acceptance surface, device-free, on a multi-tenant
+scheduler trace through the REAL scheduler:
+
+1. **launch ledger + compile registry reconciled**: every tick emits a
+   ``sched_tick`` span whose launch count equals its distinct program
+   census, the census reconciles bit-for-bit with the distinct
+   ``prefill_chunk``/``decode_step`` program labels of the request
+   spans that tick overlaps, the cost decomposition carries an HONEST
+   unattributed residual (surfaced, never gated), and the compile
+   tracker attributed real XLA compiles to serving program labels;
+2. **plan-cache warm pass**: a cold+warm keyed resolution credits
+   ``magi_plan_solver_ms_saved_total`` > 0, and a fixed-shape jitted
+   program compiles exactly once under its label — repeat calls keep
+   the per-shape compile count flat at 1;
+3. **exposition**: every ``REQUIRED_COMPILE_METRICS`` name renders
+   through ``render_prometheus``, and ``snapshot_delta`` derives the
+   plan-cache hit rate (the ROADMAP item 3 gate figure);
+4. **recompile-storm self-test** (``--self-test``): a planted
+   shape-thrashing loop (N same-label compiles inside the window) must
+   produce a flight dump tagged with the triggering program and tick.
+
+Exits non-zero on any violation.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the warm-pass keyed resolution builds a tiny cp=2 plan: virtual CPU
+# mesh, set BEFORE jax initializes
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] = "jnp"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from magiattention_tpu import telemetry  # noqa: E402
+from magiattention_tpu.serving import (  # noqa: E402
+    Request,
+    Scheduler,
+    ServingEngine,
+)
+from magiattention_tpu.telemetry import trace  # noqa: E402
+from magiattention_tpu.telemetry.collectors import (  # noqa: E402
+    H_COMPILE_S,
+    H_PLAN_SOLVER_S,
+    M_COMPILE_TOTAL,
+    M_JIT_CACHE_ENTRIES,
+    M_SCHED_LAUNCHES,
+    M_SOLVER_MS_SAVED,
+)
+
+HQ, HK, D, PS = 4, 2, 16, 8
+
+COST_KEYS = ("wall_ms", "solver_ms", "compile_ms", "device_ms",
+             "residual_ms")
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _req(rng, rid, tokens, gen, priority=0):
+    return Request(
+        rid=rid,
+        prompt_q=jnp.asarray(
+            rng.standard_normal((tokens, HQ, D)), jnp.float32
+        ),
+        prompt_k=jnp.asarray(
+            rng.standard_normal((tokens, HK, D)), jnp.float32
+        ),
+        prompt_v=jnp.asarray(
+            rng.standard_normal((tokens, HK, D)), jnp.float32
+        ),
+        decode_q=jnp.asarray(rng.standard_normal((gen, HQ, D)), jnp.float32),
+        decode_k=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        decode_v=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        priority=priority,
+    )
+
+
+def run_serving_ledger() -> int:
+    """Multi-tenant trace through the real scheduler: launch ledger and
+    compile registry populated, per-tick spans reconciled bit-for-bit
+    with the request-trace spans they overlap."""
+    rng = np.random.default_rng(1)
+    eng = ServingEngine(
+        num_pages=96, num_kv_heads=HK, head_dim=D, page_size=PS,
+        max_seqs=8, max_pages_per_seq=16, dtype=jnp.float32,
+    )
+    sched = Scheduler(eng, token_budget=24, chunk=PS)
+    # two short tenants + one long chunked prompt, interleaving prefill
+    # chunks with batched decode under the budget
+    sched.submit(_req(rng, 0, 2 * PS, gen=4))
+    sched.submit(_req(rng, 1, PS + 3, gen=3))
+    sched.submit(_req(rng, 2, 4 * PS, gen=2))
+    ticks = 0
+    while (sched.waiting or sched.num_active) and ticks < 64:
+        sched.step()
+        ticks += 1
+    if sched.num_active or sched.waiting:
+        return fail(f"scenario did not drain in {ticks} ticks")
+
+    evs = telemetry.get_event_buffer().events()
+    tick_evs = [e for e in evs if e["name"] == "sched_tick"]
+    if len(tick_evs) != ticks:
+        return fail(
+            f"{ticks} scheduler ticks emitted {len(tick_evs)} sched_tick "
+            "spans — the tick-decomposition track is incomplete"
+        )
+    # request spans that carry a program label (zero-token chunks don't)
+    prog_spans = [
+        e for e in evs
+        if e["name"] in ("req:prefill_chunk", "req:decode_step")
+        and e.get("args", {}).get("program")
+    ]
+    if not prog_spans:
+        return fail("no request span carries a program label")
+
+    launches_total = 0
+    for ev in tick_evs:
+        args = ev.get("args", {})
+        census = args.get("programs")
+        if census is None:
+            return fail(f"sched_tick span without a program census: {ev}")
+        if args.get("launches") != len(census):
+            return fail(
+                f"tick {args.get('step')}: launch count "
+                f"{args.get('launches')} != distinct census programs "
+                f"{len(census)}"
+            )
+        missing = [k for k in COST_KEYS if k not in args]
+        if missing:
+            return fail(
+                f"tick {args.get('step')}: cost decomposition missing "
+                f"{missing} — the residual must be SURFACED, not dropped"
+            )
+        # bit-for-bit: the census equals the distinct program labels of
+        # the request spans this tick overlaps (same labels, same tick
+        # window, two independent emission paths)
+        lo, hi = ev["ts"], ev["ts"] + ev["dur"]
+        overlapped = {
+            e["args"]["program"]
+            for e in prog_spans
+            if lo <= e["ts"] < hi
+        }
+        if overlapped != set(census):
+            return fail(
+                f"tick {args.get('step')}: census {sorted(census)} != "
+                f"overlapped request-span programs {sorted(overlapped)}"
+            )
+        launches_total += args["launches"]
+    if launches_total == 0:
+        return fail("no tick launched any program")
+
+    snap = telemetry.snapshot()
+    hist = snap["histograms"].get(M_SCHED_LAUNCHES)
+    if not hist or hist["count"] != ticks:
+        return fail(
+            f"{M_SCHED_LAUNCHES} observed "
+            f"{hist['count'] if hist else 0} ticks, expected {ticks}"
+        )
+    # the compile tracker attributed real XLA compiles to serving labels
+    stats = telemetry.get_compile_tracker().stats()
+    serving_labels = [
+        lab for lab in stats
+        if lab.startswith("prefill[") or lab.startswith("decode[")
+    ]
+    if not serving_labels:
+        return fail(
+            f"no serving program label in the compile tracker: "
+            f"{sorted(stats)}"
+        )
+    mirrored = [
+        k for k in snap["counters"]
+        if k.startswith(M_COMPILE_TOTAL + "{")
+    ]
+    if not mirrored:
+        return fail(f"{M_COMPILE_TOTAL} has no labeled series")
+    if not snap["histograms"].get(H_COMPILE_S):
+        return fail(f"{H_COMPILE_S} never observed a compile")
+    if snap["gauges"].get(M_JIT_CACHE_ENTRIES, 0) <= 0:
+        return fail(f"{M_JIT_CACHE_ENTRIES} gauge never set")
+    print(
+        f"compile-check: {ticks} ticks, {launches_total} launches, "
+        f"{len(serving_labels)} serving program labels "
+        f"({len(stats)} total), census==span reconciliation bit-for-bit, "
+        "residual surfaced on every tick"
+    )
+    return 0
+
+
+def check_warm_pass() -> int:
+    """Plan-cache warm pass credits solver ms saved; a fixed-shape
+    jitted program's per-shape compile count stays flat at 1."""
+    from jax.sharding import Mesh
+
+    from magiattention_tpu.api import magi_attn_flex_key
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("cp",))
+    before = telemetry.snapshot()["counters"].get(M_SOLVER_MS_SAVED, 0.0)
+    for _ in range(2):  # miss (cold build), then hit
+        magi_attn_flex_key(
+            [(0, 1024)], [(0, 1024)], [1], 1024, 1024, mesh,
+            num_heads=(2, 2), head_dim=32, chunk_size=256,
+        )
+    snap = telemetry.snapshot()
+    saved = snap["counters"].get(M_SOLVER_MS_SAVED, 0.0) - before
+    if saved <= 0:
+        return fail(
+            "warm keyed resolution credited no "
+            f"{M_SOLVER_MS_SAVED} (delta {saved})"
+        )
+    hists = snap["histograms"]
+    for outcome in ("hit", "miss"):
+        key = f"{H_PLAN_SOLVER_S}{{outcome={outcome}}}"
+        if key not in hists:
+            return fail(f"{key} never observed")
+
+    # per-shape compile count flat at 1: one label, one geometry, many
+    # executions — the jit cache must absorb every call after the first
+    tracker = telemetry.get_compile_tracker()
+    x = jnp.ones((8, 8), jnp.float32)
+    jax.block_until_ready(x)  # input creation compiles outside the label
+    f = jax.jit(lambda a: a @ a.T + 1.0)
+    label = "warmcheck[shape=8x8]"
+    with telemetry.program(label):
+        jax.block_until_ready(f(x))
+    first = tracker.stats().get(label, {}).get("count", 0)
+    if first != 1:
+        return fail(
+            f"one fixed-shape jit execution compiled {first} programs "
+            f"under {label!r}, expected exactly 1"
+        )
+    with telemetry.program(label):
+        for _ in range(5):
+            jax.block_until_ready(f(x))
+    after = tracker.stats().get(label, {}).get("count", 0)
+    if after != first:
+        return fail(
+            f"per-shape compile count grew {first} -> {after} on "
+            "repeated same-shape calls — the jit cache is not absorbing "
+            "warm executions"
+        )
+    print(
+        f"compile-check: warm pass saved {saved:.3f} solver ms, "
+        "per-shape compile count flat at 1 over 6 calls"
+    )
+    return 0
+
+
+def check_exposition() -> int:
+    """Every REQUIRED_COMPILE_METRICS name renders through
+    render_prometheus, and snapshot_delta derives the plan-cache hit
+    rate."""
+    snap = telemetry.snapshot()
+    text = telemetry.render_prometheus(snap)
+    for name in telemetry.REQUIRED_COMPILE_METRICS:
+        if not any(
+            line.startswith(name) or line.startswith("# ")
+            and f" {name} " in line
+            for line in text.splitlines()
+        ):
+            return fail(f"{name} missing from render_prometheus output")
+    delta = telemetry.snapshot_delta(None, snap)
+    rate = delta.get("derived", {}).get("plan_cache_hit_rate")
+    if rate is None:
+        return fail(
+            "snapshot_delta derived no plan_cache_hit_rate over a "
+            "window with plan-cache traffic"
+        )
+    if not (0.0 < rate <= 1.0):
+        return fail(f"plan_cache_hit_rate {rate} outside (0, 1]")
+    print(
+        f"compile-check: full REQUIRED_COMPILE_METRICS exposition, "
+        f"derived plan-cache hit rate {rate:.2f}"
+    )
+    return 0
+
+
+def check_storm_selftest(td: str) -> int:
+    """--self-test: a planted shape-thrashing loop must produce a
+    recompile_storm flight dump tagged with program and tick."""
+    threshold = 3
+    os.environ["MAGI_ATTENTION_RECOMPILE_STORM_THRESHOLD"] = str(threshold)
+    trace.reset_flight_recorder()
+    fr = trace.get_flight_recorder()
+    tracker = telemetry.get_compile_tracker()
+    tracker.note_tick(777)
+    # the dump needs at least one recorded tick to have a ring to write
+    fr.record_tick({"step": 777, "planted": "recompile_storm self-test"})
+    label = "selftest[thrash]"
+    with telemetry.program(label):
+        for t in range(threshold + 1):
+            # a fresh lambda each iteration = a fresh jit cache entry =
+            # a fresh XLA compile, all under ONE label: shape thrash
+            jax.block_until_ready(
+                jax.jit(lambda x: x * 2.0)(jnp.ones((t + 1,)))
+            )
+    fr.flush()  # deferred trigger: flushes at tick end
+    dumps = sorted(
+        f for f in os.listdir(td) if f.startswith("magi_flight_")
+    )
+    if not dumps:
+        return fail(
+            "planted recompile storm wrote no flight dump "
+            f"(threshold={threshold})"
+        )
+    with open(os.path.join(td, dumps[-1])) as fh:
+        dump = json.load(fh)
+    trig = dump.get("trigger", {})
+    ctx = trig.get("context", {})
+    if trig.get("trigger") != "recompile_storm":
+        return fail(f"dump trigger signal {trig.get('trigger')!r}")
+    if ctx.get("program") != label:
+        return fail(
+            f"storm dump names program {ctx.get('program')!r}, "
+            f"expected {label!r}"
+        )
+    if ctx.get("tick") != 777:
+        return fail(
+            f"storm dump tagged tick {ctx.get('tick')!r}, expected 777"
+        )
+    print(
+        f"compile-check: planted storm ({threshold} same-label compiles "
+        f"in window) produced tick-tagged flight dump {dumps[-1]}"
+    )
+    return 0
+
+
+def main() -> int:
+    self_test = "--self-test" in sys.argv
+    env_backup = {
+        k: os.environ.get(k)
+        for k in (
+            "MAGI_ATTENTION_RECOMPILE_STORM_THRESHOLD",
+            "MAGI_ATTENTION_TRACE_DIR",
+            "MAGI_ATTENTION_PREFILL_CHUNK",
+        )
+    }
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    telemetry.reset_compile_tracker()
+    trace.reset_flight_recorder()
+    try:
+        with tempfile.TemporaryDirectory(
+            prefix="magi_compile_check_"
+        ) as td:
+            os.environ["MAGI_ATTENTION_TRACE_DIR"] = td
+            checks = [run_serving_ledger, check_warm_pass,
+                      check_exposition]
+            if self_test:
+                checks.append(lambda: check_storm_selftest(td))
+            for check in checks:
+                rc = check()
+                if rc:
+                    return rc
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+        telemetry.reset_compile_tracker()
+        trace.reset_flight_recorder()
+        for kk, vv in env_backup.items():
+            if vv is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = vv
+    print(
+        "compile-check OK: launch ledger + compile registry reconciled, "
+        "warm pass credited solver ms with flat per-shape compiles, "
+        "full catalog exposition"
+        + (", planted recompile storm caught" if self_test else "")
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

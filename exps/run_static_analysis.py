@@ -186,6 +186,7 @@ def run_trace_audit(update: bool) -> tuple[list[str], dict]:
         print(f"recorded upcast census -> {EXPECTATIONS}")
 
     errors += ta.audit_retrace()
+    errors += ta.audit_decode_retrace()
     return errors, report
 
 

@@ -764,6 +764,63 @@ def audit_retrace(*, total: int = 512, chunk: int = 64) -> list[str]:
     return []
 
 
+def audit_decode_retrace() -> list[str]:
+    """The serving decode path under the same discipline (ISSUE 16):
+    re-executing ``decode_attn_paged`` with value-mutated same-shape
+    block tables / seq lens must not grow the trace count.
+
+    The paged cache's block tables are the serving-side analogue of the
+    plan tables — every decode tick ships a same-shape table whose
+    VALUES churn (page allocation, eviction, CoW splits). A retrace
+    here means a table value concretizes at trace time and production
+    decode recompiles per tick instead of per geometry."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..serving import DecodeBatch, magi_attn_decode
+    from ..serving.kv_cache import make_paged_kv_cache
+
+    cache = make_paged_kv_cache(
+        num_pages=8, page_size=8, num_kv_heads=2, head_dim=32, max_seqs=2
+    )
+    cache = _dc.replace(cache, seq_lens=jnp.array([13, 5], jnp.int32))
+    batch = DecodeBatch.of([0, 1])
+    q = jnp.zeros((2, 2, 32), jnp.bfloat16)
+
+    body = count_traces(
+        lambda q_, cache_: magi_attn_decode(
+            q_, cache_, batch, num_splits=2
+        )
+    )
+    f = jax.jit(body)
+    jax.block_until_ready(f(q, cache)[0])
+    first = body.traces
+    if first < 1:
+        return [
+            "decode retrace guard: harness failure — first call never "
+            "traced"
+        ]
+    # same shapes/dtypes, different values: permuted (in-bounds) page
+    # indices and shifted valid lengths — one allocator tick's churn
+    mutated = _dc.replace(
+        cache,
+        block_tables=cache.block_tables[..., ::-1],
+        seq_lens=jnp.array([12, 6], jnp.int32),
+    )
+    jax.block_until_ready(f(q, mutated)[0])
+    if body.traces != first:
+        return [
+            "decode retrace guard: value-mutated (same-shape) block "
+            "tables retraced decode_attn_paged "
+            f"({first} -> {body.traces} traces) — a cache table value "
+            "leaks into trace-time control flow and production decode "
+            "would recompile every tick"
+        ]
+    return []
+
+
 # ---------------------------------------------------------------------------
 # post-PR-6 serving surfaces (ISSUE 13 satellite)
 # ---------------------------------------------------------------------------

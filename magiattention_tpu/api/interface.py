@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from collections import OrderedDict
 from typing import Optional, Sequence
 
@@ -611,8 +612,14 @@ def magi_attn_flex_key(
         flags=env.flags_fingerprint(),
         block_config=block_config,
     )
+    _t_lookup = time.perf_counter()
     if key in _runtime_dict:
         telemetry.record_cache_access(hit=True)
+        # ISSUE 16: the hit's solver cost is the lookup itself; the
+        # ms-saved credit is priced against the measured build mean
+        telemetry.record_plan_solver(
+            time.perf_counter() - _t_lookup, cache_hit=True
+        )
         _most_recent_key = key
         return key
     telemetry.record_cache_access(hit=False)
@@ -875,8 +882,12 @@ def magi_attn_cross_key(
         flags=env.flags_fingerprint(),
         block_config=block_config,
     )
+    _t_lookup = time.perf_counter()
     if key in _runtime_dict:
         telemetry.record_cache_access(hit=True)
+        telemetry.record_plan_solver(
+            time.perf_counter() - _t_lookup, cache_hit=True
+        )
         _most_recent_key = key
         return key
     telemetry.record_cache_access(hit=False)
@@ -1036,8 +1047,12 @@ def make_flex_key_for_new_mask_after_dispatch(
         attn_type_map=types,
         block_config=block_config,
     )
+    _t_lookup = time.perf_counter()
     if new_key in _runtime_dict:
         telemetry.record_cache_access(hit=True)
+        telemetry.record_plan_solver(
+            time.perf_counter() - _t_lookup, cache_hit=True
+        )
         _most_recent_key = new_key
         return new_key
     telemetry.record_cache_access(hit=False)

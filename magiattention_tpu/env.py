@@ -217,6 +217,24 @@ def mem_pressure_threshold() -> float:
     return v
 
 
+def recompile_storm_threshold() -> int:
+    """Compiles of the SAME program label inside the compile tracker's
+    sliding window (30 s) that fire a deferred ``recompile_storm``
+    flight-recorder dump (``telemetry/compile.py``, ISSUE 16), tagged
+    with the triggering scheduler tick and live trace id — the serving
+    post-mortem for shape thrash. ``0`` (the default) disables the
+    detector; the tracker's compile accounting stays on either way.
+    Must be >= 0. Pure observability, NOT part of
+    :func:`flags_fingerprint`."""
+    v = _env_int("MAGI_ATTENTION_RECOMPILE_STORM_THRESHOLD", 0)
+    if v < 0:
+        raise ValueError(
+            f"MAGI_ATTENTION_RECOMPILE_STORM_THRESHOLD={v} must be >= 0 "
+            "(compiles of one label per window; 0 disables)"
+        )
+    return v
+
+
 def perf_gate_tolerance() -> float:
     """Fractional TF/s regression the perf gate tolerates before failing
     (``exps/run_perf_gate.py`` / ``make perf-gate``): a run below

@@ -524,7 +524,12 @@ def build_dist_attn_plan(
                 overlap_config=dataclasses.replace(cfg, degree=0),
                 cp_mesh_shape=cp_mesh_shape,
             )
-    telemetry.record_plan(plan, build_seconds=time.perf_counter() - t0)
+    build_s = time.perf_counter() - t0
+    telemetry.record_plan(plan, build_seconds=build_s)
+    # host-solver cost attribution (ISSUE 16): a cold build IS the miss
+    # path's solver time, and its measured mean prices each later
+    # cache hit's ms-saved credit
+    telemetry.record_plan_solver(build_s, cache_hit=False)
     mode = env.validate_mode()
     if mode != "off":
         from ..analysis.plan_sanity import validate_plan

@@ -726,6 +726,11 @@ class TieredEngine:
             )
         self.last_decode_info = {
             "batch": len(sid_list),
+            # per-replica decode programs are batch-keyed on the inner
+            # engine; the tiered view reports the merged batch's label
+            # (the launch ledger counts per-replica groups separately
+            # when the TieredScheduler calls per replica)
+            "program": telemetry.decode_program_label(len(sid_list)),
             "num_splits": (
                 splits_seen.pop() if len(splits_seen) == 1 else 0
             ),

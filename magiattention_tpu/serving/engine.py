@@ -315,6 +315,9 @@ class ServingEngine:
         # decode_step trace spans (ISSUE 11) — plain host state, not
         # gated on telemetry
         self.last_decode_info: dict = {}
+        # ditto for the last prefill call (program label + chunk
+        # geometry): the launch ledger (ISSUE 16) reads it
+        self.last_prefill_info: dict = {}
         self._flight = trace.get_flight_recorder()
         # OOM forensics (ISSUE 14): every flight dump embeds this
         # engine's memory ledger + pool fragmentation map (weakly held —
@@ -669,21 +672,28 @@ class ServingEngine:
         # operating condition, not a reason to destroy the sequence)
         self._ensure_reserved(slot, start + wrote)
         self._ensure_writable(slot, start)
+        label = telemetry.prefill_program_label(start, wrote)
+        self.last_prefill_info = {
+            "program": label,
+            "start": start,
+            "tokens": wrote,
+        }
         try:
             chaos.maybe_fail("prefill_error")
-            if start == 0 and (chunk is None or t <= chunk):
-                out, lse, new_cache = prefill_into_cache(
-                    q, k, v, self.cache, slot, length=length, **kw
-                )
-                self.cache = new_cache
-            else:
-                assert length is None, (
-                    "chunked/continuation prefill requires unpadded "
-                    "prompts (length=None); pre-slice the valid rows"
-                )
-                out, lse = self._chunked_prefill(
-                    q, k, v, slot, start, chunk, **kw
-                )
+            with telemetry.program(label):
+                if start == 0 and (chunk is None or t <= chunk):
+                    out, lse, new_cache = prefill_into_cache(
+                        q, k, v, self.cache, slot, length=length, **kw
+                    )
+                    self.cache = new_cache
+                else:
+                    assert length is None, (
+                        "chunked/continuation prefill requires unpadded "
+                        "prompts (length=None); pre-slice the valid rows"
+                    )
+                    out, lse = self._chunked_prefill(
+                        q, k, v, slot, start, chunk, **kw
+                    )
         except Exception:
             self._release_after_fault(slot)
             raise
@@ -808,46 +818,53 @@ class ServingEngine:
                 slot_list,
                 min_group=1 if mode == "on" else 2,
             )
-        with named_scope("magi_kvcache_append"):
-            self.cache = append_kv(self.cache, batch.slots, k_new, v_new)
-        for s in slot_list:
-            self._lengths[s] = self._lengths.get(s, 0) + 1
-        if groups:
-            # per-phase split resolution happens inside the cascade
-            # (prefix tables and suffix tables have their own widths);
-            # the num_splits gauge reports 0 = "per phase"
-            out, lse = cascade_decode_attn(
-                q,
-                self.cache,
-                np.asarray(slot_list),
-                groups,
-                num_splits=kw.get("num_splits"),
-                scale=kw.get("scale"),
-                softcap=kw.get("softcap", 0.0),
-                out_dtype=kw.get("out_dtype"),
-                interpret=kw.get("interpret"),
-            )
-            resolved = 0
-        elif self._decode_attn_fn is not None:
-            # substituted realization (TP decode over the sharded pool):
-            # split resolution happens inside the substitute, so the
-            # num_splits gauge reads 0 = "externally resolved", like the
-            # cascade per-phase convention
-            out, lse = self._decode_attn_fn(q, self.cache, batch.slots, **kw)
-            resolved = 0
-        else:
-            # resolve the split count ONCE (fingerprint + cache lookup)
-            # and hand the concrete int down — decode is the per-token
-            # hot loop
-            kw["num_splits"] = resolved = resolve_num_splits(
-                kw.get("num_splits"), self.cache, batch.batch_size,
-                q.shape[1],
-            )
-            out, lse = magi_attn_decode(q, self.cache, batch, **kw)
+        label = telemetry.decode_program_label(batch.batch_size)
+        with telemetry.program(label):
+            with named_scope("magi_kvcache_append"):
+                self.cache = append_kv(
+                    self.cache, batch.slots, k_new, v_new
+                )
+            for s in slot_list:
+                self._lengths[s] = self._lengths.get(s, 0) + 1
+            if groups:
+                # per-phase split resolution happens inside the cascade
+                # (prefix tables and suffix tables have their own
+                # widths); the num_splits gauge reports 0 = "per phase"
+                out, lse = cascade_decode_attn(
+                    q,
+                    self.cache,
+                    np.asarray(slot_list),
+                    groups,
+                    num_splits=kw.get("num_splits"),
+                    scale=kw.get("scale"),
+                    softcap=kw.get("softcap", 0.0),
+                    out_dtype=kw.get("out_dtype"),
+                    interpret=kw.get("interpret"),
+                )
+                resolved = 0
+            elif self._decode_attn_fn is not None:
+                # substituted realization (TP decode over the sharded
+                # pool): split resolution happens inside the substitute,
+                # so the num_splits gauge reads 0 = "externally
+                # resolved", like the cascade per-phase convention
+                out, lse = self._decode_attn_fn(
+                    q, self.cache, batch.slots, **kw
+                )
+                resolved = 0
+            else:
+                # resolve the split count ONCE (fingerprint + cache
+                # lookup) and hand the concrete int down — decode is the
+                # per-token hot loop
+                kw["num_splits"] = resolved = resolve_num_splits(
+                    kw.get("num_splits"), self.cache, batch.batch_size,
+                    q.shape[1],
+                )
+                out, lse = magi_attn_decode(q, self.cache, batch, **kw)
         # per-step resolution facts for the request tracer (ISSUE 11):
         # the scheduler tags each member's decode_step span with them
         self.last_decode_info = {
             "batch": batch.batch_size,
+            "program": label,
             "num_splits": resolved,
             "cascade_groups": len(groups),
             "cascade_group_of": {

@@ -212,6 +212,10 @@ class Scheduler:
         from ..telemetry.memory import MemPressureWatcher
 
         self._mem_watcher = MemPressureWatcher()
+        # launch ledger (ISSUE 16): program labels launched this tick +
+        # engine-call wall seconds, reset at the top of every step()
+        self._tick_programs: list[str] = []
+        self._tick_engine_s = 0.0
 
     # -- submission ------------------------------------------------------
 
@@ -482,6 +486,12 @@ class Scheduler:
         # cascade grouping): per-request decode spans carry it
         info = getattr(self.engine, "last_decode_info", None) or {}
         group_of = info.get("cascade_group_of", {})
+        # launch ledger (ISSUE 16): one batched decode program launched
+        program = info.get("program") or telemetry.decode_program_label(
+            len(states)
+        )
+        self._tick_programs.append(program)
+        self._tick_engine_s += dur
         now = self._clock()
         for j, st in enumerate(states):
             st.decode_outs.append(out[j])
@@ -509,6 +519,7 @@ class Scheduler:
                 token_latency_s=token_latency_s,
                 tier=self._decode_tier,
                 replica=replica,
+                program=program,
             )
             if st.tokens_done >= st.request.num_new_tokens:
                 self._finish(st)
@@ -555,6 +566,14 @@ class Scheduler:
                 req.prompt_v[lo:hi],
                 st.slot,
             )
+        dur = time.perf_counter() - t0
+        # launch ledger (ISSUE 16): a zero-token chunk (fully-cached
+        # prompt) launches nothing — the engine returns without any
+        # device program
+        program = telemetry.prefill_program_label(lo, n) if n else None
+        if n:
+            self._tick_programs.append(program)
+            self._tick_engine_s += dur
         reqtrace.span_prefill_chunk(
             st.trace_id,
             st.rid,
@@ -562,8 +581,9 @@ class Scheduler:
             chunk_idx=st.prefill_chunk_idx,
             start=lo,
             start_s=t0,
-            duration_s=time.perf_counter() - t0,
+            duration_s=dur,
             tier=self._prefill_tier,
+            program=program,
         )
         st.prefill_chunk_idx += 1
         st.prefill_pos = hi
@@ -583,6 +603,15 @@ class Scheduler:
         flushes, so the dump contains the faulting tick."""
         self._step += 1
         tick_start = time.perf_counter()  # flight-recorder arm window
+        # tick cost attribution (ISSUE 16): mark the compile tracker's
+        # always-on accumulators so the tick can diff them at the end —
+        # works with telemetry off, like the flight recorder itself
+        tracker = telemetry.get_compile_tracker()
+        tracker.note_tick(self._step)
+        compile_mark = tracker.mark()
+        solver_mark = tracker.solver_mark()
+        self._tick_programs = []
+        self._tick_engine_s = 0.0
         queue_depth = self.waiting  # at tick START, before admissions
         try:
             report = self._step_body(queue_depth)
@@ -599,6 +628,19 @@ class Scheduler:
             )
             self._flight.flush()
             raise
+        # decompose the tick's wall-clock: host solver (plan builds +
+        # LRU lookups), compile (tracker delta), device (engine-call
+        # wall minus the compiles that happened inside it), and an
+        # HONEST unattributed residual — may be negative when
+        # attribution over-counts (a compile outside an engine call);
+        # surfaced as-is, never folded into a gate
+        wall_s = time.perf_counter() - tick_start
+        compile_n, compile_s = tracker.since(compile_mark)
+        solver_s = tracker.solver_since(solver_mark)
+        device_s = max(self._tick_engine_s - compile_s, 0.0)
+        residual_s = wall_s - solver_s - compile_s - device_s
+        programs = list(self._tick_programs)
+        launches = len(set(programs))
         telemetry.record_sched_step(
             waiting=self.waiting,
             active=self.num_active,
@@ -609,6 +651,17 @@ class Scheduler:
             decode_ran=report.decode_ran,
             budget_utilization=report.budget_utilization,
             queue_depth=report.queue_depth,
+        )
+        telemetry.record_tick_programs(
+            step=self._step,
+            start_s=tick_start,
+            wall_s=wall_s,
+            programs=programs,
+            compiles=compile_n,
+            solver_s=solver_s,
+            compile_s=compile_s,
+            device_s=device_s,
+            residual_s=residual_s,
         )
         # ISSUE 14: the admission watermark, observable — headroom the
         # evictionless-admission rule demands vs the pages actually
@@ -645,6 +698,16 @@ class Scheduler:
                 "waiting": self.waiting,
                 "active": self.num_active,
                 "finished": list(report.finished),
+                "launches": launches,
+                "programs": programs,
+                "compiles": compile_n,
+                "cost_ms": {
+                    "wall": round(wall_s * 1e3, 3),
+                    "solver": round(solver_s * 1e3, 3),
+                    "compile": round(compile_s * 1e3, 3),
+                    "device": round(device_s * 1e3, 3),
+                    "residual": round(residual_s * 1e3, 3),
+                },
             },
             start_t=tick_start,
         )

@@ -39,6 +39,7 @@ from .aggregate import (  # noqa: F401
 )
 from .collectors import (  # noqa: F401
     REQUIRED_ANALYSIS_METRICS,
+    REQUIRED_COMPILE_METRICS,
     REQUIRED_DISTSERVE_METRICS,
     REQUIRED_MEMORY_METRICS,
     REQUIRED_PLAN_CACHE_METRICS,
@@ -60,6 +61,7 @@ from .collectors import (  # noqa: F401
     record_autotune_measurement,
     record_cache_access,
     record_comm_op,
+    record_compile,
     record_decode_step,
     record_degraded_path,
     record_dispatch_meta,
@@ -79,6 +81,7 @@ from .collectors import (  # noqa: F401
     record_overlap_choice,
     record_page_stream,
     record_plan,
+    record_plan_solver,
     record_prefill,
     record_prefix_cow,
     record_prefix_eviction,
@@ -91,11 +94,22 @@ from .collectors import (  # noqa: F401
     record_runtime_costs,
     record_sched_step,
     record_stream_queue_depth,
+    record_tick_programs,
     record_tier_fault,
     record_tier_state,
     record_tuning_cache_io_error,
     record_validate,
     telemetry_summary,
+)
+from .compile import (  # noqa: F401
+    CompileTracker,
+    add_solver_seconds,
+    current_program,
+    decode_program_label,
+    get_compile_tracker,
+    prefill_program_label,
+    program,
+    reset_compile_tracker,
 )
 from .events import (  # noqa: F401
     EventBuffer,
@@ -214,6 +228,7 @@ def dump_events(path: str) -> str:
 
 __all__ = [
     "BlockOccupancyMap",
+    "CompileTracker",
     "EventBuffer",
     "FlightRecorder",
     "HopTiming",
@@ -226,6 +241,7 @@ __all__ = [
     "MetricsServer",
     "PoolFragmentationMap",
     "REQUIRED_ANALYSIS_METRICS",
+    "REQUIRED_COMPILE_METRICS",
     "REQUIRED_MEMORY_METRICS",
     "REQUIRED_PLAN_METRICS",
     "REQUIRED_RESILIENCE_METRICS",
@@ -237,10 +253,13 @@ __all__ = [
     "RequestTrace",
     "RooflineReport",
     "StageTiming",
+    "add_solver_seconds",
     "aggregate_across_mesh",
     "analyze_workload",
     "block_occupancy_map",
     "configure_logging",
+    "current_program",
+    "decode_program_label",
     "dump_events",
     "dump_metrics",
     "dump_request_traces",
@@ -250,6 +269,7 @@ __all__ = [
     "ensure_metrics_server",
     "export_request_traces",
     "fragmentation_map",
+    "get_compile_tracker",
     "get_event_buffer",
     "get_flight_recorder",
     "get_logger",
@@ -260,9 +280,11 @@ __all__ = [
     "merge_snapshots",
     "parse_prometheus_text",
     "plan_memory_ledger",
+    "prefill_program_label",
     "profile_key_timeline",
     "profile_plan_timeline",
     "profile_roofline",
+    "program",
     "record_admission",
     "record_admission_watermark",
     "record_autotune_cache",
@@ -271,6 +293,7 @@ __all__ = [
     "record_autotune_measurement",
     "record_cache_access",
     "record_comm_op",
+    "record_compile",
     "record_decode_step",
     "record_degraded_path",
     "record_dispatch_meta",
@@ -290,13 +313,16 @@ __all__ = [
     "record_overlap_choice",
     "record_kvcache_state",
     "record_plan",
+    "record_plan_solver",
     "record_prefill",
     "record_roofline",
     "record_request_span",
     "record_runtime_costs",
+    "record_tick_programs",
     "render_prometheus",
     "request_context",
     "request_traces_to_chrome",
+    "reset_compile_tracker",
     "reset_flight_recorder",
     "reset_request_traces",
     "resolve_peak_tflops",

@@ -99,6 +99,7 @@ def make_history_entry(
     mask_density: dict | None = None,
     roofline_efficiency: dict | None = None,
     peak_hbm_bytes: int | None = None,
+    compile_s: float | None = None,
 ) -> dict:
     """Canonical history-entry schema (one place, so bench.py and the
     seeding path can never drift).
@@ -113,7 +114,11 @@ def make_history_entry(
     post-run ``bytes_in_use`` where the runtime exposes no peak stat) —
     memory context beside the density context, so a perf shift that
     coincides with a footprint shift is attributable; absent on
-    backends without memory_stats (CPU)."""
+    backends without memory_stats (CPU). ``compile_s`` (ISSUE 16) is
+    the headline kernel's cold-compile seconds (first call minus warm
+    step) — compile-time context beside the TF/s, so a compile-time
+    regression is visible in the same trajectory; ``0.0`` is a real
+    value (fully cache-absorbed compile) and is recorded."""
     entry: dict = {
         "source": source,
         "metrics": {
@@ -145,6 +150,8 @@ def make_history_entry(
         }
     if peak_hbm_bytes:
         entry["peak_hbm_bytes"] = int(peak_hbm_bytes)
+    if compile_s is not None:
+        entry["compile_s"] = float(compile_s)
     return entry
 
 

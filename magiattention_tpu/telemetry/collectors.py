@@ -287,6 +287,29 @@ M_TUNING_CACHE_IO = "magi_tuning_cache_io_errors"
 H_PLAN_BUILD_S = "magi_plan_build_seconds"
 H_DISPATCH_SOLVE_S = "magi_dispatch_solve_seconds"
 
+# program observability (telemetry/compile.py + the scheduler's launch
+# ledger; ISSUE 16). Compile counter is per program label ({program=};
+# prefill[start=S,t=N] / decode[b=B] / anon); compile seconds is the
+# cumulative/percentile latency histogram; jit-cache entries is the
+# executables-built-this-process gauge (a lower bound on live jit-cache
+# entries — XLA rarely evicts). Launches-per-tick is a histogram of the
+# DISTINCT jitted programs each Scheduler/TieredScheduler tick launched
+# (ROADMAP item 2's "launches-per-tick -> 1-2" gate reads its p50/p95).
+# Solver seconds times build_dist_attn_plan + plan-LRU lookups
+# ({outcome=hit|miss}); ms-saved is credited on each cache hit with the
+# mean measured cold-build latency (ROADMAP item 3's figure)
+M_COMPILE_TOTAL = "magi_compile_total"  # {program=}
+H_COMPILE_S = "magi_compile_seconds"
+M_JIT_CACHE_ENTRIES = "magi_jit_cache_entries"
+M_SCHED_LAUNCHES = "magi_sched_launches_per_tick"
+H_PLAN_SOLVER_S = "magi_plan_solver_seconds"  # {outcome=}
+M_SOLVER_MS_SAVED = "magi_plan_solver_ms_saved_total"
+
+# the named synthetic Chrome-trace track the per-tick decomposition
+# spans land on (events.py ``track=`` mechanism — one tick-decomposition
+# track next to the request tracks)
+TICK_TRACK = "scheduler ticks"
+
 # the acceptance-criteria floor: one build_dist_attn_plan through the keyed
 # interface must populate at least these (the drift guard's contract)
 REQUIRED_PLAN_METRICS: tuple[str, ...] = (
@@ -480,6 +503,21 @@ M_ANALYSIS_CEX = "magi_analysis_counterexamples"
 REQUIRED_ANALYSIS_METRICS: tuple[str, ...] = (
     M_ANALYSIS_STATES,
     M_ANALYSIS_CEX,
+)
+
+
+# populated by a multi-tenant trace through the real scheduler (compile
+# tracker + launch ledger + tick cost attribution) plus one cold+warm
+# keyed plan resolution; asserted by make compile-check
+# (exps/run_compile_check.py), swept by trace-check's exposition pass,
+# documented in docs/observability.md "Program observability"
+REQUIRED_COMPILE_METRICS: tuple[str, ...] = (
+    M_COMPILE_TOTAL,
+    H_COMPILE_S,
+    M_JIT_CACHE_ENTRIES,
+    M_SCHED_LAUNCHES,
+    H_PLAN_SOLVER_S,
+    M_SOLVER_MS_SAVED,
 )
 
 
@@ -1178,6 +1216,107 @@ def record_sched_step(
         reg.gauge_set(M_SCHED_QUEUE_DEPTH, int(queue_depth))
 
 
+def record_compile(
+    program: str, seconds: float, total_programs: int
+) -> None:
+    """One finished XLA backend compile, attributed to its program
+    label (``telemetry/compile.py`` ingestion — the tracker's own
+    accumulators are always-on; only this registry mirror is gated).
+    ``total_programs`` is the process-cumulative executable count, the
+    jit-cache-entries gauge (XLA rarely evicts, so cumulative builds
+    lower-bound the live cache)."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_COMPILE_TOTAL, program=program)
+    reg.histogram_observe(H_COMPILE_S, float(seconds))
+    reg.gauge_set(M_JIT_CACHE_ENTRIES, int(total_programs))
+
+
+def record_plan_solver(seconds: float, *, cache_hit: bool) -> None:
+    """One host-solver resolution: a plan-LRU lookup that hit
+    (``api/interface.py``) or a cold ``build_dist_attn_plan``
+    (``parallel/dist_attn.py``, the miss path's dominant cost).
+
+    ALWAYS feeds the compile tracker's solver accumulator (plain module
+    state outside the registry — the scheduler's per-tick cost
+    attribution must work with telemetry off; the disabled-mode no-op
+    contract covers the registry only). With telemetry on, the seconds
+    land on ``magi_plan_solver_seconds{outcome=}`` and each hit credits
+    ``magi_plan_solver_ms_saved_total`` with the mean measured
+    cold-build latency — the figure ROADMAP item 3's plan-reuse gate
+    reads."""
+    from . import compile as _compile
+
+    _compile.add_solver_seconds(float(seconds))
+    if not cache_hit:
+        _compile.get_compile_tracker().note_plan_build(float(seconds))
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.histogram_observe(
+        H_PLAN_SOLVER_S,
+        float(seconds),
+        outcome="hit" if cache_hit else "miss",
+    )
+    if cache_hit:
+        mean_s = _compile.get_compile_tracker().plan_build_mean_s()
+        if mean_s:
+            reg.counter_inc(M_SOLVER_MS_SAVED, mean_s * 1e3)
+
+
+def record_tick_programs(
+    *,
+    step: int,
+    start_s: float,
+    wall_s: float,
+    programs: list,
+    compiles: int,
+    solver_s: float,
+    compile_s: float,
+    device_s: float,
+    residual_s: float,
+) -> None:
+    """One scheduler tick's launch ledger + cost decomposition (ISSUE
+    16): the distinct-program launch count lands on the
+    ``magi_sched_launches_per_tick`` histogram, and the full
+    decomposition — geometry census (label -> launches), compile count,
+    solver/compile/device ms and the HONEST unattributed residual
+    (negative when attribution over-counts; surfaced, never folded into
+    a gate) — rides a span on the dedicated tick-decomposition
+    Chrome-trace track."""
+    if not _enabled():
+        return
+    from .events import record_event
+
+    census: dict[str, int] = {}
+    for p in programs:
+        census[p] = census.get(p, 0) + 1
+    reg = get_registry()
+    reg.histogram_observe(
+        M_SCHED_LAUNCHES,
+        float(len(census)),
+        bounds=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+    )
+    record_event(
+        "sched_tick",
+        start_s,
+        wall_s,
+        {
+            "step": int(step),
+            "launches": len(census),
+            "programs": census,
+            "compiles": int(compiles),
+            "solver_ms": round(solver_s * 1e3, 3),
+            "compile_ms": round(compile_s * 1e3, 3),
+            "device_ms": round(device_s * 1e3, 3),
+            "residual_ms": round(residual_s * 1e3, 3),
+            "wall_ms": round(wall_s * 1e3, 3),
+        },
+        track=TICK_TRACK,
+    )
+
+
 def record_request_traced() -> None:
     """One request entered the traced lifecycle (``trace.span_submit``)."""
     if not _enabled():
@@ -1396,6 +1535,20 @@ def telemetry_summary(snapshot: dict | None = None) -> str:
             f"active seqs {fmt(g.get(M_KVCACHE_ACTIVE_SEQS))}  "
             f"page size {fmt(g.get(M_KVCACHE_PAGE_SIZE))}  "
             f"prefill tokens {fmt(c.get(M_PREFILL_TOKENS, 0))}"
+        )
+    # program observability (ISSUE 16): compiles by label + the plan
+    # solver's saved-ms credit, when any compile was attributed
+    compile_keys = [
+        k for k in c if k.startswith(M_COMPILE_TOTAL + "{")
+    ]
+    if compile_keys:
+        total_compiles = sum(c[k] for k in compile_keys)
+        lines.append(
+            f"  programs: {len(compile_keys)} labels, "
+            f"{fmt(total_compiles)} compiles  "
+            f"jit cache entries {fmt(g.get(M_JIT_CACHE_ENTRIES))}  "
+            f"solver ms saved "
+            f"{fmt(c.get(M_SOLVER_MS_SAVED, 0))}"
         )
     # one line per compared program: predicted-vs-measured io bytes +
     # the honest unattributed temp residual (ISSUE 14)

@@ -255,6 +255,11 @@ def snapshot_delta(
     - **histograms**: bucket-wise deltas with mean/percentiles
       re-estimated on the window's buckets (window min/max are
       unknowable from two snapshots and reported as None).
+    - **derived**: ratio stats that only make sense over a window —
+      today ``plan_cache_hit_rate`` (window hits / (hits + misses) of
+      ``magi_plan_cache_hits/misses``), present whenever the window saw
+      at least one plan-cache access. This is the figure ROADMAP item
+      3's >= 90% hit-rate gate reads.
     """
     prev = prev or {}
     pc = prev.get("counters") or {}
@@ -276,6 +281,14 @@ def snapshot_delta(
         out["window_seconds"] = float(seconds)
         out["counters_per_s"] = {
             k: v / seconds for k, v in out_counters.items()
+        }
+    from .collectors import M_PLAN_CACHE_HITS, M_PLAN_CACHE_MISSES
+
+    hits = float(out_counters.get(M_PLAN_CACHE_HITS, 0.0))
+    misses = float(out_counters.get(M_PLAN_CACHE_MISSES, 0.0))
+    if hits + misses > 0:
+        out["derived"] = {
+            "plan_cache_hit_rate": hits / (hits + misses),
         }
     return out
 

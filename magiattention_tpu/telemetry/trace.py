@@ -29,7 +29,8 @@ the existing span-event ring (``telemetry/events.py``):
   depth + budget utilization) and admission decisions, independent of
   the telemetry enable flag (one small host dict per tick). When a
   resilience signal fires — ``NumericalGuardError``, a degradation
-  path, an admission-rejection storm, an engine fault mid-request — the
+  path, an admission-rejection storm, an engine fault mid-request, a
+  recompile storm (``telemetry/compile.py``) — the
   ring auto-dumps to ``MAGI_ATTENTION_TRACE_DIR`` as a post-mortem
   artifact. Depth via ``MAGI_ATTENTION_FLIGHT_RECORDER_DEPTH`` (0
   disables).
@@ -209,11 +210,12 @@ def span_rejected(trace_id: str, rid: int, *, reason: str) -> None:
 def span_prefill_chunk(
     trace_id: str, rid: int, *, tokens: int, chunk_idx: int, start: int,
     start_s: float, duration_s: float, tier: str | None = None,
+    program: str | None = None,
 ) -> None:
     record_request_span(
         trace_id, SPAN_PREFILL_CHUNK, rid=rid, tokens=tokens,
         chunk_idx=chunk_idx, start=start, start_s=start_s,
-        duration_s=duration_s, tier=tier,
+        duration_s=duration_s, tier=tier, program=program,
     )
 
 
@@ -222,7 +224,7 @@ def span_decode_step(
     num_splits: int, cascade_group: int | None, start_s: float,
     duration_s: float, ttft_s: float | None = None,
     token_latency_s: float | None = None, tier: str | None = None,
-    replica: int | None = None,
+    replica: int | None = None, program: str | None = None,
 ) -> None:
     from .collectors import (
         record_request_token_latency,
@@ -234,6 +236,7 @@ def span_decode_step(
         batch=batch, num_splits=num_splits, cascade_group=cascade_group,
         start_s=start_s, duration_s=duration_s, ttft_s=ttft_s,
         token_latency_s=token_latency_s, tier=tier, replica=replica,
+        program=program,
     )
     if ttft_s is not None:
         record_request_ttft(ttft_s, tier=tier)
@@ -530,8 +533,8 @@ class FlightRecorder:
     - :meth:`trigger` — a resilience signal fires: the trigger record
       joins the ring and the dump is written now (``immediate=True``,
       guard violations / degradations) or at the end of the current
-      tick (``immediate=False``, engine faults — so the dump contains
-      the tick that was aborted).
+      tick (``immediate=False``, engine faults and recompile storms —
+      so the dump contains the tick that was aborted or thrashed).
 
     Dumps land in ``MAGI_ATTENTION_TRACE_DIR`` as
     ``magi_flight_<pid>_<n>.json`` and are capped at ``max_dumps`` per
@@ -599,6 +602,13 @@ class FlightRecorder:
             if start_t is not None:
                 self._last_tick_start = start_t
             self._append(self._ticks, dict(tick))
+
+    def snapshot_ticks(self) -> list[dict]:
+        """Copy of the live tick ring (the ``"ticks"`` payload a dump
+        would carry right now) — lets tests and REPL post-mortems read
+        the ledger without forcing a dump."""
+        with self._lock:
+            return [dict(t) for t in self._ticks]
 
     def note_admission(self, admitted: bool, reason: str = "ok") -> None:
         """One engine admission verdict; a run of ``storm_threshold``

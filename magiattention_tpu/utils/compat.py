@@ -88,3 +88,59 @@ def tpu_compiler_params(**kwargs):
     if cls is None:
         cls = pltpu.TPUCompilerParams
     return cls(**kwargs)
+
+
+def register_compile_listeners(on_event, on_duration) -> str:
+    """Feed XLA-compile observations to the compile tracker
+    (``telemetry/compile.py``) on whatever this jax version offers;
+    never a hard dependency and never raises. Returns the ingestion
+    mode actually wired:
+
+    - ``"monitoring"`` — current jax: ``jax.monitoring`` listeners
+      (``on_event(name)`` per event, ``on_duration(name, seconds)`` per
+      duration event; backend compiles arrive as
+      ``.../backend_compile_duration``).
+    - ``"wrapped"`` — old jax without a usable monitoring API: the
+      internal ``jax._src.dispatch.backend_compile`` is wrapped to time
+      lowerings and synthesize the duration event. Best-effort by
+      construction (private module), which is why it is the fallback.
+    - ``"none"`` — neither hook exists; the tracker still accepts
+      directly-planted events (tests, manual instrumentation).
+    """
+    try:
+        from jax import monitoring as _monitoring
+
+        reg_ev = getattr(_monitoring, "register_event_listener", None)
+        reg_dur = getattr(
+            _monitoring, "register_event_duration_secs_listener", None
+        )
+        if reg_dur is not None:
+            if on_event is not None and reg_ev is not None:
+                reg_ev(on_event)
+            reg_dur(on_duration)
+            return "monitoring"
+    except Exception:  # pragma: no cover — fall through to the wrap
+        pass
+    try:
+        from jax._src import dispatch as _dispatch
+
+        original = _dispatch.backend_compile
+
+        def _timed_backend_compile(*args, **kwargs):
+            import time as _time
+
+            t0 = _time.perf_counter()
+            out = original(*args, **kwargs)
+            try:
+                on_duration(
+                    "/jax/core/compile/backend_compile_duration",
+                    _time.perf_counter() - t0,
+                )
+            except Exception:
+                pass
+            return out
+
+        _dispatch.backend_compile = _timed_backend_compile
+        return "wrapped"
+    except Exception:
+        return "none"

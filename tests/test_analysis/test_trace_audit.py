@@ -164,6 +164,16 @@ def test_count_traces_catches_baked_values():
     assert body.traces == 2
 
 
+def test_audit_decode_retrace_clean():
+    """ISSUE 16 satellite: same-shape block-table/seq-len mutation on the
+    paged decode path must hit the jit cache — a retrace here would make
+    every serving tick a compile (the recompile-storm scenario the
+    tracker exists to catch)."""
+    from magiattention_tpu.analysis import trace_audit
+
+    assert trace_audit.audit_decode_retrace() == []
+
+
 # ---------------------------------------------------------------------------
 # expectations from comm metas
 # ---------------------------------------------------------------------------

@@ -97,7 +97,13 @@ def test_auto_degree_overhead_dominates_picks_one():
     assert plan.overlap_degree == 1
 
 
-@pytest.mark.parametrize("mask", ["causal", "varlen"])
+# varlen re-tiered slow for the 870s tier-1 budget (ISSUE 16): causal
+# keeps auto-degree end-to-end live; varlen degree *selection* stays
+# covered by the unit tests above
+@pytest.mark.parametrize(
+    "mask",
+    ["causal", pytest.param("varlen", marks=pytest.mark.slow)],
+)
 def test_auto_degree_end_to_end_correct(mask):
     """Auto-degree plans stay numerically correct through the keyed API."""
     import jax.numpy as jnp

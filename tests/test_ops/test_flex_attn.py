@@ -212,7 +212,12 @@ def test_bf16_reasonable():
     assert_close(out16.astype(jnp.float32), ref_out, atol=3e-2, rtol=3e-2)
 
 
-@pytest.mark.parametrize("hq,hk,hb", [(8, 8, 8), (8, 2, 4), (4, 4, 2)])
+# the all-8 shape re-tiered slow for the 870s tier-1 budget (ISSUE 16);
+# (8,2,4) keeps GQA head-batching live and (4,4,2) the partial block
+@pytest.mark.parametrize(
+    "hq,hk,hb",
+    [pytest.param(8, 8, 8, marks=pytest.mark.slow), (8, 2, 4), (4, 4, 2)],
+)
 def test_head_batched_kernel(hq, hk, hb):
     """head_block>1 path (batched MXU calls) vs oracle, incl. bwd."""
     tq = 256

@@ -200,7 +200,13 @@ def test_pipeline_fwd_bwd(name, total, qr, kr, ts, cp):
         assert_close(a, b, atol=5e-5, rtol=5e-5, msg=f"{name} cp{cp} {nm}")
 
 
-@pytest.mark.parametrize("degree", [1, 2, 4])
+# degree=4 re-tiered slow for the 870s tier-1 budget (ISSUE 16):
+# degrees 1+2 keep the multi-stage lse-merge path live on all three
+# scenarios, and the auto-degree e2e test exercises high degrees
+@pytest.mark.parametrize(
+    "degree",
+    [1, 2, pytest.param(4, marks=pytest.mark.slow)],
+)
 @pytest.mark.parametrize(
     "name,total,qr,kr,ts",
     [s for s in SCENARIOS if s[0] in ("causal_1k", "varlen_block_causal", "mixed_types_with_holes")],
@@ -274,10 +280,15 @@ def test_zero_redundancy_comm_volume():
     assert plan.comm.recv_total[-1] == (cp - 1) * shard
 
 
+# full-attn variant re-tiered slow for the 870s tier-1 budget
+# (ISSUE 16): the varlen-causal case keeps uneven sharding live
 @pytest.mark.parametrize(
     "name,total,qr,kr,ts",
     [
-        ("uneven_full_attn", 640, [(0, 640)], [(0, 640)], [F]),
+        pytest.param(
+            "uneven_full_attn", 640, [(0, 640)], [(0, 640)], [F],
+            marks=pytest.mark.slow,
+        ),
         (
             "uneven_varlen_causal",
             640,

@@ -1,0 +1,173 @@
+"""Launch ledger + per-tick cost attribution (ISSUE 16, satellite S3).
+
+The reconciliation invariant: each scheduler tick's ``sched_tick`` span
+carries a program census that matches — bit-for-bit — the distinct
+program labels of the request-trace spans the tick's time window
+overlaps. Two independent emission paths (the scheduler's tick ledger
+vs the per-request spans), one truth.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.serving import Request, Scheduler, ServingEngine
+from magiattention_tpu.telemetry import trace
+
+D, HK, HQ, PS = 16, 2, 4, 8
+
+COST_KEYS = ("wall_ms", "solver_ms", "compile_ms", "device_ms",
+             "residual_ms")
+
+
+@pytest.fixture(autouse=True)
+def _jnp_backend(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    yield
+    telemetry.set_enabled(None)
+    telemetry.reset()
+
+
+def _engine():
+    return ServingEngine(
+        num_pages=96, num_kv_heads=HK, head_dim=D, page_size=PS,
+        max_seqs=8, max_pages_per_seq=16, dtype=jnp.float32,
+    )
+
+
+def _req(rng, rid, prompt_len, gen, priority=0):
+    return Request(
+        rid=rid,
+        prompt_q=jnp.asarray(
+            rng.standard_normal((prompt_len, HQ, D)), jnp.float32
+        ),
+        prompt_k=jnp.asarray(
+            rng.standard_normal((prompt_len, HK, D)), jnp.float32
+        ),
+        prompt_v=jnp.asarray(
+            rng.standard_normal((prompt_len, HK, D)), jnp.float32
+        ),
+        decode_q=jnp.asarray(rng.standard_normal((gen, HQ, D)), jnp.float32),
+        decode_k=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        decode_v=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        priority=priority,
+    )
+
+
+def _drain(sched, max_ticks=64):
+    ticks = 0
+    while (sched.waiting or sched.num_active) and ticks < max_ticks:
+        sched.step()
+        ticks += 1
+    assert not (sched.waiting or sched.num_active), "scenario did not drain"
+    return ticks
+
+
+def test_tick_census_reconciles_with_request_spans():
+    """S3 acceptance: multi-tenant trace; every tick's census equals the
+    distinct request-span program labels inside the tick window."""
+    rng = np.random.default_rng(3)
+    sched = Scheduler(_engine(), token_budget=24, chunk=PS)
+    sched.submit(_req(rng, 0, 2 * PS, gen=3))
+    sched.submit(_req(rng, 1, PS + 3, gen=2))
+    ticks = _drain(sched)
+
+    evs = telemetry.get_event_buffer().events()
+    tick_evs = [e for e in evs if e["name"] == "sched_tick"]
+    assert len(tick_evs) == ticks
+    prog_spans = [
+        e for e in evs
+        if e["name"] in ("req:prefill_chunk", "req:decode_step")
+        and e.get("args", {}).get("program")
+    ]
+    assert prog_spans, "no request span carries a program label"
+
+    launches_total = 0
+    for ev in tick_evs:
+        args = ev["args"]
+        census = args["programs"]
+        assert args["launches"] == len(census)
+        lo, hi = ev["ts"], ev["ts"] + ev["dur"]
+        overlapped = {
+            e["args"]["program"] for e in prog_spans if lo <= e["ts"] < hi
+        }
+        assert overlapped == set(census), (
+            f"tick {args['step']}: census vs request spans diverged"
+        )
+        launches_total += args["launches"]
+    assert launches_total > 0
+
+
+def test_tick_cost_decomposition_surfaced():
+    """Every tick span carries the full cost decomposition, and the
+    parts reconcile with wall: wall == solver + compile + device +
+    residual (the residual is the honest remainder, whatever its sign)."""
+    rng = np.random.default_rng(4)
+    sched = Scheduler(_engine(), token_budget=24, chunk=PS)
+    sched.submit(_req(rng, 0, 2 * PS, gen=2))
+    _drain(sched)
+
+    tick_evs = [
+        e for e in telemetry.get_event_buffer().events()
+        if e["name"] == "sched_tick"
+    ]
+    assert tick_evs
+    for ev in tick_evs:
+        args = ev["args"]
+        for k in COST_KEYS:
+            assert k in args, f"tick missing {k}"
+        parts = (args["solver_ms"] + args["compile_ms"]
+                 + args["device_ms"] + args["residual_ms"])
+        assert parts == pytest.approx(args["wall_ms"], abs=0.01)
+
+
+def test_flight_recorder_ticks_carry_ledger():
+    """The flight-recorder tick ring mirrors the ledger: launches,
+    program list, compile count, and the cost_ms decomposition ride on
+    every recorded tick (the post-mortem needs them offline)."""
+    rng = np.random.default_rng(5)
+    trace.reset_flight_recorder()
+    try:
+        sched = Scheduler(_engine(), token_budget=24, chunk=PS)
+        sched.submit(_req(rng, 0, PS + 2, gen=2))
+        _drain(sched)
+        ring = trace.get_flight_recorder().snapshot_ticks()
+        assert ring
+        for rec in ring:
+            assert rec["launches"] == len(set(rec["programs"]))
+            assert isinstance(rec["compiles"], int)
+            cost = rec["cost_ms"]
+            for k in ("wall", "solver", "compile", "device", "residual"):
+                assert k in cost
+    finally:
+        trace.reset_flight_recorder()
+
+
+def test_scheduler_labels_land_in_compile_tracker():
+    """With the jnp backend on CPU, engine launches compile real XLA
+    programs — the tracker must attribute at least some of them to the
+    serving labels the scheduler wrapped them in."""
+    rng = np.random.default_rng(6)
+    sched = Scheduler(_engine(), token_budget=24, chunk=PS)
+    sched.submit(_req(rng, 0, 2 * PS, gen=2))
+    _drain(sched)
+    info = sched.engine.last_decode_info
+    assert info.get("program", "").startswith("decode[b=")
+    assert sched.engine.last_prefill_info.get("program", "").startswith(
+        "prefill[start="
+    )
+    tracker = telemetry.get_compile_tracker()
+    if tracker.ingestion == "none":
+        pytest.skip("no compile-event ingestion on this jax")
+    labels = [
+        lab for lab in tracker.stats()
+        if lab.startswith(("prefill[", "decode["))
+    ]
+    assert labels, "no serving label attributed any compile"

@@ -64,6 +64,24 @@ def test_make_history_entry_filters_non_numeric_metrics():
     assert e["metrics"] == {"m": 1.0}
 
 
+def test_make_history_entry_records_compile_seconds():
+    e = baseline.make_history_entry(
+        source="s", metrics={"m": 1.0}, compile_s=1.23
+    )
+    assert e["compile_s"] == 1.23
+
+
+def test_make_history_entry_compile_seconds_optional():
+    e = baseline.make_history_entry(source="s", metrics={"m": 1.0})
+    assert "compile_s" not in e
+    # 0.0 is a real measurement (fully cache-absorbed compile), not
+    # "unmeasured" — it must be recorded
+    e0 = baseline.make_history_entry(
+        source="s", metrics={"m": 1.0}, compile_s=0.0
+    )
+    assert e0["compile_s"] == 0.0
+
+
 def test_newest_metrics_is_the_last_entry_only():
     """An old good value must never stand in for a metric the newest run
     didn't measure — that's the gate's `missing` verdict instead."""

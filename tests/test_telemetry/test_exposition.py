@@ -134,6 +134,36 @@ def test_snapshot_delta_without_prev_is_identity_on_counters():
     assert "counters_per_s" not in d
 
 
+def test_snapshot_delta_derives_plan_cache_hit_rate():
+    reg = MetricsRegistry()
+    reg.counter_inc("magi_plan_cache_hits", 3)
+    reg.counter_inc("magi_plan_cache_misses", 1)
+    d = exposition.snapshot_delta(None, reg.snapshot())
+    assert d["derived"]["plan_cache_hit_rate"] == pytest.approx(0.75)
+
+
+def test_snapshot_delta_hit_rate_is_window_local():
+    """The rate is computed on the WINDOW delta, not lifetime totals:
+    an all-miss history followed by an all-hit window reads 1.0."""
+    reg = MetricsRegistry()
+    reg.counter_inc("magi_plan_cache_misses", 10)
+    prev = reg.snapshot()
+    reg.counter_inc("magi_plan_cache_hits", 4)
+    d = exposition.snapshot_delta(prev, reg.snapshot())
+    assert d["derived"]["plan_cache_hit_rate"] == pytest.approx(1.0)
+
+
+def test_snapshot_delta_no_hit_rate_without_traffic():
+    reg = MetricsRegistry()
+    reg.counter_inc("magi_plan_cache_hits", 5)
+    snap = reg.snapshot()
+    # same snapshot on both sides: zero traffic in the window
+    d = exposition.snapshot_delta(snap, snap)
+    assert "derived" not in d
+    d2 = exposition.snapshot_delta(None, MetricsRegistry().snapshot())
+    assert "derived" not in d2
+
+
 # ---------------------------------------------------------------------------
 # the scrape server
 # ---------------------------------------------------------------------------
