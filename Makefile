@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze spmd-audit lifecycle-check resilience-check roofline-check roofline-report trace-check distserve-check memory-check compile-check check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze spmd-audit lifecycle-check resilience-check roofline-check roofline-report trace-check distserve-check memory-check compile-check tick-check check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -195,6 +195,13 @@ memory-check:
 compile-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_compile_check.py --self-test
 
+# unified serving-tick gate (ISSUE 17): one launch per tick under
+# MAGI_ATTENTION_UNIFIED_TICK=on, exact token-schedule parity vs the
+# per-request path, per-bucket compile count flat after warmup, and a
+# planted demux off-by-one the parity oracle must catch
+tick-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_tick_check.py --self-test
+
 # mask-aware roofline report + occupancy JSON artifact for the 16k
 # varlen block-causal headline (docs/observability.md "Roofline &
 # occupancy"); host-side only
@@ -206,5 +213,5 @@ roofline-report:
 # serving parity, shared-prefix/scheduler gate, group-collective
 # parity/volume, resilience gate, roofline/occupancy gate, request
 # tracing/exposition gate, disaggregated-serving gate, memory
-# observability gate — all CPU-safe
-check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check distserve-check memory-check compile-check
+# observability gate, unified-tick gate — all CPU-safe
+check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check distserve-check memory-check compile-check tick-check

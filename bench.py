@@ -839,6 +839,25 @@ def _measure_extras(dt_fwd_64k: float) -> dict:
         )
     except Exception as e:
         print(f"extras: comm volume metric failed: {e!r}", file=sys.stderr)
+
+    # 6. unified serving tick (ISSUE 17): launches-per-tick and per-tick
+    #    engine latency of the canonical scheduler trace under
+    #    MAGI_ATTENTION_UNIFIED_TICK=on — the serving-side trajectory
+    #    the tick gate bounds, recorded next to the kernel TF/s so the
+    #    perf gate can watch it drift. Guarded like sections 4-5.
+    try:
+        from exps.run_tick_check import tick_probe
+
+        p = tick_probe()
+        extras.update(p)
+        print(
+            "extras: unified tick "
+            f"{p['sched_launches_per_tick_unified_max']} launch/tick, "
+            f"p50 {p['sched_tick_latency_ms_p50']} ms",
+            file=sys.stderr,
+        )
+    except Exception as e:  # never lose sections 1-5 to the probe
+        print(f"extras: unified tick probe failed: {e!r}", file=sys.stderr)
     return extras
 
 

@@ -395,6 +395,36 @@ def cascade_mode() -> str:
     return v
 
 
+UNIFIED_TICK_MODES = ("auto", "on", "off")
+
+
+def unified_tick_mode() -> str:
+    """Unified serving-tick attention mode (``serving/unified_tick.py``,
+    ISSUE 17), validated here:
+
+    - ``off`` (default): today's per-request path — one flex launch per
+      prefilling request plus a batched decode call per tick,
+      byte-for-byte unchanged.
+    - ``auto``: fuse the tick into ONE sparse-grid launch whenever the
+      per-request path would launch >= 2 distinct programs (any mixed
+      prefill+decode tick, or >= 2 concurrent prefill chunks).
+    - ``on``: every tick with attention work runs the unified kernel,
+      single-program ticks included (the parity-test mode).
+
+    Unlike ``MAGI_ATTENTION_CASCADE`` (a pure performance choice), this
+    IS part of :func:`flags_fingerprint`: the unified path resolves its
+    own ``tick``-kind tuning records and compiles a different program
+    population, so runs sharing a tuning/plan cache directory across
+    modes must not alias."""
+    v = _env_str("MAGI_ATTENTION_UNIFIED_TICK", "off").strip().lower()
+    if v not in UNIFIED_TICK_MODES:
+        raise ValueError(
+            f"MAGI_ATTENTION_UNIFIED_TICK={v!r} must be one of "
+            f"{UNIFIED_TICK_MODES}"
+        )
+    return v
+
+
 SERVING_TIERS = ("prefill", "decode")
 
 
@@ -664,4 +694,5 @@ def flags_fingerprint() -> tuple:
         comm_pad_to(),
         guard_mode(),
         chaos_spec(),
+        unified_tick_mode(),
     )

@@ -3,6 +3,8 @@
 from .block_meta import FlexAttnBlockMeta, build_block_meta
 from .block_sparse import (
     BlockEnumeration,
+    TickEnumeration,
+    TickSegment,
     block_sparse_attn_func,
     build_block_meta_from_block_mask,
     build_block_meta_from_occupancy,
@@ -23,6 +25,8 @@ from .range_merge import merge_ranges
 __all__ = [
     "BlockEnumeration",
     "FlexAttnBlockMeta",
+    "TickEnumeration",
+    "TickSegment",
     "block_sparse_attn_func",
     "build_block_meta_from_occupancy",
     "correct_attn_lse",

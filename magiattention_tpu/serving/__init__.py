@@ -24,6 +24,10 @@ Layout:
   (:func:`cascade_decode_attn`) — ISSUE 9
 - :mod:`.scheduler`   — chunked-prefill token-budget
   :class:`Scheduler` with per-request SLO telemetry — ISSUE 9
+- :mod:`.unified_tick` — one-kernel serving tick (ISSUE 17): a whole
+  tick's prefill chunks + decode steps as rows of ONE sparse-grid
+  launch (:func:`unified_tick_attn`), behind
+  ``MAGI_ATTENTION_UNIFIED_TICK``
 
 See ``docs/serving.md`` for the architecture walkthrough.
 """
@@ -78,6 +82,11 @@ from .prefix import (  # noqa: F401
     plan_cascade_groups,
 )
 from .scheduler import Request, RequestState, Scheduler, StepReport  # noqa: F401
+from .unified_tick import (  # noqa: F401
+    demux_tick,
+    resolve_tick_splits,
+    unified_tick_attn,
+)
 
 __all__ = [
     "AdmissionResult",
@@ -110,6 +119,7 @@ __all__ = [
     "cp_merge_partials",
     "decode_attn_paged",
     "decode_partials_for_tables",
+    "demux_tick",
     "gather_kv",
     "kv_head_sharding",
     "magi_attn_decode",
@@ -120,8 +130,10 @@ __all__ = [
     "prefill_into_cache",
     "reset_slot",
     "resolve_num_splits",
+    "resolve_tick_splits",
     "shard_kv_cache",
     "swap_block_table_page",
     "tp_decode_attn",
+    "unified_tick_attn",
     "write_prefill_kv",
 ]

@@ -51,6 +51,7 @@ from .kv_cache import (
     write_prefill_kv,
 )
 from .prefix import PrefixCache, cascade_decode_attn, plan_cascade_groups
+from .unified_tick import demux_tick, resolve_tick_splits, unified_tick_attn
 
 
 @dataclasses.dataclass(frozen=True)
@@ -318,6 +319,10 @@ class ServingEngine:
         # ditto for the last prefill call (program label + chunk
         # geometry): the launch ledger (ISSUE 16) reads it
         self.last_prefill_info: dict = {}
+        # and for the last unified tick (ISSUE 17): the scheduler reads
+        # the resolved tick geometry/label to tag per-request spans and
+        # assert its launch census
+        self.last_tick_info: dict = {}
         self._flight = trace.get_flight_recorder()
         # OOM forensics (ISSUE 14): every flight dump embeds this
         # engine's memory ledger + pool fragmentation map (weakly held —
@@ -882,6 +887,263 @@ class ServingEngine:
             cascade_groups=len(groups),
         )
         return out, lse
+
+    def unified_tick(
+        self,
+        decode_items,
+        prefill_items,
+        *,
+        cascade=None,
+        num_splits: int | None = None,
+        scale: float | None = None,
+        softcap: float = 0.0,
+        interpret: bool | None = None,
+    ):
+        """One-kernel serving tick (ISSUE 17 tentpole): every decode step
+        AND every prefill chunk of this tick runs as rows of a single
+        :func:`~.unified_tick.unified_tick_attn` launch over the shared
+        paged pool, then demuxes back into per-request outputs.
+
+        - ``decode_items``: ``[(slot, q [hq, d], k [hk, d], v [hk, d])]``
+          — one new token per decoding sequence, appended then attended
+          over the whole history (same contract as :meth:`decode_step`).
+        - ``prefill_items``: ``[(slot, q [t, hq, d], k, v)]`` — one chunk
+          per prefilling sequence at the slot's committed position; a
+          ``t = 0`` item runs only the completion hooks (fully-cached
+          prompt), exactly like :meth:`prefill`'s early return.
+
+        Returns ``(decode_results, prefill_results)`` aligned with the
+        inputs: decode entries ``(out [hq, d], lse [hq])``, prefill
+        entries ``(out [t, hq, d], lse [t, hq])`` — numerically the
+        split-KV realization of the per-request paths (same masked
+        softmax; the reduction ORDER differs with the table width, so
+        parity is float-tight, not bitwise).
+
+        Cascade (``MAGI_ATTENTION_CASCADE`` semantics, decode members
+        only): a shared-prefix group's members each contribute a suffix
+        row plus a prefix row over the SAME shared pages inside the one
+        launch; the pair is merged through ``ops/correction`` before
+        demux, so the group still batches its prefix partial once per
+        member without a second program.
+
+        Faults mirror the per-request paths: a device-phase failure
+        releases every prefill item's slot (:meth:`_release_after_fault`)
+        and re-raises; decode slots are kept, like :meth:`decode_step`.
+        A ``PageAllocatorError`` from reservation growth propagates
+        untouched (check-before-pop: nothing is half-committed)."""
+        from .. import env
+        from ..resilience import chaos
+        from ..ops.block_sparse import TickEnumeration
+
+        if self._decode_attn_fn is not None:
+            raise ValueError(
+                "unified_tick does not compose with a substituted decode "
+                "realization (_decode_attn_fn): the tick kernel IS the "
+                "attention — TP decode tiers keep the per-request path"
+            )
+        ps = self.allocator.page_size
+        decode_slots = [int(it[0]) for it in decode_items]
+        prefill_slots = [int(it[0]) for it in prefill_items]
+        overlap = set(decode_slots) & set(prefill_slots)
+        if overlap:
+            raise ValueError(
+                f"unified_tick: slots {sorted(overlap)} appear as both "
+                "decode and prefill items — a sequence is in exactly one "
+                "phase per tick"
+            )
+        # host phase first (reservation growth + CoW splits), before any
+        # device work — identical ordering to decode_step / prefill, and
+        # BEFORE the enumeration reads the slot page lists (a CoW swap
+        # changes a page id)
+        for slot in decode_slots:
+            self._ensure_reserved(slot, self._lengths.get(slot, 0) + 1)
+            self._ensure_writable(slot, self._lengths.get(slot, 0))
+        prefill_meta = []  # (slot, start, t) aligned with prefill_items
+        for slot, q, _k, _v in prefill_items:
+            t = int(q.shape[0])
+            start = self._lengths.get(slot, 0)
+            prefill_meta.append((slot, start, t))
+            if t:
+                self._ensure_reserved(slot, start + t)
+                self._ensure_writable(slot, start)
+        if cascade is None:
+            mode = env.cascade_mode()
+        elif isinstance(cascade, str):
+            mode = cascade
+        else:
+            mode = "on" if cascade else "off"
+        groups = []
+        if mode != "off" and self._slot_prefix and decode_slots:
+            groups = plan_cascade_groups(
+                self._slot_prefix,
+                decode_slots,
+                min_group=1 if mode == "on" else 2,
+            )
+        group_of_pos = {
+            pos: g for g in groups for pos in g.members
+        }
+        # -- compose the tick enumeration (host) --
+        tick = TickEnumeration(ps)
+        q_parts = []  # row-ordered [n, hq, d] pieces
+        for j, (slot, q1, _k, _v) in enumerate(decode_items):
+            new_len = self._lengths.get(slot, 0) + 1
+            pages = self.allocator.slot_pages(slot)
+            need = -(-new_len // ps)
+            g = group_of_pos.get(j)
+            if g is not None:
+                ns = len(g.shared_pages)
+                tick.add_decode(
+                    ("d", j),
+                    tuple(pages[ns:need]),
+                    new_len - g.prefix_len,
+                    prefix_pages=tuple(g.shared_pages),
+                    prefix_len=g.prefix_len,
+                )
+                # prefix row precedes the main row; both attend with
+                # this member's query
+                q_parts.append(q1[None])
+                q_parts.append(q1[None])
+            else:
+                tick.add_decode(("d", j), tuple(pages[:need]), new_len)
+                q_parts.append(q1[None])
+        prefill_rows = 0
+        for j, (slot, q, _k, _v) in enumerate(prefill_items):
+            _slot, start, t = prefill_meta[j]
+            if t == 0:
+                continue
+            pages = self.allocator.slot_pages(slot)
+            need = -(-(start + t) // ps)
+            tick.add_prefill(("p", j), tuple(pages[:need]), start, t)
+            q_parts.append(q)
+            prefill_rows += t
+        if tick.num_rows == 0:
+            # nothing to launch: run the zero-chunk completion hooks
+            # (fully-cached prompts) and return empty results
+            prefill_results = []
+            for j, (slot, q, _k, _v) in enumerate(prefill_items):
+                toks = self._tokens.get(slot)
+                if toks is not None and self._lengths.get(slot, 0) >= len(
+                    toks
+                ):
+                    self.commit_prefix(slot)
+                prefill_results.append(
+                    (
+                        jnp.zeros((0, q.shape[1], q.shape[2]), q.dtype),
+                        jnp.zeros((0, q.shape[1]), jnp.float32),
+                    )
+                )
+            self.last_tick_info = {
+                "program": None,
+                "rows": 0,
+                "entries": 0,
+                "num_splits": 0,
+                "decode_batch": 0,
+                "prefill_rows": 0,
+                "cascade_groups": 0,
+                "cascade_group_of": {},
+            }
+            return [], prefill_results
+        rows, entries = tick.finalize()
+        hq = int(q_parts[0].shape[1])
+        head_dim = int(q_parts[0].shape[2])
+        resolved = resolve_tick_splits(
+            num_splits, self.cache, rows, entries, hq,
+            prefill_rows=prefill_rows,
+        )
+        label = telemetry.tick_program_label(rows, entries, resolved)
+        # -- device phase: ONE program label for the whole tick --
+        try:
+            if any(t for _s, _lo, t in prefill_meta):
+                chaos.maybe_fail("prefill_error")
+            with telemetry.program(label):
+                if decode_items:
+                    batch = DecodeBatch.of(decode_slots)
+                    k_new = jnp.stack([it[2] for it in decode_items])
+                    v_new = jnp.stack([it[3] for it in decode_items])
+                    with named_scope("magi_kvcache_append"):
+                        self.cache = append_kv(
+                            self.cache, batch.slots, k_new, v_new
+                        )
+                    for s in decode_slots:
+                        self._lengths[s] = self._lengths.get(s, 0) + 1
+                for j, (slot, _q, k, v) in enumerate(prefill_items):
+                    if prefill_meta[j][2] == 0:
+                        continue
+                    with named_scope("magi_kvcache_prefill_write"):
+                        self.cache = write_prefill_kv(self.cache, slot, k, v)
+                q_rows = jnp.concatenate(q_parts, axis=0)
+                pad = rows - q_rows.shape[0]
+                if pad:
+                    q_rows = jnp.concatenate(
+                        [
+                            q_rows,
+                            jnp.zeros((pad, hq, head_dim), q_rows.dtype),
+                        ],
+                        axis=0,
+                    )
+                out, lse = unified_tick_attn(
+                    q_rows,
+                    self.cache,
+                    tick,
+                    num_splits=resolved,
+                    scale=scale,
+                    softcap=softcap,
+                    interpret=interpret,
+                )
+                parts = demux_tick(tick, out, lse)
+        except Exception:
+            for slot, _lo, t in prefill_meta:
+                if t:
+                    self._release_after_fault(slot)
+            raise
+        # -- demux + per-request completion hooks (host) --
+        decode_results = []
+        for j, (slot, q1, _k, _v) in enumerate(decode_items):
+            o, l = parts[("d", j)]
+            decode_results.append((o[0].astype(q1.dtype), l[0]))
+        prefill_results = []
+        for j, (slot, q, _k, _v) in enumerate(prefill_items):
+            _s, start, t = prefill_meta[j]
+            if t:
+                o, l = parts[("p", j)]
+                prefill_results.append((o.astype(q.dtype), l))
+                self._lengths[slot] = start + t
+                telemetry.record_prefill(t)
+            else:
+                prefill_results.append(
+                    (
+                        jnp.zeros((0, q.shape[1], q.shape[2]), q.dtype),
+                        jnp.zeros((0, q.shape[1]), jnp.float32),
+                    )
+                )
+            toks = self._tokens.get(slot)
+            if toks is not None and self._lengths.get(slot, 0) >= len(toks):
+                self.commit_prefix(slot)
+        if decode_items:
+            telemetry.record_decode_step(
+                batch_size=len(decode_items),
+                num_splits=resolved,
+                max_seq_len=max(
+                    (self._lengths.get(s, 0) for s in decode_slots),
+                    default=0,
+                ),
+                cascade_groups=len(groups),
+            )
+        self.last_tick_info = {
+            "program": label,
+            "rows": rows,
+            "entries": entries,
+            "num_splits": resolved,
+            "decode_batch": len(decode_items),
+            "prefill_rows": prefill_rows,
+            "cascade_groups": len(groups),
+            "cascade_group_of": {
+                int(decode_slots[pos]): gi
+                for gi, g in enumerate(groups)
+                for pos in g.members
+            },
+        }
+        return decode_results, prefill_results
 
     # -- introspection --
 

@@ -719,23 +719,262 @@ class Scheduler:
         admitted, rejected = self._admit_queued()
         finished_before = set(self._finished)
 
-        decode_ran = False
-        decode_batch = 0
+        # ONE pass over the active set per tick (ISSUE 17 satellite):
+        # the decode and prefill censuses are computed here and threaded
+        # to whichever launch path runs below, which must not re-scan.
+        # Hoisting the prefill list above the decode step is
+        # behavior-identical: decode only finishes or requeues DECODING
+        # states, never grows or shrinks the PREFILLING set.
         decoding = self._decode_states()
-        if decoding:
-            decode_batch = self._run_decode(decoding)
-            decode_ran = True
-            budget -= decode_batch
+        prefilling = self._prefill_states()
 
-        chunks, budget = self._run_prefill_loop(budget)
+        unified = self._unified_tick_enabled(decoding, prefilling)
+        if unified:
+            report = self._unified_step_body(
+                budget, admitted, rejected, finished_before, queue_depth,
+                decoding, prefilling,
+            )
+        else:
+            decode_ran = False
+            decode_batch = 0
+            if decoding:
+                decode_batch = self._run_decode(decoding)
+                decode_ran = True
+                budget -= decode_batch
 
-        tokens_used = self.token_budget - budget
+            chunks, budget = self._run_prefill_loop(
+                budget, states=prefilling
+            )
+
+            tokens_used = self.token_budget - budget
+            report = StepReport(
+                step=self._step,
+                admitted=tuple(admitted),
+                rejected=tuple(rejected),
+                decode_ran=decode_ran,
+                decode_batch=decode_batch,
+                prefill_chunks=tuple(chunks),
+                tokens_used=tokens_used,
+                finished=tuple(set(self._finished) - finished_before),
+                queue_depth=queue_depth,
+                budget_utilization=tokens_used / max(self.token_budget, 1),
+            )
+        # launch census (ISSUE 17 satellite): the hoisted lists predict
+        # the tick's program count EXACTLY — one unified program when
+        # any attention ran, else one per decode group + one per
+        # token-carrying prefill chunk. Drift here means a launch loop
+        # re-scanned the active set behind the census's back.
+        if unified:
+            expected = (
+                1
+                if (
+                    report.decode_batch > 0
+                    or any(n for _rid, n in report.prefill_chunks)
+                )
+                else 0
+            )
+        else:
+            expected = (1 if report.decode_batch > 0 else 0) + sum(
+                1 for _rid, n in report.prefill_chunks if n > 0
+            )
+        assert len(self._tick_programs) == expected, (
+            f"scheduler launch census drift: {len(self._tick_programs)} "
+            f"programs recorded ({self._tick_programs}) but the hoisted "
+            f"tick census predicted {expected} (unified={unified}, "
+            f"decode_batch={report.decode_batch}, "
+            f"chunks={report.prefill_chunks})"
+        )
+        return report
+
+    def _unified_tick_enabled(
+        self,
+        decoding: list[RequestState],
+        prefilling: list[RequestState],
+    ) -> bool:
+        """Does THIS tick's work run as one fused launch (ISSUE 17)?
+        ``MAGI_ATTENTION_UNIFIED_TICK``: ``off`` never (the default —
+        the per-request path stays byte-for-byte), ``on`` whenever any
+        attention work exists (the parity-test mode), ``auto`` exactly
+        when the per-request path would launch >= 2 distinct programs
+        (a decode group alongside >= 1 prefill chunk, or >= 2 prefill
+        chunks) — a fused singleton would only re-bucket a launch that
+        is already minimal. A TP-substituted decode realization opts
+        out: the tick kernel IS the attention."""
+        from .. import env
+
+        mode = env.unified_tick_mode()
+        if mode == "off":
+            return False
+        if not hasattr(self.engine, "unified_tick"):
+            return False
+        if getattr(self.engine, "_decode_attn_fn", None) is not None:
+            return False
+        n_pf = sum(
+            1
+            for st in prefilling
+            if st.request.prompt_len - st.prefill_pos > 0
+        )
+        if mode == "on":
+            return bool(decoding) or n_pf > 0
+        return (bool(decoding) and n_pf > 0) or n_pf >= 2
+
+    def _unified_step_body(
+        self,
+        budget: int,
+        admitted: list,
+        rejected: list,
+        finished_before: set,
+        queue_depth: int,
+        decoding: list[RequestState],
+        prefilling: list[RequestState],
+    ) -> StepReport:
+        """One fused tick (ISSUE 17): the decode group and every planned
+        prefill chunk go down as ONE ``engine.unified_tick`` call — one
+        program label in the launch ledger — then the per-request
+        span/SLO/finish bookkeeping of ``_decode_group`` and
+        ``_run_prefill_chunk`` replays over the demuxed outputs.
+
+        Chunk planning is the same policy as ``_run_prefill_loop``:
+        priority order, at most one chunk per request, stop when the
+        budget cannot fit the next chunk's first token; zero-token
+        chunks (fully-cached prompts) ride along for their completion
+        hooks. Pool pressure mid-growth preempts the lowest-priority,
+        youngest decode member and retries the WHOLE tick next step
+        (the legacy path instead still ran prefill the same tick — the
+        one scheduling difference, visible only under pressure)."""
+        from .kv_cache import PageAllocatorError
+
+        decode_states = decoding
+        if self.max_decode_batch is not None:
+            decode_states = decode_states[: self.max_decode_batch]
+        decode_ran = bool(decode_states)
+        b = budget - len(decode_states)
+        plan: list[tuple[RequestState, int, int]] = []  # (st, lo, n)
+        for st in prefilling:
+            if b <= 0:
+                break
+            remaining = st.request.prompt_len - st.prefill_pos
+            cap = self.chunk if self.chunk else remaining
+            n = max(min(cap, remaining, b), 0)
+            if remaining > 0 and n == 0:
+                break  # budget can't fit the next chunk's first token
+            plan.append((st, st.prefill_pos, n))
+            b -= n
+
+        decode_items = [
+            (
+                st.slot,
+                st.request.decode_q[st.tokens_done],
+                st.request.decode_k[st.tokens_done],
+                st.request.decode_v[st.tokens_done],
+            )
+            for st in decode_states
+        ]
+        prefill_items = [
+            (
+                st.slot,
+                st.request.prompt_q[lo : lo + n],
+                st.request.prompt_k[lo : lo + n],
+                st.request.prompt_v[lo : lo + n],
+            )
+            for st, lo, n in plan
+        ]
+        t0 = time.perf_counter()
+        try:
+            decode_res, prefill_res = self.engine.unified_tick(
+                decode_items, prefill_items
+            )
+        except PageAllocatorError:
+            # transient pool pressure mid-growth: same preemption policy
+            # as _decode_group — lowest-priority, youngest member out,
+            # pages back to the pool, retry next tick. Nothing launched.
+            if not decode_states:
+                raise
+            victim = min(
+                decode_states,
+                key=lambda s: (s.request.priority, -s.submitted_at),
+            )
+            self.engine.free(victim.slot)
+            self._requeue(victim, reason="decode_pressure")
+            return StepReport(
+                step=self._step,
+                admitted=tuple(admitted),
+                rejected=tuple(rejected),
+                decode_ran=decode_ran,
+                decode_batch=0,
+                prefill_chunks=(),
+                tokens_used=0,
+                finished=tuple(set(self._finished) - finished_before),
+                queue_depth=queue_depth,
+                budget_utilization=0.0,
+            )
+        dur = time.perf_counter() - t0
+        info = getattr(self.engine, "last_tick_info", None) or {}
+        program = info.get("program")
+        if program is not None:
+            # launch ledger (ISSUE 16): the WHOLE tick was one program
+            self._tick_programs.append(program)
+            self._tick_engine_s += dur
+        group_of = info.get("cascade_group_of", {})
+        now = self._clock()
+        for j, st in enumerate(decode_states):
+            out_row, _lse_row = decode_res[j]
+            st.decode_outs.append(out_row)
+            st.tokens_done += 1
+            ttft_s = token_latency_s = None
+            if st.first_token_at is None:
+                st.first_token_at = now
+                ttft_s = now - st.slo_start
+            else:
+                token_latency_s = now - (st.last_token_at or now)
+            st.last_token_at = now
+            reqtrace.span_decode_step(
+                st.trace_id,
+                st.rid,
+                token_idx=st.tokens_done - 1,
+                batch=len(decode_states),
+                num_splits=int(info.get("num_splits", 0)),
+                cascade_group=group_of.get(st.slot),
+                start_s=t0,
+                duration_s=dur,
+                ttft_s=ttft_s,
+                token_latency_s=token_latency_s,
+                tier=self._decode_tier,
+                program=program,
+            )
+            if st.tokens_done >= st.request.num_new_tokens:
+                self._finish(st)
+        chunks: list[tuple[int, int]] = []
+        for (st, lo, n), (out_rows, _lse_rows) in zip(plan, prefill_res):
+            req = st.request
+            hi = lo + n
+            reqtrace.span_prefill_chunk(
+                st.trace_id,
+                st.rid,
+                tokens=n,
+                chunk_idx=st.prefill_chunk_idx,
+                start=lo,
+                start_s=t0,
+                duration_s=dur if n else 0.0,
+                tier=self._prefill_tier,
+                program=program if n else None,
+            )
+            st.prefill_chunk_idx += 1
+            st.prefill_pos = hi
+            if n and hi == req.prompt_len:
+                st.prefill_out_tail = out_rows[-1]
+            if st.prefill_pos >= req.prompt_len:
+                st.status = DECODING
+                if req.num_new_tokens == 0:
+                    self._finish(st)
+            chunks.append((st.rid, n))
+        tokens_used = self.token_budget - b
         return StepReport(
             step=self._step,
             admitted=tuple(admitted),
             rejected=tuple(rejected),
             decode_ran=decode_ran,
-            decode_batch=decode_batch,
+            decode_batch=len(decode_states),
             prefill_chunks=tuple(chunks),
             tokens_used=tokens_used,
             finished=tuple(set(self._finished) - finished_before),
@@ -744,15 +983,19 @@ class Scheduler:
         )
 
     def _run_prefill_loop(
-        self, budget: int
+        self, budget: int, states: list[RequestState] | None = None
     ) -> tuple[list[tuple[int, int]], int]:
         """Advance prefilling requests (priority order, at most one
         chunk each) until the chunk budget is spent; returns the
         started ``(rid, tokens)`` chunks and the budget left. Shared
         with the TieredScheduler, whose prefill tier spends its own
-        budget."""
+        budget. ``states`` threads the tick's hoisted prefill census
+        (ISSUE 17 satellite); None re-scans, for callers that do not
+        hoist."""
         chunks: list[tuple[int, int]] = []
-        for st in self._prefill_states():
+        if states is None:
+            states = self._prefill_states()
+        for st in states:
             if budget <= 0:
                 break
             n = self._run_prefill_chunk(st, budget)

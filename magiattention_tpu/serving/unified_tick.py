@@ -1,0 +1,163 @@
+"""One-kernel serving tick: unified batched prefill+decode attention
+(ISSUE 17 tentpole).
+
+A scheduler tick used to issue one flex-attention launch per prefilling
+request (each ``(start, t)`` chunk its own compiled geometry) plus a
+separate batched decode call. FlashInfer (arxiv 2501.01005) shows that
+mixed prefill chunks and decode steps over paged KV are ONE composable
+block-sparse attention problem; this module expresses a whole tick that
+way:
+
+- every tick row is one query token over a page-table prefix — a decode
+  step directly, a prefill-chunk token via the identity *causality is
+  prefix-length masking* (token ``start + i`` of a causal prefill
+  attends exactly the first ``start + i + 1`` tokens, which is a
+  split-KV row with ``valid = start + i + 1``);
+- :class:`~magiattention_tpu.ops.block_sparse.TickEnumeration` composes
+  the rows into one padded, capacity-bucketed page table whose
+  enumeration the split-KV kernel walks ONCE
+  (:func:`~magiattention_tpu.serving.decode_attn
+  .decode_partials_for_tables` — jnp reference + Pallas backends,
+  per-row LSE out);
+- cascade shared-prefix members ride along as (suffix row, prefix row)
+  pairs merged through the existing ``ops/correction`` tree after the
+  launch — the same associative LSE algebra the split merge, CP merge,
+  and cascade already share.
+
+Geometry is set by the tick budget's capacity buckets, never the
+request mix, so a multi-tenant trace cycles a bounded set of traced
+programs (the ``tick[...]`` labels the compile tracker catalogs) — the
+structural fix for the per-prompt-chunk recompile storm ROADMAP item 2
+names. The engine/scheduler wiring lives in ``engine.ServingEngine
+.unified_tick`` and ``scheduler.Scheduler`` behind
+``MAGI_ATTENTION_UNIFIED_TICK``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.block_sparse import TickEnumeration
+from ..ops.correction import correct_attn_out_lse
+from ..utils.instrument import named_scope
+from .decode_attn import decode_partials_for_tables
+from .kv_cache import PagedKVCache
+
+
+def resolve_tick_splits(
+    num_splits: int | None,
+    cache: PagedKVCache,
+    row_capacity: int,
+    entry_capacity: int,
+    hq: int,
+    *,
+    prefill_rows: int = 0,
+) -> int:
+    """Explicit arg > ``MAGI_ATTENTION_DECODE_SPLITS`` > autotuner
+    (``tick`` fingerprint kind). The result always divides the padded
+    entry capacity (a power of two). The decode-splits env override
+    applies here too: the unified tick IS the decode kernel at tick
+    batch, and an operator pinning splits expects one knob, not two."""
+    from .. import env
+
+    width = max(int(entry_capacity), 1)
+    if num_splits is None:
+        num_splits = env.decode_splits()
+    if num_splits is None:
+        from ..tuning.autotuner import select_tick_splits
+
+        decision = select_tick_splits(
+            row_capacity,
+            width,
+            cache.page_size,
+            hq,
+            cache.num_kv_heads,
+            head_dim=cache.head_dim,
+            dtype=str(cache.k_pages.dtype),
+            prefill_rows=prefill_rows,
+        )
+        num_splits = decision.head_block
+    num_splits = max(1, min(int(num_splits), width))
+    while width % num_splits:
+        num_splits -= 1
+    return num_splits
+
+
+def unified_tick_attn(
+    q_rows: jax.Array,  # [row_capacity, hq, head_dim] padded q rows
+    cache: PagedKVCache,
+    tick: TickEnumeration,
+    *,
+    num_splits: int | None = None,
+    scale: float | None = None,
+    softcap: float = 0.0,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Run one serving tick's whole attention as a single sparse-grid
+    launch; returns fp32 ``(out [row_capacity, hq, d],
+    lse [row_capacity, hq])`` with cascade (suffix, prefix) row pairs
+    already merged into the suffix (main) rows.
+
+    The kernel call is :func:`decode_partials_for_tables` over the
+    tick's padded table — the jnp/Pallas backend dispatch, split-KV
+    grid, and uncovered ``(0, -inf)`` convention are inherited, not
+    reimplemented. Padding rows (``valid = 0``) come back as exact
+    ``(0, -inf)`` and demux simply never reads them.
+    """
+    rows, entries = tick.finalize()
+    if q_rows.shape[0] != rows:
+        raise ValueError(
+            f"unified_tick_attn: q_rows has {q_rows.shape[0]} rows but "
+            f"the tick enumeration is padded to {rows} — pad q to the "
+            "row capacity bucket (zero rows are fine: valid = 0 masks "
+            "them)"
+        )
+    hq = q_rows.shape[1]
+    num_splits = resolve_tick_splits(
+        num_splits,
+        cache,
+        rows,
+        entries,
+        hq,
+        prefill_rows=sum(
+            s.num_rows for s in tick.segments if s.kind == "prefill"
+        ),
+    )
+    bt = jnp.asarray(tick.block_tables())
+    valid = jnp.asarray(tick.valid_lens())
+    with named_scope("magi_tick_attn"):
+        out, lse = decode_partials_for_tables(
+            q_rows,
+            cache,
+            bt,
+            valid,
+            num_splits=num_splits,
+            scale=scale,
+            softcap=softcap,
+            interpret=interpret,
+        )
+        pairs = tick.merge_pairs()
+        if pairs.shape[0]:
+            mains = jnp.asarray(pairs[:, 0])
+            prefs = jnp.asarray(pairs[:, 1])
+            o_m, l_m = correct_attn_out_lse(
+                out[prefs], lse[prefs], out[mains], lse[mains]
+            )
+            out = out.at[mains].set(o_m)
+            lse = lse.at[mains].set(l_m)
+    return out, lse
+
+
+def demux_tick(
+    tick: TickEnumeration, out: jax.Array, lse: jax.Array
+) -> dict:
+    """Slice the kernel's per-row output back into per-request results:
+    ``{segment.key: (out_rows, lse_rows)}`` — a decode segment yields
+    ``([1, hq, d], [1, hq])`` (callers squeeze), a prefill segment its
+    chunk's token rows in order. Cascade prefix rows were merged into
+    the main rows by :func:`unified_tick_attn` and do not appear."""
+    return {
+        seg.key: (out[seg.row_lo : seg.row_hi], lse[seg.row_lo : seg.row_hi])
+        for seg in tick.segments
+    }

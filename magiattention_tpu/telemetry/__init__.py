@@ -110,6 +110,7 @@ from .compile import (  # noqa: F401
     prefill_program_label,
     program,
     reset_compile_tracker,
+    tick_program_label,
 )
 from .events import (  # noqa: F401
     EventBuffer,
@@ -340,5 +341,6 @@ __all__ = [
     "start_metrics_server",
     "stop_metrics_server",
     "telemetry_summary",
+    "tick_program_label",
     "trace_metadata_events",
 ]

@@ -106,6 +106,15 @@ def decode_program_label(batch: int) -> str:
     return f"decode[b={int(batch)}]"
 
 
+def tick_program_label(rows: int, entries: int, splits: int) -> str:
+    """Canonical label of one unified serving-tick program (ISSUE 17):
+    keyed by the PADDED geometry buckets (row capacity, entry capacity,
+    split count) — never by the request mix — so a multi-tenant trace
+    cycles a bounded label set and the per-label compile count the
+    tick-check gate queries stays flat after warmup."""
+    return f"tick[r={int(rows)},e={int(entries)},s={int(splits)}]"
+
+
 # ---------------------------------------------------------------------------
 # the tracker
 # ---------------------------------------------------------------------------

@@ -35,6 +35,7 @@ from .autotuner import (  # noqa: F401
     resolve_block_config,
     select_block_config,
     select_decode_splits,
+    select_tick_splits,
 )
 from .cache import (  # noqa: F401
     TuningCache,
@@ -49,14 +50,17 @@ from .cost_model import (  # noqa: F401
 )
 from .fingerprint import (  # noqa: F401
     DecodeFingerprint,
+    TickFingerprint,
     WorkloadFingerprint,
     make_decode_fingerprint,
     make_fingerprint,
+    make_tick_fingerprint,
 )
 
 __all__ = [
     "CandidateScore",
     "DecodeFingerprint",
+    "TickFingerprint",
     "TuningCache",
     "TuningDecision",
     "TuningRecord",
@@ -65,9 +69,11 @@ __all__ = [
     "get_tuning_cache",
     "make_decode_fingerprint",
     "make_fingerprint",
+    "make_tick_fingerprint",
     "rank_candidates",
     "reset_tuning_cache",
     "resolve_block_config",
     "select_block_config",
     "select_decode_splits",
+    "select_tick_splits",
 ]

@@ -467,6 +467,130 @@ def select_decode_splits(
     return decision
 
 
+def select_tick_splits(
+    row_capacity: int,
+    entry_capacity: int,
+    page_size: int,
+    hq: int,
+    hk: int,
+    *,
+    head_dim: int = 128,
+    dtype: str = "bfloat16",
+    prefill_rows: int = 0,
+) -> TuningDecision:
+    """Resolve the split count of one unified serving tick (the ``tick``
+    fingerprint kind; ISSUE 17).
+
+    The unified tick is the split-KV decode kernel driven over the
+    tick's padded per-row page table, so the same bandwidth argument
+    applies with the row capacity standing in for the decode batch:
+    splits help only until ``rows * s`` covers the chip's tensorcore
+    count, and every level costs one LSE-merge map. A tick's row count
+    is a whole scheduler budget (tens to hundreds of rows), so the model
+    almost always lands on ``s = 1`` — the fingerprinted cache entry is
+    what matters: ``measure``-mode winners and real-chip recalibration
+    slot in without touching the serving path, exactly like flex/decode.
+    Candidates divide ``entry_capacity`` (a power of two, so every
+    ``s <= 16`` power of two qualifies); the record keeps the decode
+    convention ``head_block = NUM SPLITS`` with the caller clamping to a
+    divisor of its live geometry.
+
+    ``prefill_rows`` is a fingerprint axis only (decode-dominated and
+    prefill-dominated ticks read different live-KV fractions through the
+    same padded shape and must not share a winner)."""
+    from .. import env, telemetry
+    from ..utils.cost import TPU_PEAK_SPECS
+    from .fingerprint import make_tick_fingerprint
+
+    rows = max(int(row_capacity), 1)
+    width = max(int(entry_capacity), 1)
+    fp = make_tick_fingerprint(
+        rows,
+        width,
+        page_size,
+        hq,
+        hk,
+        head_dim=head_dim,
+        dtype=dtype,
+        prefill_rows=prefill_rows,
+    )
+    cache = get_tuning_cache()
+    rec, layer = cache.get(fp)
+    if rec is not None:
+        telemetry.record_autotune_cache(hit=True, layer=layer)
+        decision = TuningDecision(
+            block_q=rec.block_q,
+            block_k=rec.block_k,
+            head_block=rec.head_block,
+            source=rec.source,
+            cache_layer=layer,
+            fingerprint_hash=fp.stable_hash(),
+            predicted_ms=rec.predicted_ms,
+            measured_ms=rec.measured_ms,
+            reason=f"tick tuning-cache {layer} hit ({rec.source} winner)",
+        )
+        _record(decision)
+        return decision
+    telemetry.record_autotune_cache(hit=False, layer="miss")
+
+    gen = env.tpu_generation()
+    cores = _MEGACORE_GENERATIONS.get(gen, 1)
+    spec = TPU_PEAK_SPECS.get(gen)
+    hbm_gbps = spec.hbm_gbps if spec else 819.0
+    bytes_per_elt = 2 if "16" in str(dtype) else 4
+    kv_bytes = (
+        2 * rows * width * page_size * hk * head_dim * bytes_per_elt
+    )
+    candidates = sorted(
+        s for s in range(1, min(width, 16) + 1) if width % s == 0
+    )
+    scored = []
+    for s in candidates:
+        speedup = min(rows * s, cores) / cores
+        read_s = kv_bytes / (hbm_gbps * 1e9 * max(speedup, 1e-9))
+        merge_s = (
+            math.log2(s) * _DECODE_MERGE_LEVEL_US * 1e-6 if s > 1 else 0.0
+        )
+        scored.append((read_s + merge_s, s))
+    scored.sort()
+    best_cost, best_s = scored[0]
+    rec = TuningRecord(
+        block_q=1,
+        block_k=width // best_s,
+        head_block=best_s,  # the split count (decode record convention)
+        source="model",
+        predicted_ms=best_cost * 1e3,
+        measured_ms=None,
+        candidates=tuple(
+            {
+                "num_splits": s,
+                "pages_per_split": width // s,
+                "cost_seconds": c,
+                "feasible": True,
+            }
+            for c, s in scored
+        ),
+    )
+    cache.put(fp, rec)
+    decision = TuningDecision(
+        block_q=1,
+        block_k=width // best_s,
+        head_block=best_s,
+        source="model",
+        cache_layer="none",
+        fingerprint_hash=fp.stable_hash(),
+        predicted_ms=rec.predicted_ms,
+        measured_ms=None,
+        reason=(
+            f"tick model: {best_s} split(s) x {width // best_s} pages "
+            f"(~{best_cost * 1e3:.3f} ms, {cores} core(s), "
+            f"{rows} tick rows)"
+        ),
+    )
+    _record(decision)
+    return decision
+
+
 def resolve_block_config(
     q_ranges,
     k_ranges,

@@ -224,6 +224,81 @@ def make_decode_fingerprint(
     )
 
 
+@dataclasses.dataclass(frozen=True)
+class TickFingerprint:
+    """Workload identity for the ``tick`` tuning kind (ISSUE 17).
+
+    The unified serving tick runs the split-KV kernel over a PADDED
+    per-row page table whose geometry is the tick budget's capacity
+    buckets, not the request mix — so the fingerprint's shape axes are
+    exactly those buckets (row capacity, entry capacity) plus the
+    head/dtype/page config. ``prefill_rows_bucket`` separates
+    decode-dominated from prefill-dominated ticks: the same padded
+    geometry reads very different live-KV fractions in the two regimes,
+    and their tuned split counts must not alias. ``kind="tick"`` keeps
+    the records disjoint from flex/decode in the shared cache."""
+
+    kind: str
+    version: int
+    generation: str
+    backend: str  # kernel backend @ jax platform (same rule as decode)
+    row_bucket: int  # log2 bucket of the padded row capacity
+    entry_bucket: int  # log2 bucket of the padded entry capacity
+    num_heads_q: int
+    num_heads_kv: int
+    head_dim: int
+    dtype: str
+    page_size: int
+    prefill_rows_bucket: int  # 0 = decode-only; else 1 + log2 bucket
+
+    TICK_FINGERPRINT_VERSION = 1
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def stable_hash(self) -> str:
+        payload = json.dumps(
+            self.as_dict(), sort_keys=True, separators=(",", ":")
+        )
+        return hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+
+def make_tick_fingerprint(
+    row_capacity: int,
+    entry_capacity: int,
+    page_size: int,
+    hq: int,
+    hk: int,
+    *,
+    head_dim: int = 128,
+    dtype: str = "bfloat16",
+    prefill_rows: int = 0,
+) -> TickFingerprint:
+    """Derive the tick-kind fingerprint (host-side integers only). The
+    capacities arrive already power-of-two padded (``TickEnumeration``
+    buckets), so the log2 bucket is exact, not lossy."""
+    import jax
+
+    from .. import env
+
+    return TickFingerprint(
+        kind="tick",
+        version=TickFingerprint.TICK_FINGERPRINT_VERSION,
+        generation=env.tpu_generation(),
+        backend=f"{env.kernel_backend()}@{jax.default_backend()}",
+        row_bucket=_log2_bucket(row_capacity),
+        entry_bucket=_log2_bucket(entry_capacity),
+        num_heads_q=int(hq),
+        num_heads_kv=int(hk),
+        head_dim=int(head_dim),
+        dtype=str(dtype),
+        page_size=int(page_size),
+        prefill_rows_bucket=(
+            0 if prefill_rows <= 0 else 1 + _log2_bucket(prefill_rows)
+        ),
+    )
+
+
 def _make_fingerprint_impl(
     q,
     k,

@@ -174,7 +174,12 @@ def test_shard_kv_cache_spans_devices():
         shard_kv_cache(cache3, mesh3)
 
 
-@pytest.mark.parametrize("tp", [1, 2])
+# tp=1 (degenerate, no head split) re-tiered slow for the 870s tier-1
+# budget (ISSUE 17); tp=2 keeps the bitwise TP surface default-tier and
+# `make distserve-check` asserts TP parity too
+@pytest.mark.parametrize(
+    "tp", [pytest.param(1, marks=pytest.mark.slow), 2]
+)
 def test_tp_decode_matches_single_chip_bitwise(tp):
     """KV-head-sharded TP decode == the single-chip split-KV reference,
     bit for bit (per-head math is untouched; no collective crosses the
@@ -406,6 +411,9 @@ def test_tier_lifecycle_spans(telemetry_on):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # 11s re-tier for the 870s tier-1 budget (ISSUE 17):
+# `make distserve-check` asserts the per-tier decode-first invariant on
+# the emulated fleet every `make check`
 def test_decode_first_anti_starvation_per_tier(telemetry_on):
     """While a long prompt drains chunk-by-chunk on the prefill tier,
     every tick with a placed decode batch runs decode — the tiers have

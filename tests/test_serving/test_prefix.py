@@ -208,6 +208,10 @@ def test_trie_eviction_is_lru_and_ref_safe():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # 9s re-tier for the 870s tier-1 budget (ISSUE 17):
+# `make sched-check` asserts the residency/CoW page accounting and the
+# lifecycle model checker explores fork refcount conservation every
+# `make check`/`make analyze`
 def test_engine_fork_memory_and_isolation():
     """N users sharing an aligned P-token prefix hold pages_needed(P) +
     sum pages_needed(suffix_i) pages — and each user's data stays its
@@ -330,7 +334,12 @@ def test_plan_cascade_groups():
     assert len(groups_all) == 2
 
 
-@pytest.mark.parametrize("splits", [None, 2])
+# splits=None (auto) re-tiered slow for the 870s tier-1 budget
+# (ISSUE 17); the pinned-splits param stays default-tier and
+# `make sched-check` asserts cascade parity on both backends
+@pytest.mark.parametrize(
+    "splits", [pytest.param(None, marks=pytest.mark.slow), 2]
+)
 def test_cascade_equals_flat_and_dense(splits):
     rng = np.random.default_rng(15)
     eng = _engine()

@@ -1,0 +1,560 @@
+"""One-kernel serving tick (ISSUE 17): enumeration composition, the
+unified kernel's parity against the per-request path AND a dense f64
+oracle, LSE demux, degenerate ticks, and the bucket-reuse retrace guard.
+
+The contracts:
+
+1. composition — :class:`TickEnumeration` packs decode rows, prefill
+   chunk rows, and cascade (suffix, prefix) pairs into ONE padded
+   block-sparse table with power-of-two capacity buckets; invalid rows
+   (page prefix not covering the claimed history) raise typed errors.
+2. parity — ``unified_tick_attn`` over a mixed tick equals the
+   per-request decode/prefill paths to float tolerance and the dense
+   reference to oracle tolerance, on both kernel backends and across
+   page sizes; the scheduler under ``MAGI_ATTENTION_UNIFIED_TICK=on``
+   reproduces the EXACT token schedule of ``off``.
+3. buckets — ticks with different request mixes but the same capacity
+   buckets replay the same ``tick[...]`` program label: the label set
+   over a whole trace stays bounded (flat compile count).
+4. pool-bound validation (satellite) — ``from_block_table`` rejects a
+   table referencing pages outside the pool, naming the slot and page.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.ops.block_sparse import (
+    BlockEnumeration,
+    TickEnumeration,
+)
+from magiattention_tpu.serving import (
+    Request,
+    Scheduler,
+    ServingEngine,
+    demux_tick,
+    unified_tick_attn,
+)
+from magiattention_tpu.testing import assert_close
+
+D, HK, HQ, PS = 16, 2, 4, 8
+VOCAB = 50
+
+
+@pytest.fixture(autouse=True)
+def _default_backend(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+
+
+_rng0 = np.random.default_rng(7)
+EMB_K = _rng0.standard_normal((VOCAB, HK, D)).astype(np.float32)
+EMB_V = _rng0.standard_normal((VOCAB, HK, D)).astype(np.float32)
+
+
+def kv_of(tokens):
+    idx = np.asarray(tokens, np.int64)
+    return jnp.asarray(EMB_K[idx]), jnp.asarray(EMB_V[idx])
+
+
+def dense_ref(q_row, tokens):
+    """f64 softmax(q k^T / sqrt(d)) v over the token-embedded KV."""
+    kf = np.repeat(EMB_K[np.asarray(tokens)].astype(np.float64), HQ // HK, 1)
+    vf = np.repeat(EMB_V[np.asarray(tokens)].astype(np.float64), HQ // HK, 1)
+    z = np.einsum("hd,thd->ht", np.asarray(q_row, np.float64), kf)
+    z /= math.sqrt(D)
+    w = np.exp(z - z.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    return np.einsum("ht,thd->hd", w, vf)
+
+
+def _engine(page_size=PS, **kw):
+    kw.setdefault("num_pages", 96)
+    kw.setdefault("max_seqs", 8)
+    kw.setdefault("max_pages_per_seq", 24)
+    return ServingEngine(
+        num_kv_heads=HK, head_dim=D, page_size=page_size,
+        dtype=jnp.float32, **kw
+    )
+
+
+def _req(rng, rid, prompt_len, gen, priority=0, tokens=None):
+    mk = lambda n, h: jnp.asarray(  # noqa: E731
+        rng.standard_normal((n, h, D)), jnp.float32
+    )
+    return Request(
+        rid=rid,
+        prompt_q=mk(prompt_len, HQ),
+        prompt_k=mk(prompt_len, HK),
+        prompt_v=mk(prompt_len, HK),
+        decode_q=mk(gen, HQ),
+        decode_k=mk(gen, HK),
+        decode_v=mk(gen, HK),
+        tokens=tokens,
+        priority=priority,
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1. composition
+# ---------------------------------------------------------------------------
+
+
+def test_tick_enumeration_composition():
+    tick = TickEnumeration(PS, min_rows=8)
+    d0 = tick.add_decode("d0", (3, 5), 2 * PS)
+    pf = tick.add_prefill("p0", (7, 9, 11), start=PS + 2, tokens=3)
+    cas = tick.add_decode(
+        "d1", (13,), PS + 1 - PS, prefix_pages=(1, 2), prefix_len=2 * PS
+    )
+    assert (d0.row_lo, d0.row_hi) == (0, 1)
+    assert (pf.row_lo, pf.row_hi) == (1, 4) and pf.kind == "prefill"
+    # cascade pair: prefix row FIRST, then the main (suffix) row
+    assert (cas.prefix_row, cas.row_lo, cas.row_hi) == (4, 5, 6)
+    rows, entries = tick.finalize()
+    assert rows == 8 and entries == 4  # pow2 buckets (min_rows floor)
+    bt = tick.block_tables()
+    valid = tick.valid_lens()
+    assert bt.shape == (8, 4) and valid.shape == (8,)
+    assert bt[0, :2].tolist() == [3, 5] and valid[0] == 2 * PS
+    # prefill rows: same page prefix, valid = start + i + 1
+    assert bt[1, :3].tolist() == bt[3, :3].tolist() == [7, 9, 11]
+    assert valid[1:4].tolist() == [PS + 3, PS + 4, PS + 5]
+    # cascade: prefix row over the shared pages, suffix row after it
+    assert bt[4, :2].tolist() == [1, 2] and valid[4] == 2 * PS
+    assert bt[5, 0] == 13 and valid[5] == 1
+    # padding rows are dead: page 0 (valid DMA), valid 0 (fully masked)
+    assert valid[6:].tolist() == [0, 0] and bt[6:].max() == 0
+    pairs = tick.merge_pairs()
+    assert pairs.shape == (1, 2) and pairs[0].tolist() == [5, 4]
+    # the single BlockEnumeration the kernel walks covers every entry
+    enum = tick.enumeration(num_splits=1)
+    assert isinstance(enum, BlockEnumeration)
+    assert enum.num_rows == rows
+
+
+def test_tick_enumeration_buckets_and_dead_row_guarantee():
+    # 9 rows -> capacity 16; pairs pad with dead-row self pairs
+    tick = TickEnumeration(PS, min_rows=8)
+    for i in range(7):
+        tick.add_decode(("d", i), (i + 1,), 1)
+    tick.add_decode("c", (30,), 1, prefix_pages=(31,), prefix_len=PS)
+    assert tick.num_rows == 9
+    rows, entries = tick.finalize()
+    assert rows == 16 and entries == 1
+    pairs = tick.merge_pairs()
+    assert pairs.shape == (1, 2)
+    # a pair-carrying tick that lands EXACTLY on its bucket doubles the
+    # row capacity so a dead row exists for pair padding
+    tick2 = TickEnumeration(PS, min_rows=2)
+    tick2.add_decode("c0", (1,), 1, prefix_pages=(2,), prefix_len=PS)
+    tick2.add_decode("c1", (3,), 1, prefix_pages=(4,), prefix_len=PS)
+    rows2, _ = tick2.finalize()
+    assert tick2.num_rows == 4 and rows2 == 8
+    p2 = tick2.merge_pairs()
+    # padded to pow2 pair capacity with dead-row self pairs
+    assert p2.shape[0] == 2 or p2.shape[0] == 4
+    dead = rows2 - 1
+    for r in range(2, p2.shape[0]):
+        assert p2[r].tolist() == [dead, dead]
+
+
+def test_tick_enumeration_validation():
+    tick = TickEnumeration(PS)
+    with pytest.raises(ValueError, match="cover"):
+        tick.add_decode("d", (3,), PS + 1)  # 1 page cannot hold PS+1
+    with pytest.raises(ValueError):
+        tick.add_prefill("p", (3,), start=0, tokens=0)
+
+
+def test_from_block_table_num_pages_validation():
+    """Satellite regression: a block table referencing a page outside
+    the pool raises a typed error naming the slot and the page id."""
+    good = np.array([[0, 1, 2], [3, 4, 5]], np.int32)
+    BlockEnumeration.from_block_table(good, 1, num_pages=6)  # fits
+    bad = np.array([[0, 1, 2], [3, 99, 5]], np.int32)
+    with pytest.raises(ValueError, match=r"row 1 entry 1.*page 99.*6-page"):
+        BlockEnumeration.from_block_table(bad, 1, num_pages=6)
+    neg = np.array([[0, -1]], np.int32)
+    with pytest.raises(ValueError, match=r"row 0 entry 1"):
+        BlockEnumeration.from_block_table(neg, 1, num_pages=6)
+    # without the bound the table is trusted (traced decode path)
+    BlockEnumeration.from_block_table(bad, 1)
+
+
+# ---------------------------------------------------------------------------
+# 2. kernel-level parity: unified == per-request == dense oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("backend", ["jnp", "pallas"])
+@pytest.mark.parametrize("page_size", [PS, 2 * PS])
+def test_unified_tick_attn_vs_dense(monkeypatch, backend, page_size):
+    """A mixed tick (2 decode rows + a 3-token prefill chunk, one decode
+    row cascade-paired) against the f64 dense oracle and manual LSE."""
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", backend)
+    rng = np.random.default_rng(3)
+    eng = _engine(page_size=page_size, prefix_sharing=False)
+    toks_a = [int(t) for t in rng.integers(0, VOCAB, 2 * page_size + 3)]
+    toks_b = [int(t) for t in rng.integers(0, VOCAB, page_size + 1)]
+    slots = {}
+    for name, toks in (("a", toks_a), ("b", toks_b)):
+        res = eng.admit(len(toks))
+        k, v = kv_of(toks)
+        q = jnp.asarray(
+            rng.standard_normal((len(toks), HQ, D)), jnp.float32
+        )
+        eng.prefill(q, k, v, res.slot)
+        slots[name] = res.slot
+
+    tick = TickEnumeration(page_size, min_rows=4)
+    q_parts = []
+    # decode rows: q attends the whole written history
+    for name, toks in (("a", toks_a), ("b", toks_b)):
+        slot = slots[name]
+        pages = eng.allocator.slot_pages(slot)
+        need = -(-len(toks) // page_size)
+        tick.add_decode(("d", name), pages[:need], len(toks))
+        q_parts.append(
+            jnp.asarray(rng.standard_normal((1, HQ, D)), jnp.float32)
+        )
+    # a 3-token causal chunk of sequence a, re-attending mid-history
+    start = page_size + 1
+    pages_a = eng.allocator.slot_pages(slots["a"])
+    need_a = -(-(start + 3) // page_size)
+    tick.add_prefill("p", pages_a[:need_a], start=start, tokens=3)
+    q_parts.append(
+        jnp.asarray(rng.standard_normal((3, HQ, D)), jnp.float32)
+    )
+    rows, _ = tick.finalize()
+    q_rows = jnp.concatenate(q_parts, axis=0)
+    q_rows = jnp.concatenate(
+        [
+            q_rows,
+            jnp.zeros((rows - q_rows.shape[0], HQ, D), jnp.float32),
+        ]
+    )
+    out, lse = unified_tick_attn(q_rows, eng.cache, tick, num_splits=1)
+    parts = demux_tick(tick, out, lse)
+    o_a, l_a = parts[("d", "a")]
+    o_b, _ = parts[("d", "b")]
+    o_p, l_p = parts["p"]
+    tol = dict(atol=5e-5, rtol=5e-5)
+    assert_close(o_a[0], dense_ref(q_rows[0], toks_a), **tol, msg="dec a")
+    assert_close(o_b[0], dense_ref(q_rows[1], toks_b), **tol, msg="dec b")
+    for i in range(3):
+        assert_close(
+            o_p[i],
+            dense_ref(q_rows[2 + i], toks_a[: start + i + 1]),
+            **tol,
+            msg=f"prefill row {i}",
+        )
+    # LSE demux: row 0's lse equals the manual logsumexp of its logits
+    kf = np.repeat(EMB_K[np.asarray(toks_a)].astype(np.float64), HQ // HK, 1)
+    z = np.einsum(
+        "hd,thd->ht", np.asarray(q_rows[0], np.float64), kf
+    ) / math.sqrt(D)
+    ref_lse = np.log(np.exp(z - z.max(-1, keepdims=True)).sum(-1)) + z.max(
+        -1
+    )
+    assert_close(l_a[0], ref_lse, atol=1e-4, rtol=1e-4, msg="lse")
+    # padding rows come back as the exact empty partial (0, -inf)
+    assert np.all(np.asarray(lse[tick.num_rows :]) == -np.inf)
+    assert np.all(np.asarray(out[tick.num_rows :]) == 0.0)
+
+
+@pytest.mark.parametrize("backend", ["jnp", "pallas"])
+def test_unified_tick_cascade_pair_matches_flat(monkeypatch, backend):
+    """A cascade (suffix, prefix) pair merged in-launch equals the same
+    row expressed flat (one row over the full table)."""
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", backend)
+    rng = np.random.default_rng(4)
+    eng = _engine(prefix_sharing=False)
+    toks = [int(t) for t in rng.integers(0, VOCAB, 3 * PS + 5)]
+    res = eng.admit(len(toks))
+    k, v = kv_of(toks)
+    q = jnp.asarray(rng.standard_normal((len(toks), HQ, D)), jnp.float32)
+    eng.prefill(q, k, v, res.slot)
+    pages = eng.allocator.slot_pages(res.slot)
+    need = -(-len(toks) // PS)
+    qd = jnp.asarray(rng.standard_normal((1, HQ, D)), jnp.float32)
+
+    flat = TickEnumeration(PS, min_rows=2)
+    flat.add_decode("x", pages[:need], len(toks))
+    rows_f, _ = flat.finalize()
+    qf = jnp.concatenate(
+        [qd, jnp.zeros((rows_f - 1, HQ, D), jnp.float32)]
+    )
+    o_flat, l_flat = unified_tick_attn(qf, eng.cache, flat, num_splits=1)
+
+    paired = TickEnumeration(PS, min_rows=2)
+    seg = paired.add_decode(
+        "x",
+        pages[2:need],
+        len(toks) - 2 * PS,
+        prefix_pages=pages[:2],
+        prefix_len=2 * PS,
+    )
+    rows_p, _ = paired.finalize()
+    qp = jnp.zeros((rows_p, HQ, D), jnp.float32)
+    qp = qp.at[seg.prefix_row].set(qd[0]).at[seg.row_lo].set(qd[0])
+    o_pair, l_pair = unified_tick_attn(qp, eng.cache, paired, num_splits=1)
+    assert_close(
+        o_pair[seg.row_lo], o_flat[0], atol=2e-5, rtol=2e-5, msg="out"
+    )
+    assert_close(
+        l_pair[seg.row_lo], l_flat[0], atol=2e-5, rtol=2e-5, msg="lse"
+    )
+
+
+# ---------------------------------------------------------------------------
+# 3. scheduler-level parity: on == off token schedule + outputs
+# ---------------------------------------------------------------------------
+
+
+def _run_trace(mode, cascade="auto", page_size=PS, budget=24, chunk=PS):
+    import os
+
+    os.environ["MAGI_ATTENTION_UNIFIED_TICK"] = mode
+    os.environ["MAGI_ATTENTION_CASCADE"] = cascade
+    try:
+        eng = _engine(page_size=page_size)
+        sched = Scheduler(eng, token_budget=budget, chunk=chunk)
+        rng = np.random.default_rng(11)
+        shared = [int(t) for t in rng.integers(0, VOCAB, 2 * PS)]
+        reqs = [
+            _req(rng, 1, prompt_len=20, gen=4),
+            _req(rng, 2, prompt_len=13, gen=3, priority=1),
+            _req(
+                rng, 3, prompt_len=2 * PS + 6, gen=5,
+                tokens=tuple(shared + [1, 2, 3, 4, 5, 6]),
+            ),
+            _req(
+                rng, 4, prompt_len=2 * PS + 4, gen=5,
+                tokens=tuple(shared + [7, 8, 9, 10]),
+            ),
+            _req(rng, 5, prompt_len=3, gen=0),  # zero-gen degenerate
+        ]
+        for r in reqs:
+            sched.submit(r)
+        launches = []
+        schedule = []
+        while not sched.done:
+            rep = sched.step()
+            launches.append(len(set(sched._tick_programs)))
+            schedule.append(
+                (
+                    rep.step,
+                    rep.decode_batch,
+                    tuple(rep.prefill_chunks),
+                    rep.tokens_used,
+                    tuple(sorted(rep.finished)),
+                )
+            )
+        outs = {
+            rid: (
+                None
+                if st.prefill_out_tail is None
+                else np.asarray(st.prefill_out_tail),
+                [np.asarray(o) for o in st.decode_outs],
+            )
+            for rid, st in sched._finished.items()
+        }
+        return schedule, outs, launches, reqs
+    finally:
+        os.environ.pop("MAGI_ATTENTION_UNIFIED_TICK", None)
+        os.environ.pop("MAGI_ATTENTION_CASCADE", None)
+
+
+# page_size=PS re-tiered slow for the 870s tier-1 budget (ISSUE 17):
+# `make tick-check` drives the full parity oracle at page_size 8 every
+# `make check`, so the default tier keeps the 2*PS geometry only.
+@pytest.mark.parametrize(
+    "page_size", [pytest.param(PS, marks=pytest.mark.slow), 2 * PS]
+)
+def test_scheduler_unified_parity(page_size):
+    """The acceptance oracle: with ``on``, the token schedule is
+    IDENTICAL to ``off`` (same chunks, same decode batches, same finish
+    ticks) and every output matches to float tolerance — while every
+    tick launches at most ONE program."""
+    s_off, o_off, l_off, reqs = _run_trace("off", page_size=page_size)
+    s_on, o_on, l_on, _ = _run_trace("on", page_size=page_size)
+    assert s_on == s_off
+    assert set(o_on) == set(o_off)
+    assert all(n <= 1 for n in l_on), l_on
+    assert max(l_off) > 1  # the legacy path really did launch more
+    for rid in o_off:
+        t_off, d_off = o_off[rid]
+        t_on, d_on = o_on[rid]
+        if t_off is not None:
+            assert_close(
+                t_on, t_off, atol=2e-5, rtol=2e-5, msg=f"tail {rid}"
+            )
+        assert len(d_on) == len(d_off)
+        for i, (a, b) in enumerate(zip(d_off, d_on)):
+            assert_close(
+                b, a, atol=2e-5, rtol=2e-5, msg=f"decode {rid}[{i}]"
+            )
+    # decode outputs also match the dense oracle built from the raw
+    # request arrays (full KV history = prompt + generated steps)
+    req = {r.rid: r for r in reqs}[1]
+    kf = np.concatenate(
+        [np.asarray(req.prompt_k), np.asarray(req.decode_k)]
+    )
+    vf = np.concatenate(
+        [np.asarray(req.prompt_v), np.asarray(req.decode_v)]
+    )
+    plen = req.prompt_len
+    for i, got in enumerate(o_on[1][1]):
+        hist_k = np.repeat(
+            kf[: plen + i + 1].astype(np.float64), HQ // HK, 1
+        )
+        hist_v = np.repeat(
+            vf[: plen + i + 1].astype(np.float64), HQ // HK, 1
+        )
+        qd = np.asarray(req.decode_q[i], np.float64)
+        z = np.einsum("hd,thd->ht", qd, hist_k) / math.sqrt(D)
+        w = np.exp(z - z.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        ref = np.einsum("ht,thd->hd", w, hist_v)
+        assert_close(got, ref, atol=5e-5, rtol=5e-5, msg=f"oracle d{i}")
+
+
+def test_scheduler_auto_mode_fuses_only_multi_program_ticks():
+    s_auto, o_auto, l_auto, _ = _run_trace("auto")
+    s_off, o_off, _, _ = _run_trace("off")
+    assert s_auto == s_off
+    assert all(n <= 2 for n in l_auto), l_auto
+    for rid in o_off:
+        for a, b in zip(o_off[rid][1], o_auto[rid][1]):
+            assert_close(b, a, atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# 4. degenerate ticks
+# ---------------------------------------------------------------------------
+
+
+def test_engine_unified_tick_degenerate():
+    rng = np.random.default_rng(5)
+    eng = _engine()
+    # empty tick: no items at all
+    d, p = eng.unified_tick([], [])
+    assert d == [] and p == []
+    assert eng.last_tick_info["program"] is None
+
+    # prefill-only tick
+    toks = [int(t) for t in rng.integers(0, VOCAB, PS + 3)]
+    res = eng.admit(len(toks), tokens=toks)
+    k, v = kv_of(toks)
+    q = jnp.asarray(rng.standard_normal((len(toks), HQ, D)), jnp.float32)
+    d, p = eng.unified_tick([], [(res.slot, q, k, v)])
+    assert d == [] and len(p) == 1
+    out, lse = p[0]
+    assert out.shape == (len(toks), HQ, D) and lse.shape == (len(toks), HQ)
+    assert eng.last_tick_info["program"].startswith("tick[")
+    assert eng.last_tick_info["decode_batch"] == 0
+    for i in (0, len(toks) - 1):
+        assert_close(
+            out[i],
+            dense_ref(q[i], toks[: i + 1]),
+            atol=5e-5,
+            rtol=5e-5,
+            msg=f"row {i}",
+        )
+
+    # decode-only tick
+    qd = jnp.asarray(rng.standard_normal((HQ, D)), jnp.float32)
+    tok_new = 3
+    kd, vd = kv_of([tok_new])
+    d, p = eng.unified_tick([(res.slot, qd, kd[0], vd[0])], [])
+    assert len(d) == 1 and p == []
+    assert_close(
+        d[0][0],
+        dense_ref(qd, toks + [tok_new]),
+        atol=5e-5,
+        rtol=5e-5,
+        msg="decode",
+    )
+    assert eng.last_tick_info["prefill_rows"] == 0
+
+    # zero-token prefill item (fully cached prompt): hooks only, no
+    # launch, empty per-request output — and the prompt gets committed
+    # to the prefix trie exactly like prefill()'s early return
+    res2 = eng.admit(len(toks), tokens=toks)
+    assert res2.prefix_len == 0 or res2.prefix_len <= len(toks)
+    q0 = jnp.zeros((0, HQ, D), jnp.float32)
+    k0 = jnp.zeros((0, HK, D), jnp.float32)
+    if res2.prefix_len == len(toks):
+        d, p = eng.unified_tick([], [(res2.slot, q0, k0, k0)])
+        assert p[0][0].shape == (0, HQ, D)
+        assert eng.last_tick_info["program"] is None
+
+
+def test_engine_unified_tick_rejects_dual_phase_slot():
+    eng = _engine()
+    rng = np.random.default_rng(6)
+    toks = [int(t) for t in rng.integers(0, VOCAB, 4)]
+    res = eng.admit(len(toks))
+    k, v = kv_of(toks)
+    q = jnp.asarray(rng.standard_normal((4, HQ, D)), jnp.float32)
+    eng.prefill(q, k, v, res.slot)
+    qd = jnp.asarray(rng.standard_normal((HQ, D)), jnp.float32)
+    with pytest.raises(ValueError, match="both decode and prefill"):
+        eng.unified_tick(
+            [(res.slot, qd, k[0], v[0])], [(res.slot, q, k, v)]
+        )
+
+
+# ---------------------------------------------------------------------------
+# 5. bucket reuse / retrace guard
+# ---------------------------------------------------------------------------
+
+
+def test_tick_labels_bucket_reuse_across_mixes():
+    """Ticks with DIFFERENT request mixes land on the same padded
+    geometry bucket, hence the same program label: over a whole
+    multi-tenant trace the distinct ``tick[...]`` label count stays
+    far below the tick count (flat compile count after warmup)."""
+    schedule, _, launches, _ = _run_trace("on")
+    import os
+
+    os.environ["MAGI_ATTENTION_UNIFIED_TICK"] = "on"
+    try:
+        eng = _engine()
+        sched = Scheduler(eng, token_budget=24, chunk=PS)
+        rng = np.random.default_rng(12)
+        # a different mix than _run_trace: more, smaller requests
+        for i in range(6):
+            sched.submit(
+                _req(rng, 100 + i, prompt_len=6 + 3 * i, gen=2 + i % 3)
+            )
+        labels = []
+        while not sched.done:
+            sched.step()
+            labels.extend(sched._tick_programs)
+        assert len(labels) >= 6
+        distinct = sorted(set(labels))
+        # bounded label set: pow2 buckets, not per-mix geometry
+        assert len(distinct) <= 6, distinct
+        # steady state replays labels (bucket reuse, no retrace)
+        assert len(labels) > len(distinct)
+        for lab in distinct:
+            assert lab.startswith("tick[r="), lab
+    finally:
+        os.environ.pop("MAGI_ATTENTION_UNIFIED_TICK", None)
+
+
+def test_tick_program_label_fingerprint():
+    assert telemetry.tick_program_label(16, 4, 2) == "tick[r=16,e=4,s=2]"
+
+
+def test_unified_tick_census_assertion_holds():
+    """The scheduler's launch census (hoisted one-pass state scan)
+    predicts the ledger's program count on BOTH paths — the tick loop
+    runs with the assert armed; any drift would have raised."""
+    for mode in ("off", "on", "auto"):
+        schedule, _, launches, _ = _run_trace(mode)
+        assert schedule  # ran to completion through the assert
