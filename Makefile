@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze spmd-audit lifecycle-check resilience-check roofline-check roofline-report trace-check distserve-check memory-check compile-check tick-check check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze spmd-audit lifecycle-check resilience-check roofline-check roofline-report trace-check distserve-check memory-check compile-check tick-check numerics-check check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -202,6 +202,17 @@ compile-check:
 tick-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_tick_check.py --self-test
 
+# numerics observability gate (ISSUE 18; CPU): REQUIRED_NUMERICS_METRICS
+# populated by a live census+shadow trace (decode + parallel layers, zero
+# breaches when clean), a planted guard-invisible finite:8.0 split
+# corruption caught by the shadow sentinel with a trace-id-tagged
+# numeric_drift flight dump, census-off transparency (bit-identical
+# out/lse, trace count 1/1, identical collective census), and
+# --self-test proof that a 2-ulp-over-budget divergence fails the
+# error-budget gate by exactly the planted margin
+numerics-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_numerics_check.py --self-test
+
 # mask-aware roofline report + occupancy JSON artifact for the 16k
 # varlen block-causal headline (docs/observability.md "Roofline &
 # occupancy"); host-side only
@@ -213,5 +224,6 @@ roofline-report:
 # serving parity, shared-prefix/scheduler gate, group-collective
 # parity/volume, resilience gate, roofline/occupancy gate, request
 # tracing/exposition gate, disaggregated-serving gate, memory
-# observability gate, unified-tick gate — all CPU-safe
-check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check distserve-check memory-check compile-check tick-check
+# observability gate, unified-tick gate, numerics observability gate —
+# all CPU-safe
+check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check distserve-check memory-check compile-check tick-check numerics-check

@@ -1,0 +1,434 @@
+"""Numerics observability drift guard (``make numerics-check``) — CPU.
+
+The ISSUE 18 acceptance surface, device-free:
+
+1. **census + shadow catalog on a live trace**: a serving trace under
+   ``MAGI_ATTENTION_NUMERICS=census`` + ``MAGI_ATTENTION_SHADOW_
+   SAMPLE_RATE=1`` plus one cp=2 dist_attn call must populate every
+   ``REQUIRED_NUMERICS_METRICS`` name (both the ``decode`` and
+   ``parallel`` layers), with the shadow sentinel scoring every decode
+   batch and ZERO breaches on the clean run;
+2. **the sentinel catches what the guards cannot**: a planted
+   ``corrupt_partial:site=split0,value=finite:8.0,field=out`` under
+   ``MAGI_ATTENTION_GUARD=check`` — the finite plant passes the
+   nan/inf guards clean (zero ``magi_guard_violations``) but the
+   shadow-sampled reference recompute breaches its f32 budget and the
+   deferred ``numeric_drift`` flight dump carries the live request's
+   trace id, the breach attribution, and the ``numerics`` section;
+3. **transparency**: ``MAGI_ATTENTION_NUMERICS=off`` vs ``census`` on
+   the same plan — bit-identical out/lse, jit trace count unchanged
+   across value-mutated calls, and an identical trace-audit collective
+   census (the census threads summaries through existing outputs, it
+   never adds a collective);
+4. ``--self-test``: a divergence planted exactly 2 ulps over a tight
+   budget must FAIL ``assert_within_budget`` with the exact ulp
+   distance measured — and the same plant at exactly the budget must
+   pass (the oracle is exact, the gate is not trigger-happy).
+
+Exits non-zero on any violation.
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] = "jnp"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from magiattention_tpu import telemetry  # noqa: E402
+from magiattention_tpu.serving import (  # noqa: E402
+    Request,
+    Scheduler,
+    ServingEngine,
+)
+from magiattention_tpu.telemetry import numerics  # noqa: E402
+from magiattention_tpu.telemetry import trace  # noqa: E402
+
+HQ, HK, D, PS = 4, 2, 16, 8
+VOCAB = 89
+
+_rng = np.random.default_rng(0)
+EMB_K = _rng.standard_normal((VOCAB, HK, D)).astype(np.float32)
+EMB_V = _rng.standard_normal((VOCAB, HK, D)).astype(np.float32)
+
+_ENV_KEYS = (
+    "MAGI_ATTENTION_NUMERICS",
+    "MAGI_ATTENTION_SHADOW_SAMPLE_RATE",
+    "MAGI_ATTENTION_CHAOS",
+    "MAGI_ATTENTION_GUARD",
+    "MAGI_ATTENTION_TRACE_DIR",
+)
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def set_env(**kw) -> None:
+    """Set/clear the numerics-relevant env vars (None clears)."""
+    for k in _ENV_KEYS:
+        short = k.removeprefix("MAGI_ATTENTION_").lower()
+        if short in kw and kw[short] is not None:
+            os.environ[k] = str(kw[short])
+        else:
+            os.environ.pop(k, None)
+
+
+def _engine(**kw):
+    kw.setdefault("num_pages", 48)
+    kw.setdefault("max_seqs", 6)
+    kw.setdefault("max_pages_per_seq", 8)
+    return ServingEngine(
+        num_kv_heads=HK, head_dim=D, page_size=PS, dtype=jnp.float32, **kw
+    )
+
+
+def _req(rng, rid, tokens, gen):
+    idx = np.asarray(tokens, np.int64)
+    return Request(
+        rid=rid,
+        prompt_q=jnp.asarray(
+            rng.standard_normal((len(tokens), HQ, D)), jnp.float32
+        ),
+        prompt_k=jnp.asarray(EMB_K[idx]),
+        prompt_v=jnp.asarray(EMB_V[idx]),
+        decode_q=jnp.asarray(rng.standard_normal((gen, HQ, D)), jnp.float32),
+        decode_k=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        decode_v=jnp.asarray(rng.standard_normal((gen, HK, D)), jnp.float32),
+        tokens=list(tokens),
+    )
+
+
+def _counter_sum(snap, name) -> float:
+    return sum(
+        v
+        for k, v in snap.get("counters", {}).items()
+        if k == name or k.startswith(name + "{")
+    )
+
+
+def _dist_fixture():
+    from jax.sharding import Mesh
+
+    from magiattention_tpu.common.enum import AttnMaskType
+    from magiattention_tpu.common.ranges import AttnRanges
+    from magiattention_tpu.meta.dispatch_meta import (
+        make_dispatch_meta_from_qk_ranges,
+    )
+    from magiattention_tpu.meta.solver.overlap_solver import OverlapConfig
+    from magiattention_tpu.parallel.dist_attn import (
+        build_dist_attn_plan,
+        make_attn_params,
+    )
+
+    total, cp, d = 1024, 2, 32
+    qr = AttnRanges.from_ranges([(0, total)])
+    kr = AttnRanges.from_ranges([(0, total)])
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, [AttnMaskType.CAUSAL], total, total,
+        chunk_size=128, cp_size=cp,
+    )
+    plan = build_dist_attn_plan(
+        mq, bucket, block_q=64, block_k=64,
+        overlap_config=OverlapConfig(degree=2, min_stage_rows=64),
+    )
+    mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
+    params = make_attn_params(plan, d, out_dtype="float32")
+    return plan, mesh, params, total, d
+
+
+def _dist_operands(total, d, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((total, 2, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, 2, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, 2, d)), jnp.float32)
+    return q, k, v
+
+
+def check_catalog() -> int:
+    """Census + shadow on a live trace populate the whole catalog."""
+    from magiattention_tpu.parallel.dist_attn import make_dist_attn_fn
+
+    set_env(numerics="census", shadow_sample_rate="1")
+    telemetry.reset()
+    numerics.reset_numerics_census()
+
+    # the decode layer + shadow sentinel via a real scheduler trace
+    rng = np.random.default_rng(3)
+    eng = _engine()
+    sched = Scheduler(eng, token_budget=48, chunk=PS)
+    sched.submit(_req(rng, 0, [int(t) for t in rng.integers(0, VOCAB, 2 * PS)],
+                      gen=3))
+    sched.submit(_req(rng, 1, [int(t) for t in rng.integers(0, VOCAB, PS + 3)],
+                      gen=2))
+    sched.run()
+
+    # the parallel layer via one censused cp=2 dist_attn call
+    plan, mesh, params, total, d = _dist_fixture()
+    fn = make_dist_attn_fn(plan, mesh, params)
+    fn(*_dist_operands(total, d))
+
+    snap = telemetry.snapshot()
+
+    def has_series(name):
+        return any(
+            k == name or k.startswith(name + "{")
+            for sec in snap.values() for k in sec
+        )
+
+    missing = [
+        m for m in telemetry.REQUIRED_NUMERICS_METRICS if not has_series(m)
+    ]
+    if missing:
+        return fail(
+            f"documented numerics metrics missing from a live trace "
+            f"(catalog drift): {missing}"
+        )
+    gauges = snap.get("gauges", {})
+    for layer in ("decode", "parallel"):
+        if not any(
+            k.startswith("magi_numerics_census{") and f"layer={layer}" in k
+            for k in gauges
+        ):
+            return fail(f"census gauges carry no layer={layer} series")
+    checks = _counter_sum(snap, "magi_numerics_shadow_checks")
+    breaches = _counter_sum(snap, "magi_numerics_shadow_breaches")
+    if checks < 3:
+        return fail(
+            f"shadow sentinel at rate 1 scored only {checks} decode "
+            "batches across a 2-request trace (want >= 3)"
+        )
+    if breaches:
+        return fail(
+            f"clean trace breached the f32 shadow budget {breaches}x — "
+            "either the decode path drifted or the budget is miscalibrated"
+        )
+    print(
+        f"numerics-check: live trace populated all "
+        f"{len(telemetry.REQUIRED_NUMERICS_METRICS)} "
+        f"REQUIRED_NUMERICS_METRICS (decode + parallel layers); shadow "
+        f"sentinel scored {checks:.0f} batches, 0 breaches"
+    )
+    return 0
+
+
+def check_finite_plant(tmpdir: str) -> int:
+    """The finite plant: invisible to guards, fatal to the sentinel."""
+    from magiattention_tpu.resilience.chaos import reset_chaos
+
+    set_env(
+        numerics="census",
+        shadow_sample_rate="1",
+        guard="check",
+        chaos="corrupt_partial:site=split0,value=finite:8.0,field=out",
+        trace_dir=tmpdir,
+    )
+    reset_chaos()
+    telemetry.reset()
+    numerics.reset_numerics_census()
+    fr = trace.reset_flight_recorder()
+    try:
+        rng = np.random.default_rng(5)
+        eng = _engine()
+        sched = Scheduler(eng, token_budget=48, chunk=PS)
+        victim = sched.submit(
+            _req(rng, 0, [int(t) for t in rng.integers(0, VOCAB, 2 * PS)],
+                 gen=2)
+        )
+        sched.run()
+    finally:
+        set_env(trace_dir=tmpdir)
+        reset_chaos()
+    snap = telemetry.snapshot()
+    violations = _counter_sum(snap, "magi_guard_violations")
+    if violations:
+        return fail(
+            f"the finite:8.0 plant tripped the nan/inf guards "
+            f"({violations:.0f} violations) — it must be guard-invisible"
+        )
+    breaches = _counter_sum(snap, "magi_numerics_shadow_breaches")
+    if not breaches:
+        return fail(
+            "planted finite:8.0 split corruption was NOT caught by the "
+            "shadow sentinel (zero magi_numerics_shadow_breaches)"
+        )
+    dumps = [
+        json.load(open(p))
+        for p in fr.dump_paths
+    ]
+    drift = [
+        d for d in dumps
+        if d.get("trigger", {}).get("trigger") == "numeric_drift"
+    ]
+    if not drift:
+        return fail(
+            f"shadow breach produced no numeric_drift flight dump "
+            f"(dumps: {[d.get('trigger', {}).get('trigger') for d in dumps]})"
+        )
+    ctx = drift[-1]["trigger"]["context"]
+    if ctx.get("trace_id") != victim.trace_id:
+        return fail(
+            f"numeric_drift dump lacks the live request's trace id "
+            f"(got {ctx.get('trace_id')!r}, want {victim.trace_id!r})"
+        )
+    if "out.max_abs" not in (ctx.get("violations") or []):
+        return fail(
+            f"breach attribution lacks out.max_abs: {ctx.get('violations')}"
+        )
+    numsec = drift[-1].get("numerics") or {}
+    srcs = [k for k in numsec if k.startswith("census")]
+    if not srcs:
+        return fail("numeric_drift dump carries no census numerics section")
+    shadow = numsec[srcs[-1]].get("shadow") or []
+    if not any(r.get("breached") for r in shadow):
+        return fail(
+            f"dump's numerics section shows no breached shadow record: "
+            f"{shadow}"
+        )
+    print(
+        f"numerics-check: finite:8.0 plant passed the guards clean "
+        f"(0 violations) but breached the sentinel {breaches:.0f}x -> "
+        f"numeric_drift dump tagged with trace id {victim.trace_id} "
+        f"(max_ulp {ctx.get('max_ulp'):.3g}, dominant {ctx.get('dominant')})"
+    )
+    return 0
+
+
+def check_transparency() -> int:
+    """NUMERICS=off is bit-free: identical values, traces, collectives."""
+    from magiattention_tpu.analysis.trace_audit import (
+        collective_census,
+        count_traces,
+    )
+    from magiattention_tpu.parallel.dist_attn import make_dist_attn_fn
+
+    plan, mesh, params, total, d = _dist_fixture()
+    ops1 = _dist_operands(total, d, seed=0)
+    ops2 = _dist_operands(total, d, seed=1)
+
+    results = {}
+    for mode in ("off", "census"):
+        set_env(numerics=mode)
+        fn = make_dist_attn_fn(plan, mesh, params)
+        body = count_traces(lambda a, b, c, _fn=fn: _fn(a, b, c))
+        jf = jax.jit(body)
+        out, lse = map(np.asarray, jf(*ops1))
+        jf(*ops2)  # value change at fixed shapes: no retrace
+        census = collective_census(
+            jax.make_jaxpr(lambda a, b, c, _fn=fn: _fn(a, b, c))(*ops1)
+        )
+        results[mode] = (out, lse, body.traces, census)
+    set_env()
+    (o0, l0, t0, c0), (o1, l1, t1, c1) = results["off"], results["census"]
+    if not (np.array_equal(o0, o1) and np.array_equal(l0, l1)):
+        return fail("NUMERICS=census is not bit-identical to off")
+    if t0 != 1 or t1 != 1:
+        return fail(
+            f"trace count changed: off={t0} census={t1} (want 1/1 "
+            "across value-mutated calls)"
+        )
+    if c0 != c1:
+        return fail(
+            f"census mode changed the collective census: off={c0} "
+            f"census={c1} — the census must not add collectives"
+        )
+    print(
+        f"numerics-check: census transparent — bit-identical out/lse, "
+        f"1 trace per mode, identical collective census {c1}"
+    )
+    return 0
+
+
+def self_test() -> int:
+    """The gate must be able to FAIL — by exactly the planted margin."""
+    rng = np.random.default_rng(7)
+    ref = rng.standard_normal((64, HQ, D)).astype(np.float32)
+    budget = dataclasses.replace(
+        numerics.budget_for_dtype("float32"),
+        max_ulp=16, max_abs=float("inf"), max_rel=float("inf"),
+    )
+    # exactly AT budget: the gate must stay quiet
+    at = numerics.divergence_report(ref, numerics.nudge_ulps(ref, 16))
+    if at.out_max_ulp != 16.0:
+        return fail(
+            f"oracle inexact: 16-ulp plant measured {at.out_max_ulp}"
+        )
+    numerics.assert_within_budget(at, budget, where="self-test:at-budget")
+    # 2 ulps OVER budget: the gate must trip, attributing out.max_ulp
+    over = numerics.divergence_report(ref, numerics.nudge_ulps(ref, 18))
+    if over.out_max_ulp != 18.0:
+        return fail(
+            f"oracle inexact: 18-ulp plant measured {over.out_max_ulp}"
+        )
+    try:
+        numerics.assert_within_budget(over, budget, where="self-test:over")
+    except numerics.ErrorBudgetExceeded as e:
+        if "out.max_ulp" not in e.violations:
+            return fail(
+                f"breach attribution wrong: {e.violations} lacks "
+                "out.max_ulp"
+            )
+    else:
+        return fail(
+            "planted 2-ulp-over-budget divergence was NOT caught by "
+            "assert_within_budget"
+        )
+    print(
+        "numerics-check: --self-test planted 18-vs-16-ulp divergence "
+        "caught exactly (and the at-budget plant passed)"
+    )
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args()
+    env_backup = {k: os.environ.get(k) for k in _ENV_KEYS}
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    trace.reset_flight_recorder()
+    try:
+        with tempfile.TemporaryDirectory(prefix="magi_num_check_") as td:
+            checks = [
+                check_catalog,
+                lambda: check_finite_plant(td),
+                check_transparency,
+            ]
+            if args.self_test:
+                checks.append(self_test)
+            for check in checks:
+                rc = check()
+                if rc:
+                    return rc
+    finally:
+        for k, v in env_backup.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        telemetry.set_enabled(None)
+        telemetry.reset()
+        trace.reset_flight_recorder()
+        numerics.reset_numerics_census()
+    print("numerics-check: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

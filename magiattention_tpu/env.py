@@ -73,6 +73,54 @@ def validate_mode() -> str:
     return v
 
 
+NUMERICS_MODES = ("off", "census")
+
+
+def numerics_mode() -> str:
+    """Numerics-observability mode (``telemetry/numerics.py``, ISSUE
+    18), validated here:
+
+    - ``off`` (default): no census — the traced programs carry ZERO
+      extra ops and outputs stay bit-identical (proved by the
+      numerics-check trace audit).
+    - ``census``: the guard sites in ``parallel/dist_attn.py`` and
+      ``serving/decode_attn.py`` additionally emit cheap traced value
+      summaries (max logit, lse min/max, out max-abs, softmax-mass
+      deviation), consumed at the jit boundary into the
+      ``magi_numerics_*`` gauges/histograms and embedded in every
+      flight dump as a ``numerics`` section. Pure reductions over
+      already-materialized partials — no collectives are added.
+
+    Changes the traced program (extra summary outputs), so part of
+    :func:`flags_fingerprint`."""
+    v = _env_str("MAGI_ATTENTION_NUMERICS", "off").strip().lower()
+    if v not in NUMERICS_MODES:
+        raise ValueError(
+            f"MAGI_ATTENTION_NUMERICS={v!r} must be one of {NUMERICS_MODES}"
+        )
+    return v
+
+
+def shadow_sample_rate() -> int:
+    """Shadow-sampled drift-sentinel rate (``serving/engine.py``, ISSUE
+    18): every Nth decode batch is re-computed through the f32 jnp
+    reference path and scored against the production output with the
+    error-budget oracle (``telemetry/numerics.py``); a budget breach
+    records ``magi_numerics_shadow_divergence`` and arms a deferred
+    ``numeric_drift`` flight dump tagged with the live trace id. ``0``
+    (the default) disables the sentinel. Serving-host behavior only (the
+    shadow runs OUTSIDE the production program and never changes a plan
+    or a distributed runtime key), so NOT part of
+    :func:`flags_fingerprint`."""
+    v = _env_int("MAGI_ATTENTION_SHADOW_SAMPLE_RATE", 0)
+    if v < 0:
+        raise ValueError(
+            f"MAGI_ATTENTION_SHADOW_SAMPLE_RATE={v} must be >= 0 "
+            "(re-check every Nth decode batch; 0 disables)"
+        )
+    return v
+
+
 GUARD_MODES = ("off", "check", "repair")
 
 
@@ -695,4 +743,5 @@ def flags_fingerprint() -> tuple:
         guard_mode(),
         chaos_spec(),
         unified_tick_mode(),
+        numerics_mode(),
     )

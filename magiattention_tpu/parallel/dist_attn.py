@@ -926,6 +926,7 @@ def dist_attn_local(
     axis_name: str = "cp",
     sink: jax.Array | None = None,
     with_guard_code: bool = False,
+    with_census: bool = False,
 ):
     """The SPMD hot path — call inside shard_map over the cp axis.
 
@@ -938,13 +939,22 @@ def dist_attn_local(
     when ``MAGI_ATTENTION_GUARD`` != off; the keyed runtime consumes the
     code at the jit boundary). Default False keeps the 3-tuple contract
     for direct callers (models, timeline profiler, trace audit).
+
+    ``with_census``: additionally return the rank-local packed value
+    census (ISSUE 18 — f32 ``[len(numerics.census_keys(sites))]``, the
+    per-guard-site summaries + final softmax-mass deviation in
+    ``plan_guard_sites`` order) as the LAST output. Pure reductions
+    over partials already in registers — no collectives.
     """
     from ..resilience import chaos, guards
+    from ..telemetry import numerics
 
     gmode = guards.guard_mode()
     code = guards.new_error_code() if with_guard_code else None
+    census_vals: list = []
+    partial_lses: list = []
 
-    def _resilient(out_p, lse_p, site, site_index):
+    def _resilient(out_p, lse_p, site, site_index, rowmax=None):
         # chaos upstream of the guard — injected faults must travel the
         # exact path an organic kernel NaN would
         nonlocal code
@@ -959,7 +969,20 @@ def dist_attn_local(
             out_p, lse_p, code = guards.guard_partial(
                 out_p, lse_p, code, site_index, site
             )
+        if with_census:
+            # census downstream of chaos: an injected corruption must
+            # be visible to the instruments built to catch it
+            census_vals.extend(
+                numerics.site_summary(out_p, lse_p, rowmax)
+            )
+            partial_lses.append(lse_p)
         return out_p, lse_p
+
+    def _pack_census(final_lse):
+        census_vals.append(
+            numerics.mass_deviation(partial_lses, final_lse)
+        )
+        return numerics.pack_census(census_vals)
 
     params = ensure_kernel_steps(
         params,
@@ -1023,10 +1046,15 @@ def dist_attn_local(
                 sink,
             )
         out, lse = _headmajor_to_seq(out_h, lse_lanes, plan.shard_q_len)
-        out, lse = _resilient(out, lse, "merged", 0)
+        out, lse = _resilient(
+            out, lse, "merged", 0, rowmax=rowmax_lanes[:, :, 0]
+        )
+        res = (out, lse, _head_max(rowmax_lanes))
         if with_guard_code:
-            return out, lse, _head_max(rowmax_lanes), code
-        return out, lse, _head_max(rowmax_lanes)
+            res = res + (code,)
+        if with_census:
+            res = res + (_pack_census(lse),)
+        return res
 
     # staged path: host stage + D lse-merged remote stages.
     # The sink joins the softmax denominator exactly once — in the host
@@ -1046,7 +1074,9 @@ def dist_attn_local(
             qh, k, v, host_tab, plan.host_tables.kv_pad, host_params, sink
         )
     out, lse = _headmajor_to_seq(out_h, lse_lanes, plan.shard_q_len)
-    out, lse = _resilient(out, lse, "host", 0)
+    out, lse = _resilient(
+        out, lse, "host", 0, rowmax=rowmax_lanes[:, :, 0]
+    )
     mx = _head_max(rowmax_lanes)
 
     stage_params = dataclasses.replace(
@@ -1062,14 +1092,19 @@ def dist_attn_local(
                 stage_params, None,
             )
         out_i, lse_i = _headmajor_to_seq(out_i_h, lse_i_lanes, plan.shard_q_len)
-        out_i, lse_i = _resilient(out_i, lse_i, f"stage{i}", 1 + i)
+        out_i, lse_i = _resilient(
+            out_i, lse_i, f"stage{i}", 1 + i, rowmax=rowmax_i[:, :, 0]
+        )
         with named_scope(f"magi_stage{i}_lse_merge"):
             out, lse = correct_attn_out_lse(out, lse, out_i, lse_i)
         mx = jnp.maximum(mx, _head_max(rowmax_i))
     out = out.astype(params.out_jnp_dtype)
+    res = (out, lse, mx)
     if with_guard_code:
-        return out, lse, mx, code
-    return out, lse, mx
+        res = res + (code,)
+    if with_census:
+        res = res + (_pack_census(lse),)
+    return res
 
 
 def make_dist_attn_fn(
@@ -1100,8 +1135,20 @@ def make_dist_attn_fn(
     # code out of the traced program; this wrapper consumes it at the
     # jit boundary (check mode raises NumericalGuardError naming the
     # failing stage; repair mode records the quarantines)
+    from ..telemetry import numerics
+
     thread_code = guards.guards_active()
     guard_sites = guards.plan_guard_sites(plan) if thread_code else ()
+    # ISSUE 18: census mode threads the packed value summaries out the
+    # same way (one extra [1, S] per-rank output, consumed at the jit
+    # boundary); off-mode traces NOTHING extra — proven bit-identical
+    # by the numerics-check transparency pass
+    thread_census = numerics.census_active()
+    census_keys = (
+        numerics.census_keys(guards.plan_guard_sites(plan))
+        if thread_census
+        else ()
+    )
     tables = plan.device_tables()
     if all(d.process_index == jax.process_index() for d in mesh.devices.flat):
         tables = tuple(
@@ -1124,6 +1171,8 @@ def make_dist_attn_fn(
         out_specs = out_specs + (P(axis_name),)
     if thread_code:
         out_specs = out_specs + (P(axis_name),)  # per-rank guard codes
+    if thread_census:
+        out_specs = out_specs + (P(axis_name),)  # per-rank census [1, S]
 
     @functools.partial(
         shard_map,
@@ -1140,7 +1189,7 @@ def make_dist_attn_fn(
         s = rest[n_tab] if len(rest) > n_tab else None
         res = dist_attn_local(
             q, k, v, tabs, plan, params, axis_name=axis_name, sink=s,
-            with_guard_code=thread_code,
+            with_guard_code=thread_code, with_census=thread_census,
         )
         out, lse, mx = res[:3]
         outs = (out, lse)
@@ -1148,6 +1197,8 @@ def make_dist_attn_fn(
             outs = outs + (mx[None],)
         if thread_code:
             outs = outs + (res[3][None],)
+        if thread_census:
+            outs = outs + (res[-1][None],)
         return outs
 
     def fn(q, k, v, sink_override=None):
@@ -1161,6 +1212,9 @@ def make_dist_attn_fn(
         )
         extra = (s,) if s is not None else ()
         res = _local(q, k, v, *tables, *extra)
+        if thread_census:
+            *res, census = res
+            numerics.consume_census(census, census_keys, layer="parallel")
         if thread_code:
             *res, code = res
             guards.consume_error_code(code, guard_sites)

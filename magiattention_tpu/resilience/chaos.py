@@ -17,11 +17,11 @@ Spec grammar (see ``docs/resilience.md`` for the prose version)::
 Injector kinds and their parameters:
 
 ===================  =====================================================
-``corrupt_partial``  Plant ``value`` (nan|inf) into a per-stage kernel
-                     partial at guard site ``site=`` (host | merged |
-                     stageN | splitN), ``field=`` out|lse|both (default
-                     both), ``rank=`` (-1 = every rank), ``seed=``
-                     (position derivation).
+``corrupt_partial``  Plant ``value`` (nan | inf | finite:<scale>) into a
+                     per-stage kernel partial at guard site ``site=``
+                     (host | merged | stageN | splitN), ``field=``
+                     out|lse|both (default both), ``rank=`` (-1 = every
+                     rank), ``seed=`` (position derivation).
 ``corrupt_cast``     Plant ``value`` into one row of a group-cast recv
                      payload (``rank=``, ``seed=``).
 ``permute_cast``     Reverse the rows of a group-cast recv payload
@@ -49,6 +49,15 @@ Injector kinds and their parameters:
 Exception injectors fire at most ``times`` times per process (default 1;
 0 = unlimited) — :func:`reset_chaos` rearms them. Value injectors fire
 on every matching call (they are trace-time program edits, not events).
+
+The ``value=`` domain (``corrupt_partial`` / ``corrupt_cast`` /
+``corrupt_reduce``): ``nan`` and ``inf`` trip the nan/inf guards;
+``finite:<scale>`` (ISSUE 18; positive float scale, e.g.
+``finite:8.0``) plants the literal scale — a finite-but-wrong value
+that is *invisible* to ``MAGI_ATTENTION_GUARD=check`` by construction
+and exists to prove the shadow-sampled drift sentinel catches what the
+guards cannot. Non-positive or non-numeric scales are rejected at
+parse time, like every other grammar error.
 """
 
 from __future__ import annotations
@@ -97,7 +106,7 @@ class ChaosClause:
     kind: str
     site: str | None = None  # guard-site name for corrupt_partial
     field: str = "both"  # out | lse | both
-    value: str = "nan"  # nan | inf
+    value: str = "nan"  # nan | inf | finite:<scale>
     rank: int = -1  # -1 = every rank
     seed: int = 0  # deterministic position derivation
     hop: int = 1  # straggler hop shift
@@ -107,7 +116,15 @@ class ChaosClause:
 
     @property
     def fill(self) -> float:
-        return float("nan") if self.value == "nan" else float("inf")
+        if self.value == "nan":
+            return float("nan")
+        if self.value == "inf":
+            return float("inf")
+        # finite:<scale> — the planted value IS the scale (parse-time
+        # validated positive + finite), so the corruption stays
+        # invisible to the nan/inf guards and only the shadow sentinel
+        # / mass-deviation census can see it
+        return float(self.value.partition(":")[2])
 
 
 def parse_chaos_spec(spec: str) -> tuple[ChaosClause, ...]:
@@ -158,10 +175,29 @@ def parse_chaos_spec(spec: str) -> tuple[ChaosClause, ...]:
                 "matches no guard site and would be silently inert"
             )
         if clause.value not in _VALUES:
-            raise ValueError(
-                f"MAGI_ATTENTION_CHAOS: value={clause.value!r} must be "
-                f"one of {_VALUES}"
-            )
+            head, sep, scale = clause.value.partition(":")
+            if head != "finite" or not sep:
+                raise ValueError(
+                    f"MAGI_ATTENTION_CHAOS: value={clause.value!r} must "
+                    f"be one of {_VALUES} or finite:<scale>"
+                )
+            # matching the site= parse-rejection behavior: a bad scale
+            # fails HERE, not as a silently-inert (or nan-planting)
+            # injector at fire time
+            try:
+                scale_f = float(scale)
+            except ValueError:
+                raise ValueError(
+                    f"MAGI_ATTENTION_CHAOS: finite scale {scale!r} must "
+                    "be a number (e.g. value=finite:8.0)"
+                ) from None
+            if not (scale_f > 0) or scale_f == float("inf"):
+                raise ValueError(
+                    f"MAGI_ATTENTION_CHAOS: finite scale {scale!r} must "
+                    "be a positive finite number (a non-positive or "
+                    "non-finite plant would be inert or trip the nan/inf "
+                    "guards instead of the shadow sentinel)"
+                )
         if clause.field not in _FIELDS:
             raise ValueError(
                 f"MAGI_ATTENTION_CHAOS: field={clause.field!r} must be "

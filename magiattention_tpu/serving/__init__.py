@@ -46,6 +46,7 @@ from .distributed import (  # noqa: F401
 from .decode_attn import (  # noqa: F401
     decode_attn_paged,
     decode_partials_for_tables,
+    decode_reference,
     merge_split_partials,
     resolve_num_splits,
 )
@@ -119,6 +120,7 @@ __all__ = [
     "cp_merge_partials",
     "decode_attn_paged",
     "decode_partials_for_tables",
+    "decode_reference",
     "demux_tick",
     "gather_kv",
     "kv_head_sharding",

@@ -98,6 +98,39 @@ def _apply_split_resilience(outs, lses):
     return new_outs, new_lses, code
 
 
+def _split_census(outs, lses, merged_lse):
+    """ISSUE 18: packed value census over the (post-resilience) split
+    partials + the merge's softmax-mass deviation — ``None`` unless
+    ``MAGI_ATTENTION_NUMERICS=census`` (the off path traces zero extra
+    ops). Downstream of chaos by construction: an injected finite
+    corruption must be visible to the instruments built to catch it."""
+    from ..telemetry import numerics
+
+    if not numerics.census_active():
+        return None
+    vals: list = []
+    for o, l in zip(outs, lses):
+        vals.extend(numerics.site_summary(o, l))
+    vals.append(numerics.mass_deviation(lses, merged_lse))
+    return numerics.pack_census(vals)
+
+
+def _consume_split_census(census, num_splits: int) -> None:
+    """Land a decode split census at the jit boundary (no-op for the
+    ``None`` census of off mode)."""
+    if census is None:
+        return
+    from ..telemetry import numerics
+
+    numerics.consume_census(
+        census,
+        numerics.census_keys(
+            tuple(f"split{i}" for i in range(num_splits))
+        ),
+        layer="decode",
+    )
+
+
 def _split_partial_jnp(q, k, v, pos0, valid_len, scale, softcap):
     """One KV split's partial (out, lse) in plain jnp.
 
@@ -156,7 +189,8 @@ def _decode_jnp(q, cache: PagedKVCache, bt, seq_lens, params: DecodeParams):
         outs.append(o)
         lses.append(l)
     outs, lses, code = _apply_split_resilience(outs, lses)
-    return merge_split_partials(outs, lses) + (code,)
+    out, lse = merge_split_partials(outs, lses)
+    return out, lse, code, _split_census(outs, lses, lse)
 
 
 # ---------------------------------------------------------------------------
@@ -314,7 +348,8 @@ def _decode_pallas(q, cache: PagedKVCache, bt, seq_lens, params: DecodeParams):
     outs = [out_parts[:, i] for i in range(s)]
     lses = [lse_parts[:, i, :, 0] for i in range(s)]
     outs, lses, code = _apply_split_resilience(outs, lses)
-    return merge_split_partials(outs, lses) + (code,)
+    out, lse = merge_split_partials(outs, lses)
+    return out, lse, code, _split_census(outs, lses, lse)
 
 
 # ---------------------------------------------------------------------------
@@ -430,16 +465,58 @@ def decode_partials_for_tables(
     from .. import env
 
     if env.kernel_backend() in ("jnp", "jnp_online"):
-        out, lse, code = _decode_jnp(q, cache, bt, seq_lens, params)
+        out, lse, code, census = _decode_jnp(q, cache, bt, seq_lens, params)
     else:
-        out, lse, code = _decode_pallas(q, cache, bt, seq_lens, params)
+        out, lse, code, census = _decode_pallas(q, cache, bt, seq_lens, params)
     if code is not None:
         from ..resilience import guards
 
         guards.consume_error_code(
             code, tuple(f"split{i}" for i in range(params.num_splits))
         )
+    _consume_split_census(census, params.num_splits)
     return out.astype(jnp.float32), lse
+
+
+def decode_reference(
+    q: jax.Array,  # [b, hq, head_dim]
+    cache: PagedKVCache,
+    bt: jax.Array,  # [b, W] page-id rows
+    seq_lens: jax.Array,  # [b] true lengths within these tables
+    *,
+    scale: float | None = None,
+    softcap: float = 0.0,
+) -> tuple[jax.Array, jax.Array]:
+    """The drift sentinel's oracle (ISSUE 18): single-split f32 jnp
+    decode over explicit tables — same math as the production path but
+    deliberately OUTSIDE every resilience hook (no chaos injection, no
+    guards, no census). A planted ``corrupt_partial`` corruption must
+    hit only the production output, so the shadow comparison sees a
+    nonzero divergence instead of corruption on both sides cancelling.
+
+    Returns fp32 ``(out [b, hq, d], lse [b, hq])`` in the uncovered
+    convention.
+    """
+    b, hq, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    ps = cache.page_size
+    mpp = bt.shape[1]
+    k = cache.k_pages[bt].reshape(
+        b, mpp * ps, cache.num_kv_heads, cache.head_dim
+    )
+    v = cache.v_pages[bt].reshape(
+        b, mpp * ps, cache.num_kv_heads, cache.head_dim
+    )
+    return _split_partial_jnp(
+        q,
+        k,
+        v,
+        0,
+        jnp.asarray(seq_lens, jnp.int32),
+        float(scale),
+        float(softcap),
+    )
 
 
 def decode_attn_paged(
@@ -494,9 +571,13 @@ def decode_attn_paged(
 
     with named_scope("magi_decode_attn"):
         if env.kernel_backend() in ("jnp", "jnp_online"):
-            out, lse, code = _decode_jnp(q, cache, bt, seq_lens, params)
+            out, lse, code, census = _decode_jnp(
+                q, cache, bt, seq_lens, params
+            )
         else:
-            out, lse, code = _decode_pallas(q, cache, bt, seq_lens, params)
+            out, lse, code, census = _decode_pallas(
+                q, cache, bt, seq_lens, params
+            )
     if code is not None:
         # jit boundary of the split guards: eager callers (the serving
         # engine's host loop) get a concrete code here — check mode
@@ -506,4 +587,5 @@ def decode_attn_paged(
         guards.consume_error_code(
             code, tuple(f"split{i}" for i in range(params.num_splits))
         )
+    _consume_split_census(census, params.num_splits)
     return out.astype(out_dtype), lse

@@ -33,10 +33,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry
-from ..telemetry import exposition, trace
+from ..telemetry import exposition, numerics, trace
 from ..common.enum import AttnMaskType
 from ..utils.instrument import named_scope
-from .decode_attn import decode_attn_paged, resolve_num_splits
+from .decode_attn import (
+    decode_attn_paged,
+    decode_reference,
+    resolve_num_splits,
+)
 from .kv_cache import (
     PagedKVCache,
     PageAllocator,
@@ -332,6 +336,15 @@ class ServingEngine:
         # instead and opts its member engines out here
         if register_flight_memory:
             self._flight.register_memory_source("engine", self)
+        # numerics forensics (ISSUE 18): (re-)attach the process-global
+        # value census to the CURRENT recorder so dumps carry a
+        # `numerics` section even after a reset_flight_recorder(), and
+        # count decode batches for the shadow-sampled drift sentinel
+        # (every Nth batch re-computed through the f32 reference and
+        # scored against production output — MAGI_ATTENTION_SHADOW_
+        # SAMPLE_RATE, 0 = off)
+        numerics.ensure_flight_registration()
+        self._shadow_counter = 0
         self._pool_exhausted_armed = False
         # live exposition (ISSUE 11): one scrape thread per process when
         # MAGI_ATTENTION_METRICS_PORT is set; no-op (None) by default
@@ -886,7 +899,82 @@ class ServingEngine:
             ),
             cascade_groups=len(groups),
         )
+        self._maybe_shadow_check(q, slot_list, out, lse, kw)
         return out, lse
+
+    def _maybe_shadow_check(self, q, slot_list, out, lse, kw) -> None:
+        """Shadow-sampled drift sentinel (ISSUE 18): every Nth decode
+        batch (``MAGI_ATTENTION_SHADOW_SAMPLE_RATE``; 0 = off) is
+        re-computed through :func:`decode_reference` — the f32
+        single-split jnp oracle that lives OUTSIDE every resilience
+        hook — and scored against the production output with the
+        error-budget oracle. Every check lands in the
+        ``magi_numerics_shadow_*`` series and the census ring; a budget
+        breach arms a DEFERRED ``numeric_drift`` flight dump tagged
+        with the live trace id (the scheduler's tick-end flush writes
+        it, so the dump carries the faulting tick too). Host-side only:
+        the shadow never changes a plan, a key, or the production
+        output."""
+        from .. import env
+
+        rate = env.shadow_sample_rate()
+        if rate <= 0:
+            return
+        self._shadow_counter += 1
+        if self._shadow_counter % rate:
+            return
+        if isinstance(out, jax.core.Tracer):
+            # decode_step traced into a larger program: the sentinel
+            # needs concrete outputs, so this sample is skipped (the
+            # scheduler's host loop — the production caller — is eager)
+            return
+        slots = np.asarray(slot_list)
+        bt = self.cache.block_tables[slots]
+        seq_lens = self.cache.seq_lens[slots]
+        ref_out, ref_lse = decode_reference(
+            q,
+            self.cache,
+            bt,
+            seq_lens,
+            scale=kw.get("scale"),
+            softcap=kw.get("softcap", 0.0),
+        )
+        report = numerics.divergence_report(
+            ref_out, out, ref_lse=ref_lse, test_lse=lse
+        )
+        try:
+            budget = numerics.budget_for_dtype(report.dtype)
+        except ValueError:
+            # exotic out dtype without a calibrated row: score against
+            # the f32 budget rather than silently skipping the check
+            budget = numerics.budget_for_dtype("float32")
+        violations = budget.violations(report)
+        breached = bool(violations)
+        telemetry.record_shadow_check(
+            report.out_max_ulp, breached=breached
+        )
+        ctx = trace.current_trace()
+        record = {
+            "batch": len(slot_list),
+            "trace_id": ctx[0] if ctx else None,
+            "rid": ctx[1] if ctx else None,
+            "breached": breached,
+            "violations": list(violations),
+            "report": report.to_json(),
+        }
+        numerics.get_numerics_census().note_shadow(
+            record, breached=breached
+        )
+        if breached:
+            self._flight.trigger(
+                "numeric_drift",
+                immediate=False,
+                trace_id=ctx[0] if ctx else None,
+                rid=ctx[1] if ctx else None,
+                violations=list(violations),
+                max_ulp=report.out_max_ulp,
+                dominant=report.dominant,
+            )
 
     def unified_tick(
         self,

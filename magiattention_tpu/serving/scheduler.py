@@ -462,7 +462,12 @@ class Scheduler:
         slots = [st.slot for st in states]
         t0 = time.perf_counter()
         try:
-            out, _lse = self.engine.decode_step(qs, ks, vs, slots)
+            # the batch lead's identity tags engine-internal emissions
+            # for this step (ISSUE 18: the shadow sentinel's deferred
+            # numeric_drift dump carries a LIVE trace id this way, like
+            # admission backpressure dumps carry the admitting request)
+            with reqtrace.request_context(states[0].trace_id, states[0].rid):
+                out, _lse = self.engine.decode_step(qs, ks, vs, slots)
         except PageAllocatorError:
             # transient pool pressure mid-growth (reservation extension
             # or a CoW split found the pool empty). Resource pressure

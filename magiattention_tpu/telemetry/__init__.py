@@ -42,6 +42,7 @@ from .collectors import (  # noqa: F401
     REQUIRED_COMPILE_METRICS,
     REQUIRED_DISTSERVE_METRICS,
     REQUIRED_MEMORY_METRICS,
+    REQUIRED_NUMERICS_METRICS,
     REQUIRED_PLAN_CACHE_METRICS,
     REQUIRED_PLAN_METRICS,
     REQUIRED_PREFIX_METRICS,
@@ -78,6 +79,7 @@ from .collectors import (  # noqa: F401
     record_memory_ledger,
     record_memory_measurement,
     record_memory_pool,
+    record_numerics_census,
     record_overlap_choice,
     record_page_stream,
     record_plan,
@@ -93,6 +95,7 @@ from .collectors import (  # noqa: F401
     record_request_ttft,
     record_runtime_costs,
     record_sched_step,
+    record_shadow_check,
     record_stream_queue_depth,
     record_tick_programs,
     record_tier_fault,
@@ -166,6 +169,20 @@ from .roofline import (  # noqa: F401
     profile_roofline,
     resolve_peak_tflops,
 )
+from .numerics import (  # noqa: F401
+    DEFAULT_BUDGETS,
+    DivergenceReport,
+    ErrorBudget,
+    ErrorBudgetExceeded,
+    NumericsCensus,
+    assert_within_budget,
+    budget_for_dtype,
+    divergence_report,
+    get_numerics_census,
+    nudge_ulps,
+    reset_numerics_census,
+    ulp_distance,
+)
 from .timeline import (  # noqa: F401
     HopTiming,
     MeasuredTimeline,
@@ -238,12 +255,18 @@ __all__ = [
     "MemPressureWatcher",
     "MemoryComparison",
     "MemoryLedger",
+    "DEFAULT_BUDGETS",
+    "DivergenceReport",
+    "ErrorBudget",
+    "ErrorBudgetExceeded",
     "MetricsRegistry",
     "MetricsServer",
+    "NumericsCensus",
     "PoolFragmentationMap",
     "REQUIRED_ANALYSIS_METRICS",
     "REQUIRED_COMPILE_METRICS",
     "REQUIRED_MEMORY_METRICS",
+    "REQUIRED_NUMERICS_METRICS",
     "REQUIRED_PLAN_METRICS",
     "REQUIRED_RESILIENCE_METRICS",
     "REQUIRED_ROOFLINE_METRICS",
@@ -257,6 +280,9 @@ __all__ = [
     "add_solver_seconds",
     "aggregate_across_mesh",
     "analyze_workload",
+    "assert_within_budget",
+    "budget_for_dtype",
+    "divergence_report",
     "block_occupancy_map",
     "configure_logging",
     "current_program",
@@ -274,7 +300,9 @@ __all__ = [
     "get_event_buffer",
     "get_flight_recorder",
     "get_logger",
+    "get_numerics_census",
     "get_registry",
+    "nudge_ulps",
     "ledger_vs_measured",
     "measure_program_memory",
     "merge_chrome_traces",
@@ -311,6 +339,7 @@ __all__ = [
     "record_memory_ledger",
     "record_memory_measurement",
     "record_memory_pool",
+    "record_numerics_census",
     "record_overlap_choice",
     "record_kvcache_state",
     "record_plan",
@@ -323,11 +352,14 @@ __all__ = [
     "render_prometheus",
     "request_context",
     "request_traces_to_chrome",
+    "record_shadow_check",
     "reset_compile_tracker",
     "reset_flight_recorder",
+    "reset_numerics_census",
     "reset_request_traces",
     "resolve_peak_tflops",
     "record_tuning_cache_io_error",
+    "ulp_distance",
     "record_validate",
     "reset",
     "sample_memory_stats",

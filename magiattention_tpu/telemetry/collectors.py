@@ -521,6 +521,93 @@ REQUIRED_COMPILE_METRICS: tuple[str, ...] = (
 )
 
 
+# numerics observability (telemetry/numerics.py; ISSUE 18). Census
+# gauges carry the last consumed in-graph value summary per guard site
+# ({layer=parallel|decode, site=, stat=logit_max|lse_min|lse_max|
+# out_max_abs}); the two histograms track the distribution of the
+# magnitude stats that actually drift (out max-abs per census, and the
+# softmax-mass deviation of the final merge — accumulated merge
+# rounding). Shadow-sentinel series: checks counts every Nth-batch f32
+# re-computation (MAGI_ATTENTION_SHADOW_SAMPLE_RATE), divergence is the
+# max-ulp score of each check, breaches counts budget violations (0
+# increments still materialize the series, record_analysis_run-style)
+M_NUMERICS_CENSUS = "magi_numerics_census"  # {layer=, site=, stat=}
+H_NUMERICS_OUT_MAX_ABS = "magi_numerics_out_max_abs"  # {layer=}
+H_NUMERICS_MASS_DEV = "magi_numerics_mass_dev"  # {layer=}
+M_NUMERICS_SHADOW_CHECKS = "magi_numerics_shadow_checks"
+H_NUMERICS_SHADOW_DIVERGENCE = "magi_numerics_shadow_divergence"
+M_NUMERICS_SHADOW_BREACHES = "magi_numerics_shadow_breaches"
+
+# out max-abs in powers of two (attention outputs are O(1) convex
+# combinations; a finite-corruption plant shows up in the top buckets)
+_OUT_MAX_ABS_BOUNDS = (0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 64.0)
+# mass deviation is ~ulp-scale rounding when healthy, O(1) when a
+# partial is corrupt: log-spaced decades
+_MASS_DEV_BOUNDS = (1e-7, 1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0)
+# shadow divergence is scored in ulps of the production dtype: healthy
+# split-merge drift sits in the low buckets, corruption at the top
+_SHADOW_ULP_BOUNDS = (
+    1.0, 8.0, 64.0, 512.0, 4096.0, 32768.0, 2.0**20, 2.0**30,
+)
+
+# populated by one census-mode decode + one shadow-sentinel check;
+# asserted by make numerics-check (exps/run_numerics_check.py), swept
+# by trace-check's exposition pass, documented in docs/observability.md
+# "Numerics"
+REQUIRED_NUMERICS_METRICS: tuple[str, ...] = (
+    M_NUMERICS_CENSUS,
+    H_NUMERICS_OUT_MAX_ABS,
+    H_NUMERICS_MASS_DEV,
+    M_NUMERICS_SHADOW_CHECKS,
+    H_NUMERICS_SHADOW_DIVERGENCE,
+    M_NUMERICS_SHADOW_BREACHES,
+)
+
+
+def record_numerics_census(
+    layer: str, site: str, stats: dict
+) -> None:
+    """One consumed in-graph value census for one guard site: gauges
+    for every stat, plus the out-max-abs / mass-deviation histograms
+    (``site='final'`` carries only ``mass_dev``)."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    for stat, val in stats.items():
+        v = float(val)
+        reg.gauge_set(
+            M_NUMERICS_CENSUS, v, layer=layer, site=site, stat=stat
+        )
+        if stat == "out_max_abs":
+            reg.histogram_observe(
+                H_NUMERICS_OUT_MAX_ABS, v,
+                bounds=_OUT_MAX_ABS_BOUNDS, layer=layer,
+            )
+        elif stat == "mass_dev":
+            reg.histogram_observe(
+                H_NUMERICS_MASS_DEV, v,
+                bounds=_MASS_DEV_BOUNDS, layer=layer,
+            )
+
+
+def record_shadow_check(
+    divergence_ulp: float, *, breached: bool
+) -> None:
+    """One drift-sentinel shadow re-computation: the max-ulp score of
+    production vs f32 reference, and whether it breached the error
+    budget (0 increments still materialize the breach series)."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_NUMERICS_SHADOW_CHECKS)
+    reg.histogram_observe(
+        H_NUMERICS_SHADOW_DIVERGENCE,
+        max(float(divergence_ulp), 0.0),
+        bounds=_SHADOW_ULP_BOUNDS,
+    )
+    reg.counter_inc(M_NUMERICS_SHADOW_BREACHES, 1 if breached else 0)
+
+
 def record_analysis_run(
     states_explored: int, counterexamples: int
 ) -> None:

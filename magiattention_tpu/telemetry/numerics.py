@@ -1,0 +1,575 @@
+"""Numerics observability: error-budget oracles + in-graph value census
+(ISSUE 18).
+
+The sixth observability pillar — *values*. The resilience guards
+(``resilience/guards.py``) trip only on nan/±inf, so a finite-but-wrong
+partial (a bad rescale, a miscompiled tile, a corrupted cast payload
+that stays finite) sails through serving silently. This module builds
+the measurement layer that makes finite corruption visible, and the
+accuracy instruments ROADMAP item 5 (fp8/int8 paged KV) is explicitly
+gated on:
+
+- **Error-budget oracle.** :func:`divergence_report` scores a test
+  array against a reference per position — abs / rel / *ulp* error
+  (bit-pattern distance in the test dtype's own grid, the instrument
+  the AMLA exponent-field tricks demand — arxiv 2509.25224) — with
+  out-vs-lse attribution when both components are supplied.
+  :class:`ErrorBudget` is the composable policy object (per-dtype
+  defaults: bf16/f32 today, fp8 rows ready for the low-precision PR;
+  ``&`` = strictest of two budgets, ``|`` = loosest), and
+  :func:`assert_within_budget` is the reusable gate primitive.
+- **In-graph value census.** Behind ``MAGI_ATTENTION_NUMERICS=census``
+  (env-validated, part of ``flags_fingerprint``), the guard sites in
+  ``parallel/dist_attn.py`` and ``serving/decode_attn.py`` emit cheap
+  traced summaries per site — max logit, lse min/max, out max-abs —
+  plus the softmax-mass deviation of the final merge (the partial
+  masses ``sum_i exp(lse_i - lse_merged)`` must reconstruct 1 up to
+  rounding; drift there IS accumulated merge error). The summaries are
+  plain reductions over already-materialized partials: no collectives,
+  and deliberately no ``jnp.isfinite`` (the ``is_finite`` primitive is
+  the *guards'* census marker — the trace audit must keep counting
+  zero of them with guards off). :func:`consume_census` lands them at
+  the jit boundary in the ``magi_numerics_*`` gauges/histograms and
+  the host-side :class:`NumericsCensus`, which every flight dump
+  embeds as a ``numerics`` section (the FlightRecorder source pattern
+  from ISSUE 14).
+- **Shadow scoring.** The serving engine's drift sentinel
+  (``MAGI_ATTENTION_SHADOW_SAMPLE_RATE``) re-computes every Nth decode
+  batch through the f32 jnp reference and scores it here; breaches
+  land in :class:`NumericsCensus` and a ``numeric_drift`` flight dump.
+
+Everything below the census emitters is host-side numpy; the emitters
+themselves are pure jnp and safe inside shard_map/jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+
+import numpy as np
+
+NEG_INF = float("-inf")
+
+# relative error denominator floor: |test - ref| / max(|ref|, floor) —
+# keeps near-zero reference positions from reporting infinite rel error
+# (attention outputs are O(1) convex combinations; 1e-6 is far below
+# any dtype's resolution of interest here)
+REL_FLOOR = 1e-6
+
+
+# ---------------------------------------------------------------------------
+# ulp machinery
+# ---------------------------------------------------------------------------
+
+
+def _int_type(dtype: np.dtype) -> np.dtype:
+    return np.dtype(f"int{np.dtype(dtype).itemsize * 8}")
+
+
+def _ordered_ints(x: np.ndarray) -> np.ndarray:
+    """Map float bit patterns to integers ordered like the floats
+    (±0 coincide at 0): ulp distance is then plain integer distance."""
+    itype = _int_type(x.dtype)
+    i = x.view(itype).astype(np.int64)
+    return np.where(i >= 0, i, np.iinfo(itype).min - i)
+
+
+def ulp_distance(ref, test) -> np.ndarray:
+    """Per-position ulp distance between ``ref`` and ``test``, measured
+    in ``test``'s dtype grid (``ref`` is quantized onto it first — the
+    honest comparison for a low-precision path scored against an f32
+    oracle). Agreeing nans count 0; any other non-finite disagreement
+    shows up as the (huge) bit-pattern distance it is."""
+    t = np.asarray(test)
+    r = np.asarray(ref).astype(t.dtype)
+    d = np.abs(_ordered_ints(t) - _ordered_ints(r))
+    both_nan = np.isnan(t.astype(np.float64)) & np.isnan(
+        r.astype(np.float64)
+    )
+    return np.where(both_nan, 0, d)
+
+
+def nudge_ulps(x, n: int):
+    """``x`` advanced by ``n`` ulps (bit-pattern walk in ``x``'s own
+    dtype; negative ``n`` walks down). Test/self-test utility — how the
+    numerics-check plants an exactly-k-ulp divergence."""
+    a = np.asarray(x)
+    itype = _int_type(a.dtype)
+    ordered = _ordered_ints(a) + int(n)
+    back = np.where(
+        ordered >= 0, ordered, np.iinfo(itype).min - ordered
+    ).astype(itype)
+    return back.view(a.dtype)
+
+
+# ---------------------------------------------------------------------------
+# error budgets (composable policy objects)
+# ---------------------------------------------------------------------------
+
+
+class ErrorBudgetExceeded(ValueError):
+    """The oracle's gate tripped: a divergence report breached its
+    budget. ``violations`` names the breached stats (``out.max_ulp``,
+    ``lse.max_abs``, ...) — the out-vs-lse attribution."""
+
+    def __init__(self, violations, report, budget, where: str = ""):
+        self.violations = tuple(violations)
+        self.report = report
+        self.budget = budget
+        loc = f" at {where}" if where else ""
+        super().__init__(
+            f"error budget exceeded{loc}: {list(self.violations)} "
+            f"(dtype {report.dtype}: out max_abs {report.out_max_abs:.3e}"
+            f"/{budget.max_abs:.3e}, max_rel {report.out_max_rel:.3e}"
+            f"/{budget.max_rel:.3e}, max_ulp {report.out_max_ulp:.0f}"
+            f"/{budget.max_ulp:.0f}; dominant component: "
+            f"{report.dominant})"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ErrorBudget:
+    """Per-dtype divergence policy. ``max_*`` bound the out component;
+    ``lse_max_*`` bound the (always-f32) lse component. Compose with
+    ``&`` (strictest of each field — both must pass) or ``|`` (loosest
+    — either regime acceptable), or ``dataclasses.replace`` for a
+    one-field override."""
+
+    dtype: str
+    max_abs: float
+    max_rel: float
+    max_ulp: float
+    lse_max_abs: float
+    lse_max_ulp: float
+
+    _FIELDS = ("max_abs", "max_rel", "max_ulp", "lse_max_abs",
+               "lse_max_ulp")
+
+    def __and__(self, other: "ErrorBudget") -> "ErrorBudget":
+        return ErrorBudget(
+            dtype=f"{self.dtype}&{other.dtype}",
+            **{f: min(getattr(self, f), getattr(other, f))
+               for f in self._FIELDS},
+        )
+
+    def __or__(self, other: "ErrorBudget") -> "ErrorBudget":
+        return ErrorBudget(
+            dtype=f"{self.dtype}|{other.dtype}",
+            **{f: max(getattr(self, f), getattr(other, f))
+               for f in self._FIELDS},
+        )
+
+    def violations(self, report: "DivergenceReport") -> list[str]:
+        """Breached stat names, ``component.stat`` form — empty means
+        within budget. The component prefixes ARE the out-vs-lse
+        attribution a breach message carries."""
+        out = []
+        if report.out_max_abs > self.max_abs:
+            out.append("out.max_abs")
+        if report.out_max_rel > self.max_rel:
+            out.append("out.max_rel")
+        if report.out_max_ulp > self.max_ulp:
+            out.append("out.max_ulp")
+        if report.lse_max_abs is not None:
+            if report.lse_max_abs > self.lse_max_abs:
+                out.append("lse.max_abs")
+            if report.lse_max_ulp > self.lse_max_ulp:
+                out.append("lse.max_ulp")
+        return out
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# Per-dtype defaults. f32/bf16 are calibrated against the split-merge
+# vs single-split reference drift of the serving decode path (summation
+# order only — well under these bounds); the fp8 rows are the accuracy
+# contract ROADMAP item 5 (quantized paged KV) will gate against —
+# 2-3 mantissa bits make per-ulp bounds the only meaningful ones.
+DEFAULT_BUDGETS: dict[str, ErrorBudget] = {
+    "float32": ErrorBudget(
+        "float32", max_abs=1e-4, max_rel=1e-3, max_ulp=4096,
+        lse_max_abs=1e-4, lse_max_ulp=4096,
+    ),
+    "bfloat16": ErrorBudget(
+        "bfloat16", max_abs=0.05, max_rel=0.05, max_ulp=8,
+        lse_max_abs=1e-3, lse_max_ulp=8192,
+    ),
+    "float16": ErrorBudget(
+        "float16", max_abs=0.01, max_rel=0.01, max_ulp=32,
+        lse_max_abs=1e-3, lse_max_ulp=8192,
+    ),
+    "float8_e4m3fn": ErrorBudget(
+        "float8_e4m3fn", max_abs=0.25, max_rel=0.25, max_ulp=2,
+        lse_max_abs=1e-2, lse_max_ulp=16384,
+    ),
+    "float8_e5m2": ErrorBudget(
+        "float8_e5m2", max_abs=0.5, max_rel=0.5, max_ulp=2,
+        lse_max_abs=1e-2, lse_max_ulp=16384,
+    ),
+}
+
+
+def budget_for_dtype(dtype) -> ErrorBudget:
+    """The default :class:`ErrorBudget` for a dtype (name or dtype
+    object); raises ``ValueError`` for dtypes without a calibrated
+    row."""
+    name = str(np.dtype(dtype)) if not isinstance(dtype, str) else dtype
+    try:
+        return DEFAULT_BUDGETS[name]
+    except KeyError:
+        raise ValueError(
+            f"no default error budget for dtype {name!r} "
+            f"(known: {sorted(DEFAULT_BUDGETS)}); pass an explicit "
+            "ErrorBudget"
+        ) from None
+
+
+# ---------------------------------------------------------------------------
+# divergence oracle
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DivergenceReport:
+    """Per-position divergence stats of a (test vs reference) pair.
+
+    Out stats are measured in the *test* dtype's grid (``dtype``); lse
+    stats, when lse pairs were supplied, in the lse dtype's (f32
+    throughout this runtime). ``worst`` is the flat index of the
+    maximum-ulp out position; ``dominant`` attributes the divergence to
+    the component with the larger ulp error."""
+
+    dtype: str
+    shape: tuple
+    out_max_abs: float
+    out_mean_abs: float
+    out_max_rel: float
+    out_max_ulp: float
+    out_mean_ulp: float
+    worst: int
+    lse_max_abs: float | None
+    lse_max_ulp: float | None
+    dominant: str  # "out" | "lse"
+
+    def within(self, budget: ErrorBudget) -> bool:
+        return not budget.violations(self)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def divergence_report(
+    ref,
+    test,
+    *,
+    ref_lse=None,
+    test_lse=None,
+) -> DivergenceReport:
+    """Score ``test`` against reference ``ref`` (host-side; call with
+    concrete arrays at a jit boundary). ``ref``/``test`` are the out
+    component; pass the lse pair too for out-vs-lse attribution —
+    essential when debugging an LSE-corrected merge, where a wrong lse
+    poisons out multiplicatively."""
+    t = np.asarray(test)
+    r64 = np.asarray(ref).astype(np.float64)
+    t64 = t.astype(np.float64)
+    if r64.shape != t64.shape:
+        raise ValueError(
+            f"divergence_report: shape mismatch ref {r64.shape} vs "
+            f"test {t64.shape}"
+        )
+    abs_err = np.abs(t64 - r64)
+    rel_err = abs_err / np.maximum(np.abs(r64), REL_FLOOR)
+    ulp = ulp_distance(ref, test).astype(np.float64)
+    # nan abs/rel (non-finite values) must not hide behind np.max's nan
+    # propagation semantics: score them as infinite error
+    abs_err = np.where(np.isnan(abs_err), np.inf, abs_err)
+    rel_err = np.where(np.isnan(rel_err), np.inf, rel_err)
+    worst = int(np.argmax(ulp)) if ulp.size else 0
+    lse_max_abs = lse_max_ulp = None
+    if test_lse is not None:
+        if ref_lse is None:
+            raise ValueError(
+                "divergence_report: test_lse supplied without ref_lse"
+            )
+        rl = np.asarray(ref_lse).astype(np.float64)
+        tl = np.asarray(test_lse).astype(np.float64)
+        # lse = -inf is the legitimate zero-coverage value: agreeing
+        # -inf rows are exact (the -inf - -inf nan is masked away),
+        # disagreeing ones are infinite error
+        with np.errstate(invalid="ignore"):
+            lse_abs = np.abs(tl - rl)
+        lse_abs = np.where(
+            np.isneginf(rl) & np.isneginf(tl), 0.0, lse_abs
+        )
+        lse_abs = np.where(np.isnan(lse_abs), np.inf, lse_abs)
+        lse_max_abs = float(np.max(lse_abs)) if lse_abs.size else 0.0
+        lse_ulp = ulp_distance(ref_lse, test_lse).astype(np.float64)
+        lse_max_ulp = float(np.max(lse_ulp)) if lse_ulp.size else 0.0
+    out_max_ulp = float(np.max(ulp)) if ulp.size else 0.0
+    dominant = "out"
+    if lse_max_ulp is not None and lse_max_ulp > out_max_ulp:
+        dominant = "lse"
+    return DivergenceReport(
+        dtype=str(t.dtype),
+        shape=tuple(int(s) for s in t.shape),
+        out_max_abs=float(np.max(abs_err)) if abs_err.size else 0.0,
+        out_mean_abs=float(np.mean(abs_err)) if abs_err.size else 0.0,
+        out_max_rel=float(np.max(rel_err)) if rel_err.size else 0.0,
+        out_max_ulp=out_max_ulp,
+        out_mean_ulp=float(np.mean(ulp)) if ulp.size else 0.0,
+        worst=worst,
+        lse_max_abs=lse_max_abs,
+        lse_max_ulp=lse_max_ulp,
+        dominant=dominant,
+    )
+
+
+def assert_within_budget(
+    report: DivergenceReport,
+    budget: ErrorBudget | None = None,
+    *,
+    where: str = "",
+) -> DivergenceReport:
+    """The reusable gate primitive: raise :class:`ErrorBudgetExceeded`
+    naming the breached stats (out-vs-lse attributed) when ``report``
+    exceeds ``budget`` (default: the report dtype's
+    :func:`budget_for_dtype` row). Returns the report for chaining."""
+    if budget is None:
+        budget = budget_for_dtype(report.dtype)
+    bad = budget.violations(report)
+    if bad:
+        raise ErrorBudgetExceeded(bad, report, budget, where=where)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# in-graph value census (traced emitters)
+# ---------------------------------------------------------------------------
+
+# per-site summary stats, in packed order; "final/mass_dev" is appended
+# once per program (the merge-reconstruction deviation)
+CENSUS_STATS = ("logit_max", "lse_min", "lse_max", "out_max_abs")
+MASS_DEV_KEY = "final/mass_dev"
+
+
+def census_active() -> bool:
+    """Trace-time gate: with ``MAGI_ATTENTION_NUMERICS=off`` (default)
+    every emitter below is skipped entirely — zero extra traced ops,
+    bit-identical outputs (the numerics-check transparency proof)."""
+    from .. import env
+
+    return env.numerics_mode() == "census"
+
+
+def site_summary(out, lse, logits_max=None) -> list:
+    """Cheap traced summaries of one (out, lse) partial, in
+    ``CENSUS_STATS`` order (f32 scalars). ``logits_max`` supplies the
+    kernel's true per-head max logit when the caller has it (dist_attn
+    rowmax lanes); otherwise max lse stands in — a tight upper proxy
+    (``max_logit <= lse <= max_logit + log n``). Uses eq-based
+    ``isneginf`` masking only: ``is_finite`` stays the guards' private
+    census marker."""
+    import jax.numpy as jnp
+
+    lse32 = lse.astype(jnp.float32)
+    if logits_max is not None:
+        logit_max = jnp.max(logits_max.astype(jnp.float32))
+    else:
+        logit_max = jnp.max(lse32)
+    lse_min = jnp.min(jnp.where(jnp.isneginf(lse32), jnp.inf, lse32))
+    return [
+        logit_max,
+        lse_min,
+        jnp.max(lse32),
+        jnp.max(jnp.abs(out.astype(jnp.float32))),
+    ]
+
+
+def mass_deviation(partial_lses, merged_lse):
+    """Softmax-mass deviation of an LSE-corrected merge: the partial
+    masses ``sum_i exp(lse_i - lse_merged)`` reconstruct exactly 1 in
+    exact arithmetic — the traced max deviation over positions measures
+    accumulated merge rounding (and explodes on a finite-corrupted
+    partial). Zero-coverage merged rows (lse = -inf) contribute 0."""
+    import jax.numpy as jnp
+
+    merged = merged_lse.astype(jnp.float32)
+    uncovered = jnp.isneginf(merged)
+    safe = jnp.where(uncovered, 0.0, merged)
+    mass = None
+    for l_i in partial_lses:
+        l32 = l_i.astype(jnp.float32)
+        term = jnp.where(jnp.isneginf(l32), 0.0, jnp.exp(l32 - safe))
+        mass = term if mass is None else mass + term
+    dev = jnp.where(uncovered, 0.0, jnp.abs(mass - 1.0))
+    return jnp.max(dev)
+
+
+def census_keys(sites) -> tuple[str, ...]:
+    """The packed-census key order for a program's guard-site names —
+    shared by the emitter and :func:`consume_census` (they must agree;
+    the consumer reshapes on ``len(keys)``)."""
+    keys = [f"{s}/{stat}" for s in sites for stat in CENSUS_STATS]
+    keys.append(MASS_DEV_KEY)
+    return tuple(keys)
+
+
+def pack_census(values) -> "object":
+    """Stack the emitted scalars into one f32 vector — the single extra
+    output a census-mode program threads to its jit boundary."""
+    import jax.numpy as jnp
+
+    return jnp.stack([jnp.asarray(v, jnp.float32) for v in values])
+
+
+def consume_census(values, keys, *, layer: str) -> None:
+    """The census jit boundary: land a packed census vector (``[S]``,
+    or ``[R, S]`` per-rank from shard_map) in the ``magi_numerics_*``
+    metrics and the host :class:`NumericsCensus`. Concrete values
+    record immediately; under an outer jit the same decode runs as a
+    ``jax.debug.callback`` at execution time (best-effort, like the
+    guards' error-code report)."""
+    if values is None:
+        return
+    import jax
+
+    if isinstance(values, jax.core.Tracer):
+        try:
+            jax.debug.callback(
+                functools.partial(
+                    _consume_census_host, keys=tuple(keys), layer=layer
+                ),
+                values,
+            )
+        except Exception:  # noqa: BLE001 — observability must never
+            # take the traced program down (callbacks unsupported in
+            # some tracing contexts); the census is lost, the data
+            # path is untouched
+            from .logger import get_logger
+
+            get_logger("telemetry").debug(
+                "numerics census could not attach to this tracing "
+                "context"
+            )
+        return
+    _consume_census_host(values, keys=tuple(keys), layer=layer)
+
+
+def _consume_census_host(values, *, keys, layer: str) -> None:
+    arr = np.asarray(values, np.float64).reshape(-1, len(keys))
+    site_stats: dict[str, dict[str, float]] = {}
+    for j, key in enumerate(keys):
+        site, _, stat = key.rpartition("/")
+        col = arr[:, j]
+        # cross-rank reduction mirrors the per-site semantics: minima
+        # stay minima, everything else takes the worst (max) rank
+        val = float(np.min(col) if stat == "lse_min" else np.max(col))
+        site_stats.setdefault(site, {})[stat] = val
+    from . import collectors
+
+    for site, stats in site_stats.items():
+        collectors.record_numerics_census(layer, site, stats)
+    get_numerics_census().note_sites(layer, site_stats)
+
+
+# ---------------------------------------------------------------------------
+# host-side census state (the flight dump's `numerics` section)
+# ---------------------------------------------------------------------------
+
+
+class NumericsCensus:
+    """Last-consumed census per (layer, site) + a bounded ring of
+    shadow-sentinel scores — the host state every flight dump embeds as
+    its ``numerics`` section (registered with the FlightRecorder via
+    the ISSUE 14 weakly-held source pattern). Independent of the
+    telemetry enable flag, like the flight recorder itself."""
+
+    SHADOW_RING = 8
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sites: dict[str, dict[str, dict[str, float]]] = {}
+        self._shadow: list[dict] = []
+        self._shadow_checks = 0
+        self._shadow_breaches = 0
+
+    def note_sites(
+        self, layer: str, site_stats: dict[str, dict[str, float]]
+    ) -> None:
+        with self._lock:
+            self._sites.setdefault(layer, {}).update(
+                {s: dict(v) for s, v in site_stats.items()}
+            )
+
+    def note_shadow(self, record: dict, *, breached: bool) -> None:
+        with self._lock:
+            self._shadow_checks += 1
+            if breached:
+                self._shadow_breaches += 1
+            self._shadow.append(dict(record))
+            if len(self._shadow) > self.SHADOW_RING:
+                del self._shadow[: len(self._shadow) - self.SHADOW_RING]
+
+    def numerics_snapshot(self) -> dict:
+        """JSON-safe snapshot (the FlightRecorder source contract)."""
+        with self._lock:
+            return {
+                "census": {
+                    layer: {s: dict(v) for s, v in sites.items()}
+                    for layer, sites in self._sites.items()
+                },
+                "shadow": [dict(r) for r in self._shadow],
+                "shadow_checks": self._shadow_checks,
+                "shadow_breaches": self._shadow_breaches,
+            }
+
+
+_census: NumericsCensus | None = None
+_census_lock = threading.Lock()
+# identity of the FlightRecorder the census last registered with: a
+# reset_flight_recorder() swaps the global recorder, so registration
+# re-arms lazily on the next note (and eagerly at engine construction)
+_registered_with = None
+
+
+def get_numerics_census() -> NumericsCensus:
+    """The process-global census (created on first use; registered as a
+    flight-recorder ``numerics`` source so dumps carry it)."""
+    global _census
+    if _census is None:
+        with _census_lock:
+            if _census is None:
+                _census = NumericsCensus()
+    ensure_flight_registration()
+    return _census
+
+
+def ensure_flight_registration() -> None:
+    """(Re-)attach the census to the CURRENT flight recorder — called
+    lazily by :func:`get_numerics_census` and eagerly by the serving
+    engine, so a ``reset_flight_recorder()`` never silently drops the
+    ``numerics`` section from subsequent dumps."""
+    global _registered_with
+    if _census is None:
+        return
+    from .trace import get_flight_recorder
+
+    fr = get_flight_recorder()
+    with _census_lock:
+        if _registered_with is fr:
+            return
+        _registered_with = fr
+    fr.register_numerics_source("census", _census)
+
+
+def reset_numerics_census() -> NumericsCensus:
+    """Fresh census (tests); re-registers with the current recorder."""
+    global _census, _registered_with
+    with _census_lock:
+        _census = NumericsCensus()
+        _registered_with = None
+    return get_numerics_census()

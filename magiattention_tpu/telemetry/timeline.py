@@ -288,9 +288,22 @@ def profile_plan_timeline(
 
     # ---- operands ---------------------------------------------------------
     if q is None:
-        assert num_heads is not None and head_dim is not None, (
-            "synthesizing operands needs num_heads=(hq, hkv) and head_dim"
-        )
+        # typed error naming exactly what is missing (was a bare
+        # assert, invisible under python -O and nameless when tripped)
+        missing = [
+            name
+            for name, val in (
+                ("num_heads", num_heads),
+                ("head_dim", head_dim),
+            )
+            if val is None
+        ]
+        if missing:
+            raise ValueError(
+                "profile_plan_timeline: synthesizing operands (q=None) "
+                f"needs num_heads=(hq, hkv) and head_dim; missing: "
+                f"{', '.join(missing)}"
+            )
         hq, hkv = num_heads
         dt = jnp.dtype(dtype if dtype is not None else params.out_dtype)
         total = plan.cp_size * plan.shard_q_len

@@ -578,6 +578,10 @@ class FlightRecorder:
         # a memory_snapshot() method (engines register themselves);
         # every dump embeds their ledger + fragmentation snapshots
         self._memory_sources: list[tuple[str, weakref.ref]] = []
+        # numerics sources (ISSUE 18): same weakly-held contract, but
+        # the method is numerics_snapshot() and the dump section is
+        # `numerics` (the value census + shadow-sentinel scores)
+        self._numerics_sources: list[tuple[str, weakref.ref]] = []
         self._source_counter = 0
 
     @property
@@ -690,45 +694,66 @@ class FlightRecorder:
         never pins itself or stales the recorder; every subsequent dump
         embeds a ``memory`` section with one entry per live source.
         Returns the (uniquified) registered name."""
+        return self._register_source("_memory_sources", name, obj)
+
+    def register_numerics_source(self, name: str, obj) -> str:
+        """Attach a numerics source (ISSUE 18): ``obj`` must expose
+        ``numerics_snapshot() -> dict`` (JSON-safe; the in-graph value
+        census + shadow-sentinel scores — see ``telemetry/numerics.
+        NumericsCensus``). Same weakly-held contract as
+        :meth:`register_memory_source`; every subsequent dump embeds a
+        ``numerics`` section with one entry per live source. Returns
+        the (uniquified) registered name."""
+        return self._register_source("_numerics_sources", name, obj)
+
+    def _register_source(self, attr: str, name: str, obj) -> str:
         with self._lock:
             # prune dead sources here too: churny construction (tests,
             # the lifecycle model checker) must not grow the list
             # unboundedly between dumps
-            self._memory_sources = [
-                (n, r) for n, r in self._memory_sources
+            setattr(self, attr, [
+                (n, r) for n, r in getattr(self, attr)
                 if r() is not None
-            ]
+            ])
             self._source_counter += 1
             uname = f"{name}#{self._source_counter}"
-            self._memory_sources.append((uname, weakref.ref(obj)))
+            getattr(self, attr).append((uname, weakref.ref(obj)))
         return uname
 
     def _collect_memory(self) -> dict | None:
-        """Snapshot every live memory source (best-effort — forensics
-        must never turn a dump into a crash). Runs OUTSIDE the ring
-        lock: sources execute arbitrary ledger code that may itself
-        touch the recorder. Dead weakrefs are pruned."""
+        return self._collect_sources(
+            "_memory_sources", "memory_snapshot"
+        )
+
+    def _collect_numerics(self) -> dict | None:
+        return self._collect_sources(
+            "_numerics_sources", "numerics_snapshot"
+        )
+
+    def _collect_sources(self, attr: str, method: str) -> dict | None:
+        """Snapshot every live source of one kind (best-effort —
+        forensics must never turn a dump into a crash). Runs OUTSIDE
+        the ring lock: sources execute arbitrary ledger code that may
+        itself touch the recorder. Dead weakrefs are pruned."""
         with self._lock:
-            sources = list(self._memory_sources)
+            sources = list(getattr(self, attr))
         out: dict = {}
-        alive: list[tuple[str, weakref.ref]] = []
         for name, ref in sources:
             obj = ref()
             if obj is None:
                 continue
-            alive.append((name, ref))
             try:
-                out[name] = obj.memory_snapshot()
+                out[name] = getattr(obj, method)()
             except Exception as e:  # noqa: BLE001 — recorded, not raised
                 out[name] = {"error": repr(e)}
         with self._lock:
             # prune dead refs from the CURRENT list (never replace it
             # wholesale: a source registered while the snapshots ran
             # above must survive into future dumps)
-            self._memory_sources = [
-                (n, r) for n, r in self._memory_sources
+            setattr(self, attr, [
+                (n, r) for n, r in getattr(self, attr)
                 if r() is not None
-            ]
+            ])
         return out or None
 
     def flush(self) -> str | None:
@@ -741,6 +766,7 @@ class FlightRecorder:
         # only when a dump is plausibly coming (the OOM-forensics
         # payload: what the pools looked like at the incident)
         memory = self._collect_memory() if armed else None
+        numerics = self._collect_numerics() if armed else None
         with self._lock:
             rec = self._armed
             if rec is None:
@@ -764,6 +790,8 @@ class FlightRecorder:
             }
             if memory is not None:
                 payload["memory"] = memory
+            if numerics is not None:
+                payload["numerics"] = numerics
             n = self._dump_count
         path = self._write_dump(payload, n)
         if path is not None:

@@ -40,11 +40,58 @@ def test_parse_full_grammar():
         "straggler:delay=0",  # out of range
         "corrupt_partial:site",  # malformed param (no '=')
         "corrupt_partial:value=nan",  # site-less: would be silently inert
+        # finite:<scale> grammar (ISSUE 18): a non-positive or
+        # non-numeric scale fails at parse time, like a bad site=
+        "corrupt_partial:site=split0,value=finite",  # no scale at all
+        "corrupt_partial:site=split0,value=finite:",  # empty scale
+        "corrupt_partial:site=split0,value=finite:0",  # not positive
+        "corrupt_partial:site=split0,value=finite:-2.5",  # negative
+        "corrupt_partial:site=split0,value=finite:abc",  # non-numeric
+        "corrupt_partial:site=split0,value=finite:inf",  # not finite
+        "corrupt_partial:site=split0,value=finite:nan",  # nan > 0 false
+        "corrupt_cast:value=finite:0.0",  # same domain for cast plants
     ],
 )
 def test_parse_rejects_bad_specs(bad):
     with pytest.raises(ValueError):
         C.parse_chaos_spec(bad)
+
+
+def test_parse_finite_value_flavor():
+    """``value=finite:<scale>`` (ISSUE 18) plants the literal scale — a
+    finite-but-wrong value invisible to the nan/inf guards, caught only
+    by the shadow-sampled drift sentinel."""
+    (cp,) = C.parse_chaos_spec(
+        "corrupt_partial:site=split0,value=finite:8.0,field=out"
+    )
+    assert cp.value == "finite:8.0"
+    assert cp.fill == 8.0
+    (cc,) = C.parse_chaos_spec("corrupt_cast:value=finite:0.5")
+    assert cc.fill == 0.5
+    (cr,) = C.parse_chaos_spec("corrupt_reduce:value=finite:1e3")
+    assert cr.fill == 1000.0
+
+
+def test_finite_plant_is_invisible_to_guards(monkeypatch):
+    """End-to-end contract of the flavor: the planted finite value
+    passes ``guard_partial`` clean (no bad rows) while a nan plant at
+    the same site trips it."""
+    import jax.numpy as jnp
+
+    from magiattention_tpu.resilience import guards
+
+    out = jnp.ones((4, 2, 8), jnp.float32)
+    lse = jnp.zeros((4, 2), jnp.float32)
+    for value, expect_bad in (("finite:8.0", False), ("nan", True)):
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_CHAOS",
+            f"corrupt_partial:site=split0,value={value},field=out",
+        )
+        monkeypatch.setenv("MAGI_ATTENTION_GUARD", "check")
+        o, l = C.corrupt_partial(out, lse, "split0")
+        code = guards.new_error_code()
+        _, _, code = guards.guard_partial(o, l, code, 0, "split0")
+        assert bool(code != 0) == expect_bad, value
 
 
 def test_env_accessor_validates_and_fingerprints(monkeypatch):

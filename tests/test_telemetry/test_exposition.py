@@ -126,6 +126,52 @@ def test_snapshot_delta_histograms_difference_bucketwise():
     assert dh["p50"] is not None
 
 
+def test_snapshot_delta_over_numerics_histograms():
+    """ISSUE 18 satellite: the ``magi_numerics_*`` histograms carry
+    explicit bounds — bucket deltas and re-estimated percentiles must
+    be window-local, and a window with ZERO new samples must survive
+    (count 0, no percentile blow-up) rather than divide by zero."""
+    from magiattention_tpu.telemetry import collectors
+
+    reg = MetricsRegistry()
+    monkey_get = collectors.get_registry
+    collectors.get_registry = lambda: reg
+    try:
+        telemetry.set_enabled(True)
+        collectors.record_numerics_census(
+            "decode", "split0",
+            {"logit_max": 1.0, "lse_min": -1.0, "lse_max": 2.0,
+             "out_max_abs": 0.75},
+        )
+        collectors.record_numerics_census(
+            "decode", "final", {"mass_dev": 3e-6}
+        )
+        prev = reg.snapshot()
+        collectors.record_numerics_census(
+            "decode", "split0",
+            {"logit_max": 1.0, "lse_min": -1.0, "lse_max": 2.0,
+             "out_max_abs": 12.0},
+        )
+        curr = reg.snapshot()
+    finally:
+        collectors.get_registry = monkey_get
+        telemetry.set_enabled(None)
+    d = exposition.snapshot_delta(prev, curr)
+    dh = d["histograms"]["magi_numerics_out_max_abs{layer=decode}"]
+    # exactly the window's one observation, in the right bucket
+    assert dh["count"] == 1
+    assert dh["sum"] == pytest.approx(12.0)
+    assert sum(dh["bucket_counts"]) == 1
+    assert dh["p50"] is not None and dh["p50"] > 8.0
+    # a later window with zero new samples: flat deltas, no crash
+    d2 = exposition.snapshot_delta(curr, curr)
+    dh2 = d2["histograms"]["magi_numerics_out_max_abs{layer=decode}"]
+    assert dh2["count"] == 0
+    assert sum(dh2["bucket_counts"]) == 0
+    dm = d2["histograms"]["magi_numerics_mass_dev{layer=decode}"]
+    assert dm["count"] == 0
+
+
 def test_snapshot_delta_without_prev_is_identity_on_counters():
     reg = MetricsRegistry()
     reg.counter_inc("c", 5)

@@ -180,6 +180,24 @@ def test_hier_plan_requires_axis_pair():
         )
 
 
+def test_synthesized_operands_missing_fields_raise_value_error():
+    """ISSUE 18 satellite: q=None without num_heads/head_dim is a typed
+    ValueError NAMING the missing fields (was a bare assert — invisible
+    under ``python -O`` and nameless when tripped)."""
+    plan = _plan(degree=0)
+    params = make_attn_params(plan, 64, out_dtype="float32")
+    with pytest.raises(ValueError, match="missing: num_heads, head_dim"):
+        telemetry.profile_plan_timeline(plan, _mesh(4), params)
+    with pytest.raises(ValueError, match="missing: head_dim"):
+        telemetry.profile_plan_timeline(
+            plan, _mesh(4), params, num_heads=(4, 2)
+        )
+    with pytest.raises(ValueError, match="missing: num_heads"):
+        telemetry.profile_plan_timeline(
+            plan, _mesh(4), params, head_dim=64
+        )
+
+
 def test_merged_degree0_plan_profiles_as_one_stage():
     plan = _plan(degree=0)
     params = make_attn_params(plan, 64, out_dtype="float32")
