@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze spmd-audit lifecycle-check resilience-check roofline-check roofline-report trace-check distserve-check memory-check compile-check tick-check numerics-check check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze spmd-audit lifecycle-check resilience-check roofline-check roofline-report trace-check distserve-check memory-check compile-check tick-check numerics-check fleet-check check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -213,6 +213,17 @@ tick-check:
 numerics-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_numerics_check.py --self-test
 
+# fleet gate (ISSUE 19; CPU, logical-tick simulator over the stubbed
+# device layer): healthy fleet holds the SLO with every
+# REQUIRED_FLEET_METRICS name live, the closed-loop autopilot beats the
+# static config on the burst-arrival AND decode-replica-fault
+# adversarial scenarios with zero anti-oscillation violations,
+# exps/data/capacity_curve.json regenerated (users-per-chip at the p99
+# SLO), and --self-test proof that a planted oscillating controller is
+# caught by the action-log checker
+fleet-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_fleet_check.py --self-test
+
 # mask-aware roofline report + occupancy JSON artifact for the 16k
 # varlen block-causal headline (docs/observability.md "Roofline &
 # occupancy"); host-side only
@@ -224,6 +235,6 @@ roofline-report:
 # serving parity, shared-prefix/scheduler gate, group-collective
 # parity/volume, resilience gate, roofline/occupancy gate, request
 # tracing/exposition gate, disaggregated-serving gate, memory
-# observability gate, unified-tick gate, numerics observability gate —
-# all CPU-safe
-check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check distserve-check memory-check compile-check tick-check numerics-check
+# observability gate, unified-tick gate, numerics observability gate,
+# fleet simulator + autopilot gate — all CPU-safe
+check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check distserve-check memory-check compile-check tick-check numerics-check fleet-check

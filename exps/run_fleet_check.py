@@ -1,0 +1,354 @@
+"""Fleet gate (``make fleet-check``) — CPU.
+
+The ISSUE 19 acceptance surface, entirely on the logical-tick fleet
+simulator (real ``Scheduler``/``TieredScheduler`` + engines over the
+lifecycle checker's stubbed device layer):
+
+1. **Healthy-fleet SLO**: a stationary Poisson trace replayed on the
+   stock tiered config must meet the SLO attainment target outright,
+   and every ``REQUIRED_FLEET_METRICS`` name must be populated by the
+   run (presence asserted on the registry snapshot).
+2. **Autopilot beats static — burst arrival**: the adversarial MMPP
+   burst trace (calm 0.8/tick, bursts at 12/tick) drives a deliberately
+   undersized static config far below SLO; the same config under the
+   closed-loop autopilot must recover a decisively higher offered-load
+   attainment AND goodput, with ZERO anti-oscillation violations in
+   its action log (``find_oscillations``).
+3. **Autopilot beats static — decode-replica faults**: a hot Poisson
+   trace with chaos ``decode_fault`` injections mid-replay; same
+   comparison, plus the fault windows must show the ``fault`` hold
+   (the controller never retunes on fault-polluted numbers) and every
+   fault must be absorbed (requeue+replay, the replay drains).
+4. **Capacity curve**: regenerate ``exps/data/capacity_curve.json``
+   (binary-searched users-per-chip at the p99 SLO per fleet config)
+   and sanity-check it — every config sustains nonzero load and the
+   tiered fleet beats single-chip on absolute sustained rate.
+5. ``--self-test``: a PLANTED oscillating controller (alternates one
+   knob up/down every window, bypassing the cooldown bookkeeping) is
+   driven through the same simulator — ``find_oscillations`` must flag
+   it, proving the gate's anti-oscillation check has teeth.
+
+Exits non-zero on any violation.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from magiattention_tpu import telemetry  # noqa: E402
+from magiattention_tpu.fleet import (  # noqa: E402
+    Autopilot,
+    FleetSimulator,
+    SLOTargets,
+    generate_trace,
+    write_capacity_curve,
+)
+from magiattention_tpu.fleet.autopilot import find_oscillations  # noqa: E402
+from magiattention_tpu.fleet.workload import validate_trace  # noqa: E402
+from magiattention_tpu.telemetry.collectors import (  # noqa: E402
+    REQUIRED_FLEET_METRICS,
+)
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+# the gate's SLO: tick-denominated, same targets across every scenario
+SLO = SLOTargets(
+    ttft_p99_ticks=16.0, toklat_p99_ticks=8.0, attainment_target=0.9
+)
+
+# the deliberately undersized static config the adversarial scenarios
+# start from (the autopilot may retune it; the static baseline may not)
+STATIC_SIM = dict(
+    mode="tiered", window_ticks=8, dp=2, prefill_budget=32,
+    decode_budget=16, chunk=8, num_pages=256, max_seqs=32,
+    max_pages_per_seq=8,
+)
+COOLDOWN = 3
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _metric_names(snap: dict) -> set:
+    return {
+        k.split("{", 1)[0]
+        for d in snap.values()
+        for k in d
+    }
+
+
+def _summarize(tag: str, rep) -> None:
+    print(
+        f"  {tag}: offered={rep.offered} finished={rep.finished} "
+        f"attainment(offered)={rep.attainment_offered:.3f} "
+        f"goodput={rep.goodput_tokens} ttft_p99={rep.ttft_p99:.1f} "
+        f"peak_concurrent={rep.peak_concurrent} "
+        f"actions={len(rep.actions)} faults={rep.chaos_faults}"
+    )
+
+
+def check_healthy_fleet() -> int:
+    """A stationary fleet on the stock config must hold the SLO, and
+    one autopilot-attached run must populate the whole catalog."""
+    trace = generate_trace(
+        "healthy", seed=41, horizon_ticks=96, arrival="poisson",
+        rate=1.2, output_len_max=16, suffix_len_range=(2, 10),
+    )
+    errs = validate_trace(trace)
+    if errs:
+        return fail(f"healthy trace lint: {errs[:3]}")
+    ap = Autopilot(SLO, mode="tiered", cooldown_windows=COOLDOWN)
+    rep = FleetSimulator(trace, autopilot=ap, **STATIC_SIM).run()
+    snap = telemetry.snapshot()
+    _summarize("healthy", rep)
+    if rep.finished != rep.offered:
+        return fail(
+            f"healthy fleet did not drain: {rep.finished}/{rep.offered}"
+        )
+    if rep.attainment_offered < SLO.attainment_target:
+        return fail(
+            f"healthy fleet misses SLO: attainment "
+            f"{rep.attainment_offered:.3f} < {SLO.attainment_target}"
+        )
+    names = _metric_names(snap)
+    missing = [m for m in REQUIRED_FLEET_METRICS if m not in names]
+    if missing:
+        return fail(f"REQUIRED_FLEET_METRICS missing: {missing}")
+    print(
+        f"fleet-check [1/5] healthy fleet: attainment "
+        f"{rep.attainment_offered:.3f}, all "
+        f"{len(REQUIRED_FLEET_METRICS)} magi_fleet_* metrics live"
+    )
+    return 0
+
+
+def _adversarial(tag, trace, chaos_ticks=None) -> tuple[int, dict]:
+    """Static-vs-autopilot on one scenario; returns (rc, summary)."""
+    errs = validate_trace(trace)
+    if errs:
+        return fail(f"{tag} trace lint: {errs[:3]}"), {}
+    kw = dict(STATIC_SIM, chaos_ticks=chaos_ticks)
+    static = FleetSimulator(trace, autopilot=None, slo=SLO, **kw).run()
+    ap = Autopilot(SLO, mode="tiered", cooldown_windows=COOLDOWN)
+    auto = FleetSimulator(trace, autopilot=ap, **kw).run()
+    _summarize(f"{tag} static", static)
+    _summarize(f"{tag} autopilot", auto)
+    if auto.attainment_offered < static.attainment_offered + 0.1:
+        return fail(
+            f"{tag}: autopilot does not beat static decisively: "
+            f"{auto.attainment_offered:.3f} vs "
+            f"{static.attainment_offered:.3f} (want +0.1)"
+        ), {}
+    if auto.goodput_tokens <= static.goodput_tokens:
+        return fail(
+            f"{tag}: autopilot goodput {auto.goodput_tokens} <= "
+            f"static {static.goodput_tokens}"
+        ), {}
+    if not auto.actions:
+        return fail(f"{tag}: autopilot never acted"), {}
+    osc = find_oscillations(auto.actions, cooldown_windows=COOLDOWN)
+    if osc:
+        return fail(f"{tag}: oscillation violations: {osc}"), {}
+    summary = {
+        "static_attainment": static.attainment_offered,
+        "auto_attainment": auto.attainment_offered,
+        "static_goodput": static.goodput_tokens,
+        "auto_goodput": auto.goodput_tokens,
+        "actions": [list(a) for a in auto.actions],
+        "report": auto,
+    }
+    return 0, summary
+
+
+def check_burst_scenario() -> int:
+    """Adversarial scenario A: MMPP burst arrivals (ISSUE 19's 'burst
+    arrival' case)."""
+    trace = generate_trace(
+        "burst", seed=11, horizon_ticks=160, arrival="mmpp",
+        rate=0.8, burst_rate=12.0, burst_prob=0.04, calm_prob=0.10,
+        output_len_max=16, suffix_len_range=(2, 10),
+    )
+    rc, s = _adversarial("burst", trace)
+    if rc:
+        return rc
+    print(
+        f"fleet-check [2/5] burst arrivals: autopilot "
+        f"{s['auto_attainment']:.3f} vs static "
+        f"{s['static_attainment']:.3f} attainment "
+        f"({len(s['actions'])} bounded actions, zero oscillation)"
+    )
+    return 0
+
+
+def check_fault_scenario() -> int:
+    """Adversarial scenario B: decode-replica chaos faults under hot
+    load (ISSUE 19's 'decode-replica fault' case)."""
+    trace = generate_trace(
+        "fault", seed=23, horizon_ticks=160, arrival="poisson",
+        rate=4.5, output_len_max=16, suffix_len_range=(2, 10),
+    )
+    chaos = {t: "decode_fault:times=1" for t in (40, 44, 48, 52, 56, 60)}
+    rc, s = _adversarial("fault", trace, chaos_ticks=chaos)
+    if rc:
+        return rc
+    auto = s["report"]
+    if auto.chaos_faults != len(chaos):
+        return fail(
+            f"fault: expected {len(chaos)} absorbed faults, saw "
+            f"{auto.chaos_faults}"
+        )
+    fault_holds = [
+        w for w in auto.windows
+        if ["*", "fault"] in w.get("holds", [])
+    ]
+    if not fault_holds:
+        return fail("fault: no window recorded the fault hold")
+    if any(w.get("actions") for w in fault_holds):
+        return fail(
+            "fault: the autopilot acted on a fault-polluted window"
+        )
+    print(
+        f"fleet-check [3/5] decode-replica faults: autopilot "
+        f"{s['auto_attainment']:.3f} vs static "
+        f"{s['static_attainment']:.3f} attainment; "
+        f"{auto.chaos_faults} faults absorbed, "
+        f"{len(fault_holds)} fault-held windows, zero oscillation"
+    )
+    return 0
+
+
+def check_capacity_curve() -> int:
+    """Regenerate + sanity-check the committed capacity artifact."""
+    path = os.path.join(DATA_DIR, "capacity_curve.json")
+    curve = write_capacity_curve(path, slo=SLO, iterations=5)
+    rows = {r["name"]: r for r in curve["configs"]}
+    for name, r in rows.items():
+        if r["max_rate_per_tick"] <= 0 or r["users_per_chip"] <= 0:
+            return fail(
+                f"capacity: config {name} sustains no load: {r}"
+            )
+        if r["attainment"] < SLO.attainment_target:
+            return fail(
+                f"capacity: config {name} reported infeasible point "
+                f"as feasible: {r}"
+            )
+    if (
+        rows["tiered-dp2"]["max_rate_per_tick"]
+        <= rows["single"]["max_rate_per_tick"]
+    ):
+        return fail(
+            "capacity: tiered-dp2 does not sustain more load than "
+            f"single: {rows['tiered-dp2']} vs {rows['single']}"
+        )
+    with open(path) as f:
+        reread = json.load(f)
+    if reread != curve:
+        return fail("capacity: artifact does not round-trip")
+    per_chip = {
+        n: round(r["users_per_chip"], 1) for n, r in rows.items()
+    }
+    print(
+        f"fleet-check [4/5] capacity curve -> {path}: "
+        f"users/chip {per_chip}"
+    )
+    return 0
+
+
+class _OscillatingPilot(Autopilot):
+    """The planted bad controller: alternates the first knob up/down
+    EVERY window, writing its own bookkeeping so the in-controller
+    guards can't save it — only the external action-log checker can
+    catch this."""
+
+    def evaluate(self, window, *, current):
+        from magiattention_tpu.fleet.autopilot import AutopilotDecision
+
+        spec = self.specs[0]
+        cur = float(current.get(spec.name, spec.default))
+        direction = +1 if (self._window % 2 == 0) else -1
+        new = spec.clamp(cur + direction * spec.step)
+        decision = AutopilotDecision(
+            window=self._window,
+            actions={spec.name: new},
+            holds=(),
+            facts={},
+        )
+        self.history.append(decision)
+        self._window += 1
+        return decision
+
+
+def check_selftest() -> int:
+    """--self-test: the oscillation checker must catch the planted
+    limit-cycle controller on a real simulated run."""
+    trace = generate_trace(
+        "selftest", seed=71, horizon_ticks=64, arrival="poisson",
+        rate=1.5, output_len_max=8, suffix_len_range=(2, 8),
+    )
+    bad = _OscillatingPilot(
+        SLO, mode="tiered", cooldown_windows=COOLDOWN
+    )
+    rep = FleetSimulator(trace, autopilot=bad, **STATIC_SIM).run()
+    if len(rep.actions) < 4:
+        return fail(
+            f"self-test: planted controller only acted "
+            f"{len(rep.actions)} times — not an oscillation run"
+        )
+    osc = find_oscillations(rep.actions, cooldown_windows=COOLDOWN)
+    if not osc:
+        return fail(
+            "self-test: planted oscillating controller NOT caught "
+            f"(actions: {rep.actions[:6]}...)"
+        )
+    if not any("windows apart" in e for e in osc):
+        return fail(f"self-test: no cooldown violation flagged: {osc}")
+    if not any("reversal" in e for e in osc):
+        return fail(f"self-test: no reversal violation flagged: {osc}")
+    print(
+        f"fleet-check [5/5] self-test: planted oscillator caught "
+        f"({len(osc)} violations, e.g. {osc[0]!r})"
+    )
+    return 0
+
+
+def main() -> int:
+    self_test = "--self-test" in sys.argv
+    saved_chaos = os.environ.get("MAGI_ATTENTION_CHAOS")
+    os.environ.pop("MAGI_ATTENTION_CHAOS", None)
+    try:
+        checks = [
+            check_healthy_fleet,
+            check_burst_scenario,
+            check_fault_scenario,
+            check_capacity_curve,
+        ]
+        if self_test:
+            checks.append(check_selftest)
+        for check in checks:
+            rc = check()
+            if rc:
+                return rc
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+        telemetry.reset_request_traces()
+        if saved_chaos is not None:
+            os.environ["MAGI_ATTENTION_CHAOS"] = saved_chaos
+    print(
+        "fleet-check OK: SLO held on the healthy fleet, autopilot "
+        "beats static on burst arrivals AND decode-replica faults "
+        "with zero oscillation, capacity curve regenerated"
+        + (", planted oscillator caught" if self_test else "")
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

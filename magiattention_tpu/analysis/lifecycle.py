@@ -270,10 +270,13 @@ def _set(t: tuple, i: int, v):
 
 def _stub_assign_block_table(cache, slot, pages, *, keep_len=False):
     # mirrors the real op's validation exactly — a fork claiming tokens
-    # past its installed pages must be REJECTED here too
-    assert len(pages) <= cache.max_pages_per_seq, (
-        f"{len(pages)} pages > max_pages_per_seq {cache.max_pages_per_seq}"
-    )
+    # past its installed pages must be REJECTED here too (same typed
+    # ValueError contract as serving.kv_cache.assign_block_table)
+    if len(pages) > cache.max_pages_per_seq:
+        raise ValueError(
+            f"block table for slot {slot} would overflow: {len(pages)} "
+            f"pages > max_pages_per_seq {cache.max_pages_per_seq}"
+        )
     row = tuple(int(p) for p in pages) + (0,) * (
         cache.max_pages_per_seq - len(pages)
     )
@@ -281,9 +284,11 @@ def _stub_assign_block_table(cache, slot, pages, *, keep_len=False):
         seq = cache.seq_lens
     else:
         n = 0 if keep_len is False else int(keep_len)
-        assert 0 <= n <= len(pages) * cache.page_size, (
-            f"keep_len={n} exceeds the {len(pages)}-page installed capacity"
-        )
+        if not 0 <= n <= len(pages) * cache.page_size:
+            raise ValueError(
+                f"keep_len={n} exceeds the {len(pages)}-page installed "
+                f"capacity ({len(pages) * cache.page_size} tokens)"
+            )
         seq = _set(cache.seq_lens, int(slot), n)
     return dataclasses.replace(
         cache, block_tables=_set(cache.block_tables, int(slot), row),

@@ -549,6 +549,61 @@ def tier_token_budget(tier: str) -> int:
     return v
 
 
+def fleet_window_ticks() -> int:
+    """Scheduler ticks per autopilot evaluation window (``fleet/``,
+    ISSUE 19): the FleetSimulator snapshots the registry every N ticks
+    and hands the ``snapshot_delta`` to the autopilot. Smaller windows
+    react faster but see noisier SLO samples. Simulation-host behavior
+    only, NOT part of :func:`flags_fingerprint`."""
+    v = _env_int("MAGI_ATTENTION_FLEET_WINDOW", 16)
+    if v < 1:
+        raise ValueError(
+            f"MAGI_ATTENTION_FLEET_WINDOW={v} must be a positive tick count"
+        )
+    return v
+
+
+def fleet_cooldown_windows() -> int:
+    """Autopilot per-knob cooldown (``fleet/autopilot.py``): after a
+    knob moves, it is frozen for this many evaluation windows — the
+    anti-oscillation half of the controller contract (``make
+    fleet-check`` asserts no knob flips more than once per cooldown
+    under chaos). NOT part of :func:`flags_fingerprint`."""
+    v = _env_int("MAGI_ATTENTION_FLEET_COOLDOWN", 3)
+    if v < 1:
+        raise ValueError(
+            f"MAGI_ATTENTION_FLEET_COOLDOWN={v} must be a positive "
+            "window count"
+        )
+    return v
+
+
+def fleet_slo_ttft_ticks() -> float:
+    """Default p99 time-to-first-token SLO target in LOGICAL TICKS for
+    the fleet simulator (``fleet/autopilot.SLOTargets``); explicit
+    SLOTargets arguments win. NOT part of :func:`flags_fingerprint`."""
+    v = _env_float("MAGI_ATTENTION_FLEET_SLO_TTFT", 16.0)
+    if v <= 0:
+        raise ValueError(
+            f"MAGI_ATTENTION_FLEET_SLO_TTFT={v} must be a positive tick "
+            "count"
+        )
+    return v
+
+
+def fleet_slo_toklat_ticks() -> float:
+    """Default p99 per-token decode-latency SLO target in LOGICAL TICKS
+    (``fleet/autopilot.SLOTargets``); explicit arguments win. NOT part
+    of :func:`flags_fingerprint`."""
+    v = _env_float("MAGI_ATTENTION_FLEET_SLO_TOKLAT", 8.0)
+    if v <= 0:
+        raise ValueError(
+            f"MAGI_ATTENTION_FLEET_SLO_TOKLAT={v} must be a positive "
+            "tick count"
+        )
+    return v
+
+
 def decode_splits() -> int | None:
     """Split-KV decode split count (``serving/decode_attn.py``): an
     integer pins the number of KV splits per sequence; 'auto' (default)

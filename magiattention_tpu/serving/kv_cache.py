@@ -123,10 +123,12 @@ def make_paged_kv_cache(
 ) -> PagedKVCache:
     """Zero-initialized cache. ``max_pages_per_seq`` bounds a sequence's
     KV history (block-table width); defaults to the whole pool."""
-    assert page_size % 8 == 0, (
-        f"page_size {page_size} must be a multiple of 8 (TPU sublane "
-        "tiling of the page's token axis)"
-    )
+    if page_size % 8 != 0:
+        raise ValueError(
+            f"page_size {page_size} must be a multiple of 8 (TPU sublane "
+            "tiling of the page's token axis); got "
+            f"{page_size} % 8 == {page_size % 8}"
+        )
     if max_pages_per_seq is None:
         max_pages_per_seq = num_pages
     shape = (num_pages, page_size, num_kv_heads, head_dim)
@@ -264,19 +266,28 @@ def assign_block_table(
       tokens beyond its page list would decode block-table padding
       (page 0 — possibly another live sequence's data) as its own KV.
     """
-    assert len(pages) <= cache.max_pages_per_seq, (
-        f"{len(pages)} pages > max_pages_per_seq {cache.max_pages_per_seq}"
-    )
+    if len(pages) > cache.max_pages_per_seq:
+        raise ValueError(
+            f"block table for slot {slot} would overflow: {len(pages)} "
+            f"pages > max_pages_per_seq {cache.max_pages_per_seq} "
+            f"(block_tables shape {tuple(cache.block_tables.shape)}, "
+            f"pages {list(pages)[:8]}{'...' if len(pages) > 8 else ''})"
+        )
     row = np.zeros((cache.max_pages_per_seq,), np.int32)
     row[: len(pages)] = np.asarray(pages, np.int32)
     if keep_len is True:
         seq_lens = cache.seq_lens
     else:
         n = 0 if keep_len is False else int(keep_len)
-        assert 0 <= n <= len(pages) * cache.page_size, (
-            f"keep_len={n} exceeds the {len(pages)}-page installed "
-            f"capacity ({len(pages) * cache.page_size} tokens)"
-        )
+        if not 0 <= n <= len(pages) * cache.page_size:
+            raise ValueError(
+                f"keep_len={n} out of range for slot {slot}: the "
+                f"{len(pages)}-page installed list holds at most "
+                f"{len(pages) * cache.page_size} tokens "
+                f"(page_size {cache.page_size}); a fork claiming tokens "
+                "beyond its pages would decode block-table padding "
+                "(page 0) as its own KV"
+            )
         seq_lens = cache.seq_lens.at[slot].set(n)
     return PagedKVCache(
         k_pages=cache.k_pages,

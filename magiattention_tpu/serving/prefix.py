@@ -327,7 +327,18 @@ def cascade_decode_attn(
     mpp = cache.max_pages_per_seq
 
     grouped = [i for g in groups for i in g.members]
-    assert len(grouped) == len(set(grouped)), "overlapping cascade groups"
+    if len(grouped) != len(set(grouped)):
+        dupes = sorted({i for i in grouped if grouped.count(i) > 1})
+        owners = {
+            i: [gi for gi, g in enumerate(groups) if i in g.members]
+            for i in dupes
+        }
+        raise ValueError(
+            "overlapping cascade groups: batch position(s) "
+            f"{dupes} appear in more than one group "
+            f"(position -> group indices: {owners}); each batch row "
+            "may belong to at most one CascadeGroup"
+        )
     rest = [i for i in range(b) if i not in set(grouped)]
 
     outs = [None] * b
@@ -342,7 +353,15 @@ def cascade_decode_attn(
         for g in groups:
             idx = list(g.members)
             n_shared = len(g.shared_pages)
-            assert n_shared > 0 and g.prefix_len == n_shared * cache.page_size
+            if n_shared == 0 or g.prefix_len != n_shared * cache.page_size:
+                raise ValueError(
+                    f"misaligned cascade group (members {idx}): "
+                    f"prefix_len {g.prefix_len} must equal "
+                    f"len(shared_pages) ({n_shared}) * page_size "
+                    f"({cache.page_size}) = {n_shared * cache.page_size} "
+                    "and cover at least one page — the level-1 partial "
+                    "reads whole shared pages only"
+                )
             qg = q[jnp.asarray(idx, jnp.int32)]
             # level 1: the shared prefix, once per group — every member
             # reads the SAME page row, so the row is broadcast, fully

@@ -41,6 +41,7 @@ from .collectors import (  # noqa: F401
     REQUIRED_ANALYSIS_METRICS,
     REQUIRED_COMPILE_METRICS,
     REQUIRED_DISTSERVE_METRICS,
+    REQUIRED_FLEET_METRICS,
     REQUIRED_MEMORY_METRICS,
     REQUIRED_NUMERICS_METRICS,
     REQUIRED_PLAN_CACHE_METRICS,
@@ -94,6 +95,12 @@ from .collectors import (  # noqa: F401
     record_request_token_latency,
     record_request_ttft,
     record_runtime_costs,
+    record_fleet_autopilot_action,
+    record_fleet_autopilot_hold,
+    record_fleet_finished,
+    record_fleet_knob,
+    record_fleet_offered,
+    record_fleet_window,
     record_sched_step,
     record_shadow_check,
     record_stream_queue_depth,
@@ -265,6 +272,7 @@ __all__ = [
     "PoolFragmentationMap",
     "REQUIRED_ANALYSIS_METRICS",
     "REQUIRED_COMPILE_METRICS",
+    "REQUIRED_FLEET_METRICS",
     "REQUIRED_MEMORY_METRICS",
     "REQUIRED_NUMERICS_METRICS",
     "REQUIRED_PLAN_METRICS",
@@ -344,6 +352,12 @@ __all__ = [
     "record_kvcache_state",
     "record_plan",
     "record_plan_solver",
+    "record_fleet_autopilot_action",
+    "record_fleet_autopilot_hold",
+    "record_fleet_finished",
+    "record_fleet_knob",
+    "record_fleet_offered",
+    "record_fleet_window",
     "record_prefill",
     "record_roofline",
     "record_request_span",

@@ -563,6 +563,55 @@ REQUIRED_NUMERICS_METRICS: tuple[str, ...] = (
     M_NUMERICS_SHADOW_BREACHES,
 )
 
+# counters + gauges + histograms — fleet simulation & autopilot
+# (fleet/; ISSUE 19). The fleet layer runs on a LOGICAL tick clock, so
+# the latency histograms are in ticks, not seconds, and "QPS" figures
+# are requests per tick window (snapshot_delta's counters_per_s over a
+# tick-denominated window). offered counts arrivals the trace presented
+# (whether or not admission took them), served counts requests that
+# FINISHED; the gap between the two rates is shed load. goodput counts
+# only the tokens of requests that finished inside their SLO — the
+# figure the autopilot maximizes. autopilot actions are labelled
+# {knob=,direction=up|down}; holds are windows where the controller
+# deliberately did nothing ({reason=steady|cooldown|hysteresis|fault|
+# bounds|reversal}); knob gauges ({knob=}) expose the live value every
+# retune writes.
+M_FLEET_OFFERED = "magi_fleet_offered_requests_total"
+M_FLEET_SERVED = "magi_fleet_served_requests_total"
+M_FLEET_SLO_OK = "magi_fleet_slo_ok_total"  # finished inside SLO
+M_FLEET_SLO_ATTAINMENT = "magi_fleet_slo_attainment"  # gauge 0..1 window
+M_FLEET_GOODPUT = "magi_fleet_goodput_tokens_total"
+M_FLEET_CONCURRENT = "magi_fleet_concurrent_requests"  # gauge: in flight
+M_FLEET_AUTOPILOT_ACTIONS = "magi_fleet_autopilot_actions_total"
+M_FLEET_AUTOPILOT_HOLDS = "magi_fleet_autopilot_holds_total"
+M_FLEET_KNOB = "magi_fleet_knob_value"  # gauge {knob=}
+H_FLEET_TTFT_TICKS = "magi_fleet_ttft_ticks"
+H_FLEET_TOKLAT_TICKS = "magi_fleet_token_latency_ticks"
+
+# tick-denominated latency bounds: a healthy fleet's TTFT sits in the
+# single-digit-tick buckets; a saturated one spills past the decade
+_FLEET_TICK_BOUNDS = (
+    1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+)
+
+# populated by one FleetSimulator.run() over any trace with the
+# autopilot attached; asserted by make fleet-check
+# (exps/run_fleet_check.py), documented in docs/fleet.md +
+# docs/observability.md "Fleet"
+REQUIRED_FLEET_METRICS: tuple[str, ...] = (
+    M_FLEET_OFFERED,
+    M_FLEET_SERVED,
+    M_FLEET_SLO_OK,
+    M_FLEET_SLO_ATTAINMENT,
+    M_FLEET_GOODPUT,
+    M_FLEET_CONCURRENT,
+    M_FLEET_AUTOPILOT_ACTIONS,
+    M_FLEET_AUTOPILOT_HOLDS,
+    M_FLEET_KNOB,
+    H_FLEET_TTFT_TICKS,
+    H_FLEET_TOKLAT_TICKS,
+)
+
 
 def record_numerics_census(
     layer: str, site: str, stats: dict
@@ -1506,6 +1555,77 @@ def record_tier_state(
         labels["replica"] = replica
     reg.gauge_set(M_TIER_PAGES_USED, int(pages_in_use), **labels)
     reg.gauge_set(M_TIER_ACTIVE, int(active), tier=tier)
+
+
+def record_fleet_offered(n: int = 1) -> None:
+    """``n`` trace arrivals presented to the fleet this tick (counted
+    whether or not admission accepted them — offered load)."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_FLEET_OFFERED, int(n))
+
+
+def record_fleet_finished(
+    *, ttft_ticks: float, token_latency_ticks: float,
+    tokens: int, slo_ok: bool,
+) -> None:
+    """One request finished: served counter, tick-unit latency
+    histograms, and — only when it met its SLO — the slo-ok counter and
+    its tokens into goodput."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_FLEET_SERVED)
+    reg.histogram_observe(
+        H_FLEET_TTFT_TICKS, float(ttft_ticks), bounds=_FLEET_TICK_BOUNDS
+    )
+    reg.histogram_observe(
+        H_FLEET_TOKLAT_TICKS, float(token_latency_ticks),
+        bounds=_FLEET_TICK_BOUNDS,
+    )
+    if slo_ok:
+        reg.counter_inc(M_FLEET_SLO_OK)
+        reg.counter_inc(M_FLEET_GOODPUT, int(tokens))
+
+
+def record_fleet_window(
+    *, slo_attainment: float, concurrent: int
+) -> None:
+    """End of one autopilot window: the window's SLO attainment (of the
+    requests that finished in it) and the in-flight request count."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.gauge_set(M_FLEET_SLO_ATTAINMENT, float(slo_attainment))
+    reg.gauge_set(M_FLEET_CONCURRENT, int(concurrent))
+
+
+def record_fleet_autopilot_action(
+    knob: str, direction: str, value: float
+) -> None:
+    """The autopilot retuned one knob (``direction`` up|down) to
+    ``value`` — action counter + live knob gauge."""
+    if not _enabled():
+        return
+    reg = get_registry()
+    reg.counter_inc(M_FLEET_AUTOPILOT_ACTIONS, knob=knob,
+                    direction=direction)
+    reg.gauge_set(M_FLEET_KNOB, float(value), knob=knob)
+
+
+def record_fleet_autopilot_hold(reason: str) -> None:
+    """The autopilot evaluated a window and deliberately did NOT act
+    (``reason``: steady|cooldown|hysteresis|fault|bounds|reversal)."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_FLEET_AUTOPILOT_HOLDS, reason=reason)
+
+
+def record_fleet_knob(knob: str, value: float) -> None:
+    """Seed/refresh a knob gauge without an action (initial values)."""
+    if not _enabled():
+        return
+    get_registry().gauge_set(M_FLEET_KNOB, float(value), knob=knob)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,258 @@
+"""Closed-loop SLO autopilot (fleet/autopilot.py, ISSUE 19): the
+rule policy on synthetic windows, and every clause of the structural
+anti-oscillation contract — hysteresis, per-knob cooldown, direction
+reversal suppression, fault hold, one action per window — plus the
+find_oscillations checker that the fleet gate runs on action logs."""
+
+import pytest
+
+from magiattention_tpu.fleet.autopilot import (
+    Autopilot,
+    KnobSpec,
+    SLOTargets,
+    default_knob_specs,
+    find_oscillations,
+)
+from magiattention_tpu.telemetry.collectors import (
+    M_FLEET_SLO_ATTAINMENT,
+    M_KVCACHE_FREE,
+    M_SCHED_BUDGET_UTIL,
+    M_SCHED_QUEUE_DEPTH,
+    M_TIER_FAULTS,
+)
+
+
+def window(
+    attainment=1.0, util=0.0, queue=0.0, free=None, faults=0.0
+):
+    """A synthetic snapshot_delta window with just the series the
+    controller reads."""
+    gauges = {
+        M_FLEET_SLO_ATTAINMENT: attainment,
+        M_SCHED_BUDGET_UTIL: util,
+        M_SCHED_QUEUE_DEPTH: queue,
+    }
+    if free is not None:
+        gauges[M_KVCACHE_FREE] = free
+    counters = {}
+    if faults:
+        counters[M_TIER_FAULTS + "{tier=decode}"] = faults
+    return {"counters": counters, "gauges": gauges}
+
+
+def pilot(**kw):
+    kw.setdefault("cooldown_windows", 3)
+    return Autopilot(
+        SLOTargets(ttft_p99_ticks=16, toklat_p99_ticks=8,
+                   attainment_target=0.9),
+        mode="tiered",
+        **kw,
+    )
+
+
+CURRENT = {
+    "decode_budget": 32, "prefill_budget": 64,
+    "admission_watermark": 0, "__num_pages": 256,
+}
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+
+
+def test_slo_targets_validate():
+    with pytest.raises(ValueError, match="must be positive"):
+        SLOTargets(ttft_p99_ticks=0)
+    with pytest.raises(ValueError, match="attainment_target"):
+        SLOTargets(attainment_target=1.5)
+    slo = SLOTargets(ttft_p99_ticks=16, toklat_p99_ticks=8)
+    assert slo.met_by(16.0, 8.0)
+    assert not slo.met_by(16.1, 8.0)
+    assert not slo.met_by(16.0, 8.1)
+
+
+def test_knob_spec_validates_and_clamps():
+    with pytest.raises(ValueError, match="outside"):
+        KnobSpec("k", lo=0, hi=10, step=1, default=99)
+    with pytest.raises(ValueError, match="step"):
+        KnobSpec("k", lo=0, hi=10, step=0, default=5)
+    s = KnobSpec("k", lo=0, hi=10, step=4, default=0)
+    assert s.clamp(12) == 10
+    assert s.clamp(-3) == 0
+
+
+def test_default_knob_specs_by_mode():
+    tiered = {s.name for s in default_knob_specs("tiered")}
+    assert tiered == {
+        "decode_budget", "prefill_budget", "admission_watermark"
+    }
+    single = {s.name for s in default_knob_specs("single")}
+    assert single == {"token_budget", "admission_watermark"}
+    with pytest.raises(ValueError, match="unknown scheduler mode"):
+        default_knob_specs("hybrid")
+
+
+# ---------------------------------------------------------------------------
+# the policy
+# ---------------------------------------------------------------------------
+
+
+def test_steady_fleet_is_never_touched():
+    ap = pilot()
+    for _ in range(6):
+        d = ap.evaluate(window(attainment=0.95), current=dict(CURRENT))
+        assert not d.acted
+        assert ("*", "steady") in d.holds
+    assert ap.actions_taken == []
+
+
+def test_under_slo_saturated_scales_first_budget_knob():
+    ap = pilot()
+    d = ap.evaluate(
+        window(attainment=0.5, util=0.95, queue=4), current=dict(CURRENT)
+    )
+    assert d.actions == {"decode_budget": 32 + 16}
+
+
+def test_page_pressure_raises_admission_watermark():
+    ap = pilot()
+    # under SLO, NOT budget-saturated, but the page pool is nearly dry:
+    # the watermark (the only pressure-triggered knob) must move
+    d = ap.evaluate(
+        window(attainment=0.5, util=0.2, queue=0, free=10),
+        current=dict(CURRENT),
+    )
+    assert d.actions == {"admission_watermark": 2}
+
+
+def test_comfortable_fleet_relaxes_toward_defaults():
+    ap = pilot()
+    cur = dict(CURRENT, decode_budget=96)
+    d = ap.evaluate(window(attainment=1.0, util=0.1), current=cur)
+    assert d.actions == {"decode_budget": 96 - 16}
+
+
+def test_one_action_per_window():
+    ap = pilot()
+    d = ap.evaluate(
+        window(attainment=0.3, util=0.95, queue=9), current=dict(CURRENT)
+    )
+    assert len(d.actions) == 1
+
+
+def test_convergence_to_steady_state():
+    """Persistent saturation: the controller walks the budgets up in
+    bounded steps, and once the (synthetic) fleet recovers it goes
+    quiet — no further actions for the rest of the run."""
+    ap = pilot(cooldown_windows=2)
+    cur = dict(CURRENT)
+    recovery_at = 6
+    for w in range(16):
+        if w < recovery_at:
+            win = window(attainment=0.5, util=0.95, queue=4)
+        else:
+            win = window(attainment=0.95, util=0.6)
+        d = ap.evaluate(win, current=dict(cur))
+        for k, v in d.actions.items():
+            cur[k] = v
+    acted_windows = [w for w, _, _ in ap.actions_taken]
+    assert acted_windows, "saturation must trigger scaling"
+    assert max(acted_windows) < recovery_at + 1
+    # steady tail: every post-recovery window held
+    tail = [d for d in ap.history if d.window > recovery_at]
+    assert tail and all(not d.acted for d in tail)
+    # and the walk itself obeys the contract
+    assert find_oscillations(ap.actions_taken, cooldown_windows=2) == []
+
+
+# ---------------------------------------------------------------------------
+# the anti-oscillation contract
+# ---------------------------------------------------------------------------
+
+
+def test_cooldown_freezes_a_moved_knob():
+    ap = pilot(cooldown_windows=3)
+    cur = dict(CURRENT)
+    hot = window(attainment=0.5, util=0.95, queue=4)
+    d0 = ap.evaluate(hot, current=dict(cur))
+    assert "decode_budget" in d0.actions
+    cur.update(d0.actions)
+    # next two windows: decode_budget frozen; other knobs may act once
+    for _ in range(2):
+        d = ap.evaluate(hot, current=dict(cur))
+        assert "decode_budget" not in d.actions
+        cur.update(d.actions)
+    moves = [w for w, k, _ in ap.actions_taken if k == "decode_budget"]
+    assert moves == [0]
+
+
+def test_reversal_suppression_blocks_direction_flip():
+    ap = pilot(cooldown_windows=2)
+    cur = dict(CURRENT)
+    d0 = ap.evaluate(
+        window(attainment=0.5, util=0.95, queue=4), current=dict(cur)
+    )
+    assert d0.actions == {"decode_budget": 48}
+    cur.update(d0.actions)
+    # cooldown expires after 2 windows, but a DOWN move (comfortable
+    # fleet) within 2*cooldown of the UP move must be suppressed
+    for _ in range(2):
+        d = ap.evaluate(window(attainment=0.95), current=dict(cur))
+        assert not d.acted
+    d3 = ap.evaluate(window(attainment=1.0, util=0.1), current=dict(cur))
+    assert "decode_budget" not in d3.actions
+    assert ("decode_budget", "reversal") in d3.holds
+
+
+def test_fault_window_is_never_acted_on():
+    ap = pilot()
+    d = ap.evaluate(
+        window(attainment=0.2, util=0.99, queue=20, faults=2.0),
+        current=dict(CURRENT),
+    )
+    assert not d.acted
+    assert d.holds == (("*", "fault"),)
+    assert d.facts["tier_faults"] == 2.0
+
+
+def test_bounds_hold_at_knob_ceiling():
+    ap = pilot()
+    cur = dict(CURRENT, decode_budget=512, prefill_budget=1024)
+    d = ap.evaluate(
+        window(attainment=0.5, util=0.95, queue=4), current=cur
+    )
+    assert "decode_budget" not in d.actions
+    assert ("decode_budget", "bounds") in d.holds
+
+
+# ---------------------------------------------------------------------------
+# find_oscillations (the gate's checker)
+# ---------------------------------------------------------------------------
+
+
+def test_find_oscillations_clean_log():
+    log = [(0, "decode_budget", 48.0), (3, "decode_budget", 64.0),
+           (1, "prefill_budget", 96.0)]
+    assert find_oscillations(log, cooldown_windows=3) == []
+
+
+def test_find_oscillations_flags_cooldown_violation():
+    log = [(0, "decode_budget", 48.0), (1, "decode_budget", 64.0)]
+    errs = find_oscillations(log, cooldown_windows=3)
+    assert len(errs) == 1
+    assert "1 windows apart" in errs[0]
+
+
+def test_find_oscillations_flags_limit_cycle():
+    # the classic up/down/up limit cycle, spaced wide enough to clear
+    # the per-knob cooldown but not the 2x reversal span
+    log = [(0, "decode_budget", 48.0), (3, "decode_budget", 32.0),
+           (6, "decode_budget", 48.0)]
+    errs = find_oscillations(log, cooldown_windows=3)
+    assert any("reversal" in e for e in errs)
+
+
+def test_find_oscillations_validates_cooldown():
+    with pytest.raises(ValueError, match="cooldown_windows"):
+        find_oscillations([], cooldown_windows=0)

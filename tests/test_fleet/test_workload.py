@@ -1,0 +1,186 @@
+"""Fleet trace generators (fleet/workload.py, ISSUE 19): seeded
+determinism, JSON round-trip, arrival-kind shapes, prefix sharing, and
+the structural lint."""
+
+import json
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.fleet.workload import (
+    FLEET_TRACE_FORMAT,
+    FleetTrace,
+    TraceRequest,
+    generate_trace,
+    scale_rate,
+    validate_trace,
+)
+
+
+# ---------------------------------------------------------------------------
+# determinism + serialization
+# ---------------------------------------------------------------------------
+
+
+def test_same_seed_same_trace():
+    kw = dict(seed=7, horizon_ticks=48, arrival="mmpp", rate=1.0)
+    a = generate_trace("det", **kw)
+    b = generate_trace("det", **kw)
+    assert a.to_json() == b.to_json()
+    assert a.num_requests > 0
+
+
+def test_different_seed_different_trace():
+    a = generate_trace("det", seed=1, horizon_ticks=48)
+    b = generate_trace("det", seed=2, horizon_ticks=48)
+    assert a.to_json() != b.to_json()
+
+
+def test_json_roundtrip_exact(tmp_path):
+    trace = generate_trace(
+        "rt", seed=11, horizon_ticks=32, arrival="diurnal", rate=2.0,
+        priority_levels=3,
+    )
+    p = tmp_path / "trace.json"
+    trace.save(p)
+    loaded = FleetTrace.load(p)
+    assert loaded == trace
+    # and the artifact is honest JSON with the format tag
+    d = json.loads(p.read_text())
+    assert d["format"] == FLEET_TRACE_FORMAT
+
+
+def test_from_json_rejects_wrong_format():
+    trace = generate_trace("fmt", seed=1, horizon_ticks=8)
+    d = trace.to_json()
+    d["format"] = "something-else/v9"
+    with pytest.raises(ValueError, match="not a fleet trace"):
+        FleetTrace.from_json(d)
+
+
+def test_request_roundtrip_defaults():
+    r = TraceRequest(rid=3, arrival_tick=5, prompt_tokens=(1, 2, 3),
+                     output_len=4)
+    d = r.to_json()
+    del d["priority"], d["prefix_id"]
+    r2 = TraceRequest.from_json(d)
+    assert r2 == r
+
+
+# ---------------------------------------------------------------------------
+# generator shapes
+# ---------------------------------------------------------------------------
+
+
+def test_poisson_rate_is_roughly_kept():
+    trace = generate_trace(
+        "p", seed=3, horizon_ticks=400, arrival="poisson", rate=2.0
+    )
+    mean = trace.num_requests / trace.horizon_ticks
+    assert 1.5 < mean < 2.5
+
+
+def test_mmpp_bursts_exceed_calm_rate():
+    trace = generate_trace(
+        "b", seed=5, horizon_ticks=400, arrival="mmpp", rate=0.5,
+        burst_rate=12.0, burst_prob=0.05, calm_prob=0.2,
+    )
+    counts = trace.offered_per_tick()
+    # the burst state must actually show up: some ticks far beyond
+    # anything a rate-0.5 Poisson plausibly produces
+    assert int(counts.max()) >= 6
+    assert trace.meta["burst_rate"] == 12.0
+
+
+def test_diurnal_peak_vs_trough():
+    trace = generate_trace(
+        "d", seed=9, horizon_ticks=256, arrival="diurnal", rate=4.0,
+        diurnal_period=128, diurnal_amplitude=0.8,
+    )
+    counts = trace.offered_per_tick().astype(np.float64)
+    # first quarter of each period is the sinusoid's peak; third
+    # quarter the trough
+    peak = counts[0:32].mean() + counts[128:160].mean()
+    trough = counts[64:96].mean() + counts[192:224].mean()
+    assert peak > 1.5 * trough
+
+
+def test_shared_prefixes_are_page_aligned_and_zipf_headed():
+    trace = generate_trace(
+        "z", seed=13, horizon_ticks=200, rate=2.0, page_size=8,
+        prefix_pool=8, prefix_pages=2, shared_fraction=0.8,
+        zipf_alpha=1.3,
+    )
+    shared = [r for r in trace.requests if r.prefix_id >= 0]
+    assert len(shared) > 0.6 * trace.num_requests
+    by_pid: dict[int, list[TraceRequest]] = {}
+    for r in shared:
+        assert len(r.prompt_tokens) > 16  # extends past the prefix
+        by_pid.setdefault(r.prefix_id, []).append(r)
+    # every request of one prefix_id shares the identical 16-token head
+    for rs in by_pid.values():
+        heads = {r.prompt_tokens[:16] for r in rs}
+        assert len(heads) == 1
+    # zipf head: rank 0 is the most popular prompt
+    sizes = sorted(
+        ((len(v), k) for k, v in by_pid.items()), reverse=True
+    )
+    assert sizes[0][1] == 0
+
+
+def test_output_lengths_clipped_to_max():
+    trace = generate_trace(
+        "o", seed=17, horizon_ticks=100, rate=2.0,
+        output_len_median=4.0, output_len_sigma=1.0, output_len_max=16,
+    )
+    outs = [r.output_len for r in trace.requests]
+    assert min(outs) >= 1
+    assert max(outs) <= 16
+
+
+def test_unknown_arrival_kind_raises():
+    with pytest.raises(ValueError, match="unknown arrival kind"):
+        generate_trace("x", seed=1, horizon_ticks=8, arrival="weibull")
+
+
+def test_bad_shared_fraction_raises():
+    with pytest.raises(ValueError, match="shared_fraction"):
+        generate_trace("x", seed=1, horizon_ticks=8, shared_fraction=1.5)
+
+
+# ---------------------------------------------------------------------------
+# scale_rate + lint
+# ---------------------------------------------------------------------------
+
+
+def test_scale_rate_rescales_burst_proportionally():
+    kw = {"rate": 2.0, "burst_rate": 16.0, "seed": 1}
+    out = scale_rate(kw, 4.0)
+    assert out["rate"] == 4.0
+    assert out["burst_rate"] == 32.0
+    assert kw["rate"] == 2.0  # original untouched
+
+
+def test_generated_traces_pass_lint():
+    for kind in ("poisson", "mmpp", "diurnal"):
+        trace = generate_trace(
+            f"lint-{kind}", seed=21, horizon_ticks=64, arrival=kind,
+            rate=1.5,
+        )
+        assert validate_trace(trace) == []
+
+
+def test_lint_flags_structural_problems():
+    base = generate_trace("lint", seed=1, horizon_ticks=16, rate=1.0)
+    bad = FleetTrace(
+        name="bad", seed=1, horizon_ticks=16, page_size=8,
+        requests=base.requests[:1] + (
+            TraceRequest(rid=base.requests[0].rid, arrival_tick=99,
+                         prompt_tokens=(), output_len=0),
+        ),
+    )
+    errs = validate_trace(bad)
+    assert any("duplicate rid" in e for e in errs)
+    assert any("arrival_tick" in e for e in errs)
+    assert any("output_len" in e for e in errs)
+    assert any("empty prompt" in e for e in errs)

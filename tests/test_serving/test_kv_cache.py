@@ -214,8 +214,9 @@ def test_assign_block_table_keep_len_int_semantics(ps):
     c6 = assign_block_table(cache, 0, pages, keep_len=True)
     assert int(c6.seq_lens[0]) == 3 * ps
 
-    # claiming past the installed pages' capacity is rejected
-    with pytest.raises(AssertionError):
+    # claiming past the installed pages' capacity is rejected with a
+    # typed, shape-carrying error (ISSUE 19 satellite)
+    with pytest.raises(ValueError, match="keep_len"):
         assign_block_table(cache, 0, pages[:1], keep_len=ps + 1)
 
 
@@ -256,3 +257,18 @@ def test_full_slot_append_is_dropped_not_wrapped():
     )
     assert int(cache.seq_lens[0]) == ps  # saturated, not grown
     np.testing.assert_array_equal(np.asarray(cache.k_pages[0]), page0_before)
+
+
+def test_make_cache_rejects_unaligned_page_size():
+    """ISSUE 19 satellite: a page_size off the TPU sublane multiple is a
+    typed ValueError carrying the offending value, not a bare assert."""
+    with pytest.raises(ValueError, match="page_size 12 must be a multiple"):
+        make_paged_kv_cache(4, 12, 2, 16, max_seqs=2)
+
+
+def test_assign_block_table_overflow_is_typed_value_error():
+    """ISSUE 19 satellite: installing more pages than the block-table
+    row holds raises a ValueError naming the slot and both sizes."""
+    cache = make_paged_kv_cache(8, 8, 2, 16, max_seqs=2, max_pages_per_seq=2)
+    with pytest.raises(ValueError, match="slot 1 would overflow: 3 pages"):
+        assign_block_table(cache, 1, [1, 2, 3])

@@ -405,3 +405,56 @@ def test_cascade_auto_engages_only_with_real_groups():
         assert snap["gauges"]["magi_decode_num_splits"] == 0  # per-phase
     finally:
         telemetry.set_enabled(None)
+
+
+# ---------------------------------------------------------------------------
+# cascade-group validation: typed errors (ISSUE 19 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_cascade_overlapping_groups_raise_named_value_error():
+    """A batch position claimed by two CascadeGroups must raise a typed
+    ValueError that names the duplicated positions and their groups —
+    never a bare assert."""
+    from magiattention_tpu.serving import CascadeGroup, cascade_decode_attn
+    from magiattention_tpu.serving.kv_cache import (
+        assign_block_table, make_paged_kv_cache,
+    )
+
+    cache = make_paged_kv_cache(
+        8, PS, HK, D, max_seqs=4, max_pages_per_seq=4, dtype=jnp.float32
+    )
+    for slot in range(3):
+        cache = assign_block_table(cache, slot, [1 + slot], keep_len=PS)
+    q = jnp.zeros((3, HQ, D), jnp.float32)
+    groups = [
+        CascadeGroup(shared_pages=(1,), prefix_len=PS, members=(0, 1)),
+        CascadeGroup(shared_pages=(2,), prefix_len=PS, members=(1, 2)),
+    ]
+    with pytest.raises(ValueError, match=r"overlapping cascade groups.*\[1\]"):
+        cascade_decode_attn(q, cache, np.arange(3), groups)
+
+
+def test_cascade_misaligned_prefix_raises_value_error():
+    """prefix_len not equal to len(shared_pages) * page_size (or zero
+    shared pages) must raise a ValueError naming the group and the
+    page-size arithmetic."""
+    from magiattention_tpu.serving import CascadeGroup, cascade_decode_attn
+    from magiattention_tpu.serving.kv_cache import (
+        assign_block_table, make_paged_kv_cache,
+    )
+
+    cache = make_paged_kv_cache(
+        8, PS, HK, D, max_seqs=4, max_pages_per_seq=4, dtype=jnp.float32
+    )
+    for slot in range(2):
+        cache = assign_block_table(cache, slot, [1, 2], keep_len=2 * PS)
+    q = jnp.zeros((2, HQ, D), jnp.float32)
+    bad_len = CascadeGroup(
+        shared_pages=(1,), prefix_len=PS + 3, members=(0, 1)
+    )
+    with pytest.raises(ValueError, match="misaligned cascade group"):
+        cascade_decode_attn(q, cache, np.arange(2), [bad_len])
+    no_pages = CascadeGroup(shared_pages=(), prefix_len=0, members=(0, 1))
+    with pytest.raises(ValueError, match="misaligned cascade group"):
+        cascade_decode_attn(q, cache, np.arange(2), [no_pages])
