@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze spmd-audit lifecycle-check resilience-check roofline-check roofline-report trace-check distserve-check memory-check compile-check tick-check numerics-check fleet-check check
+.PHONY: install test test-fast test-slow lint typecheck bench-plan telemetry-check autotune-check perf-gate timeline-demo serving-check sched-check decode-bench comm-check analyze spmd-audit lifecycle-check resilience-check roofline-check roofline-report trace-check distserve-check memory-check compile-check tick-check numerics-check fleet-check plan-reuse-check check
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -224,6 +224,18 @@ numerics-check:
 fleet-check:
 	JAX_PLATFORMS=cpu $(PY) exps/run_fleet_check.py --self-test
 
+# plan-reuse gate (ISSUE 20; CPU): fingerprint-bucketed plan reuse —
+# bucketed-adapter parity (fwd+grad, jnp AND pallas-interpret backends,
+# both the fingerprint-miss and bucket-hit flavors), exact-hit identity
+# (the exact LRU stays byte-for-byte in front of the fingerprint cache),
+# a zipf fleet replay through the real Scheduler clearing >= 90%
+# plan-cache hit rate with positive solver-ms-saved and live bucket/
+# incremental engagement, and --self-test proof that one stolen REAL
+# dispatch row trips the parity oracle
+plan-reuse-check:
+	JAX_PLATFORMS=cpu $(PY) exps/run_plan_reuse_check.py --self-test
+	JAX_PLATFORMS=cpu $(PY) exps/run_plan_reuse_check.py
+
 # mask-aware roofline report + occupancy JSON artifact for the 16k
 # varlen block-causal headline (docs/observability.md "Roofline &
 # occupancy"); host-side only
@@ -236,5 +248,5 @@ roofline-report:
 # parity/volume, resilience gate, roofline/occupancy gate, request
 # tracing/exposition gate, disaggregated-serving gate, memory
 # observability gate, unified-tick gate, numerics observability gate,
-# fleet simulator + autopilot gate — all CPU-safe
-check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check distserve-check memory-check compile-check tick-check numerics-check fleet-check
+# fleet simulator + autopilot gate, plan-reuse gate — all CPU-safe
+check: lint analyze telemetry-check autotune-check perf-gate serving-check sched-check comm-check resilience-check roofline-check trace-check distserve-check memory-check compile-check tick-check numerics-check fleet-check plan-reuse-check

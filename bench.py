@@ -858,6 +858,29 @@ def _measure_extras(dt_fwd_64k: float) -> dict:
         )
     except Exception as e:  # never lose sections 1-5 to the probe
         print(f"extras: unified tick probe failed: {e!r}", file=sys.stderr)
+
+    # 7. plan-reuse scorecard (ISSUE 20): the fleet-replayed plan-cache
+    #    hit rate + solver-ms-saved the plan-reuse gate bounds, recorded
+    #    into history so run_perf_gate.py watches the same numbers drift.
+    #    Host-side planning only; guarded like sections 4-6.
+    try:
+        from exps.run_plan_reuse_check import fleet_probe
+
+        p = fleet_probe()
+        extras["flex_attn_plan_cache_hit_rate"] = p[
+            "flex_attn_plan_cache_hit_rate"
+        ]
+        extras["flex_attn_plan_solver_ms_saved"] = p[
+            "flex_attn_plan_solver_ms_saved"
+        ]
+        print(
+            "extras: plan reuse hit rate "
+            f"{p['flex_attn_plan_cache_hit_rate']} "
+            f"({p['flex_attn_plan_solver_ms_saved']} ms saved)",
+            file=sys.stderr,
+        )
+    except Exception as e:  # never lose sections 1-6 to the probe
+        print(f"extras: plan-reuse probe failed: {e!r}", file=sys.stderr)
     return extras
 
 

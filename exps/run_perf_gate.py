@@ -69,6 +69,10 @@ def _gated_metric(name: str) -> bool:
         "tflops" in name
         or "comm_volume" in name
         or "step_reduction" in name
+        # ISSUE 20: the fleet-replayed plan-reuse scorecard — hit rate
+        # and solver-ms-saved are higher-is-better like TF/s
+        or "plan_cache_hit_rate" in name
+        or "plan_solver_ms_saved" in name
     )
 
 

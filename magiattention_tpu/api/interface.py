@@ -32,6 +32,13 @@ from .. import env, telemetry
 from ..common.enum import AttnMaskType
 from ..common.ranges import AttnRanges
 from ..meta.dispatch_meta import DispatchMeta, make_dispatch_meta_from_qk_ranges
+from ..meta.plan_fingerprint import (
+    PlanReuseCache,
+    ReuseEntry,
+    canonicalize_mask,
+    make_plan_fingerprint,
+    try_incremental_update,
+)
 from ..meta.solver.dispatch_solver import DispatchConfig
 from ..parallel.dist_attn import (
     DistAttnPlan,
@@ -296,6 +303,70 @@ class DistAttnRuntimeMgr:
         return out, AttnForwardMeta(lse=lse, max_logits=max_logits)
 
 
+class BucketedDistAttnRuntimeMgr(DistAttnRuntimeMgr):
+    """Adapter runtime serving a request-shaped mask off a CANONICAL
+    (bucket-padded) plan (ISSUE 20, fingerprint-bucketed plan reuse).
+
+    Shares the canonical mgr's dispatch meta, plan, and jitted attn_fn —
+    zero solver/trace work per served request. Only the three
+    data-movement surfaces are overridden, each one gather built from the
+    canonical<->real row maps:
+
+    - ``dispatch``: a single ``take(..., mode="fill")`` from the REAL
+      (unpadded) global tensor straight into the canonical dispatched
+      layout. Every pad class — the request's chunk pad, the bucket pad,
+      uneven-shard physical slots — is an out-of-range index the fill mode
+      materializes as ``pad_value``; no pre-padding pass.
+    - ``undispatch``: plain gather of the real rows back out (its
+      transpose scatter-adds, dropping pad cotangents — gradients flow).
+    - ``get_position_ids``: canonical position table with pad slots at 0.
+
+    NOTE the dispatched shapes are the CANONICAL ones (>= the request's
+    ``key.total_seqlen_q``); size buffers off the dispatch output, not the
+    key fields. ``roll`` and the after-dispatch re-key entry points reject
+    bucketed keys with typed errors: both reason in request coordinates,
+    which the bucketed layout does not preserve globally.
+    """
+
+    def __init__(
+        self,
+        key: DistAttnRuntimeKey,
+        canonical_mgr: DistAttnRuntimeMgr,
+        dispatch_idx: np.ndarray,
+        undispatch_idx: np.ndarray,
+        position_ids: np.ndarray,
+    ):
+        super().__init__(
+            key,
+            canonical_mgr.mesh,
+            canonical_mgr.dispatch_meta,
+            canonical_mgr.plan,
+            canonical_mgr._attn_fn,
+            dist_attn_config=canonical_mgr.dist_attn_config,
+        )
+        self.canonical_key = canonical_mgr.key
+        self._bucket_dispatch_idx = np.asarray(dispatch_idx, np.int32)
+        self._bucket_undispatch_idx = np.asarray(undispatch_idx, np.int32)
+        self._bucket_position_ids = np.asarray(position_ids, np.int32)
+
+    def dispatch(self, x: jax.Array, pad_value: float = 0.0) -> jax.Array:
+        # x is the REAL [total_real, ...] tensor — every pad slot is an
+        # out-of-range source index the fill mode resolves to pad_value
+        return jnp.take(
+            x,
+            jnp.asarray(self._bucket_dispatch_idx),
+            axis=0,
+            mode="fill",
+            fill_value=pad_value,
+        )
+
+    def undispatch(self, y: jax.Array) -> jax.Array:
+        return jnp.take(y, jnp.asarray(self._bucket_undispatch_idx), axis=0)
+
+    def get_position_ids(self) -> jax.Array:
+        return jnp.asarray(self._bucket_position_ids)
+
+
 class DistAttnRuntimeDict:
     """LRU key -> mgr cache (reference DistAttnRuntimeDict :410-449 +
     the manager interface of DistAttnRuntimeDictManager,
@@ -331,6 +402,7 @@ class DistAttnRuntimeDict:
         self._d.move_to_end(key)
         while len(self._d) > self.maxsize:
             self._d.popitem(last=False)
+            telemetry.record_plan_cache_eviction(cache="runtime")
 
     def __getitem__(self, key: DistAttnRuntimeKey) -> DistAttnRuntimeMgr:
         mgr = self.get(key)
@@ -367,6 +439,17 @@ _runtime_dict = DistAttnRuntimeDict(maxsize=env.runtime_dict_size())
 DistAttnRuntimeDictManager = DistAttnRuntimeDict
 dist_attn_runtime_dict_mgr = _runtime_dict
 _most_recent_key: Optional[DistAttnRuntimeKey] = None
+
+# -- fingerprint-bucketed plan reuse (ISSUE 20) ---------------------------
+# second-level cache consulted between an exact-key LRU miss and the cold
+# solver: PlanFingerprint -> the canonical key whose planned runtime can
+# serve every mask in the bucket through a row-map adapter
+_plan_reuse_cache = PlanReuseCache()
+# reentrancy guard: while resolving a canonical mask we are INSIDE one
+# logical cache miss — the nested magi_attn_flex_key call must not record
+# a second interface-level cache access (its cold build still records
+# record_plan_solver, pricing the ms-saved credit)
+_in_canonical_resolve = False
 
 
 def _resolve_overlap_config(oc, hq, hkv, head_dim, *, hier: bool = False):
@@ -440,6 +523,145 @@ def get_most_recent_key() -> DistAttnRuntimeKey:
     HF-integration hook where the attention module can't thread the key)."""
     assert _most_recent_key is not None, "no key has been created yet"
     return _most_recent_key
+
+
+def _make_bucketed_mgr(
+    key: DistAttnRuntimeKey, canonical_mgr: DistAttnRuntimeMgr, maps
+) -> BucketedDistAttnRuntimeMgr:
+    """Build the request->canonical adapter runtime: three index tables
+    composed host-side from the canonical dispatch meta + row maps."""
+    from ..parallel.dispatch import (
+        padded_dispatch_indices,
+        padded_position_ids,
+        padded_undispatch_indices,
+    )
+
+    meta = canonical_mgr.dispatch_meta
+    real_total = key.total_seqlen_q - key.pad_size
+    return BucketedDistAttnRuntimeMgr(
+        key,
+        canonical_mgr,
+        padded_dispatch_indices(meta, maps.canon_to_real, real_total),
+        padded_undispatch_indices(meta, maps.real_to_canon),
+        padded_position_ids(meta, maps.canon_to_real),
+    )
+
+
+def _try_plan_reuse(
+    key: DistAttnRuntimeKey,
+    t_lookup: float,
+    *,
+    mesh,
+    sink,
+    out_dtype,
+    dispatch_config,
+    dist_attn_config,
+    interpret,
+) -> Optional[DistAttnRuntimeKey]:
+    """Fingerprint-bucketed second-level lookup (ISSUE 20).
+
+    Called only after an exact-key LRU miss — exact hits stay byte-for-byte
+    identical to the reuse-off path. Returns the exact key with a bucketed
+    adapter runtime installed, either from a live canonical plan (bucket
+    hit: zero solver work, O(total) — or on a pure tail extend O(delta) —
+    row-map work) or after cold-solving the canonical mask once
+    (fingerprint miss: one solve now serves the whole bucket). Returns
+    ``None`` when reuse is off or inapplicable; the caller then records
+    the miss and runs the ordinary cold path.
+    """
+    global _in_canonical_resolve
+    if _in_canonical_resolve or env.plan_reuse_mode() != "bucket":
+        return None
+    if env.is_qo_comm_enable():
+        # qo-comm plans a dynamic plane partition exact to the mask —
+        # there is no static bucketed dispatch table to adapt onto
+        return None
+    real_total = key.total_seqlen_q - key.pad_size
+    canon = canonicalize_mask(
+        key.q_ranges, key.k_ranges, key.attn_type_map, real_total
+    )
+    if canon is None:
+        # unbucketable structure, or already exactly on bucket boundaries —
+        # the exact LRU is the right (and only) cache for this mask
+        return None
+    new_sig = (key.q_ranges, key.k_ranges, key.attn_type_map, real_total)
+    fp = make_plan_fingerprint(
+        canon,
+        chunk_size=key.chunk_size,
+        cp_size=key.cp_size,
+        cp_axis=key.cp_axis,
+        num_heads_q=key.num_heads_q,
+        num_heads_kv=key.num_heads_kv,
+        head_dim=key.head_dim,
+        softcap=key.softcap,
+        has_sink=key.has_sink,
+        sink_fingerprint=key.sink_fingerprint,
+        out_dtype=key.out_dtype,
+        dispatch_config_repr=key.dispatch_config_repr,
+        interpret=key.interpret,
+        mesh_id=key.mesh_id,
+        flags=key.flags,
+    )
+    entry = _plan_reuse_cache.get(fp)
+    canonical_mgr = (
+        _runtime_dict.get(entry.canonical_key) if entry is not None else None
+    )
+    if canonical_mgr is not None:
+        # bucket hit: the canonical plan is live — no solver, no retrace
+        maps = None
+        if entry.last_sig is not None and entry.last_maps is not None:
+            if try_incremental_update(
+                entry.last_sig, new_sig, entry.last_maps
+            ):
+                maps = entry.last_maps
+                telemetry.record_plan_incremental(patched=True)
+            else:
+                telemetry.record_plan_incremental(patched=False)
+        if maps is None:
+            maps = canon.build_row_maps()
+        mgr = _make_bucketed_mgr(key, canonical_mgr, maps)
+        _runtime_dict.put(key, mgr)
+        entry.last_sig = new_sig
+        entry.last_maps = maps
+        telemetry.record_cache_access(hit=True)
+        telemetry.record_plan_solver(
+            time.perf_counter() - t_lookup, cache_hit=True
+        )
+        telemetry.record_plan_bucket(hit=True)
+        return key
+    # fingerprint miss (or the canonical runtime was LRU-evicted): cold-
+    # solve the CANONICAL mask once, then adapt this request onto it
+    telemetry.record_cache_access(hit=False)
+    telemetry.record_plan_bucket(hit=False)
+    _in_canonical_resolve = True
+    try:
+        canonical_key = magi_attn_flex_key(
+            canon.q_ranges,
+            canon.k_ranges,
+            canon.attn_type_map,
+            canon.total_seqlen,
+            canon.total_seqlen,
+            mesh,
+            num_heads=(key.num_heads_q, key.num_heads_kv),
+            head_dim=key.head_dim,
+            cp_axis=key.cp_axis,
+            chunk_size=key.chunk_size,
+            softcap=key.softcap,
+            has_sink=key.has_sink,
+            sink=sink,
+            out_dtype=out_dtype,
+            dispatch_config=dispatch_config,
+            dist_attn_config=dist_attn_config,
+            interpret=interpret,
+        )
+    finally:
+        _in_canonical_resolve = False
+    canonical_mgr = _runtime_dict[canonical_key]
+    maps = canon.build_row_maps()
+    mgr = _make_bucketed_mgr(key, canonical_mgr, maps)
+    _runtime_dict.put(key, mgr)
+    _plan_reuse_cache.put(fp, ReuseEntry(canonical_key, new_sig, maps))
+    return key
 
 
 def magi_attn_flex_key(
@@ -614,15 +836,32 @@ def magi_attn_flex_key(
     )
     _t_lookup = time.perf_counter()
     if key in _runtime_dict:
-        telemetry.record_cache_access(hit=True)
-        # ISSUE 16: the hit's solver cost is the lookup itself; the
-        # ms-saved credit is priced against the measured build mean
-        telemetry.record_plan_solver(
-            time.perf_counter() - _t_lookup, cache_hit=True
-        )
+        if not _in_canonical_resolve:
+            telemetry.record_cache_access(hit=True)
+            # ISSUE 16: the hit's solver cost is the lookup itself; the
+            # ms-saved credit is priced against the measured build mean
+            telemetry.record_plan_solver(
+                time.perf_counter() - _t_lookup, cache_hit=True
+            )
         _most_recent_key = key
         return key
-    telemetry.record_cache_access(hit=False)
+    # ISSUE 20: fingerprint-bucketed second-level lookup sits between the
+    # exact-key miss and the cold solver (exact hits above stay untouched)
+    reuse_key = _try_plan_reuse(
+        key,
+        _t_lookup,
+        mesh=mesh,
+        sink=sink,
+        out_dtype=out_dtype,
+        dispatch_config=dispatch_config,
+        dist_attn_config=dist_attn_config,
+        interpret=interpret,
+    )
+    if reuse_key is not None:
+        _most_recent_key = reuse_key
+        return reuse_key
+    if not _in_canonical_resolve:
+        telemetry.record_cache_access(hit=False)
 
     # cold path: full planning
     mq, _, bucket = make_dispatch_meta_from_qk_ranges(
@@ -1003,10 +1242,22 @@ def make_flex_key_for_new_mask_after_dispatch(
     """
     global _most_recent_key
     old_mgr = get_runtime_mgr(old_key)
-    assert not old_key.has_sink, (
-        "key reuse with an attention sink is not supported: re-key with "
-        "magi_attn_flex_key(sink=...) instead"
-    )
+    if old_key.has_sink:
+        raise ValueError(
+            "key reuse with an attention sink is not supported: re-key "
+            "with magi_attn_flex_key(sink=...) instead "
+            f"(old_key has sink_fingerprint={old_key.sink_fingerprint})"
+        )
+    if isinstance(old_mgr, BucketedDistAttnRuntimeMgr):
+        raise ValueError(
+            "key reuse after dispatch is not supported on a bucketed "
+            "(plan-reuse) key: its dispatch layout belongs to the "
+            "canonical plan "
+            f"(canonical total={old_mgr.dispatch_meta.total_seqlen}, "
+            f"request total={old_key.total_seqlen_q}), so a new mask in "
+            "request coordinates cannot be planned on it — create a fresh "
+            "key with magi_attn_flex_key"
+        )
     from ..parallel.qo_comm import QoCommPlan
 
     if isinstance(old_mgr.plan, QoCommPlan):
@@ -1280,9 +1531,11 @@ def clear_cache(mesh: "jax.sharding.Mesh | None" = None) -> None:
     global _most_recent_key
     if mesh is None:
         _runtime_dict.clear()
+        _plan_reuse_cache.clear()
         _most_recent_key = None
         return
     _runtime_dict.clear(mesh_id=id(mesh))
+    _plan_reuse_cache.clear(mesh_id=id(mesh))
     if _most_recent_key is not None and _most_recent_key.mesh_id == id(mesh):
         _most_recent_key = None
 
@@ -1298,6 +1551,15 @@ def roll(x: jax.Array, key: DistAttnRuntimeKey, shift: int, axis: int = 0):
     from ..parallel.dispatch import roll as _roll
 
     mgr = get_runtime_mgr(key)
+    if isinstance(mgr, BucketedDistAttnRuntimeMgr):
+        raise ValueError(
+            "roll is not supported on a bucketed (plan-reuse) key: the "
+            "shared canonical dispatch meta describes canonical "
+            f"coordinates (total={mgr.dispatch_meta.total_seqlen}), so a "
+            f"global roll of the request's {key.total_seqlen_q} rows "
+            "would shift through bucket-pad slots — undispatch, roll in "
+            "natural order, and re-dispatch instead"
+        )
     return _roll(
         x,
         mgr.dispatch_meta,

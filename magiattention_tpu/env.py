@@ -328,6 +328,50 @@ def runtime_dict_size() -> int:
     return _env_int("MAGI_ATTENTION_RUNTIME_DICT_SIZE", 100)
 
 
+PLAN_REUSE_MODES = ("off", "bucket")
+
+
+def plan_reuse_mode() -> str:
+    """Fingerprint-bucketed plan reuse (ISSUE 20, ``docs/plan_reuse.md``):
+
+    - ``off`` (default): every novel mask pays the full host solve —
+      today's behavior, bit-identical.
+    - ``bucket``: on an exact-key LRU miss, ``magi_attn_flex_key`` /
+      ``magi_attn_varlen_key`` canonicalize the mask to pow2-ish length
+      buckets and consult a fingerprint-keyed second-level cache; a hit
+      serves a padded-dispatch adapter over the bucketed plan instead of
+      re-solving.
+
+    Part of :func:`flags_fingerprint`: for the SAME runtime key the
+    served plan differs between modes (exact plan vs bucketed adapter),
+    so a mid-process flip must re-key rather than alias stale entries.
+    """
+    mode = _env_str("MAGI_ATTENTION_PLAN_REUSE", "off").lower()
+    if mode not in PLAN_REUSE_MODES:
+        raise ValueError(
+            f"MAGI_ATTENTION_PLAN_REUSE={mode!r} is not one of "
+            f"{PLAN_REUSE_MODES}"
+        )
+    return mode
+
+
+def plan_cache_size() -> int:
+    """Capacity of the fingerprint->canonical-plan second-level cache
+    (``meta/plan_fingerprint.PlanReuseCache``); defaults to the runtime
+    LRU capacity. Deliberately NOT part of :func:`flags_fingerprint`:
+    capacity only changes WHEN an entry is evicted (and re-solved),
+    never WHAT any plan contains — every plan is a pure function of its
+    key, so two processes with different capacities still serve
+    identical plans for identical keys."""
+    size = _env_int("MAGI_ATTENTION_PLAN_CACHE_SIZE", runtime_dict_size())
+    if size < 1:
+        raise ValueError(
+            f"MAGI_ATTENTION_PLAN_CACHE_SIZE={size} must be >= 1 (the "
+            "second-level plan cache cannot hold zero fingerprints)"
+        )
+    return size
+
+
 def kernel_backend() -> str:
     """'pallas' (TPU production), 'jnp' (any-platform dense reference
     path), or 'jnp_online' (block-wise online-softmax reference path)."""
@@ -799,4 +843,5 @@ def flags_fingerprint() -> tuple:
         chaos_spec(),
         unified_tick_mode(),
         numerics_mode(),
+        plan_reuse_mode(),
     )

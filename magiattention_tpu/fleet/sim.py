@@ -162,6 +162,7 @@ class FleetSimulator:
         chaos_ticks: dict[int, str] | None = None,
         max_ticks: int | None = None,
         manage_telemetry: bool = True,
+        plan_probe=None,
     ):
         from .. import env
 
@@ -195,6 +196,9 @@ class FleetSimulator:
             else 4 * trace.horizon_ticks + 256
         )
         self.manage_telemetry = bool(manage_telemetry)
+        # plan-reuse probe (ISSUE 20): attached to the scheduler so every
+        # replayed tick's request shapes resolve real runtime keys
+        self.plan_probe = plan_probe
 
     # -- stack construction (under the stub layer) -----------------------
 
@@ -218,6 +222,7 @@ class FleetSimulator:
                 chunk=self.chunk,
                 max_decode_batch=self.max_decode_batch,
                 clock=clock,
+                plan_probe=self.plan_probe,
             )
         else:
             from ..serving.distributed import TieredEngine, TieredScheduler
@@ -239,6 +244,7 @@ class FleetSimulator:
                 chunk=self.chunk,
                 max_decode_batch=self.max_decode_batch,
                 clock=clock,
+                plan_probe=self.plan_probe,
             )
         return sched, engine
 

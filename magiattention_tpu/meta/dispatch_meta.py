@@ -141,10 +141,12 @@ def make_global_bucket_from_qk_ranges(
 
     (reference _make_dispatch_meta.py:450 make_global_bucket_from_qk_ranges)
     """
-    assert total_seqlen_q % chunk_size == 0, (
-        f"total_seqlen_q {total_seqlen_q} must be a chunk_size {chunk_size} "
-        "multiple (apply padding first)"
-    )
+    if total_seqlen_q % chunk_size != 0:
+        raise ValueError(
+            f"total_seqlen_q {total_seqlen_q} must be a chunk_size "
+            f"{chunk_size} multiple (apply padding first; "
+            f"{len(q_ranges)} mask slices)"
+        )
     num_chunks = total_seqlen_q // chunk_size
     # sort slices by q start for deterministic per-chunk ordering
     order = sorted(
@@ -196,12 +198,23 @@ def _solve_q_partitions(
         )
     )
     solve_s = time.perf_counter() - t0
-    assert solution.bucket_partitions, (
-        f"{dispatch_config.alg.type} does not return partitions; "
-        "choose a partition-returning algorithm for dispatch"
-    )
+    if not solution.bucket_partitions:
+        raise ValueError(
+            f"{dispatch_config.alg.type} does not return partitions; "
+            "choose a partition-returning algorithm for dispatch "
+            f"({num_chunks} chunks over {cp_size} ranks)"
+        )
     partitions = [sorted(p) for p in solution.bucket_partitions]
-    assert sorted(x for p in partitions for x in p) == list(range(num_chunks))
+    covered = sorted(x for p in partitions for x in p)
+    if covered != list(range(num_chunks)):
+        raise ValueError(
+            f"dispatch solution does not cover every chunk exactly once: "
+            f"{cp_size} rank partitions cover {len(covered)} chunk slots "
+            f"of {num_chunks} chunks "
+            f"(alg={dispatch_config.alg.type}, "
+            f"missing={sorted(set(range(num_chunks)) - set(covered))[:8]}, "
+            f"dupes={sorted({x for x in covered if covered.count(x) > 1})[:8]})"
+        )
     if telemetry.enabled():  # keep the O(num_chunks) sums off the disabled path
         telemetry.record_dispatch_solution(
             dispatch_config.alg.type.value,
@@ -231,21 +244,29 @@ def make_cross_attn_dispatch_meta(
     if dispatch_config is None:
         dispatch_config = DispatchConfig()
     num_chunks_k = total_seqlen_k // chunk_size_k
-    assert total_seqlen_k % chunk_size_k == 0, (
-        f"total_seqlen_k {total_seqlen_k} must be a chunk_size_k "
-        f"{chunk_size_k} multiple"
-    )
-    assert num_chunks_k % cp_size == 0, (
-        f"k chunks {num_chunks_k} must be divisible by cp_size {cp_size}"
-    )
+    if total_seqlen_k % chunk_size_k != 0:
+        raise ValueError(
+            f"total_seqlen_k {total_seqlen_k} must be a chunk_size_k "
+            f"{chunk_size_k} multiple (apply k-side padding first)"
+        )
+    if num_chunks_k % cp_size != 0:
+        raise ValueError(
+            f"k chunks {num_chunks_k} (total_seqlen_k {total_seqlen_k} / "
+            f"chunk_size_k {chunk_size_k}) must be divisible by cp_size "
+            f"{cp_size}"
+        )
     num_chunks_q = total_seqlen_q // chunk_size_q
-    assert total_seqlen_q % chunk_size_q == 0, (
-        f"total_seqlen_q {total_seqlen_q} must be a chunk_size_q "
-        f"{chunk_size_q} multiple"
-    )
-    assert num_chunks_q % cp_size == 0, (
-        f"q chunks {num_chunks_q} must be divisible by cp_size {cp_size}"
-    )
+    if total_seqlen_q % chunk_size_q != 0:
+        raise ValueError(
+            f"total_seqlen_q {total_seqlen_q} must be a chunk_size_q "
+            f"{chunk_size_q} multiple (apply q-side padding first)"
+        )
+    if num_chunks_q % cp_size != 0:
+        raise ValueError(
+            f"q chunks {num_chunks_q} (total_seqlen_q {total_seqlen_q} / "
+            f"chunk_size_q {chunk_size_q}) must be divisible by cp_size "
+            f"{cp_size}"
+        )
 
     bucket = make_global_bucket_from_qk_ranges(
         q_ranges, k_ranges, attn_mask_type, total_seqlen_q, chunk_size_q
@@ -291,17 +312,23 @@ def make_dispatch_meta_from_qk_ranges(
     (reference _make_dispatch_meta.py:56). Self-attention: queries and keys
     share the permutation so K/V shards line up with Q shards.
     """
-    assert total_seqlen_q == total_seqlen_k, (
-        "self-attention dispatch requires equal q/k seqlens "
-        "(cross-attention dispatches roles separately)"
-    )
+    if total_seqlen_q != total_seqlen_k:
+        raise ValueError(
+            f"self-attention dispatch requires equal q/k seqlens, got "
+            f"total_seqlen_q={total_seqlen_q} != total_seqlen_k="
+            f"{total_seqlen_k} (cross-attention dispatches roles "
+            "separately via make_cross_attn_dispatch_meta)"
+        )
     if dispatch_config is None:
         dispatch_config = DispatchConfig()
     num_chunks = total_seqlen_q // chunk_size
-    assert dispatch_config.uneven_shard or num_chunks % cp_size == 0, (
-        f"num_chunks {num_chunks} must be divisible by cp_size {cp_size} "
-        "(apply padding first, or set DispatchConfig(uneven_shard=True))"
-    )
+    if not dispatch_config.uneven_shard and num_chunks % cp_size != 0:
+        raise ValueError(
+            f"num_chunks {num_chunks} (total_seqlen_q {total_seqlen_q} / "
+            f"chunk_size {chunk_size}) must be divisible by cp_size "
+            f"{cp_size} (apply padding first, or set "
+            "DispatchConfig(uneven_shard=True))"
+        )
 
     bucket = make_global_bucket_from_qk_ranges(
         q_ranges, k_ranges, attn_mask_type, total_seqlen_q, chunk_size

@@ -1,0 +1,442 @@
+"""Fingerprint-bucketed plan reuse: mask canonicalization + the
+second-level plan cache (ISSUE 20; generalizes the
+``tuning/fingerprint.py`` quantization idea from cost-model keys to
+*plan* keys).
+
+A streaming fleet thrashes the exact-key plan LRU on masks that differ
+by a few tokens (a +1 extend, a rolling-window shift, jittery decode
+batches). FlashInfer's cure (PAPERS.md) is to plan per *shape class*:
+quantize the varlen boundaries so near-identical masks canonicalize to
+ONE plan, then map each request's true rows onto the bucketed plan's
+tables with pad rows riding the existing trash-slot convention.
+
+The pieces here are pure host-side numpy/int machinery:
+
+- :func:`bucket_len` — pow2-ish length quantization (4 mantissa steps
+  per octave, <= 25% relative padding; exact below 8).
+- :func:`canonicalize_mask` — cut the sequence at every slice boundary,
+  optimistically pad each segment's tail to its bucket, then force pads
+  to zero wherever a pad row would be ATTENDED by a real query (that
+  would corrupt the softmax denominator — a pad key contributes
+  exp(0 - max)). Pad queries are harmless: their outputs ride the
+  trash-slot convention and are dropped at undispatch.
+- :class:`RowMaps` — real<->canonical position maps with O(delta)
+  tail-extend patching (the incremental re-plan path).
+- :class:`PlanFingerprint` — frozen identity of (canonical mask x every
+  non-mask plan axis); :class:`PlanReuseCache` — the fingerprint-keyed
+  LRU in front of the cold solver.
+
+Pad-soundness rules per slice (mask types: FULL=0 CAUSAL=1 INVCAUSAL=2
+BICAUSAL=3; CAUSAL is bottom-right aligned, INVCAUSAL top-left):
+
+- every segment interior to a slice's q or k range: pad forced 0
+  (an interior pad would shift real rows of the same range by different
+  amounts, breaking diagonal alignment);
+- FULL: the k range's tail pad forced 0 (all its keys are attended);
+- CAUSAL: tail pads survive only when q and k ranges share their last
+  segment — then Kpad == Qpad holds trivially and the bottom-right
+  diagonal (aligned on range ENDS) is preserved for every real row;
+  distinct tails are conservatively forced 0;
+- INVCAUSAL: k tail forced 0 (the top-left diagonal attends through the
+  end of the k range); q tail survives;
+- BICAUSAL: both tails forced 0 (intersection of the two rules).
+
+Uncovered segments pad freely. All rules force-to-zero monotonically,
+so one pass is a fixpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from collections import OrderedDict
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .. import env, telemetry
+
+FULL, CAUSAL, INVCAUSAL, BICAUSAL = 0, 1, 2, 3
+
+
+def bucket_len(n: int) -> int:
+    """Smallest pow2-ish grid length >= ``n``: exact for n <= 8, then
+    ``m * 2^e`` with mantissa m in {5, 6, 7, 8} (4 steps per octave, so
+    the optimistic tail padding never exceeds 25%)."""
+    n = int(n)
+    if n <= 0:
+        return 0
+    if n <= 8:
+        return n
+    e = (n - 1).bit_length()  # 2^e is the smallest power of two >= n
+    return min(m << (e - 3) for m in (5, 6, 7, 8) if (m << (e - 3)) >= n)
+
+
+@dataclasses.dataclass(frozen=True)
+class CanonicalMask:
+    """A mask re-expressed over bucketed coordinates.
+
+    ``segments`` are the REAL-coordinate cuts ``(start, length, pad)``
+    in order; canonical coordinates are the cumulative ``length + pad``
+    offsets. Canonical slice ranges cover FULL buckets (tail pads
+    included) — that is what makes every same-bucket mask canonicalize
+    to the same ranges, and why the pad-soundness analysis above is
+    load-bearing.
+    """
+
+    q_ranges: tuple[tuple[int, int], ...]
+    k_ranges: tuple[tuple[int, int], ...]
+    attn_type_map: tuple[int, ...]
+    total_seqlen: int
+    segments: tuple[tuple[int, int, int], ...]
+    real_total: int
+
+    @property
+    def identity(self) -> bool:
+        """No pad anywhere: canonical coords == real coords, so bucketed
+        reuse adds nothing over the exact-key LRU."""
+        return self.total_seqlen == self.real_total
+
+    def build_row_maps(self) -> "RowMaps":
+        return RowMaps.from_segments(
+            self.segments, self.real_total, self.total_seqlen
+        )
+
+
+class RowMaps:
+    """real<->canonical position maps with O(delta) tail extension.
+
+    ``real_to_canon`` is allocated at full bucket capacity so a
+    same-bucket tail extend patches ``delta`` entries in place instead
+    of reallocating; ``canon_to_real`` holds ``-1`` on pad rows.
+    """
+
+    def __init__(
+        self,
+        real_buf: np.ndarray,
+        real_len: int,
+        canon_to_real: np.ndarray,
+        canon_total: int,
+    ):
+        self._real_buf = real_buf
+        self.real_len = int(real_len)
+        self.canon_to_real = canon_to_real
+        self.canon_total = int(canon_total)
+
+    @classmethod
+    def from_segments(
+        cls,
+        segments: Sequence[tuple[int, int, int]],
+        real_total: int,
+        canon_total: int,
+    ) -> "RowMaps":
+        real_buf = np.full(canon_total, -1, np.int64)
+        canon_to_real = np.full(canon_total, -1, np.int64)
+        off = 0
+        for start, length, pad in segments:
+            real_buf[start : start + length] = off + np.arange(length)
+            canon_to_real[off : off + length] = start + np.arange(length)
+            off += length + pad
+        if off != canon_total:
+            raise ValueError(
+                f"segment cover {off} != canonical total {canon_total} "
+                f"(segments={list(segments)})"
+            )
+        return cls(real_buf, real_total, canon_to_real, canon_total)
+
+    @property
+    def real_to_canon(self) -> np.ndarray:
+        return self._real_buf[: self.real_len]
+
+    def extend_tail(self, delta: int) -> None:
+        """Grow the last segment by ``delta`` real rows INTO its pad —
+        the O(delta) incremental patch. Caller guarantees the extension
+        stays inside the bucket (``try_incremental_update`` checks)."""
+        last_real = self.real_len
+        last_canon = int(self._real_buf[last_real - 1]) + 1
+        self._real_buf[last_real : last_real + delta] = last_canon + np.arange(
+            delta
+        )
+        self.canon_to_real[last_canon : last_canon + delta] = (
+            last_real + np.arange(delta)
+        )
+        self.real_len += delta
+
+
+MaskSig = tuple[tuple, tuple, tuple, int]  # (q, k, types, total)
+
+
+def canonicalize_mask(
+    q_ranges: Sequence[Sequence[int]],
+    k_ranges: Sequence[Sequence[int]],
+    attn_type_map: Sequence[int],
+    total_seqlen: int,
+) -> Optional[CanonicalMask]:
+    """Canonicalize a self-attention mask to bucketed coordinates.
+
+    Returns ``None`` when the mask cannot benefit: out-of-bounds or
+    overlapping-degenerate input (let the exact path raise its own
+    typed errors), no slices at all, or every pad forced to zero
+    (``identity`` masks resolve through the exact-key LRU, which the
+    canonical plan also lives in — so exact-boundary requests still
+    hit, bit-identically).
+    """
+    total = int(total_seqlen)
+    if total <= 0:
+        return None
+    slices = []
+    for (q0, q1), (k0, k1), t in zip(q_ranges, k_ranges, attn_type_map):
+        q0, q1, k0, k1, t = int(q0), int(q1), int(k0), int(k1), int(t)
+        if not (0 <= q0 <= q1 <= total and 0 <= k0 <= k1 <= total):
+            return None
+        if t not in (FULL, CAUSAL, INVCAUSAL, BICAUSAL):
+            return None
+        if q0 == q1 or k0 == k1:
+            continue  # degenerate slices impose nothing; drop like the
+            # tuning fingerprint does
+        slices.append((q0, q1, k0, k1, t))
+    if not slices:
+        return None
+
+    bounds = sorted(
+        {0, total}
+        | {s[0] for s in slices}
+        | {s[1] for s in slices}
+        | {s[2] for s in slices}
+        | {s[3] for s in slices}
+    )
+    starts = bounds[:-1]
+    seg_of = {b: i for i, b in enumerate(starts)}
+    lens = [bounds[i + 1] - bounds[i] for i in range(len(starts))]
+    pads = [bucket_len(ln) - ln for ln in lens]
+
+    for q0, q1, k0, k1, t in slices:
+        qa, qb = seg_of[q0], seg_of[q1] if q1 < total else len(starts)
+        ka, kb = seg_of[k0], seg_of[k1] if k1 < total else len(starts)
+        for i in range(qa, qb - 1):  # interior q segments
+            pads[i] = 0
+        for i in range(ka, kb - 1):  # interior k segments
+            pads[i] = 0
+        q_tail, k_tail = qb - 1, kb - 1
+        if t == FULL:
+            pads[k_tail] = 0
+        elif t == CAUSAL:
+            if q_tail != k_tail:
+                pads[q_tail] = 0
+                pads[k_tail] = 0
+        elif t == INVCAUSAL:
+            pads[k_tail] = 0
+        else:  # BICAUSAL
+            pads[q_tail] = 0
+            pads[k_tail] = 0
+
+    if not any(pads):
+        return None
+
+    canon_start = {}
+    off = 0
+    for i, b in enumerate(starts):
+        canon_start[b] = off
+        off += lens[i] + pads[i]
+    canon_start[total] = off
+
+    cq = tuple((canon_start[s[0]], canon_start[s[1]]) for s in slices)
+    ck = tuple((canon_start[s[2]], canon_start[s[3]]) for s in slices)
+    return CanonicalMask(
+        q_ranges=cq,
+        k_ranges=ck,
+        attn_type_map=tuple(s[4] for s in slices),
+        total_seqlen=off,
+        segments=tuple(
+            (starts[i], lens[i], pads[i]) for i in range(len(starts))
+        ),
+        real_total=total,
+    )
+
+
+def try_incremental_update(
+    prev_sig: MaskSig, new_sig: MaskSig, maps: RowMaps
+) -> bool:
+    """O(delta) metadata patch for a tail extend (the +1-token decode /
+    chunked-prefill growth pattern): the new mask must equal the old one
+    with every range END at the old total moved to the new total, the
+    growth staying inside the last segment's bucket. Patches ``maps`` in
+    place and returns True; returns False (caller re-canonicalizes — a
+    full map rebuild, still no solver) on any other delta, including a
+    cross-bucket extension."""
+    pq, pk, pt, ptot = prev_sig
+    nq, nk, nt, ntot = new_sig
+    delta = ntot - ptot
+    if delta <= 0 or nt != pt or len(nq) != len(pq):
+        return False
+    if maps.real_len != ptot:
+        return False
+    headroom = maps.canon_total - int(maps.real_to_canon[ptot - 1]) - 1
+    if delta > headroom:
+        return False  # crosses the bucket boundary -> full path
+
+    def grows(old: tuple, new: tuple) -> bool:
+        (o0, o1), (n0, n1) = old, new
+        if o0 != n0:
+            return False
+        if o1 == n1:
+            return True
+        return o1 == ptot and n1 == ntot
+
+    if not all(grows(o, n) for o, n in zip(pq, nq)):
+        return False
+    if not all(grows(o, n) for o, n in zip(pk, nk)):
+        return False
+    if not any(o != n for o, n in zip(pq + pk, nq + nk)):
+        return False  # totals grew but no range followed: not an extend
+    maps.extend_tail(delta)
+    return True
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanFingerprint:
+    """Identity of one bucketed plan: the canonical mask plus every
+    non-mask axis a :class:`DistAttnRuntimeKey` hashes (two requests may
+    share a bucketed plan only if they'd share EVERYTHING except the
+    exact mask lengths)."""
+
+    version: int
+    canon_q_ranges: tuple[tuple[int, int], ...]
+    canon_k_ranges: tuple[tuple[int, int], ...]
+    attn_type_map: tuple[int, ...]
+    canon_total: int
+    chunk_size: int
+    cp_size: int
+    cp_axis: Any
+    num_heads_q: int
+    num_heads_kv: int
+    head_dim: int
+    softcap: float
+    has_sink: bool
+    sink_fingerprint: int
+    out_dtype: str
+    dispatch_config_repr: str
+    interpret: Optional[bool]
+    mesh_id: int
+    flags: tuple
+
+    FINGERPRINT_VERSION = 1
+
+    def stable_hash(self) -> str:
+        """Content hash for logs/debugging (the in-memory cache keys on
+        the frozen dataclass itself)."""
+        payload = json.dumps(
+            dataclasses.asdict(self),
+            sort_keys=True,
+            separators=(",", ":"),
+            default=repr,
+        )
+        return hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+
+def make_plan_fingerprint(
+    canon: CanonicalMask,
+    *,
+    chunk_size: int,
+    cp_size: int,
+    cp_axis,
+    num_heads_q: int,
+    num_heads_kv: int,
+    head_dim: int,
+    softcap: float,
+    has_sink: bool,
+    sink_fingerprint: int,
+    out_dtype: str,
+    dispatch_config_repr: str,
+    interpret: Optional[bool],
+    mesh_id: int,
+    flags: tuple,
+) -> PlanFingerprint:
+    return PlanFingerprint(
+        version=PlanFingerprint.FINGERPRINT_VERSION,
+        canon_q_ranges=canon.q_ranges,
+        canon_k_ranges=canon.k_ranges,
+        attn_type_map=canon.attn_type_map,
+        canon_total=canon.total_seqlen,
+        chunk_size=int(chunk_size),
+        cp_size=int(cp_size),
+        cp_axis=cp_axis,
+        num_heads_q=int(num_heads_q),
+        num_heads_kv=int(num_heads_kv),
+        head_dim=int(head_dim),
+        softcap=float(softcap),
+        has_sink=bool(has_sink),
+        sink_fingerprint=int(sink_fingerprint),
+        out_dtype=str(out_dtype),
+        dispatch_config_repr=str(dispatch_config_repr),
+        interpret=interpret,
+        mesh_id=int(mesh_id),
+        flags=tuple(flags),
+    )
+
+
+@dataclasses.dataclass
+class ReuseEntry:
+    """One fingerprint's cached resolution: the canonical plan's runtime
+    key plus the last request's mask/maps (the incremental path's
+    baseline)."""
+
+    canonical_key: Any
+    last_sig: Optional[MaskSig] = None
+    last_maps: Optional[RowMaps] = None
+
+
+class PlanReuseCache:
+    """Fingerprint-keyed LRU in front of the cold solver. Capacity
+    defaults to ``env.plan_cache_size()`` (read lazily so tests may set
+    the env var after import); evictions tick
+    ``magi_plan_cache_evictions_total{cache="fingerprint"}``."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self._capacity = capacity
+        self._d: "OrderedDict[PlanFingerprint, ReuseEntry]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @property
+    def capacity(self) -> int:
+        return (
+            self._capacity
+            if self._capacity is not None
+            else env.plan_cache_size()
+        )
+
+    def get(self, fp: PlanFingerprint) -> Optional[ReuseEntry]:
+        entry = self._d.get(fp)
+        if entry is None:
+            self.misses += 1
+            return None
+        self._d.move_to_end(fp)
+        self.hits += 1
+        return entry
+
+    def put(self, fp: PlanFingerprint, entry: ReuseEntry) -> None:
+        self._d[fp] = entry
+        self._d.move_to_end(fp)
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+            telemetry.record_plan_cache_eviction(cache="fingerprint")
+
+    def __contains__(self, fp: PlanFingerprint) -> bool:
+        return fp in self._d
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def clear(self, mesh_id: Optional[int] = None) -> None:
+        """Drop all entries, or only those fingerprinted over one mesh
+        (mirrors ``DistAttnRuntimeDict.clear`` so ``clear_cache(mesh)``
+        drops both levels consistently)."""
+        if mesh_id is not None:
+            for fp in [f for f in self._d if f.mesh_id == mesh_id]:
+                del self._d[fp]
+            return
+        self._d.clear()
+        self.hits = 0
+        self.misses = 0

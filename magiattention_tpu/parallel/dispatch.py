@@ -51,6 +51,64 @@ def position_ids(meta: DispatchMeta) -> jax.Array:
     return jnp.asarray(perm)
 
 
+def padded_dispatch_indices(
+    meta: DispatchMeta, canon_to_real: np.ndarray, real_total: int
+) -> np.ndarray:
+    """Composite gather for the bucketed-plan adapter (ISSUE 20,
+    docs/plan_reuse.md): ``dispatched[slot] = x[idx[slot]]`` maps a
+    request's TRUE rows straight into the canonical (bucketed) plan's
+    dispatch layout. Bucket-pad rows and uneven-shard pad slots both
+    carry the sentinel ``real_total`` — gather with
+    ``mode="fill"``, exactly the existing trash-slot convention.
+
+    ``canon_to_real`` maps canonical global positions to real positions
+    (-1 on pad rows); canonical chunk-pad tail rows (beyond its length)
+    are pad too.
+    """
+    if canon_to_real.shape[0] > meta.total_seqlen:
+        raise ValueError(
+            f"canon_to_real has {canon_to_real.shape[0]} rows but the "
+            f"canonical dispatch meta covers total_seqlen="
+            f"{meta.total_seqlen}"
+        )
+    perm = meta.perm_idx.astype(np.int64)  # sentinel total_seqlen on pads
+    c2r = np.full(meta.total_seqlen + 1, -1, np.int64)
+    c2r[: canon_to_real.shape[0]] = canon_to_real
+    src = c2r[np.minimum(perm, meta.total_seqlen)]
+    return np.where(src >= 0, src, real_total).astype(np.int32)
+
+
+def padded_undispatch_indices(
+    meta: DispatchMeta, real_to_canon: np.ndarray
+) -> np.ndarray:
+    """Inverse of :func:`padded_dispatch_indices`:
+    ``x[t] = dispatched[idx[t]]`` for every REAL row ``t`` — canonical
+    pad rows are simply never read back, so no fill is needed."""
+    bad = (real_to_canon < 0) | (real_to_canon >= meta.total_seqlen)
+    if bad.any():
+        t = int(np.flatnonzero(bad)[0])
+        raise ValueError(
+            f"real_to_canon[{t}]={int(real_to_canon[t])} is outside the "
+            f"canonical sequence [0, {meta.total_seqlen}) — row maps and "
+            "dispatch meta disagree"
+        )
+    unperm = meta.unperm_idx.astype(np.int64)
+    return unperm[real_to_canon.astype(np.int64)].astype(np.int32)
+
+
+def padded_position_ids(
+    meta: DispatchMeta, canon_to_real: np.ndarray
+) -> np.ndarray:
+    """REAL global position of each canonical dispatched slot (pad slots
+    read 0, same convention as :func:`position_ids` — their values are
+    never consumed)."""
+    perm = meta.perm_idx.astype(np.int64)
+    c2r = np.full(meta.total_seqlen + 1, -1, np.int64)
+    c2r[: canon_to_real.shape[0]] = canon_to_real
+    src = c2r[np.minimum(perm, meta.total_seqlen)]
+    return np.where(src >= 0, src, 0).astype(np.int32)
+
+
 def _roll_src_slots(meta: DispatchMeta, shift: int) -> np.ndarray:
     """Dispatch-space source slot feeding every output slot of a global
     roll by ``shift``; pad slots source themselves (keep their value)."""
@@ -106,7 +164,14 @@ def _roll_p2p(x, meta, src_slot, axis, mesh, cp_axis):
 
     names = cp_axis_names(cp_axis)
     cp = cp_axis_size(mesh, cp_axis)
-    assert cp == meta.cp_size, (cp, meta.cp_size)
+    if cp != meta.cp_size:
+        raise ValueError(
+            f"mesh axis {cp_axis!r} has size {cp} but the dispatch meta "
+            f"was planned for cp_size={meta.cp_size} "
+            f"(total_seqlen={meta.total_seqlen}, "
+            f"chunk_size={meta.chunk_size}) — roll must run over the "
+            "mesh the plan was built for"
+        )
     shard = meta.shard_seqlen
     n = cp * shard
     slots = np.arange(n, dtype=np.int64)

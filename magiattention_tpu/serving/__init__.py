@@ -82,6 +82,7 @@ from .prefix import (  # noqa: F401
     cascade_decode_attn,
     plan_cascade_groups,
 )
+from .plan_probe import PlanProbeStats, PlanReuseProbe  # noqa: F401
 from .scheduler import Request, RequestState, Scheduler, StepReport  # noqa: F401
 from .unified_tick import (  # noqa: F401
     demux_tick,
@@ -101,6 +102,8 @@ __all__ = [
     "PagedKVCache",
     "PageShareError",
     "PendingStream",
+    "PlanProbeStats",
+    "PlanReuseProbe",
     "PrefixCache",
     "PrefixMatch",
     "Request",

@@ -857,6 +857,7 @@ class TieredScheduler(Scheduler):
         chunk: int | None = None,
         max_decode_batch: int | None = None,
         clock=time.perf_counter,
+        plan_probe=None,
     ):
         from .. import env
 
@@ -876,6 +877,7 @@ class TieredScheduler(Scheduler):
             chunk=chunk,
             max_decode_batch=max_decode_batch,
             clock=clock,
+            plan_probe=plan_probe,
         )
 
     # -- decode (per replica) --------------------------------------------

@@ -193,10 +193,18 @@ class Scheduler:
         chunk: int | None = None,
         max_decode_batch: int | None = None,
         clock=time.perf_counter,
+        plan_probe=None,
     ):
         from .. import env
 
         self.engine = engine
+        # plan-reuse probe (ISSUE 20): threads each tick's REAL request
+        # shapes through the keyed-runtime planner so the plan-cache hit
+        # rate is measured against genuine traffic. Host solver work
+        # only — it must never append to the launch ledger
+        # (_tick_programs), whose census invariants assume device
+        # programs exclusively.
+        self.plan_probe = plan_probe
         self.token_budget = int(token_budget)
         self.chunk = int(chunk) if chunk is not None else env.prefill_chunk()
         self.max_decode_batch = max_decode_batch
@@ -573,6 +581,8 @@ class Scheduler:
         replica fault is isolated to its own group."""
         from .kv_cache import PageAllocatorError
 
+        if self.plan_probe is not None:
+            self.plan_probe.note_decode(states)
         qs = jnp.stack([st.request.decode_q[st.tokens_done] for st in states])
         ks = jnp.stack([st.request.decode_k[st.tokens_done] for st in states])
         vs = jnp.stack([st.request.decode_v[st.tokens_done] for st in states])
@@ -680,6 +690,8 @@ class Scheduler:
         if remaining > 0 and n == 0:
             return 0  # budget exhausted
         lo, hi = st.prefill_pos, st.prefill_pos + n
+        if self.plan_probe is not None and n:
+            self.plan_probe.note_prefill(st.rid, lo, hi)
         t0 = time.perf_counter()
         with reqtrace.request_context(st.trace_id, st.rid):
             out, _lse = self.engine.prefill(
@@ -834,6 +846,8 @@ class Scheduler:
             start_t=tick_start,
         )
         self._flight.flush()
+        if self.plan_probe is not None:
+            self.plan_probe.on_step_end(report)
         return report
 
     def _step_body(self, queue_depth: int) -> StepReport:
@@ -983,6 +997,12 @@ class Scheduler:
             plan.append((st, st.prefill_pos, n))
             b -= n
 
+        if self.plan_probe is not None:
+            if decode_states:
+                self.plan_probe.note_decode(decode_states)
+            for st, lo, n in plan:
+                if n:
+                    self.plan_probe.note_prefill(st.rid, lo, lo + n)
         decode_items = [
             (
                 st.slot,

@@ -84,6 +84,9 @@ from .collectors import (  # noqa: F401
     record_overlap_choice,
     record_page_stream,
     record_plan,
+    record_plan_bucket,
+    record_plan_cache_eviction,
+    record_plan_incremental,
     record_plan_solver,
     record_prefill,
     record_prefix_cow,
@@ -351,6 +354,9 @@ __all__ = [
     "record_overlap_choice",
     "record_kvcache_state",
     "record_plan",
+    "record_plan_bucket",
+    "record_plan_cache_eviction",
+    "record_plan_incremental",
     "record_plan_solver",
     "record_fleet_autopilot_action",
     "record_fleet_autopilot_hold",

@@ -304,6 +304,20 @@ M_JIT_CACHE_ENTRIES = "magi_jit_cache_entries"
 M_SCHED_LAUNCHES = "magi_sched_launches_per_tick"
 H_PLAN_SOLVER_S = "magi_plan_solver_seconds"  # {outcome=}
 M_SOLVER_MS_SAVED = "magi_plan_solver_ms_saved_total"
+# fingerprint-bucketed plan reuse (ISSUE 20, docs/plan_reuse.md).
+# Evictions: one tick per entry dropped by a capacity-bound cache
+# ({cache=runtime} — the exact-key LRU, {cache=fingerprint} — the
+# second-level PlanReuseCache). Bucket hits/misses: second-level
+# lookups AFTER an exact-key miss (a bucket hit serves a padded-
+# dispatch adapter instead of re-solving; both still tick the
+# magi_plan_cache_* pair, which stays the hit-rate source of truth).
+# Incremental: tail-extend deltas patched in O(delta) vs falling back
+# to a full row-map rebuild (either way, no solver)
+M_PLAN_CACHE_EVICTIONS = "magi_plan_cache_evictions_total"  # {cache=}
+M_PLAN_BUCKET_HITS = "magi_plan_bucket_hits_total"
+M_PLAN_BUCKET_MISSES = "magi_plan_bucket_misses_total"
+M_PLAN_INCR_PATCHES = "magi_plan_incremental_patches_total"
+M_PLAN_INCR_FALLBACKS = "magi_plan_incremental_fallbacks_total"
 
 # the named synthetic Chrome-trace track the per-tick decomposition
 # spans land on (events.py ``track=`` mechanism — one tick-decomposition
@@ -975,6 +989,36 @@ def record_cache_access(hit: bool) -> None:
     reg = get_registry()
     reg.counter_inc(M_CACHE_HITS if hit else M_CACHE_MISSES)
     reg.counter_inc(M_PLAN_CACHE_HITS if hit else M_PLAN_CACHE_MISSES)
+
+
+def record_plan_cache_eviction(cache: str) -> None:
+    """One entry dropped by a capacity-bound plan cache (ISSUE 20):
+    ``cache`` is ``runtime`` (the exact-key LRU in ``api/interface``) or
+    ``fingerprint`` (the second-level ``PlanReuseCache``)."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(M_PLAN_CACHE_EVICTIONS, cache=cache)
+
+
+def record_plan_bucket(hit: bool) -> None:
+    """One fingerprint-bucketed second-level lookup after an exact-key
+    miss (``MAGI_ATTENTION_PLAN_REUSE=bucket`` only)."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(
+        M_PLAN_BUCKET_HITS if hit else M_PLAN_BUCKET_MISSES
+    )
+
+
+def record_plan_incremental(patched: bool) -> None:
+    """Bucket-hit row-map resolution: ``patched`` means the tail-extend
+    O(delta) patch applied; otherwise the full rebuild ran (both avoid
+    the solver — this decomposes hit cost, not hit rate)."""
+    if not _enabled():
+        return
+    get_registry().counter_inc(
+        M_PLAN_INCR_PATCHES if patched else M_PLAN_INCR_FALLBACKS
+    )
 
 
 # ---------------------------------------------------------------------------

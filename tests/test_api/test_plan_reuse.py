@@ -1,0 +1,296 @@
+"""API-level plan-reuse behavior (ISSUE 20, ``docs/plan_reuse.md``):
+bucket-hit parity (fwd + grad) against the cold path, exact-hit
+bit-identity, the incremental extend patch, cross-bucket fallback, the
+typed roll/after-dispatch rejections on bucketed keys, and the
+after-dispatch edge cases (empty slices, shrunk masks) on normal keys.
+
+Runs on the ``jnp`` backend (dense reference routed through the real
+distributed runtime) so parity assertions are exact-arithmetic tight.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.api import (
+    calc_attn,
+    clear_cache,
+    dispatch,
+    get_runtime_mgr,
+    magi_attn_flex_key,
+    magi_attn_varlen_key,
+    make_flex_key_for_new_mask_after_dispatch,
+    make_varlen_key_for_new_mask_after_dispatch,
+    roll,
+    undispatch,
+)
+from magiattention_tpu.api import interface as api_interface
+from magiattention_tpu.api.interface import (
+    BucketedDistAttnRuntimeMgr,
+    DistAttnRuntimeDict,
+)
+
+HQ, HK, D = 2, 2, 32
+KW = dict(num_heads=(HQ, HK), head_dim=D, chunk_size=16, out_dtype="float32")
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:1]), ("cp",))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "jnp")
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    clear_cache()
+    yield
+    telemetry.set_enabled(None)
+    telemetry.reset()
+    clear_cache()
+
+
+def _causal_key(total, mesh):
+    return magi_attn_flex_key(
+        [(0, total)], [(0, total)], "causal", total, total, mesh, **KW
+    )
+
+
+def _loss_and_grads(key, total, seed=0):
+    """Scalar loss + (dq, dk, dv) through dispatch -> attn -> undispatch,
+    with input AND weight tensors fixed by seed so two keys serving the
+    same mask are comparable."""
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((total, HQ, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, HK, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, HK, D)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((total, HQ, D)), jnp.float32)
+
+    def loss_fn(q, k, v):
+        qd, kd, vd = (
+            dispatch(q, key),
+            dispatch(k, key),
+            dispatch(v, key),
+        )
+        out = undispatch(calc_attn(qd, kd, vd, key)[0], key)
+        return (out * w).sum()
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(q, k, v)
+    return loss, grads
+
+
+def _counter(name, **labels):
+    from magiattention_tpu.telemetry.registry import series_key
+
+    return telemetry.snapshot()["counters"].get(series_key(name, labels), 0)
+
+
+# ------------------------------------------------------------- parity
+
+
+def test_reuse_off_by_default_no_bucketing():
+    mesh = _mesh()
+    key = _causal_key(51, mesh)
+    assert not isinstance(get_runtime_mgr(key), BucketedDistAttnRuntimeMgr)
+    assert len(api_interface._plan_reuse_cache) == 0
+
+
+def test_bucket_hit_parity_forward_and_grad(monkeypatch):
+    mesh = _mesh()
+    # cold references, reuse off
+    ref53 = _loss_and_grads(_causal_key(53, mesh), 53, seed=3)
+    clear_cache()
+
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_REUSE", "bucket")
+    k49 = _causal_key(49, mesh)  # fingerprint miss: seeds canonical 56
+    k53 = _causal_key(53, mesh)  # same bucket -> adapter over same plan
+    m49, m53 = get_runtime_mgr(k49), get_runtime_mgr(k53)
+    assert isinstance(m49, BucketedDistAttnRuntimeMgr)
+    assert isinstance(m53, BucketedDistAttnRuntimeMgr)
+    assert m49.canonical_key == m53.canonical_key
+    assert m53.plan is m49.plan  # the solved plan object is shared
+    assert _counter("magi_plan_bucket_hits_total") == 1
+
+    loss, grads = _loss_and_grads(k53, 53, seed=3)
+    np.testing.assert_allclose(loss, ref53[0], rtol=2e-5, atol=2e-5)
+    for g, rg in zip(grads, ref53[1]):
+        np.testing.assert_allclose(g, rg, rtol=2e-5, atol=2e-5)
+
+
+def test_incremental_extend_patch_and_parity(monkeypatch):
+    mesh = _mesh()
+    ref52 = _loss_and_grads(_causal_key(52, mesh), 52, seed=5)
+    clear_cache()
+
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_REUSE", "bucket")
+    _causal_key(51, mesh)
+    k52 = _causal_key(52, mesh)  # +1-token extend, same bucket (56)
+    assert isinstance(get_runtime_mgr(k52), BucketedDistAttnRuntimeMgr)
+    assert _counter("magi_plan_incremental_patches_total") == 1
+    assert _counter("magi_plan_incremental_fallbacks_total") == 0
+
+    loss, grads = _loss_and_grads(k52, 52, seed=5)
+    np.testing.assert_allclose(loss, ref52[0], rtol=2e-5, atol=2e-5)
+    for g, rg in zip(grads, ref52[1]):
+        np.testing.assert_allclose(g, rg, rtol=2e-5, atol=2e-5)
+
+
+def test_cross_bucket_roll_replans(monkeypatch):
+    mesh = _mesh()
+    ref57 = _loss_and_grads(_causal_key(57, mesh), 57, seed=7)
+    clear_cache()
+
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_REUSE", "bucket")
+    _causal_key(51, mesh)  # canonical 56
+    k57 = _causal_key(57, mesh)  # crosses into bucket 64 -> new canonical
+    assert _counter("magi_plan_bucket_misses_total") == 2
+    assert _counter("magi_plan_bucket_hits_total") == 0
+    assert len(api_interface._plan_reuse_cache) == 2
+    assert _counter("magi_plan_incremental_patches_total") == 0
+
+    loss, grads = _loss_and_grads(k57, 57, seed=7)
+    np.testing.assert_allclose(loss, ref57[0], rtol=2e-5, atol=2e-5)
+    for g, rg in zip(grads, ref57[1]):
+        np.testing.assert_allclose(g, rg, rtol=2e-5, atol=2e-5)
+
+
+def test_varlen_key_takes_bucketed_path(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_REUSE", "bucket")
+    mesh = _mesh()
+    # docs (21, 30) and (21, 29): per-doc buckets (24, 32) in both cases
+    k1 = magi_attn_varlen_key([0, 21, 51], 51, mesh, causal=True, **KW)
+    k2 = magi_attn_varlen_key([0, 21, 50], 50, mesh, causal=True, **KW)
+    m1, m2 = get_runtime_mgr(k1), get_runtime_mgr(k2)
+    assert isinstance(m1, BucketedDistAttnRuntimeMgr)
+    assert isinstance(m2, BucketedDistAttnRuntimeMgr)
+    assert m1.canonical_key == m2.canonical_key
+
+
+# ------------------------------------------------------- exact tiers
+
+
+def test_exact_hit_beats_fingerprint(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_REUSE", "bucket")
+    mesh = _mesh()
+    k1 = _causal_key(51, mesh)
+    m1 = get_runtime_mgr(k1)
+    n_fp = len(api_interface._plan_reuse_cache)
+    k2 = _causal_key(51, mesh)
+    assert k2 == k1
+    assert get_runtime_mgr(k2) is m1  # the same mgr OBJECT: bit-identical
+    assert len(api_interface._plan_reuse_cache) == n_fp  # not re-consulted
+    assert _counter("magi_plan_cache_hits") >= 1
+
+
+def test_on_grid_mask_is_identity(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_REUSE", "bucket")
+    mesh = _mesh()
+    key = _causal_key(64, mesh)  # 64 is on the bucket grid
+    assert not isinstance(get_runtime_mgr(key), BucketedDistAttnRuntimeMgr)
+    assert len(api_interface._plan_reuse_cache) == 0
+
+
+def test_clear_cache_drops_fingerprint_level(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_REUSE", "bucket")
+    mesh = _mesh()
+    _causal_key(51, mesh)
+    assert len(api_interface._plan_reuse_cache) == 1
+    clear_cache()
+    assert len(api_interface._plan_reuse_cache) == 0
+
+
+# -------------------------------------------------- typed rejections
+
+
+def test_roll_rejects_bucketed_key(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_REUSE", "bucket")
+    mesh = _mesh()
+    _causal_key(49, mesh)
+    k53 = _causal_key(53, mesh)
+    assert isinstance(get_runtime_mgr(k53), BucketedDistAttnRuntimeMgr)
+    x = dispatch(jnp.zeros((53, HQ, D), jnp.float32), k53)
+    with pytest.raises(ValueError, match="bucketed .*plan-reuse.* key"):
+        roll(x, k53, 1)
+
+
+def test_after_dispatch_rejects_bucketed_key(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_REUSE", "bucket")
+    mesh = _mesh()
+    _causal_key(49, mesh)
+    k53 = _causal_key(53, mesh)
+    assert isinstance(get_runtime_mgr(k53), BucketedDistAttnRuntimeMgr)
+    with pytest.raises(ValueError, match="bucketed"):
+        make_flex_key_for_new_mask_after_dispatch(
+            [(0, 53)], [(0, 53)], ["full"], k53
+        )
+    with pytest.raises(ValueError, match="bucketed"):
+        make_varlen_key_for_new_mask_after_dispatch([0, 21, 53], k53)
+
+
+# --------------------------------- after-dispatch edge cases (normal)
+
+
+def test_after_dispatch_tolerates_empty_slices():
+    mesh = _mesh()
+    total = 512
+    k1 = magi_attn_varlen_key([0, 256, 512], total, mesh, **KW)
+    # an empty slice among valid ones imposes nothing and is dropped
+    k2 = make_flex_key_for_new_mask_after_dispatch(
+        [(0, 256), (256, 256), (256, 512)],
+        [(0, 256), (0, 256), (0, 512)],
+        ["causal", "full", "causal"],
+        k1,
+    )
+    assert k2 != k1
+    assert get_runtime_mgr(k2).dispatch_meta is get_runtime_mgr(k1).dispatch_meta
+    # varlen flavor: a zero-length document
+    k3 = make_varlen_key_for_new_mask_after_dispatch(
+        [0, 256, 256, 512], k1, causal=True
+    )
+    assert k3 != k1
+
+
+def test_after_dispatch_shrunk_mask():
+    # the new mask may cover fewer rows than the dispatch (a single-token
+    # trim) — uncovered rows simply produce no attention output
+    mesh = _mesh()
+    k1 = magi_attn_varlen_key([0, 256, 512], 512, mesh, **KW)
+    k2 = make_flex_key_for_new_mask_after_dispatch(
+        [(0, 511)], [(0, 511)], ["causal"], k1
+    )
+    assert k2 != k1
+    assert get_runtime_mgr(k2).dispatch_meta is get_runtime_mgr(k1).dispatch_meta
+
+
+# ---------------------------------------------------- caches and env
+
+
+def test_runtime_dict_eviction_counter():
+    d = DistAttnRuntimeDict(maxsize=1)
+    d.put("a", object())
+    d.put("b", object())
+    assert _counter("magi_plan_cache_evictions_total", cache="runtime") == 1
+    assert "a" not in d and "b" in d
+
+
+def test_plan_reuse_env_validation(monkeypatch):
+    from magiattention_tpu import env
+
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_REUSE", "aggressive")
+    with pytest.raises(ValueError, match="PLAN_REUSE"):
+        env.plan_reuse_mode()
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_REUSE", "bucket")
+    assert env.plan_reuse_mode() == "bucket"
+    # mode is part of the flags fingerprint (it changes plan content)...
+    base = env.flags_fingerprint()
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_REUSE", "off")
+    assert env.flags_fingerprint() != base
+    # ...capacity is not (it never changes what a cached plan contains)
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_CACHE_SIZE", "7")
+    assert env.flags_fingerprint() == env.flags_fingerprint()
+    off = env.flags_fingerprint()
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_CACHE_SIZE", "9")
+    assert env.flags_fingerprint() == off

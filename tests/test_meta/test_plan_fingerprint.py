@@ -1,0 +1,350 @@
+"""Pure host-side plan-reuse machinery (meta/plan_fingerprint.py,
+ISSUE 20): bucket grid, per-mask-type pad-soundness of the
+canonicalizer, RowMaps construction + O(delta) tail extension, the
+incremental-update predicate, and the fingerprint-keyed LRU. No jax on
+this path — everything is numpy/int."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.meta.plan_fingerprint import (
+    BICAUSAL,
+    CAUSAL,
+    FULL,
+    INVCAUSAL,
+    CanonicalMask,
+    PlanReuseCache,
+    ReuseEntry,
+    RowMaps,
+    bucket_len,
+    canonicalize_mask,
+    make_plan_fingerprint,
+    try_incremental_update,
+)
+
+
+# ---------------------------------------------------------------- grid
+
+
+def test_bucket_len_exact_below_eight():
+    for n in range(9):
+        assert bucket_len(n) == n
+
+
+def test_bucket_len_grid_points():
+    # 4 mantissa steps per octave: 8,10,12,14,16,20,24,28,32,40,48,...
+    assert bucket_len(9) == 10
+    assert bucket_len(11) == 12
+    assert bucket_len(13) == 14
+    assert bucket_len(17) == 20
+    assert bucket_len(21) == 24
+    assert bucket_len(33) == 40
+    assert bucket_len(51) == 56
+    assert bucket_len(1000) == 1024
+
+
+def test_bucket_len_on_grid_identity():
+    for n in (8, 10, 12, 14, 16, 20, 24, 28, 32, 40, 48, 56, 64, 128):
+        assert bucket_len(n) == n
+
+
+def test_bucket_len_bounded_overhead():
+    # mantissa {5,6,7,8} -> relative padding strictly < 25%
+    for n in range(9, 5000):
+        b = bucket_len(n)
+        assert n <= b < n * 1.25
+
+
+# ------------------------------------------------------- canonicalizer
+
+
+def _canon_start(canon, real_pos):
+    """Map a real boundary to its canonical offset via segments."""
+    off = 0
+    for start, length, pad in canon.segments:
+        if start == real_pos:
+            return off
+        off += length + pad
+    if real_pos == canon.real_total:
+        return off
+    raise AssertionError(f"{real_pos} is not a segment boundary")
+
+
+def test_whole_sequence_causal_pads_tail():
+    # q and k share their last segment -> CAUSAL tail pad survives
+    canon = canonicalize_mask([(0, 51)], [(0, 51)], [CAUSAL], 51)
+    assert canon is not None
+    assert canon.total_seqlen == bucket_len(51) == 56
+    assert canon.q_ranges == ((0, 56),)
+    assert canon.k_ranges == ((0, 56),)
+    assert canon.segments == ((0, 51, 5),)
+
+
+def test_whole_sequence_full_is_identity():
+    # FULL forces the k tail to zero; in self-attention q shares it
+    assert canonicalize_mask([(0, 51)], [(0, 51)], [FULL], 51) is None
+
+
+def test_whole_sequence_bicausal_is_identity():
+    assert canonicalize_mask([(0, 51)], [(0, 51)], [BICAUSAL], 51) is None
+
+
+def test_on_grid_total_is_identity():
+    # 64 is on the bucket grid -> nothing to pad
+    assert canonicalize_mask([(0, 64)], [(0, 64)], [CAUSAL], 64) is None
+
+
+def test_full_offset_pads_uncovered_q_tail():
+    # q tail [32,53) is not any slice's k range -> pads freely; the
+    # FULL slice's k range [0,32) is on-grid anyway
+    canon = canonicalize_mask([(32, 53)], [(0, 32)], [FULL], 53)
+    assert canon is not None
+    tail = canon.segments[-1]
+    assert tail[1] == 21 and tail[2] == bucket_len(21) - 21 == 3
+    assert canon.k_ranges == ((0, 32),)  # untouched
+
+
+def test_full_k_tail_forced_zero():
+    # k range covers the final segment -> FULL forbids its pad, and in
+    # self-attention the shared q tail is pinned with it
+    assert canonicalize_mask([(0, 51)], [(30, 51)], [FULL], 51) is None
+
+
+def test_invcausal_offset_q_tail_survives():
+    canon = canonicalize_mask([(32, 53)], [(0, 32)], [INVCAUSAL], 53)
+    assert canon is not None
+    assert canon.segments[-1][2] > 0
+
+
+def test_causal_distinct_tails_forced_zero():
+    # q ends at 51, k ends at 40 -> distinct tail segments, both pinned;
+    # the only paddable segment left is [40,51) via... nothing: q's tail
+    # IS [40,51). Everything pinned -> identity.
+    assert canonicalize_mask([(0, 51)], [(0, 40)], [CAUSAL], 51) is None
+
+
+def test_bicausal_uncovered_tail_engages():
+    # slice covers [0,30); [30,51) is uncovered and pads freely
+    canon = canonicalize_mask([(0, 30)], [(0, 30)], [BICAUSAL], 51)
+    assert canon is not None
+    # covered segment [0,30): BICAUSAL pins both tails -> no pad
+    assert canon.segments[0] == (0, 30, 0)
+    assert canon.segments[1][2] > 0
+
+
+def test_varlen_causal_each_doc_pads():
+    canon = canonicalize_mask(
+        [(0, 21), (21, 51)], [(0, 21), (21, 51)], [CAUSAL, CAUSAL], 51
+    )
+    assert canon is not None
+    # doc 0: len 21 -> bucket 24; doc 1: len 30 -> bucket 32
+    assert canon.segments == ((0, 21, 3), (21, 30, 2))
+    assert canon.q_ranges == ((0, 24), (24, 56))
+    assert canon.total_seqlen == 56
+
+
+def test_interior_segments_never_pad():
+    # boundary at 21 splits k=[0,51) into two segments; [0,21) is
+    # interior to the second slice's k range -> pad forced 0 there
+    canon = canonicalize_mask(
+        [(0, 21), (21, 51)], [(0, 21), (0, 51)], [CAUSAL, CAUSAL], 51
+    )
+    assert canon is not None
+    assert canon.segments[0][2] == 0
+
+
+def test_degenerate_and_invalid_inputs():
+    assert canonicalize_mask([], [], [], 51) is None
+    assert canonicalize_mask([(0, 0)], [(0, 51)], [CAUSAL], 51) is None
+    assert canonicalize_mask([(0, 60)], [(0, 51)], [CAUSAL], 51) is None
+    assert canonicalize_mask([(0, 51)], [(0, 51)], [7], 51) is None
+    assert canonicalize_mask([(0, 51)], [(0, 51)], [CAUSAL], 0) is None
+
+
+def test_same_bucket_masks_share_canonical_form():
+    a = canonicalize_mask([(0, 49)], [(0, 49)], [CAUSAL], 49)
+    b = canonicalize_mask([(0, 53)], [(0, 53)], [CAUSAL], 53)
+    assert a is not None and b is not None
+    assert a.q_ranges == b.q_ranges
+    assert a.k_ranges == b.k_ranges
+    assert a.total_seqlen == b.total_seqlen == 56
+
+
+# ------------------------------------------------------------ row maps
+
+
+def test_row_maps_roundtrip():
+    canon = canonicalize_mask([(0, 51)], [(0, 51)], [CAUSAL], 51)
+    maps = canon.build_row_maps()
+    assert maps.real_len == 51 and maps.canon_total == 56
+    r2c = maps.real_to_canon
+    assert len(r2c) == 51
+    # every real row lands on a distinct canonical row and back
+    assert len(set(r2c.tolist())) == 51
+    for real, can in enumerate(r2c):
+        assert maps.canon_to_real[can] == real
+    # pad rows map to -1
+    pads = set(range(56)) - set(r2c.tolist())
+    assert all(maps.canon_to_real[p] == -1 for p in pads)
+
+
+def test_row_maps_extend_tail():
+    canon = canonicalize_mask([(0, 51)], [(0, 51)], [CAUSAL], 51)
+    maps = canon.build_row_maps()
+    maps.extend_tail(2)
+    assert maps.real_len == 53
+    assert maps.real_to_canon[51] == 51 and maps.real_to_canon[52] == 52
+    assert maps.canon_to_real[52] == 52
+
+
+def test_row_maps_cover_mismatch_raises():
+    with pytest.raises(ValueError, match="segment cover"):
+        RowMaps.from_segments([(0, 10, 2)], 10, 99)
+
+
+# --------------------------------------------------------- incremental
+
+
+def _sig(total):
+    return (((0, total),), ((0, total),), (CAUSAL,), total)
+
+
+def test_incremental_plus_one_extend_patches():
+    canon = canonicalize_mask([(0, 51)], [(0, 51)], [CAUSAL], 51)
+    maps = canon.build_row_maps()
+    assert try_incremental_update(_sig(51), _sig(52), maps)
+    assert maps.real_len == 52
+
+
+def test_incremental_cross_bucket_falls_back():
+    canon = canonicalize_mask([(0, 51)], [(0, 51)], [CAUSAL], 51)
+    maps = canon.build_row_maps()
+    # 51 -> 57 crosses bucket 56: headroom is 5
+    assert not try_incremental_update(_sig(51), _sig(57), maps)
+    assert maps.real_len == 51  # untouched on refusal
+
+
+def test_incremental_rejects_non_extend_deltas():
+    canon = canonicalize_mask([(0, 51)], [(0, 51)], [CAUSAL], 51)
+    maps = canon.build_row_maps()
+    # shrink
+    assert not try_incremental_update(_sig(51), _sig(50), maps)
+    # same total (no-op is not an extend)
+    assert not try_incremental_update(_sig(51), _sig(51), maps)
+    # start moved (a roll, not an extend)
+    rolled = (((1, 52),), ((1, 52),), (CAUSAL,), 52)
+    assert not try_incremental_update(_sig(51), rolled, maps)
+    # mask type changed
+    retyped = (((0, 52),), ((0, 52),), (FULL,), 52)
+    assert not try_incremental_update(_sig(51), retyped, maps)
+    # stale maps (real_len disagrees with prev total)
+    assert not try_incremental_update(_sig(50), _sig(52), maps)
+
+
+def test_incremental_grows_every_touching_range():
+    # varlen: only ranges ending at the old total may grow
+    canon = canonicalize_mask(
+        [(0, 21), (21, 51)], [(0, 21), (21, 51)], [CAUSAL, CAUSAL], 51
+    )
+    maps = canon.build_row_maps()
+    prev = (
+        ((0, 21), (21, 51)),
+        ((0, 21), (21, 51)),
+        (CAUSAL, CAUSAL),
+        51,
+    )
+    good = (
+        ((0, 21), (21, 52)),
+        ((0, 21), (21, 52)),
+        (CAUSAL, CAUSAL),
+        52,
+    )
+    assert try_incremental_update(prev, good, maps)
+    # a mid-sequence range growing is NOT an extend
+    maps2 = canon.build_row_maps()
+    bad = (
+        ((0, 22), (21, 52)),
+        ((0, 21), (21, 52)),
+        (CAUSAL, CAUSAL),
+        52,
+    )
+    assert not try_incremental_update(prev, bad, maps2)
+
+
+# --------------------------------------------------------------- cache
+
+
+def _fp(canon, salt=0, mesh_id=1):
+    return make_plan_fingerprint(
+        canon,
+        chunk_size=16,
+        cp_size=1,
+        cp_axis="cp",
+        num_heads_q=2,
+        num_heads_kv=2,
+        head_dim=32 + salt,
+        softcap=0.0,
+        has_sink=False,
+        sink_fingerprint=0,
+        out_dtype="float32",
+        dispatch_config_repr="d",
+        interpret=None,
+        mesh_id=mesh_id,
+        flags=(),
+    )
+
+
+def test_fingerprint_same_bucket_same_key():
+    a = canonicalize_mask([(0, 49)], [(0, 49)], [CAUSAL], 49)
+    b = canonicalize_mask([(0, 53)], [(0, 53)], [CAUSAL], 53)
+    assert _fp(a) == _fp(b)
+    assert _fp(a).stable_hash() == _fp(b).stable_hash()
+    assert _fp(a) != _fp(a, salt=1)
+
+
+def test_cache_lru_eviction_counts():
+    from magiattention_tpu import telemetry
+
+    cache = PlanReuseCache(capacity=2)
+    masks = [
+        canonicalize_mask([(0, n)], [(0, n)], [CAUSAL], n)
+        for n in (51, 99, 201)
+    ]
+    fps = [_fp(m, salt=i) for i, m in enumerate(masks)]
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        for fp in fps:
+            cache.put(fp, ReuseEntry(canonical_key=None))
+        assert len(cache) == 2
+        assert fps[0] not in cache and fps[2] in cache
+        counters = telemetry.snapshot()["counters"]
+        assert (
+            counters["magi_plan_cache_evictions_total{cache=fingerprint}"]
+            == 1
+        )
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.reset()
+    assert cache.get(fps[0]) is None and cache.misses == 1
+    assert cache.get(fps[2]) is not None and cache.hits == 1
+
+
+def test_cache_capacity_env_lazy(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_CACHE_SIZE", "3")
+    assert PlanReuseCache().capacity == 3
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_CACHE_SIZE", "0")
+    with pytest.raises(ValueError, match="PLAN_CACHE_SIZE"):
+        _ = PlanReuseCache().capacity
+
+
+def test_cache_clear_by_mesh():
+    cache = PlanReuseCache(capacity=10)
+    a = canonicalize_mask([(0, 51)], [(0, 51)], [CAUSAL], 51)
+    fp1, fp2 = _fp(a), _fp(a, salt=1, mesh_id=2)
+    cache.put(fp1, ReuseEntry(canonical_key=None))
+    cache.put(fp2, ReuseEntry(canonical_key=None))
+    cache.clear(mesh_id=1)
+    assert fp1 not in cache and fp2 in cache
+    cache.clear()
+    assert len(cache) == 0

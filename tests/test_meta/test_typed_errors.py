@@ -1,0 +1,106 @@
+"""Regression tests for the typed ValueErrors that replaced bare
+asserts in ``parallel/dispatch.py`` and ``meta/dispatch_meta.py``
+(ISSUE 20 satellite): every rejection carries shape context so a
+serving-stack caller can log WHICH request geometry was malformed
+instead of a bare AssertionError."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.common import AttnMaskType, AttnRanges
+from magiattention_tpu.meta import (
+    DispatchConfig,
+    make_dispatch_meta_from_qk_ranges,
+    make_global_bucket_from_qk_ranges,
+)
+from magiattention_tpu.meta.dispatch_meta import make_cross_attn_dispatch_meta
+from magiattention_tpu.parallel.dispatch import (
+    padded_dispatch_indices,
+    padded_undispatch_indices,
+)
+
+C = AttnMaskType.CAUSAL
+
+
+def _ranges(*pairs):
+    return AttnRanges.from_ranges(list(pairs))
+
+
+def _self_meta(total=128, chunk=16, cp=2):
+    qr = _ranges((0, total))
+    meta_q, _, _ = make_dispatch_meta_from_qk_ranges(
+        qr, qr, [C], total, total, chunk, cp
+    )
+    return meta_q
+
+
+def test_global_bucket_rejects_unaligned_total():
+    qr = _ranges((0, 100))
+    with pytest.raises(ValueError, match="100 must be a chunk_size 16"):
+        make_global_bucket_from_qk_ranges(qr, qr, [C], 100, 16)
+
+
+def test_self_dispatch_rejects_unequal_seqlens():
+    qr = _ranges((0, 128))
+    with pytest.raises(
+        ValueError, match="total_seqlen_q=128 != total_seqlen_k=256"
+    ):
+        make_dispatch_meta_from_qk_ranges(qr, qr, [C], 128, 256, 16, 2)
+
+
+def test_self_dispatch_rejects_indivisible_chunks():
+    qr = _ranges((0, 48))
+    # 3 chunks over 2 ranks without uneven_shard
+    with pytest.raises(ValueError, match="divisible by cp_size 2"):
+        make_dispatch_meta_from_qk_ranges(
+            qr, qr, [C], 48, 48, 16, 2,
+            dispatch_config=DispatchConfig(uneven_shard=False),
+        )
+
+
+@pytest.mark.parametrize(
+    "tq,tk,match",
+    [
+        (128, 100, "total_seqlen_k 100 must be a chunk_size_k"),
+        (100, 128, "total_seqlen_q 100 must be a chunk_size_q"),
+        (128, 48, "divisible by cp_size"),
+        (48, 128, "divisible by cp_size"),
+    ],
+)
+def test_cross_dispatch_shape_errors(tq, tk, match):
+    qr = _ranges((0, tq))
+    kr = _ranges((0, tk))
+    with pytest.raises(ValueError, match=match):
+        make_cross_attn_dispatch_meta(
+            qr, kr, [AttnMaskType.FULL], tq, tk, 16, 16, 2
+        )
+
+
+def test_padded_dispatch_rejects_oversized_row_map():
+    meta = _self_meta(total=128)
+    too_many = np.arange(meta.total_seqlen + 5, dtype=np.int64)
+    with pytest.raises(ValueError, match="canonical dispatch meta covers"):
+        padded_dispatch_indices(meta, too_many, real_total=100)
+
+
+def test_padded_undispatch_rejects_out_of_range_rows():
+    meta = _self_meta(total=128)
+    r2c = np.arange(100, dtype=np.int64)
+    r2c[7] = meta.total_seqlen + 3  # beyond the canonical sequence
+    with pytest.raises(ValueError, match=r"real_to_canon\[7\]"):
+        padded_undispatch_indices(meta, r2c)
+    r2c[7] = -2
+    with pytest.raises(ValueError, match="outside the canonical sequence"):
+        padded_undispatch_indices(meta, r2c)
+
+
+def test_padded_maps_identity_roundtrip():
+    # sanity companion to the error tests: an identity row map through a
+    # real meta reproduces plain dispatch/undispatch index semantics
+    meta = _self_meta(total=128)
+    ident = np.arange(meta.total_seqlen, dtype=np.int64)
+    d_idx = padded_dispatch_indices(meta, ident, real_total=128)
+    u_idx = padded_undispatch_indices(meta, ident)
+    x = np.arange(128)
+    dispatched = np.where(d_idx < 128, x[np.minimum(d_idx, 127)], -1)
+    np.testing.assert_array_equal(dispatched[u_idx], x)
